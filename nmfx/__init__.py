@@ -1,0 +1,34 @@
+"""nmfx — TPU-native consensus NMF.
+
+A brand-new JAX/XLA framework with the capabilities of mschubert/NMFconsensus
+(reference layer map in /root/repo/SURVEY.md): randomly-restarted non-negative
+matrix factorization (mu / als / neals / pg / alspg solvers, random or NNDSVD
+init), connectivity/consensus aggregation across restarts, and rank selection
+by cophenetic correlation — with the restart axis vmapped, the sweep sharded
+over a TPU device mesh, and consensus accumulation kept on-device.
+"""
+
+from nmfx.config import (
+    ConsensusConfig,
+    InitConfig,
+    OutputConfig,
+    SolverConfig,
+)
+from nmfx.io import read_dataset, read_gct, read_res, write_gct
+from nmfx.api import ConsensusResult, nmf, nmfconsensus
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ConsensusConfig",
+    "ConsensusResult",
+    "InitConfig",
+    "OutputConfig",
+    "SolverConfig",
+    "nmf",
+    "nmfconsensus",
+    "read_dataset",
+    "read_gct",
+    "read_res",
+    "write_gct",
+]

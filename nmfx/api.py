@@ -1,0 +1,221 @@
+"""Top-level API: single factorization and the full consensus pipeline.
+
+The public surface a user of the reference lands on:
+
+* ``nmf(...)``          ≈ one ``doNMF`` call (reference ``nmf.r:23-51``),
+  with all five solvers wired instead of only mu.
+* ``nmfconsensus(...)`` ≈ ``runNMFinJobs`` + ``computeConsensusAndSaveFiles``
+  (reference ``nmf.r:106-119, 146-253``): the (k × restart) sweep, consensus
+  matrices, cophenetic rank selection, memberships, and optional file/plot
+  outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+
+from nmfx import cophenetic as coph
+from nmfx.config import ConsensusConfig, InitConfig, OutputConfig, SolverConfig
+from nmfx.io import Dataset, read_dataset, write_gct
+from nmfx.solvers.base import SolverResult, solve
+from nmfx.init import initialize
+from nmfx.sweep import default_mesh, sweep
+
+
+@dataclasses.dataclass(frozen=True)
+class KResult:
+    """Everything the pipeline derives at one rank k."""
+
+    k: int
+    consensus: np.ndarray  # (n, n) mean connectivity
+    rho: float  # cophenetic correlation
+    membership: np.ndarray  # (n,) labels 1..k from cutree
+    order: np.ndarray  # (n,) dendrogram leaf order
+    iterations: np.ndarray  # (restarts,)
+    dnorms: np.ndarray  # (restarts,) final RMS residuals
+    stop_reasons: np.ndarray  # (restarts,)
+    best_w: np.ndarray  # (m, k) factors of the lowest-residual restart
+    best_h: np.ndarray  # (k, n) — the "metagenes" (reference H, nmf.r:50)
+
+    @property
+    def ordered_consensus(self) -> np.ndarray:
+        """Consensus matrix reordered by the dendrogram (reference
+        ``connect.matrix[HC$order, HC$order]``, nmf.r:174)."""
+        return self.consensus[np.ix_(self.order, self.order)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusResult:
+    ks: tuple[int, ...]
+    per_k: Mapping[int, KResult]
+    col_names: tuple[str, ...]
+
+    @property
+    def rhos(self) -> np.ndarray:
+        return np.array([self.per_k[k].rho for k in self.ks])
+
+    @property
+    def best_k(self) -> int:
+        """Rank with the highest cophenetic correlation."""
+        return self.ks[int(np.argmax(self.rhos))]
+
+    def summary(self) -> str:
+        lines = ["k\trho\tmean_iters"]
+        for k in self.ks:
+            r = self.per_k[k]
+            lines.append(f"{k}\t{r.rho:.4f}\t{r.iterations.mean():.1f}")
+        lines.append(f"best k = {self.best_k}")
+        return "\n".join(lines)
+
+
+def _as_matrix(data) -> tuple[np.ndarray, list[str]]:
+    if isinstance(data, str):
+        data = read_dataset(data)
+    if isinstance(data, Dataset):
+        return np.asarray(data.values), list(data.col_names)
+    arr = np.asarray(data)
+    return arr, [str(i + 1) for i in range(arr.shape[1])]
+
+
+def _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg):
+    """Merge convenience args with config objects; reject silent conflicts."""
+    if solver_cfg is not None:
+        if algorithm is not None or max_iter is not None:
+            raise ValueError(
+                "pass either solver_cfg or algorithm/max_iter, not both — "
+                "set them on the SolverConfig instead")
+        scfg = solver_cfg
+    else:
+        scfg = SolverConfig(algorithm=algorithm or "mu",
+                            max_iter=max_iter or 10000)
+    if init_cfg is not None:
+        if init is not None:
+            raise ValueError("pass either init_cfg or init, not both")
+        icfg = init_cfg
+    else:
+        icfg = InitConfig(method=init or "random")
+    return scfg, icfg
+
+
+def nmf(a, k: int, *, seed: int = 0, algorithm: str | None = None,
+        max_iter: int | None = None, init: str | None = None,
+        solver_cfg: SolverConfig | None = None,
+        init_cfg: InitConfig | None = None) -> SolverResult:
+    """One non-negative factorization A ≈ W·H at rank k."""
+    arr, _ = _as_matrix(a)
+    if (arr < 0).any():
+        # reference-side validation lives in dead C code (checkmatrices.c:43-81);
+        # here it is a real error
+        raise ValueError("input matrix must be non-negative")
+    scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(scfg.dtype)
+    w0, h0 = initialize(jax.random.key(seed), jnp.asarray(arr, dtype), k,
+                        icfg, dtype)
+    return solve(arr, w0, h0, scfg)
+
+
+def nmfconsensus(
+    data,
+    ks: Sequence[int] = (2, 3, 4, 5),
+    restarts: int = 10,
+    *,
+    seed: int = 123,
+    algorithm: str | None = None,
+    max_iter: int | None = None,
+    init: str | None = None,
+    label_rule: str = "argmax",
+    solver_cfg: SolverConfig | None = None,
+    init_cfg: InitConfig | None = None,
+    mesh=None,
+    use_mesh: bool = True,
+    output: OutputConfig | None = None,
+) -> ConsensusResult:
+    """Full consensus-NMF rank sweep (the reference's ``runExample`` pipeline,
+    nmf.r:6-14, minus the hardcoded paths).
+
+    Runs `restarts` factorizations per rank in `ks`, reduces each rank's runs
+    to a consensus matrix on-device, selects ranks by cophenetic correlation,
+    and (optionally) writes GCT/plot outputs.
+    """
+    arr, col_names = _as_matrix(data)
+    if (arr < 0).any():
+        raise ValueError("input matrix must be non-negative")
+    ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
+                           label_rule=label_rule)
+    scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
+    if mesh is None and use_mesh:
+        mesh = default_mesh()
+
+    raw = sweep(arr, ccfg, scfg, icfg, mesh)
+
+    per_k: dict[int, KResult] = {}
+    for k, out in raw.items():
+        cons = np.asarray(out.consensus, dtype=np.float64)
+        rho, membership, order = coph.rank_selection(cons, k)
+        rho = float(np.format_float_positional(
+            rho, precision=4, fractional=False))  # signif(rho, 4), nmf.r:172
+        per_k[k] = KResult(
+            k=k, consensus=cons, rho=rho, membership=membership, order=order,
+            iterations=np.asarray(out.iterations),
+            dnorms=np.asarray(out.dnorms),
+            stop_reasons=np.asarray(out.stop_reasons),
+            best_w=np.asarray(out.best_w),
+            best_h=np.asarray(out.best_h),
+        )
+
+    result = ConsensusResult(ks=ccfg.ks, per_k=per_k,
+                             col_names=tuple(col_names))
+    if output is not None:
+        save_results(result, output)
+    return result
+
+
+def save_results(result: ConsensusResult, out: OutputConfig) -> list[str]:
+    """Write the reference's output set (nmf.r:195-252) under a configurable
+    directory: per-k ordered membership GCTs, the all-k membership matrix,
+    `cophenetic.txt`, per-k consensus-matrix GCTs, and (optionally) plots."""
+    os.makedirs(out.directory, exist_ok=True)
+    doc = out.doc_string
+    prefix = os.path.join(out.directory, f"{doc}." if doc else "")
+    written: list[str] = []
+    names = np.asarray(result.col_names)
+
+    if out.write_gcts:
+        for k in result.ks:
+            r = result.per_k[k]
+            ordered_names = names[r.order]
+            path = f"{prefix}consensus.k.{k}.gct"
+            write_gct(r.membership[r.order].reshape(-1, 1), path,
+                      row_names=list(ordered_names), col_names=["membership"])
+            written.append(path)
+            path = f"{prefix}consensus.matrix.k.{k}.gct"
+            write_gct(r.consensus, path, row_names=list(names),
+                      col_names=list(names))
+            written.append(path)
+        all_membership = np.stack(
+            [result.per_k[k].membership for k in result.ks], axis=1)
+        path = f"{prefix}membership.gct"
+        write_gct(all_membership, path, row_names=list(names),
+                  col_names=[f"k={k}" for k in result.ks])
+        written.append(path)
+
+    path = f"{prefix}cophenetic.txt"
+    with open(path, "wt") as f:
+        for k in result.ks:
+            f.write(f"{k}\t{result.per_k[k].rho}\n")
+    written.append(path)
+
+    if out.write_plots:
+        try:
+            from nmfx import plots
+        except ImportError:  # matplotlib absent: GCT outputs still complete
+            return written
+        written += plots.save_all(result, prefix)
+    return written

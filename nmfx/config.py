@@ -1,0 +1,144 @@
+"""Typed configuration for the whole framework.
+
+One config object per concern, replacing the reference's scatter of compile-time
+macros (``ZERO_THRESHOLD``/``DEBUG_LEVEL``, reference ``libnmf/include/common.h:15-25``),
+the ``options_t`` struct defaults (reference ``libnmf/setdefaultopts.c:38-52``), and
+R-level function arguments (reference ``nmf.r:106``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+ALGORITHMS = ("mu", "als", "neals", "pg", "alspg")
+INIT_METHODS = ("random", "nndsvd")
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Per-factorization solver settings.
+
+    Defaults mirror the reference's observed defaults: ``TolX = TolFun = 1e-4``
+    and projected-gradient ``tol = 2e-16`` (reference ``libnmf/setdefaultopts.c:47-51``),
+    ``maxiter = 10000`` (reference ``nmf.r:13``), division guard ``1e-9``
+    (reference ``libnmf/nmf_mu.c:56``), class-stability stop after 200 stable
+    checks performed every 2nd iteration (reference ``libnmf/nmf_mu.c:253-282``).
+
+    Intentional divergences from observed reference behavior (SURVEY.md §3.2):
+
+    * Q1 — the stability check reads per-column argmax of H with correct
+      indexing (the reference indexes out of bounds for n > k).
+    * Q2 — ``tol_x``/``tol_fun`` are live: the reference passes them to C where
+      the checks are commented out; here ``use_tol_checks`` enables the
+      *documented* semantics (delta < TolX, or relative residual decrease
+      below TolFun). Note the reference's non-mu solvers compare
+      ``dnorm <= TolFun * dnorm0`` *after* assigning ``dnorm0 = dnorm``
+      (reference ``libnmf/nmf_als.c:330-352``) — a self-comparison that can
+      never fire for TolFun < 1; we test against the previous iteration's
+      residual instead.
+    """
+
+    algorithm: str = "mu"
+    max_iter: int = 10000
+    tol_x: float = 1e-4
+    tol_fun: float = 1e-4
+    #: relative projected-gradient tolerance for pg/alspg. The reference's
+    #: (dead) driver default is ``opts->tol = 2E-16`` (libnmf/setdefaultopts.c:51),
+    #: which disables the stop in practice; we default to Lin (2007)'s usual
+    #: 1e-4 so pg/alspg terminate — set 2e-16 for reference-default parity.
+    tol_pg: float = 1e-4
+    #: check convergence every `check_every` iterations (reference: even iters)
+    check_every: int = 2
+    #: consecutive stable class checks before stopping (mu only)
+    stable_checks: int = 200
+    #: enable class-stability early stop (mu; the only live stop in the reference)
+    use_class_stop: bool = True
+    #: enable the documented TolX/TolFun stops (dead code in reference nmf_mu)
+    use_tol_checks: bool = True
+    #: values below this are clamped to zero after updates (reference
+    #: ZERO_THRESHOLD, common.h:15; effective value 0.0 in the shipped build)
+    zero_threshold: float = 0.0
+    #: additive guard on denominators (reference DIV_BY_ZERO_AVOIDANCE)
+    div_eps: float = 1e-9
+    #: max inner line-search steps for pg/alspg (reference pg_subprob_h.c:113)
+    ls_max_steps: int = 20
+    #: line-search step shrink factor (reference factor_b = 0.1)
+    ls_beta: float = 0.1
+    #: sufficient-decrease constant (reference 0.99 / 0.01 tests)
+    ls_sigma: float = 0.01
+    #: max iterations for pg subproblems inside alspg (reference nmf_alspg.c:218)
+    sub_max_iter: int = 1000
+    #: computation dtype: "float32" (TPU default) or "float64" (parity testing
+    #: vs the reference's f64 BLAS; requires jax_enable_x64)
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.algorithm not in ALGORITHMS:
+            raise ValueError(
+                f"algorithm must be one of {ALGORITHMS}, got {self.algorithm!r}"
+            )
+        if self.max_iter < 1:
+            raise ValueError("max_iter must be >= 1")
+        if self.check_every < 1:
+            raise ValueError("check_every must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class InitConfig:
+    """W0/H0 initialization (reference ``libnmf/generatematrix.c:59-250``).
+
+    ``random`` draws uniform [minval, maxval) with explicit, splittable PRNG
+    keys — fixing the reference's non-reproducible libc ``rand()`` self-seeded
+    with wall-clock time (reference ``libnmf/randnumber.c:27-35``).
+    ``nndsvd`` is the Boutsidis NNDSVD scheme (reference
+    ``libnmf/generatematrix.c:145-247``).
+    """
+
+    method: str = "random"
+    minval: float = 0.0
+    maxval: float = 1.0
+
+    def __post_init__(self):
+        if self.method not in INIT_METHODS:
+            raise ValueError(
+                f"init method must be one of {INIT_METHODS}, got {self.method!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusConfig:
+    """Consensus sweep settings (reference ``nmf.r:106-119``)."""
+
+    ks: Sequence[int] = (2, 3, 4, 5)
+    restarts: int = 10
+    seed: int = 123
+    #: cluster label rule. "argmax" is the intended BROAD semantics (largest
+    #: H-loading; matches the C early-stop's biggestInRow, nmf_mu.c:258-261);
+    #: "argmin" reproduces the reference R layer's observed behavior
+    #: (`apply(H, 2, order)[1,]` picks the SMALLEST loading, nmf.r:128 — Q3).
+    label_rule: str = "argmax"
+    #: hierarchical clustering linkage for rank selection (reference
+    #: hclust(method="average"), nmf.r:166)
+    linkage: str = "average"
+
+    def __post_init__(self):
+        ks = tuple(int(k) for k in self.ks)
+        object.__setattr__(self, "ks", ks)
+        if any(k < 2 for k in ks):
+            # reference guard: "Need at least two clusters" (nmf.r:107-108)
+            raise ValueError("all k must be >= 2")
+        if self.restarts < 1:
+            raise ValueError("restarts must be >= 1")
+        if self.label_rule not in ("argmax", "argmin"):
+            raise ValueError("label_rule must be 'argmax' or 'argmin'")
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputConfig:
+    """File outputs (reference writes to hardcoded './temp*', nmf.r:157-159)."""
+
+    directory: str = "./nmfx_out"
+    doc_string: str = ""
+    write_gcts: bool = True
+    write_plots: bool = True

@@ -1,0 +1,44 @@
+"""Connectivity / consensus matrices, on-device.
+
+TPU-native re-design of reference ``computeConsensusMatrixFromClusterings``
+(``nmf.r:121-144``): per-restart cluster labels from H, pairwise
+same-cluster connectivity, averaged over restarts. The reference builds each
+restart's n×n connectivity with ``outer(l, l, ==)`` and Reduce('+')s them on
+the host; here the whole reduction is one one-hot einsum on the MXU and the
+restart axis never leaves the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def labels_from_h(h: jax.Array, rule: str = "argmax") -> jax.Array:
+    """Per-sample cluster label from H (k×n).
+
+    ``argmax`` = intended BROAD semantics (dominant metagene);
+    ``argmin`` = the reference R layer's observed behavior
+    (``apply(H, 2, order)[1,]`` takes the SMALLEST loading, nmf.r:128 —
+    quirk Q3 in SURVEY.md §3.2).
+    """
+    if rule == "argmax":
+        return jnp.argmax(h, axis=0).astype(jnp.int32)
+    return jnp.argmin(h, axis=0).astype(jnp.int32)
+
+
+def connectivity(labels: jax.Array) -> jax.Array:
+    """0/1 connectivity matrix of one labelling (n,) -> (n, n)."""
+    return (labels[:, None] == labels[None, :]).astype(jnp.float32)
+
+
+def consensus_matrix(labels: jax.Array, k: int) -> jax.Array:
+    """Mean connectivity across restarts: (R, n) int labels -> (n, n).
+
+    One-hot einsum form: C = (1/R) Σ_r E_r E_rᵀ with E_r the n×k one-hot
+    label matrix — a batched matmul XLA maps straight onto the MXU, replacing
+    the reference's host-side outer-product loop (nmf.r:140-143).
+    """
+    onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32)  # (R, n, k)
+    r = labels.shape[0]
+    return jnp.einsum("rik,rjk->ij", onehot, onehot) / r

@@ -1,0 +1,87 @@
+"""W0/H0 initialization: uniform random and NNDSVD.
+
+TPU-native re-design of reference ``libnmf/generatematrix.c:59-250``.
+
+* ``random``: uniform [minval, maxval) with explicit, splittable
+  ``jax.random`` keys. This deliberately fixes the reference's
+  reproducibility hole — its C RNG self-seeds from wall-clock time and
+  ignores every caller-provided seed (``libnmf/randnumber.c:27-35``, quirk
+  Q2 in SURVEY.md), while its R-layer init draws from R's global RNG
+  (``nmf.r:37-38``). Here a seed fully determines every restart.
+
+* ``nndsvd``: Boutsidis & Gallopoulos NNDSVD (reference
+  ``generatematrix.c:145-247``): rank-k SVD, leading pair from
+  √σ₀·|u₀|,|v₀|, remaining pairs split into ± parts keeping the dominant
+  side scaled by √(σⱼ·‖side_u‖·‖side_v‖), final zero-threshold clamp. The
+  reference pulls the SVD from ARPACK Lanczos reverse communication
+  (``calculatesvd.c:141-224``); at consensus-NMF sizes a dense
+  ``jnp.linalg.svd`` on-device is both simpler and faster on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nmfx.config import InitConfig
+
+
+def random_init(key: jax.Array, m: int, n: int, k: int,
+                cfg: InitConfig = InitConfig(),
+                dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """Uniform random W0 (m×k), H0 (k×n) (reference generatematrix.c:94-100;
+    R-layer equivalent runif in (0,1), nmf.r:37-38)."""
+    kw, kh = jax.random.split(key)
+    w0 = jax.random.uniform(kw, (m, k), dtype, cfg.minval, cfg.maxval)
+    h0 = jax.random.uniform(kh, (k, n), dtype, cfg.minval, cfg.maxval)
+    return w0, h0
+
+
+def nndsvd_init(a: jax.Array, k: int, zero_threshold: float = 0.0,
+                dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """NNDSVD initialization (deterministic in A)."""
+    a = jnp.asarray(a, dtype)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    u, s, vt = u[:, :k], s[:k], vt[:k, :]
+
+    # leading pair: W[:,0] = sqrt(s0)*|u0|, H[0,:] = sqrt(s0)*|v0|
+    # (generatematrix.c:172-175; sign-ambiguous SVD made non-negative by abs)
+    w0 = jnp.sqrt(s[0]) * jnp.abs(u[:, :1])
+    h0 = jnp.sqrt(s[0]) * jnp.abs(vt[:1, :])
+
+    if k > 1:
+        uj = u[:, 1:]  # (m, k-1)
+        vj = vt[1:, :].T  # (n, k-1)
+        up, un = jnp.maximum(uj, 0), jnp.maximum(-uj, 0)
+        vp, vn = jnp.maximum(vj, 0), jnp.maximum(-vj, 0)
+        nup = jnp.linalg.norm(up, axis=0)
+        nun = jnp.linalg.norm(un, axis=0)
+        nvp = jnp.linalg.norm(vp, axis=0)
+        nvn = jnp.linalg.norm(vn, axis=0)
+        termp = nup * nvp
+        termn = nun * nvn
+        use_p = termp >= termn
+        term = jnp.where(use_p, termp, termn)
+        scale = jnp.sqrt(s[1:] * term)
+        tiny = jnp.finfo(dtype).tiny
+        wcols = scale * jnp.where(use_p, up / jnp.maximum(nup, tiny),
+                                  un / jnp.maximum(nun, tiny))
+        hrows = scale * jnp.where(use_p, vp / jnp.maximum(nvp, tiny),
+                                  vn / jnp.maximum(nvn, tiny))
+        w0 = jnp.concatenate([w0, wcols], axis=1)
+        h0 = jnp.concatenate([h0, hrows.T], axis=0)
+
+    # final clamp (generatematrix.c:229-247)
+    w0 = jnp.where(w0 <= zero_threshold, 0.0, w0)
+    h0 = jnp.where(h0 <= zero_threshold, 0.0, h0)
+    return w0, h0
+
+
+def initialize(key: jax.Array, a: jax.Array, k: int, cfg: InitConfig,
+               dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """Dispatch on cfg.method; NNDSVD ignores the key (deterministic in A,
+    as in the reference — restarts only differ under random init)."""
+    m, n = a.shape
+    if cfg.method == "random":
+        return random_init(key, m, n, k, cfg, dtype)
+    return nndsvd_init(a, k, dtype=dtype)

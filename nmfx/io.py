@@ -1,0 +1,151 @@
+"""GCT / RES expression-matrix I/O (pure numpy, no pandas).
+
+Covers the reference's R readers/writer: ``read.dataset``/``read.gct``/
+``read.res``/``write.gct`` (reference ``nmf.r:261-408``).
+
+Divergence from observed reference behavior, on purpose: the reference's
+``write.gct`` emits a malformed header line containing BOTH the column indices
+``1..ncol`` and the column names (reference ``nmf.r:384-392``); we write a
+well-formed GCT v1.2 header (``Name<TAB>Description<TAB><col names...>``) that
+its own ``read.gct`` — and ours — parses correctly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+
+class Dataset(NamedTuple):
+    """An expression matrix with row/column labels."""
+
+    values: np.ndarray  # (n_rows, n_cols) float64
+    row_names: list[str]
+    col_names: list[str]
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+
+def read_dataset(path: str) -> Dataset:
+    """Dispatch on file extension (reference ``read.dataset``, nmf.r:261-269)."""
+    lower = path.lower()
+    if lower.endswith(".gct"):
+        return read_gct(path)
+    if lower.endswith(".res"):
+        return read_res(path)
+    raise ValueError(f"Input is not a res or gct file: {path}")
+
+
+def read_gct(path: str) -> Dataset:
+    """Read a GCT v1.2 file (reference ``read.gct``, nmf.r:371-377).
+
+    Layout: line 1 version tag ``#1.2``; line 2 ``<rows>TAB<cols>``; line 3
+    header ``Name TAB Description TAB <sample names...>``; then one row per
+    gene: name, description, values. The Description column is dropped, as the
+    reference does (``ds <- ds[-1]``, nmf.r:376).
+    """
+    with open(path, "rt") as f:
+        version = f.readline().strip()
+        if not version.startswith("#"):
+            raise ValueError(f"{path}: missing GCT version line, got {version!r}")
+        dims = f.readline().split()
+        if len(dims) < 2:
+            raise ValueError(f"{path}: malformed GCT dimension line")
+        n_rows, n_cols = int(dims[0]), int(dims[1])
+        header = f.readline().rstrip("\n").split("\t")
+        col_names = [c for c in header[2:] if c != ""]
+        row_names: list[str] = []
+        values = np.empty((n_rows, n_cols), dtype=np.float64)
+        r = 0
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            fields = line.split("\t")
+            row_names.append(fields[0])
+            row = fields[2 : 2 + n_cols]
+            if len(row) != n_cols:
+                raise ValueError(
+                    f"{path}: row {r} has {len(row)} values, expected {n_cols}"
+                )
+            values[r] = [float(v) for v in row]
+            r += 1
+        if r != n_rows:
+            raise ValueError(f"{path}: found {r} data rows, header said {n_rows}")
+    if len(col_names) != n_cols:
+        # tolerate headers with trailing junk; fall back to numbered columns
+        col_names = (col_names + [str(i + 1) for i in range(n_cols)])[:n_cols]
+    return Dataset(values, row_names, col_names)
+
+
+def read_res(path: str) -> Dataset:
+    """Read a RES file (reference ``read.res``, nmf.r:351-369).
+
+    RES interleaves a value column and a call column per sample; sample names
+    sit at every 2nd header field starting at the 3rd (reference extracts
+    ``temp[seq(3, colst, 2)]``, nmf.r:358). Row names come from the Accession
+    (2nd) column; line 3 holds the row count.
+    """
+    with open(path, "rt") as f:
+        header = f.readline().rstrip("\n").split("\t")
+        col_names = [c for c in header[2::2] if c != ""]
+        f.readline()  # per-sample description line, unused
+        n_rows = int(f.readline().split()[0])
+        row_names: list[str] = []
+        rows: list[list[float]] = []
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            fields = line.split("\t")
+            row_names.append(fields[1])
+            rows.append([float(v) for v in fields[2::2]])
+    values = np.asarray(rows, dtype=np.float64)
+    if values.shape[0] != n_rows:
+        raise ValueError(
+            f"{path}: found {values.shape[0]} data rows, header said {n_rows}"
+        )
+    if values.shape[1] != len(col_names):
+        raise ValueError(
+            f"{path}: {values.shape[1]} value columns vs {len(col_names)} names"
+        )
+    return Dataset(values, row_names, col_names)
+
+
+def write_gct(
+    values: np.ndarray,
+    path: str,
+    row_names: Sequence[str] | None = None,
+    col_names: Sequence[str] | None = None,
+    descriptions: Sequence[str] | None = None,
+) -> None:
+    """Write a well-formed GCT v1.2 file (cf. reference ``write.gct``,
+    nmf.r:379-408, which duplicates row names into Name and Description —
+    we keep that default but emit a spec-conformant header).
+    """
+    values = np.atleast_2d(np.asarray(values))
+    n_rows, n_cols = values.shape
+    if row_names is None:
+        row_names = [str(i + 1) for i in range(n_rows)]
+    if col_names is None:
+        col_names = [str(i + 1) for i in range(n_cols)]
+    if descriptions is None:
+        descriptions = row_names
+    if len(row_names) != n_rows or len(col_names) != n_cols:
+        raise ValueError("row/col name lengths do not match matrix shape")
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "wt") as f:
+        f.write("#1.2\n")
+        f.write(f"{n_rows}\t{n_cols}\n")
+        f.write("Name\tDescription\t" + "\t".join(map(str, col_names)) + "\n")
+        for name, desc, row in zip(row_names, descriptions, values):
+            vals = "\t".join(
+                str(int(v)) if float(v).is_integer() else repr(float(v))
+                for v in row)
+            f.write(f"{name}\t{desc}\t{vals}\n")

@@ -1,0 +1,21 @@
+"""NMF solver family — five update rules sharing one while_loop driver.
+
+TPU-native re-designs of the reference's five C solvers
+(reference ``libnmf/nmf_{mu,als,neals,pg,alspg}.c``): each solver is a pure
+``step`` function over arrays, jit-compiled into a ``lax.while_loop`` and
+vmappable over the restart axis.
+"""
+
+from nmfx.solvers.base import SolverResult, StopReason, solve
+from nmfx.solvers import als, alspg, mu, neals, pg
+
+SOLVERS = {
+    "mu": mu,
+    "als": als,
+    "neals": neals,
+    "pg": pg,
+    "alspg": alspg,
+}
+
+__all__ = ["SOLVERS", "SolverResult", "StopReason", "solve", "mu", "als",
+           "neals", "pg", "alspg"]

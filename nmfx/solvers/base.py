@@ -1,0 +1,242 @@
+"""Shared solver driver: one ``lax.while_loop`` for all five update rules.
+
+The reference implements convergence control separately (and inconsistently)
+in each C solver; here every solver exposes
+
+* ``init_aux(a, w0, h0, cfg) -> aux``   — solver-specific carry (pytree)
+* ``step(a, state, cfg) -> state``      — one full iteration incl. its own
+                                          convergence decision
+
+and this module runs the loop, vmap-compatible (JAX's while_loop batching rule
+runs a batch until every element's predicate is false, masking updates — which
+is exactly the per-restart early-stop semantics SURVEY.md §7 calls out as hard
+part #1).
+
+Convergence helpers mirror the reference's C utilities:
+``residual_norm`` = calculateNorm (reference ``libnmf/calculatenorm.c:44-78``),
+``maxchange`` = calculateMaxchange (reference ``libnmf/calculatemaxchange.c:42-71``).
+"""
+
+from __future__ import annotations
+
+import enum
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nmfx.config import SolverConfig
+
+
+class StopReason(enum.IntEnum):
+    MAX_ITER = 0
+    #: per-column argmax of H unchanged for `stable_checks` consecutive checks
+    #: (the only live stop in the reference's exercised solver, nmf_mu.c:253-282)
+    CLASS_STABLE = 1
+    #: max-change of W and H below TolX (reference delta < TolX)
+    TOL_X = 2
+    #: relative residual decrease below TolFun (intended semantics of the
+    #: reference's dead `dnorm <= TolFun*dnorm0` check — see SolverConfig)
+    TOL_FUN = 3
+    #: projected-gradient norm below tol * initial gradient norm (Lin 2007;
+    #: reference nmf_pg.c:228-243 / nmf_alspg.c:193-209)
+    PG_TOL = 4
+
+
+class State(NamedTuple):
+    """Loop carry. ``w``/``h`` are the current factors; ``w_prev``/``h_prev``
+    the previous iteration's (for TolX); ``aux`` is solver-specific."""
+
+    w: jax.Array
+    h: jax.Array
+    w_prev: jax.Array
+    h_prev: jax.Array
+    iteration: jax.Array  # i32, iterations completed
+    dnorm: jax.Array  # residual at last check (f32), inf until computed
+    classes: jax.Array  # (n,) i32 per-sample argmax label at last check
+    stable: jax.Array  # i32 consecutive stable checks
+    done: jax.Array  # bool
+    stop_reason: jax.Array  # i32 StopReason
+    aux: Any
+
+
+class SolverResult(NamedTuple):
+    w: jax.Array
+    h: jax.Array
+    iterations: jax.Array
+    dnorm: jax.Array  # final ||A - W H||_F / sqrt(m n)
+    stop_reason: jax.Array
+
+
+def residual_norm(a: jax.Array, w: jax.Array, h: jax.Array) -> jax.Array:
+    """RMS residual ||A - W H||_F / sqrt(m*n).
+
+    The reference materializes an m*n scratch D = A - W*H for this
+    (calculatenorm.c:44-78); XLA fuses the subtraction into the reduction so
+    no scratch ever hits HBM.
+    """
+    m, n = a.shape
+    d = a - w @ h
+    return jnp.sqrt(jnp.sum(d * d) / (m * n))
+
+
+def maxchange(mat: jax.Array, mat0: jax.Array) -> jax.Array:
+    """max|mat - mat0| / (sqrt(eps) + max|mat0|) (calculatemaxchange.c:42-71)."""
+    sqrteps = jnp.sqrt(jnp.finfo(mat.dtype).eps)
+    return jnp.max(jnp.abs(mat - mat0)) / (sqrteps + jnp.max(jnp.abs(mat0)))
+
+
+def class_labels(h: jax.Array) -> jax.Array:
+    """Per-sample cluster label = argmax over H's rows.
+
+    Intended semantics of both the C early-stop (biggestInRow, nmf_mu.c:258-261,
+    which reads out of bounds — quirk Q1) and the BROAD method; the reference R
+    layer instead takes the argmin (quirk Q3), available via
+    ConsensusConfig.label_rule="argmin".
+    """
+    return jnp.argmax(h, axis=0).astype(jnp.int32)
+
+
+def clamp(x: jax.Array, zero_threshold: float) -> jax.Array:
+    """Zero out negatives and sub-threshold values (reference ZERO_THRESHOLD
+    clamp applied after every update, e.g. nmf_als.c:247-250)."""
+    return jnp.where(x <= zero_threshold, jnp.zeros_like(x), x)
+
+
+def check_convergence(
+    state: State,
+    cfg: SolverConfig,
+    *,
+    a: jax.Array | None = None,
+    use_class: bool = False,
+    use_tolx: bool = False,
+    use_tolfun: bool = False,
+) -> State:
+    """Apply the generic convergence tests after a step.
+
+    Tests run every ``cfg.check_every``-th iteration for iteration > 1
+    (reference: even iterations only, nmf_mu.c:253 / nmf_als.c:338). All
+    bookkeeping is branchless (jnp.where on scalars) so it vmaps and keeps the
+    while_loop body a single fused XLA computation.
+    """
+    it = state.iteration
+    is_check = (it > 1) & (it % cfg.check_every == 0) & (~state.done)
+    done = state.done
+    reason = state.stop_reason
+
+    classes = state.classes
+    stable = state.stable
+    if use_class:
+        new_classes = class_labels(state.h)
+        same = jnp.all(new_classes == state.classes)
+        stable = jnp.where(is_check, jnp.where(same, state.stable + 1, 0),
+                           state.stable)
+        classes = jnp.where(is_check, new_classes, state.classes)
+        hit = is_check & (stable >= cfg.stable_checks)
+        done = done | hit
+        reason = jnp.where(hit, StopReason.CLASS_STABLE, reason)
+
+    if use_tolx and cfg.use_tol_checks:
+        delta = jnp.maximum(maxchange(state.w, state.w_prev),
+                            maxchange(state.h, state.h_prev))
+        hit = is_check & (delta < cfg.tol_x) & ~done
+        done = done | hit
+        reason = jnp.where(hit, StopReason.TOL_X, reason)
+
+    dnorm = state.dnorm
+    if use_tolfun and cfg.use_tol_checks:
+        assert a is not None
+        new_dnorm = residual_norm(a, state.w, state.h)
+        # relative decrease vs the residual at the previous check
+        hit = (is_check & jnp.isfinite(state.dnorm)
+               & (state.dnorm - new_dnorm <= cfg.tol_fun * state.dnorm) & ~done)
+        dnorm = jnp.where(is_check, new_dnorm, state.dnorm)
+        done = done | hit
+        reason = jnp.where(hit, StopReason.TOL_FUN, reason)
+
+    return state._replace(classes=classes, stable=stable, done=done,
+                          stop_reason=reason, dnorm=dnorm)
+
+
+def init_state(a: jax.Array, w0: jax.Array, h0: jax.Array, aux: Any) -> State:
+    n = h0.shape[1]
+    f = w0.dtype
+    return State(
+        w=w0,
+        h=h0,
+        w_prev=w0,
+        h_prev=h0,
+        iteration=jnp.zeros((), jnp.int32),
+        dnorm=jnp.array(jnp.inf, f),
+        classes=jnp.full((n,), -1, jnp.int32),
+        stable=jnp.zeros((), jnp.int32),
+        done=jnp.zeros((), bool),
+        stop_reason=jnp.full((), StopReason.MAX_ITER, jnp.int32),
+        aux=aux,
+    )
+
+
+def run_loop(a, w0, h0, cfg: SolverConfig, step_fn, aux) -> SolverResult:
+    """Drive ``step_fn`` to convergence under jit.
+
+    The loop body unrolls ``check_every`` solver steps and only the last one
+    runs the (possibly O(mnk)) convergence tests — mirroring the reference's
+    check-every-2nd-iteration scheme structurally, so off-iterations never
+    compute a residual that a ``where``/``cond`` would discard (under vmap a
+    cond lowers to a select that executes both branches).
+    """
+    state0 = init_state(a, w0, h0, aux)
+
+    def one_step(state: State, check: bool) -> State:
+        state = state._replace(
+            w_prev=state.w, h_prev=state.h, iteration=state.iteration + 1
+        )
+        return step_fn(a, state, cfg, check)
+
+    def cond(state: State):
+        return (~state.done) & (state.iteration + cfg.check_every
+                                <= cfg.max_iter)
+
+    def body(state: State):
+        for i in range(cfg.check_every):
+            state = one_step(state, check=(i == cfg.check_every - 1))
+        return state
+
+    final = lax.while_loop(cond, body, state0)
+
+    # tail: if max_iter is not a multiple of check_every, finish the last
+    # few iterations one at a time (checking each — at most check_every-1)
+    def tail_cond(state: State):
+        return (~state.done) & (state.iteration < cfg.max_iter)
+
+    final = lax.while_loop(tail_cond, lambda s: one_step(s, True), final)
+    return SolverResult(
+        w=final.w,
+        h=final.h,
+        iterations=final.iteration,
+        dnorm=residual_norm(a, final.w, final.h),
+        stop_reason=final.stop_reason,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def solve(a: jax.Array, w0: jax.Array, h0: jax.Array,
+          cfg: SolverConfig = SolverConfig()) -> SolverResult:
+    """Factorize A ≈ W·H with the configured algorithm.
+
+    Jittable and vmappable; the single-restart analogue of the reference's
+    ``doNMF`` R→C bridge (reference ``nmf.r:23-51``), minus the process
+    boundary and with all five solvers wired (the reference only wires mu —
+    "calls to add: nmf_als, mu, neals, alspg, pg", nmf.r:40).
+    """
+    from nmfx.solvers import SOLVERS  # local import to avoid cycle
+
+    dtype = jnp.dtype(cfg.dtype)
+    a = jnp.asarray(a, dtype)
+    w0 = jnp.asarray(w0, dtype)
+    h0 = jnp.asarray(h0, dtype)
+    mod = SOLVERS[cfg.algorithm]
+    aux = mod.init_aux(a, w0, h0, cfg)
+    return run_loop(a, w0, h0, cfg, mod.step, aux)

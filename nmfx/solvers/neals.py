@@ -1,0 +1,52 @@
+"""Normal-equation ALS NMF.
+
+TPU-native re-design of reference ``libnmf/nmf_neals.c:180-470``:
+
+    H = max((WᵀW) \\ (WᵀA), 0)
+    W = max(((HHᵀ) \\ (HAᵀ))ᵀ, 0)
+
+solved by LU on the k×k Gram (reference dgesv, nmf_neals.c:200-204,302-306).
+When the Gram is singular the reference lazily switches that half-step to the
+QR least-squares path of nmf_als (nmf_neals.c:206-291,308-393); here the
+fallback is a ``lax.cond`` on non-finite solve output into the same QR solve
+als uses — no shape-changing branches (SURVEY.md §7 hard part #5).
+
+Convergence: TolX/TolFun checks every 2nd iteration as in als.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from nmfx.config import SolverConfig
+from nmfx.solvers import base
+from nmfx.solvers.als import lstsq_qr
+
+
+def init_aux(a, w0, h0, cfg: SolverConfig):
+    return ()
+
+
+def _solve_normal(factor, rhs_gram, fallback_b):
+    """solve(factorᵀfactor, rhs_gram) with QR fallback on singularity.
+
+    ``rhs_gram`` is factorᵀ·B; ``fallback_b`` is B for the QR path.
+    """
+    gram = factor.T @ factor
+    sol = jnp.linalg.solve(gram, rhs_gram)
+    ok = jnp.all(jnp.isfinite(sol))
+    return lax.cond(ok, lambda: sol, lambda: lstsq_qr(factor, fallback_b))
+
+
+def step(a, state: base.State, cfg: SolverConfig,
+         check: bool = True) -> base.State:
+    w0 = state.w
+    h = base.clamp(_solve_normal(w0, w0.T @ a, a), cfg.zero_threshold)
+    wt = _solve_normal(h.T, h @ a.T, a.T)
+    w = base.clamp(wt.T, cfg.zero_threshold)
+    state = state._replace(w=w, h=h)
+    if not check:
+        return state
+    return base.check_convergence(state, cfg, a=a, use_tolx=True,
+                                  use_tolfun=True)

@@ -1,0 +1,123 @@
+"""Projected-gradient NNLS subproblem (Lin 2007), shared by pg and alspg.
+
+One generic routine replaces the reference's mirrored pair
+``pg_subprob_h`` / ``pg_subprob_w`` (reference ``libnmf/pg_subprob_h.c:75-202``,
+``libnmf/pg_subprob_w.c:78-208``): both half-problems are
+
+    min_{X >= 0}  1/2 <X, G X> - <C, X>     (G = the k×k Gram, C = cross term)
+
+— for H: G = WᵀW, C = WᵀA, X = H; for W: G = HHᵀ, C = HAᵀ, X = Wᵀ (the
+reference writes the W variant untransposed to dodge BLAS transposes; with
+einsum-level codegen that contortion buys nothing on TPU).
+
+Line-search semantics follow the reference exactly: step ``alpha`` persists
+across outer iterations, up to 20 inner trials, shrink/grow factor 0.1,
+sufficient decrease ``0.99·⟨g,d⟩ + 0.5·⟨Gd,d⟩ < 0``, first-trial direction
+choice, and the previous-candidate-equality bailout in grow mode
+(pg_subprob_h.c:116-195).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nmfx.config import SolverConfig
+from nmfx.solvers.base import clamp
+
+
+class SubprobResult(NamedTuple):
+    x: jax.Array
+    grad: jax.Array  # gradient at the returned x
+    iterations: jax.Array  # outer iterations entered (drives alspg tol tightening)
+
+
+def projgrad_norm_sq(grad: jax.Array, x: jax.Array) -> jax.Array:
+    """Squared norm of the projected gradient: entries where grad<0 or x>0
+    (reference pg_subprob_h.c:102-106)."""
+    mask = (grad < 0) | (x > 0)
+    return jnp.sum(jnp.where(mask, grad * grad, jnp.zeros_like(grad)))
+
+
+class _Inner(NamedTuple):
+    alpha: jax.Array
+    xp: jax.Array  # previous candidate (grow mode)
+    xres: jax.Array  # accepted iterate
+    trial: jax.Array
+    finished: jax.Array
+    decrease: jax.Array  # direction flag fixed on the first trial
+
+
+class _Outer(NamedTuple):
+    x: jax.Array
+    grad: jax.Array
+    alpha: jax.Array
+    it: jax.Array
+    done: jax.Array
+
+
+def _line_search(x, grad, gram, alpha0, cfg: SolverConfig):
+    """One inner search: returns (new x, new alpha)."""
+    zt = cfg.zero_threshold
+    sigma = cfg.ls_sigma  # 0.01 → the 0.99 in the reference's test
+    beta = cfg.ls_beta
+
+    def trial_point(alpha):
+        xn = clamp(x - alpha * grad, zt)
+        d = xn - x
+        gradd = jnp.vdot(grad, d)
+        dqd = jnp.vdot(gram @ d, d)
+        suff = (1.0 - sigma) * gradd + 0.5 * dqd < 0
+        return xn, suff
+
+    def body(c: _Inner) -> _Inner:
+        xn, suff = trial_point(c.alpha)
+        first = c.trial == 1
+        decrease = jnp.where(first, ~suff, c.decrease)
+        xp = jnp.where(first, x, c.xp)
+        eq = jnp.all(xp == xn)
+        stop_decr = decrease & suff
+        stop_grow = (~decrease) & (~suff | eq)
+        finished = stop_decr | stop_grow
+        xres = jnp.where(stop_decr, xn, jnp.where(stop_grow, xp, c.xres))
+        alpha = jnp.where(
+            finished, c.alpha,
+            jnp.where(decrease, c.alpha * beta, c.alpha / beta))
+        xp = jnp.where(finished | decrease, xp, xn)
+        return _Inner(alpha, xp, xres, c.trial + 1, finished, decrease)
+
+    def cond(c: _Inner):
+        return (~c.finished) & (c.trial <= cfg.ls_max_steps)
+
+    init = _Inner(alpha0, x, x, jnp.ones((), jnp.int32),
+                  jnp.zeros((), bool), jnp.zeros((), bool))
+    out = lax.while_loop(cond, body, init)
+    return out.xres, out.alpha
+
+
+def solve_subproblem(gram, ctc, x0, tol, cfg: SolverConfig) -> SubprobResult:
+    """Projected-gradient descent on the NNLS subproblem to tolerance ``tol``
+    (absolute, on the projected-gradient norm) or ``cfg.sub_max_iter`` outer
+    iterations."""
+
+    def cond(c: _Outer):
+        return (~c.done) & (c.it < cfg.sub_max_iter)
+
+    def body(c: _Outer) -> _Outer:
+        grad = gram @ c.x - ctc
+        pg = jnp.sqrt(projgrad_norm_sq(grad, c.x))
+        hit = pg < tol
+        x_new, alpha_new = _line_search(c.x, grad, gram, c.alpha, cfg)
+        x = jnp.where(hit, c.x, x_new)
+        alpha = jnp.where(hit, c.alpha, alpha_new)
+        return _Outer(x, grad, alpha, c.it + 1, hit)
+
+    dtype = x0.dtype
+    init = _Outer(x0, jnp.zeros_like(x0), jnp.ones((), dtype),
+                  jnp.zeros((), jnp.int32), jnp.zeros((), bool))
+    out = lax.while_loop(cond, body, init)
+    grad_final = gram @ out.x - ctc
+    return SubprobResult(out.x, grad_final, out.it)

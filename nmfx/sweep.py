@@ -1,0 +1,113 @@
+"""Restart/k sweep: vmapped restarts, optionally sharded over a device mesh.
+
+TPU-native replacement for the reference's job-grid layer (reference
+``nmf.r:53-119``): where the reference expands a (k × restart) grid into
+BatchJobs R worker processes communicating through a filesystem registry
+(SURVEY.md §2c), here the restart axis is a vmapped batch dimension sharded
+across TPU cores over ICI, and the per-k consensus reduction happens on-device
+— only the n×n consensus matrix and per-restart stats are pulled to host.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.consensus import consensus_matrix, labels_from_h
+from nmfx.init import initialize
+from nmfx.solvers.base import solve
+
+#: mesh axis name for the restart batch dimension
+RESTART_AXIS = "restarts"
+
+
+class KSweepOutput(NamedTuple):
+    consensus: jax.Array  # (n, n)
+    iterations: jax.Array  # (restarts,)
+    dnorms: jax.Array  # (restarts,)
+    stop_reasons: jax.Array  # (restarts,)
+    labels: jax.Array  # (restarts, n)
+    best_w: jax.Array  # (m, k) factors of the lowest-residual restart
+    best_h: jax.Array  # (k, n)
+
+
+def _pad_count(restarts: int, mesh: Mesh | None) -> int:
+    """Round restarts up to a multiple of the mesh's restart-axis size so the
+    batch shards evenly; surplus lanes are computed and discarded."""
+    if mesh is None or RESTART_AXIS not in mesh.axis_names:
+        return restarts
+    size = mesh.shape[RESTART_AXIS]
+    return -(-restarts // size) * size
+
+
+@lru_cache(maxsize=64)
+def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
+                    init_cfg: InitConfig, label_rule: str, mesh: Mesh | None):
+    padded = _pad_count(restarts, mesh)
+    dtype = jnp.dtype(solver_cfg.dtype)
+
+    def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
+        a = jnp.asarray(a, dtype)
+        keys = jax.random.split(key, padded)
+        w0s, h0s = jax.vmap(
+            lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys)
+        if mesh is not None and RESTART_AXIS in mesh.axis_names:
+            shard = NamedSharding(mesh, P(RESTART_AXIS))
+            w0s = lax.with_sharding_constraint(w0s, shard)
+            h0s = lax.with_sharding_constraint(h0s, shard)
+        res = jax.vmap(lambda w0, h0: solve(a, w0, h0, solver_cfg))(w0s, h0s)
+        labels = jax.vmap(partial(labels_from_h, rule=label_rule))(res.h)
+        labels = labels[:restarts]  # drop padding lanes before the reduction
+        cons = consensus_matrix(labels, k)
+        best = jnp.argmin(res.dnorm[:restarts])
+        return KSweepOutput(cons, res.iterations[:restarts],
+                            res.dnorm[:restarts],
+                            res.stop_reason[:restarts], labels,
+                            res.w[best], res.h[best])
+
+    return jax.jit(impl)
+
+
+def sweep_one_k(a, key, k: int, restarts: int,
+                solver_cfg: SolverConfig = SolverConfig(),
+                init_cfg: InitConfig = InitConfig(),
+                label_rule: str = "argmax",
+                mesh: Mesh | None = None) -> KSweepOutput:
+    """Run `restarts` independent factorizations at rank k and reduce them to
+    one consensus matrix, entirely on-device."""
+    fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh)
+    return fn(jnp.asarray(a), key)
+
+
+def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
+          solver_cfg: SolverConfig = SolverConfig(),
+          init_cfg: InitConfig = InitConfig(),
+          mesh: Mesh | None = None) -> dict[int, KSweepOutput]:
+    """Full (k × restart) grid. k values run sequentially (their shapes
+    differ); each k uses every device via the sharded restart batch —
+    the TPU analogue of the reference's shuffled job chunks (nmf.r:111)."""
+    root = jax.random.key(cfg.seed)
+    out: dict[int, KSweepOutput] = {}
+    for k in cfg.ks:
+        # fold in k itself (not its position) so a given (seed, k) always
+        # yields the same factorizations regardless of sweep composition
+        key = jax.random.fold_in(root, k)
+        out[k] = sweep_one_k(a, key, k, cfg.restarts, solver_cfg, init_cfg,
+                             cfg.label_rule, mesh)
+    return out
+
+
+def default_mesh() -> Mesh | None:
+    """A 1-D mesh over all local devices for the restart axis; None if only
+    one device is visible (plain vmap is already optimal there)."""
+    devices = jax.devices()
+    if len(devices) <= 1:
+        return None
+    return Mesh(np.array(devices), (RESTART_AXIS,))

@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors SURVEY.md §4's plan — the mesh/sharding code paths are exercised
+without TPUs via ``--xla_force_host_platform_device_count`` (the reference has
+no test suite at all; this pyramid replaces its run-and-eyeball smoke script,
+reference ``test_nmf.r:25-27``).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def two_group_data():
+    """Synthetic 2-group expression-like matrix (fixture factory standing in
+    for the reference's OCplus MAsim.smyth generator, test_nmf.r:1-3, and its
+    bundled 20+20x1000.gct two-group design)."""
+    from nmfx.datasets import two_group_matrix
+
+    return two_group_matrix(n_genes=120, n_per_group=12, seed=7)
+
+
+@pytest.fixture(scope="session")
+def low_rank_data():
+    """Exactly low-rank non-negative matrix A = W H with known k."""
+    rng = np.random.default_rng(0)
+    w = rng.uniform(0.5, 1.5, size=(60, 3))
+    h = rng.uniform(0.5, 1.5, size=(3, 25))
+    return np.asarray(w @ h), 3

@@ -1,0 +1,39 @@
+"""Consensus/connectivity reduction tests (reference nmf.r:121-144)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from nmfx.consensus import connectivity, consensus_matrix, labels_from_h
+
+
+def test_labels_argmax_argmin():
+    h = jnp.array([[0.1, 0.9, 0.5],
+                   [0.8, 0.2, 0.6]])
+    np.testing.assert_array_equal(labels_from_h(h, "argmax"), [1, 0, 1])
+    np.testing.assert_array_equal(labels_from_h(h, "argmin"), [0, 1, 0])
+
+
+def test_connectivity_matches_outer_equality():
+    labels = jnp.array([0, 1, 0, 2])
+    c = np.asarray(connectivity(labels))
+    expect = np.equal.outer([0, 1, 0, 2], [0, 1, 0, 2]).astype(float)
+    np.testing.assert_array_equal(c, expect)
+
+
+def test_consensus_matches_naive_loop():
+    # on-device einsum reduction == the reference's Reduce('+', outer(l,l,==))
+    rng = np.random.default_rng(3)
+    labels = rng.integers(0, 4, size=(11, 9))
+    cons = np.asarray(consensus_matrix(jnp.asarray(labels), 4))
+    naive = np.zeros((9, 9))
+    for l in labels:
+        naive += np.equal.outer(l, l)
+    naive /= len(labels)
+    np.testing.assert_allclose(cons, naive, atol=1e-6)
+
+
+def test_consensus_diagonal_is_one():
+    labels = jnp.zeros((5, 7), jnp.int32)
+    cons = np.asarray(consensus_matrix(labels, 3))
+    np.testing.assert_allclose(np.diag(cons), 1.0)
+    np.testing.assert_allclose(cons, 1.0)  # identical labels => all ones

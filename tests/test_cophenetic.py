@@ -1,0 +1,81 @@
+"""Rank-selection layer vs scipy oracle (reference nmf.r:165-177)."""
+
+import numpy as np
+import pytest
+import scipy.cluster.hierarchy as sch
+import scipy.spatial.distance as ssd
+
+from nmfx.cophenetic import (average_linkage, condensed, cophenetic_rho,
+                             cut_tree, rank_selection)
+
+
+def _random_dist(n, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4))
+    d = ssd.squareform(ssd.pdist(x))
+    return d
+
+
+@pytest.mark.parametrize("n,seed", [(6, 0), (12, 1), (25, 2), (40, 3)])
+def test_linkage_matches_scipy(n, seed):
+    d = _random_dist(n, seed)
+    ours = average_linkage(d)
+    theirs = sch.linkage(ssd.squareform(d), method="average")
+    # heights and cluster sizes must agree merge-for-merge
+    np.testing.assert_allclose(ours.linkage[:, 2], theirs[:, 2], rtol=1e-10)
+    np.testing.assert_allclose(ours.linkage[:, 3], theirs[:, 3])
+    # generic-position distances => identical merge pairs
+    np.testing.assert_array_equal(np.sort(ours.linkage[:, :2], axis=1),
+                                  np.sort(theirs[:, :2], axis=1))
+
+
+@pytest.mark.parametrize("n,seed", [(10, 4), (30, 5)])
+def test_cophenetic_matches_scipy(n, seed):
+    d = _random_dist(n, seed)
+    ours = average_linkage(d)
+    z = sch.linkage(ssd.squareform(d), method="average")
+    coph_scipy = sch.cophenet(z)
+    np.testing.assert_allclose(condensed(ours.coph), coph_scipy, rtol=1e-10)
+    # rho vs scipy's cophenet correlation output
+    rho_scipy, _ = sch.cophenet(z, ssd.squareform(d))
+    assert abs(cophenetic_rho(d, ours.coph) - rho_scipy) < 1e-10
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 5])
+def test_cut_tree_matches_scipy(k):
+    d = _random_dist(18, 6)
+    ours = average_linkage(d)
+    labels = cut_tree(ours.linkage, 18, k)
+    z = sch.linkage(ssd.squareform(d), method="average")
+    theirs = sch.fcluster(z, t=k, criterion="maxclust")
+    assert labels.min() == 1 and labels.max() == k
+    # same partition up to label permutation
+    for a in range(18):
+        for b in range(18):
+            assert (labels[a] == labels[b]) == (theirs[a] == theirs[b])
+
+
+def test_leaf_order_is_permutation():
+    d = _random_dist(15, 7)
+    ours = average_linkage(d)
+    assert sorted(ours.order.tolist()) == list(range(15))
+    # dendrogram order must keep merged clusters contiguous at every height:
+    # spot-check against scipy's leaves ordering semantics
+    z = sch.linkage(ssd.squareform(d), method="average")
+    scipy_leaves = sch.leaves_list(z)
+    # both orders cluster the same pairs adjacently at the lowest merge
+    a, b = int(z[0, 0]), int(z[0, 1])
+    ia, ib = list(ours.order).index(a), list(ours.order).index(b)
+    assert abs(ia - ib) == 1
+
+
+def test_perfect_consensus_rho_is_one():
+    # block-diagonal consensus: two clean clusters => rho == 1
+    c = np.zeros((8, 8))
+    c[:4, :4] = 1.0
+    c[4:, 4:] = 1.0
+    rho, membership, order = rank_selection(c, 2)
+    assert rho == pytest.approx(1.0)
+    assert len(set(membership[:4])) == 1
+    assert len(set(membership[4:])) == 1
+    assert membership[0] != membership[7]

@@ -1,0 +1,66 @@
+"""I/O layer tests (reference readers/writer: nmf.r:261-408)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from nmfx.io import Dataset, read_dataset, read_gct, read_res, write_gct
+
+REFERENCE_GCT = "/root/reference/20+20x1000.gct"
+
+
+def test_gct_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    vals = rng.uniform(0, 10, size=(7, 5))
+    path = str(tmp_path / "x.gct")
+    write_gct(vals, path, row_names=[f"g{i}" for i in range(7)],
+              col_names=[f"s{j}" for j in range(5)])
+    ds = read_gct(path)
+    np.testing.assert_allclose(ds.values, vals, rtol=1e-6)
+    assert ds.row_names == [f"g{i}" for i in range(7)]
+    assert ds.col_names == [f"s{j}" for j in range(5)]
+
+
+def test_read_dataset_dispatch(tmp_path):
+    vals = np.ones((2, 3))
+    path = str(tmp_path / "y.GCT")
+    write_gct(vals, path)
+    ds = read_dataset(path)
+    assert ds.shape == (2, 3)
+    with pytest.raises(ValueError):
+        read_dataset(str(tmp_path / "z.txt"))
+
+
+def test_read_res(tmp_path):
+    path = str(tmp_path / "x.res")
+    with open(path, "w") as f:
+        f.write("Description\tAccession\tsampA\t\tsampB\t\n")
+        f.write("\t\tdescA\tdescB\n")
+        f.write("2\n")
+        f.write("gene one\tG1\t1.5\tP\t2.5\tA\n")
+        f.write("gene two\tG2\t3.0\tP\t4.0\tM\n")
+    ds = read_res(path)
+    assert ds.col_names == ["sampA", "sampB"]
+    assert ds.row_names == ["G1", "G2"]
+    np.testing.assert_allclose(ds.values, [[1.5, 2.5], [3.0, 4.0]])
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_GCT),
+                    reason="reference fixture not mounted")
+def test_reference_fixture_dims():
+    # the bundled dataset is 1000 genes x 40 samples (SURVEY.md, GCT header)
+    ds = read_gct(REFERENCE_GCT)
+    assert ds.shape == (1000, 40)
+    assert np.isfinite(ds.values).all()
+
+
+def test_write_gct_creates_dirs(tmp_path):
+    path = str(tmp_path / "sub" / "dir" / "o.gct")
+    write_gct(np.zeros((1, 1)), path)
+    assert os.path.exists(path)
+
+
+def test_write_gct_shape_validation(tmp_path):
+    with pytest.raises(ValueError):
+        write_gct(np.zeros((2, 2)), str(tmp_path / "bad.gct"), row_names=["a"])

@@ -1,0 +1,115 @@
+"""Solver property tests (SURVEY.md §4 test pyramid: solver invariants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.config import SolverConfig
+from nmfx.init import random_init
+from nmfx.solvers import SOLVERS, StopReason, solve
+from nmfx.solvers.base import residual_norm
+
+ALGOS = list(SOLVERS)
+
+
+def _problem(low_rank_data, k=None, seed=0):
+    a, true_k = low_rank_data
+    k = k or true_k
+    w0, h0 = random_init(jax.random.key(seed), a.shape[0], a.shape[1], k)
+    return jnp.asarray(a, jnp.float32), w0, h0
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+def test_nonnegativity_and_residual_decrease(low_rank_data, algo):
+    a, w0, h0 = _problem(low_rank_data)
+    cfg = SolverConfig(algorithm=algo, max_iter=60)
+    res = solve(a, w0, h0, cfg)
+    assert bool(jnp.all(res.w >= 0)), "W must be non-negative"
+    assert bool(jnp.all(res.h >= 0)), "H must be non-negative"
+    assert float(res.dnorm) < float(residual_norm(a, w0, h0))
+    assert np.isfinite(float(res.dnorm))
+
+
+@pytest.mark.parametrize("algo", ["mu", "als", "neals"])
+def test_low_rank_recovery(low_rank_data, algo):
+    # A is exactly rank 3; ALS-family and mu should drive the residual small
+    a, w0, h0 = _problem(low_rank_data)
+    cfg = SolverConfig(algorithm=algo, max_iter=500)
+    res = solve(a, w0, h0, cfg)
+    rel = float(res.dnorm) / float(jnp.sqrt(jnp.mean(a**2)))
+    assert rel < 0.05, f"{algo}: relative residual {rel}"
+
+
+def test_mu_monotone_loss(low_rank_data):
+    # Lee-Seung guarantee: ||A - WH|| never increases across mu iterations
+    a, w0, h0 = _problem(low_rank_data)
+    cfg = SolverConfig(algorithm="mu", use_class_stop=False,
+                       use_tol_checks=False, max_iter=1)
+    norms = [float(residual_norm(a, w0, h0))]
+    w, h = w0, h0
+    for _ in range(30):
+        res = solve(a, w, h, cfg)
+        w, h = res.w, res.h
+        norms.append(float(res.dnorm))
+    assert all(b <= a_ + 1e-5 for a_, b in zip(norms, norms[1:])), norms
+
+
+def test_mu_class_stability_stop(low_rank_data):
+    a, w0, h0 = _problem(low_rank_data)
+    cfg = SolverConfig(algorithm="mu", max_iter=10000, use_tol_checks=False)
+    res = solve(a, w0, h0, cfg)
+    assert int(res.iterations) < 10000
+    assert int(res.stop_reason) == StopReason.CLASS_STABLE
+    # stop rule: 200 stable checks, every 2nd iteration => at least ~400 iters
+    assert int(res.iterations) >= 2 * cfg.stable_checks
+
+
+def test_tolx_stop_fires(low_rank_data):
+    a, w0, h0 = _problem(low_rank_data)
+    cfg = SolverConfig(algorithm="neals", max_iter=5000, tol_x=1e-5)
+    res = solve(a, w0, h0, cfg)
+    assert int(res.iterations) < 5000
+    assert int(res.stop_reason) in (StopReason.TOL_X, StopReason.TOL_FUN)
+
+
+@pytest.mark.parametrize("algo", ["pg", "alspg"])
+def test_pg_family_stops_on_projgrad(low_rank_data, algo):
+    a, w0, h0 = _problem(low_rank_data)
+    cfg = SolverConfig(algorithm=algo, max_iter=300, tol_pg=1e-3)
+    res = solve(a, w0, h0, cfg)
+    assert np.isfinite(float(res.dnorm))
+    # on an exactly low-rank problem the projected gradient should vanish
+    assert int(res.stop_reason) in (StopReason.PG_TOL, StopReason.MAX_ITER)
+    assert float(res.dnorm) < float(residual_norm(a, w0, h0))
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+def test_vmap_over_restarts(low_rank_data, algo):
+    a, _, _ = _problem(low_rank_data)
+    m, n = a.shape
+    k = 3
+    keys = jax.random.split(jax.random.key(1), 4)
+    w0s, h0s = jax.vmap(lambda kk: random_init(kk, m, n, k))(keys)
+    cfg = SolverConfig(algorithm=algo, max_iter=30)
+    batched = jax.vmap(lambda w0, h0: solve(a, w0, h0, cfg))(w0s, h0s)
+    assert batched.w.shape == (4, m, k)
+    assert batched.h.shape == (4, k, n)
+    # different seeds must give different runs
+    assert not np.allclose(np.asarray(batched.w[0]), np.asarray(batched.w[1]))
+    # batched result matches the unbatched solve lane-for-lane
+    single = solve(a, w0s[0], h0s[0], cfg)
+    np.testing.assert_allclose(np.asarray(batched.w[0]),
+                               np.asarray(single.w), rtol=2e-4, atol=2e-5)
+
+
+def test_f64_parity_mode(low_rank_data):
+    # dtype="float64" is the parity-testing path vs the reference's f64 BLAS
+    a, w0, h0 = _problem(low_rank_data)
+    cfg = SolverConfig(algorithm="mu", max_iter=20, dtype="float64")
+    try:
+        res = solve(a, w0, h0, cfg)
+    except Exception:
+        pytest.skip("x64 not enabled in this environment")
+    if res.w.dtype == jnp.float64:
+        assert np.isfinite(float(res.dnorm))
