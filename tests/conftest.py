@@ -1,19 +1,20 @@
-"""Test harness: force an 8-device virtual CPU platform BEFORE jax import.
+"""Test harness: force an 8-device virtual CPU platform.
 
 Mirrors SURVEY.md §4's plan — the mesh/sharding code paths are exercised
-without TPUs via ``--xla_force_host_platform_device_count`` (the reference has
-no test suite at all; this pyramid replaces its run-and-eyeball smoke script,
-reference ``test_nmf.r:25-27``).
+without TPUs via 8 virtual CPU devices (the reference has no test suite at
+all; this pyramid replaces its run-and-eyeball smoke script, reference
+``test_nmf.r:25-27``).
+
+Note: env vars (JAX_PLATFORMS/XLA_FLAGS) are NOT enough here — a
+sitecustomize may import jax and register a TPU plugin before pytest starts.
+Backend *initialization* is lazy, so jax.config updates at conftest import
+time still win, as long as no test module touches devices at import time.
 """
 
-import os
+import jax
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest

@@ -1,0 +1,76 @@
+"""Multi-device mesh tests on the 8-device virtual CPU platform
+(SURVEY.md §2c: restart axis sharded over the mesh, consensus reduced
+on-device; conftest.py forces 8 CPU devices via jax.config
+jax_platforms/jax_num_cpu_devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.sweep import RESTART_AXIS, default_mesh, sweep, sweep_one_k
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) == 8, f"conftest should give 8 cpu devices, got {devices}"
+    return Mesh(np.array(devices), (RESTART_AXIS,))
+
+
+def test_default_mesh_uses_all_devices():
+    m = default_mesh()
+    assert m is not None
+    assert m.shape[RESTART_AXIS] == 8
+
+
+def test_sharded_matches_unsharded(low_rank_data, mesh):
+    a, _ = low_rank_data
+    cfg = SolverConfig(max_iter=200)
+    key = jax.random.key(0)
+    got = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg, mesh=mesh)
+    ref = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg, mesh=None)
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))
+
+
+def test_uneven_restarts_padded(low_rank_data, mesh):
+    # 6 restarts on an 8-device mesh: padded to 8 lanes, surplus discarded
+    a, _ = low_rank_data
+    cfg = SolverConfig(max_iter=100)
+    key = jax.random.key(1)
+    got = sweep_one_k(a, key, k=3, restarts=6, solver_cfg=cfg, mesh=mesh)
+    assert got.iterations.shape == (6,)
+    assert got.labels.shape == (6, a.shape[1])
+    ref = sweep_one_k(a, key, k=3, restarts=6, solver_cfg=cfg, mesh=None)
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+
+
+def test_full_sweep_on_mesh(low_rank_data, mesh):
+    a, _ = low_rank_data
+    out = sweep(a, ConsensusConfig(ks=(2, 3), restarts=16, seed=3),
+                SolverConfig(max_iter=150), InitConfig(), mesh)
+    for k in (2, 3):
+        c = np.asarray(out[k].consensus)
+        assert c.shape == (a.shape[1], a.shape[1])
+        np.testing.assert_allclose(np.diag(c), 1.0, atol=1e-6)
+
+
+def test_initial_factors_actually_sharded(low_rank_data, mesh):
+    # the sharding constraint must place the restart axis across devices:
+    # check the compiled output sharding of a representative batched op
+    a, _ = low_rank_data
+    shard = NamedSharding(mesh, P(RESTART_AXIS))
+
+    @jax.jit
+    def batch_norms(w0s):
+        return jnp.sum(w0s**2, axis=(1, 2))
+
+    w0s = jax.device_put(np.ones((8, a.shape[0], 3), np.float32), shard)
+    out = batch_norms(w0s)
+    assert len(out.sharding.device_set) == 8
