@@ -14,10 +14,7 @@ plus detail fields (restarts/sec, per-k iterations, hardware).
 
 import argparse
 import json
-import sys
 import time
-
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 
 def main():
@@ -28,6 +25,10 @@ def main():
     p.add_argument("--restarts", type=int, default=50)
     p.add_argument("--maxiter", type=int, default=10000)
     p.add_argument("--algorithm", default="mu")
+    p.add_argument("--precision", default="bfloat16",
+                   choices=("default", "bfloat16", "highest"),
+                   help="solver matmul precision (bfloat16 validated to give "
+                        "identical consensus on this workload)")
     p.add_argument("--target-s", type=float, default=10.0)
     args = p.parse_args()
 
@@ -41,14 +42,17 @@ def main():
     ks = tuple(range(2, args.kmax + 1))
     if not ks:
         p.error("--kmax must be >= 2")
-    scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter)
+    scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter,
+                        matmul_precision=args.precision)
     ccfg = ConsensusConfig(ks=ks, restarts=args.restarts, seed=123)
     icfg = InitConfig()
     mesh = default_mesh()
 
-    a = grouped_matrix(args.genes, (args.samples // 4,) * 4,
-                       effect=2.0, seed=0)
-    a = a[:, : args.samples]
+    # 4 planted groups summing to exactly --samples columns
+    sizes = [args.samples // 4] * 4
+    sizes[0] += args.samples % 4
+    a = grouped_matrix(args.genes, tuple(sizes), effect=2.0, seed=0)
+    assert a.shape == (args.genes, args.samples)
 
     # warmup: one full sweep triggers every per-k compile at the exact static
     # config (a different max_iter would be a different jit cache entry);
@@ -79,7 +83,7 @@ def main():
         "detail": {
             "config": f"k=2..{args.kmax} x {args.restarts} restarts, "
                       f"{args.genes}x{args.samples}, {args.algorithm}, "
-                      f"maxiter={args.maxiter}",
+                      f"maxiter={args.maxiter}, precision={args.precision}",
             "restarts_per_s": round(total_restarts / wall, 2),
             "mean_iters_per_k": {str(k): round(v, 1) for k, v in
                                  iters.items()},

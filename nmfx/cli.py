@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max solver iterations (default 10000)")
     p.add_argument("--seed", type=int, default=123)
     p.add_argument("--algorithm", choices=ALGORITHMS, default="mu")
+    p.add_argument("--precision", default="default",
+                   choices=("default", "bfloat16", "highest"),
+                   help="TPU matmul precision for solver dots")
     p.add_argument("--init", choices=INIT_METHODS, default="random")
     p.add_argument("--label-rule", choices=("argmax", "argmin"),
                    default="argmax",
@@ -64,13 +67,16 @@ def main(argv: list[str] | None = None) -> int:
     if not args.no_files:
         output = OutputConfig(directory=args.outdir,
                               write_plots=not args.no_plots)
+    from nmfx.config import SolverConfig
+
     result = nmfconsensus(
         args.dataset,
         ks=args.ks,
         restarts=args.restarts,
         seed=args.seed,
-        algorithm=args.algorithm,
-        max_iter=args.maxiter,
+        solver_cfg=SolverConfig(algorithm=args.algorithm,
+                                max_iter=args.maxiter,
+                                matmul_precision=args.precision),
         init=args.init,
         label_rule=args.label_rule,
         use_mesh=not args.no_mesh,

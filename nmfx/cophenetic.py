@@ -5,9 +5,14 @@ delegates to base R: ``hclust(as.dist(1-C), method="average")`` →
 ``cophenetic`` → ``cor`` → ``cutree`` (reference ``nmf.r:165-177``). n is the
 number of samples (tiny next to the NMF work), so this runs on host numpy;
 the heavy consensus reduction stays on-device (see consensus.py). Validated
-against scipy.cluster.hierarchy in tests. A native C++ fast path can be
-slotted behind `average_linkage` if profiling ever demands it (it has not:
-O(n³) at n≤500 is microseconds).
+against scipy.cluster.hierarchy in tests.
+
+``average_linkage`` and ``cut_tree`` dispatch to the native C++ library
+(nmfx/native, the framework's host-side analogue of the reference's
+libnmf.so) when it is available, and fall back to the pure-numpy
+implementations (``average_linkage_numpy`` / ``cut_tree_numpy``) otherwise;
+set NMFX_NATIVE=0 to force the fallback. Both paths share one contract and
+are cross-tested in tests/test_native.py.
 """
 
 from __future__ import annotations
@@ -26,7 +31,27 @@ class HClust(NamedTuple):
 
 
 def average_linkage(dist: np.ndarray) -> HClust:
-    """UPGMA agglomerative clustering.
+    """UPGMA agglomerative clustering (native C++ when available)."""
+    from nmfx import native
+
+    if native.available():
+        nat = native.average_linkage(dist)
+        return HClust(nat.linkage, nat.coph, nat.order)
+    return average_linkage_numpy(dist)
+
+
+def cut_tree(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
+    """Memberships 1..k from the first n-k merges (reference ``cutree``,
+    nmf.r:177); native C++ when available."""
+    from nmfx import native
+
+    if native.available():
+        return native.cut_tree(linkage, n, k)
+    return cut_tree_numpy(linkage, n, k)
+
+
+def average_linkage_numpy(dist: np.ndarray) -> HClust:
+    """UPGMA agglomerative clustering (pure-numpy reference implementation).
 
     Cluster ids follow the scipy convention: leaves are 0..n-1, the cluster
     created at merge t is n+t. Cophenetic distance of a cross pair = height
@@ -101,10 +126,9 @@ def cophenetic_rho(dist: np.ndarray, coph: np.ndarray) -> float:
     return float((xc @ yc) / denom)
 
 
-def cut_tree(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
-    """Memberships 1..k from the first n-k merges (reference ``cutree``,
-    nmf.r:177; labels numbered by first appearance in leaf index order, as R
-    does)."""
+def cut_tree_numpy(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
+    """Memberships 1..k from the first n-k merges (pure-numpy; labels
+    numbered by first appearance in leaf index order, as R's cutree does)."""
     if not 1 <= k <= n:
         raise ValueError(f"k must be in [1, {n}]")
     parent = np.arange(2 * n - 1)

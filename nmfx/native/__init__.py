@@ -1,0 +1,96 @@
+"""ctypes loader for the native host library (hclust/cophenetic fast path).
+
+Auto-builds ``libnmfx_native.so`` with the bundled Makefile on first import
+when a C++ toolchain is present (the reference repo's equivalent move: a top
+Makefile producing ``libnmf.so`` that the R layer dyn.loads, reference
+``Makefile:1-7`` / ``nmf.r:4``). Everything degrades gracefully to the pure
+numpy implementation in ``nmfx/cophenetic.py``; set ``NMFX_NATIVE=0`` to
+force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import NamedTuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libnmfx_native.so")
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _try_load() -> ctypes.CDLL | None:
+    if os.environ.get("NMFX_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                           capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    c_double_p = ctypes.POINTER(ctypes.c_double)
+    c_int32_p = ctypes.POINTER(ctypes.c_int32)
+    lib.nmfx_average_linkage.restype = ctypes.c_int
+    lib.nmfx_average_linkage.argtypes = [c_double_p, ctypes.c_int64,
+                                         c_double_p, c_double_p, c_int32_p]
+    lib.nmfx_cut_tree.restype = ctypes.c_int
+    lib.nmfx_cut_tree.argtypes = [c_double_p, ctypes.c_int64,
+                                  ctypes.c_int64, c_int32_p]
+    return lib
+
+
+def available() -> bool:
+    global _lib, _load_attempted
+    if not _load_attempted:
+        _load_attempted = True  # cache failures too: never re-spawn make
+        _lib = _try_load()
+    return _lib is not None
+
+
+class NativeHClust(NamedTuple):
+    linkage: np.ndarray
+    coph: np.ndarray
+    order: np.ndarray
+
+
+def average_linkage(dist: np.ndarray) -> NativeHClust:
+    """Native UPGMA; same contract as nmfx.cophenetic.average_linkage."""
+    assert available(), "native library not loaded"
+    d = np.ascontiguousarray(dist, dtype=np.float64)
+    n = d.shape[0]
+    if d.shape != (n, n) or n < 2:
+        raise ValueError("dist must be square with n >= 2")
+    linkage = np.zeros((n - 1, 4), dtype=np.float64)
+    coph = np.zeros((n, n), dtype=np.float64)
+    order = np.zeros(n, dtype=np.int32)
+    c_double_p = ctypes.POINTER(ctypes.c_double)
+    c_int32_p = ctypes.POINTER(ctypes.c_int32)
+    rc = _lib.nmfx_average_linkage(
+        d.ctypes.data_as(c_double_p), n,
+        linkage.ctypes.data_as(c_double_p),
+        coph.ctypes.data_as(c_double_p),
+        order.ctypes.data_as(c_int32_p))
+    if rc != 0:
+        raise RuntimeError(f"nmfx_average_linkage failed with code {rc}")
+    return NativeHClust(linkage, coph, order.astype(np.int64))
+
+
+def cut_tree(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
+    assert available(), "native library not loaded"
+    lk = np.ascontiguousarray(linkage, dtype=np.float64)
+    labels = np.zeros(n, dtype=np.int32)
+    c_double_p = ctypes.POINTER(ctypes.c_double)
+    c_int32_p = ctypes.POINTER(ctypes.c_int32)
+    rc = _lib.nmfx_cut_tree(lk.ctypes.data_as(c_double_p), n, k,
+                            labels.ctypes.data_as(c_int32_p))
+    if rc != 0:
+        raise RuntimeError(f"nmfx_cut_tree failed with code {rc}")
+    return labels.astype(np.int64)

@@ -1,0 +1,147 @@
+// Native host path for the rank-selection step: average-linkage (UPGMA)
+// hierarchical clustering, cophenetic distances, dendrogram leaf order, and
+// cut-tree memberships.
+//
+// This is nmfx's analogue of the reference's native layer (libnmf.so loaded
+// via dyn.load, reference nmf.r:4): the TPU handles the NMF compute, and this
+// library handles the inherently-sequential host-side agglomeration the
+// reference delegated to base R's hclust/cophenetic/cutree (nmf.r:165-177).
+// Semantics match nmfx/cophenetic.py exactly (tested against it and scipy).
+//
+// Build: make -C nmfx/native   (g++ -O3, no dependencies)
+// ABI: plain C, loaded with ctypes.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace {
+constexpr double kInf = std::numeric_limits<double>::infinity();
+}
+
+extern "C" {
+
+// dist: n*n row-major symmetric, zero diagonal (not modified)
+// linkage: out, (n-1)*4 rows [id_a, id_b, height, size], scipy id convention
+// coph: out, n*n cophenetic distances
+// order: out, n dendrogram leaf order
+// returns 0 on success, nonzero on bad input
+int nmfx_average_linkage(const double* dist, int64_t n, double* linkage,
+                         double* coph, int32_t* order) {
+  if (n < 2) return 1;
+  std::vector<double> d(dist, dist + n * n);
+  for (int64_t i = 0; i < n; ++i) d[i * n + i] = kInf;
+
+  std::vector<uint8_t> active(n, 1);
+  std::vector<double> size(n, 1.0);
+  std::vector<int64_t> cid(n);
+  std::vector<std::vector<int32_t>> members(n);
+  // children[t] = ids merged at step t (cluster id n+t)
+  std::vector<std::pair<int64_t, int64_t>> children(n - 1);
+  for (int64_t i = 0; i < n; ++i) {
+    cid[i] = i;
+    members[i].push_back(static_cast<int32_t>(i));
+  }
+  std::memset(coph, 0, sizeof(double) * n * n);
+
+  for (int64_t t = 0; t < n - 1; ++t) {
+    // find the closest active pair
+    double best = kInf;
+    int64_t bi = -1, bj = -1;
+    for (int64_t i = 0; i < n; ++i) {
+      if (!active[i]) continue;
+      const double* row = d.data() + i * n;
+      for (int64_t j = i + 1; j < n; ++j) {
+        if (active[j] && row[j] < best) {
+          best = row[j];
+          bi = i;
+          bj = j;
+        }
+      }
+    }
+    if (bi < 0) return 2;
+
+    int64_t a = std::min(cid[bi], cid[bj]);
+    int64_t b = std::max(cid[bi], cid[bj]);
+    double new_size = size[bi] + size[bj];
+    linkage[t * 4 + 0] = static_cast<double>(a);
+    linkage[t * 4 + 1] = static_cast<double>(b);
+    linkage[t * 4 + 2] = best;
+    linkage[t * 4 + 3] = new_size;
+
+    for (int32_t mi : members[bi])
+      for (int32_t mj : members[bj]) {
+        coph[static_cast<int64_t>(mi) * n + mj] = best;
+        coph[static_cast<int64_t>(mj) * n + mi] = best;
+      }
+
+    // UPGMA distance update into slot bi
+    for (int64_t kcol = 0; kcol < n; ++kcol) {
+      double merged =
+          (size[bi] * d[bi * n + kcol] + size[bj] * d[bj * n + kcol]) /
+          new_size;
+      d[bi * n + kcol] = merged;
+      d[kcol * n + bi] = merged;
+    }
+    d[bi * n + bi] = kInf;
+    active[bj] = 0;
+    children[t] = {a, b};
+    auto& mj = members[bj];
+    members[bi].insert(members[bi].end(), mj.begin(), mj.end());
+    mj.clear();
+    mj.shrink_to_fit();
+    size[bi] = new_size;
+    cid[bi] = n + t;
+  }
+
+  // depth-first leaf order, left child first
+  std::vector<int64_t> stack;
+  stack.push_back(2 * n - 2);
+  int64_t pos = 0;
+  while (!stack.empty()) {
+    int64_t node = stack.back();
+    stack.pop_back();
+    if (node < n) {
+      order[pos++] = static_cast<int32_t>(node);
+    } else {
+      auto [left, right] = children[node - n];
+      stack.push_back(right);
+      stack.push_back(left);
+    }
+  }
+  return pos == n ? 0 : 3;
+}
+
+// linkage: (n-1)*4 as produced above; labels out: n entries in 1..k,
+// numbered by first appearance in leaf index order (R cutree convention)
+int nmfx_cut_tree(const double* linkage, int64_t n, int64_t k,
+                  int32_t* labels) {
+  if (k < 1 || k > n) return 1;
+  std::vector<int64_t> parent(2 * n - 1);
+  for (int64_t i = 0; i < 2 * n - 1; ++i) parent[i] = i;
+  auto find = [&](int64_t x) {
+    while (parent[x] != x) {
+      parent[x] = parent[parent[x]];
+      x = parent[x];
+    }
+    return x;
+  };
+  for (int64_t t = 0; t < n - k; ++t) {
+    int64_t a = static_cast<int64_t>(linkage[t * 4 + 0]);
+    int64_t b = static_cast<int64_t>(linkage[t * 4 + 1]);
+    parent[find(a)] = n + t;
+    parent[find(b)] = n + t;
+  }
+  std::vector<int64_t> seen(2 * n - 1, 0);
+  int32_t next_label = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t root = find(i);
+    if (seen[root] == 0) seen[root] = ++next_label;
+    labels[i] = static_cast<int32_t>(seen[root]);
+  }
+  return 0;
+}
+
+}  // extern "C"

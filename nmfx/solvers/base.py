@@ -30,6 +30,16 @@ from jax import lax
 from nmfx.config import SolverConfig
 
 
+def matmul_precision_ctx(precision: str):
+    """Context applying a SolverConfig.matmul_precision at trace time
+    ("default" = leave JAX's platform default untouched)."""
+    import contextlib
+
+    if precision == "default":
+        return contextlib.nullcontext()
+    return jax.default_matmul_precision(precision)
+
+
 class StopReason(enum.IntEnum):
     MAX_ITER = 0
     #: per-column argmax of H unchanged for `stable_checks` consecutive checks
@@ -238,5 +248,8 @@ def solve(a: jax.Array, w0: jax.Array, h0: jax.Array,
     w0 = jnp.asarray(w0, dtype)
     h0 = jnp.asarray(h0, dtype)
     mod = SOLVERS[cfg.algorithm]
-    aux = mod.init_aux(a, w0, h0, cfg)
-    return run_loop(a, w0, h0, cfg, mod.step, aux)
+    # the context applies at trace time; cfg is a static arg, so each
+    # precision gets its own jit cache entry
+    with matmul_precision_ctx(cfg.matmul_precision):
+        aux = mod.init_aux(a, w0, h0, cfg)
+        return run_loop(a, w0, h0, cfg, mod.step, aux)

@@ -5,8 +5,9 @@ import pytest
 import scipy.cluster.hierarchy as sch
 import scipy.spatial.distance as ssd
 
-from nmfx.cophenetic import (average_linkage, condensed, cophenetic_rho,
-                             cut_tree, rank_selection)
+from nmfx.cophenetic import (average_linkage_numpy as average_linkage,
+                             condensed, cophenetic_rho,
+                             cut_tree_numpy as cut_tree, rank_selection)
 
 
 def _random_dist(n, seed):

@@ -1,0 +1,60 @@
+"""Native C++ hclust library vs the numpy reference implementation."""
+
+import numpy as np
+import pytest
+import scipy.cluster.hierarchy as sch
+import scipy.spatial.distance as ssd
+
+from nmfx import cophenetic as pycoph
+from nmfx import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def _random_dist(n, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4))
+    return ssd.squareform(ssd.pdist(x))
+
+
+@pytest.mark.parametrize("n,seed", [(5, 0), (20, 1), (60, 2)])
+def test_native_matches_numpy(n, seed):
+    d = _random_dist(n, seed)
+    ours = native.average_linkage(d)
+    ref = pycoph.average_linkage_numpy(d)
+    np.testing.assert_allclose(ours.linkage, ref.linkage, rtol=1e-12)
+    np.testing.assert_allclose(ours.coph, ref.coph, rtol=1e-12)
+    np.testing.assert_array_equal(ours.order, ref.order)
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_native_cut_tree_matches_numpy(k):
+    d = _random_dist(25, 3)
+    nat = native.average_linkage(d)
+    labels_native = native.cut_tree(nat.linkage, 25, k)
+    labels_py = pycoph.cut_tree_numpy(pycoph.average_linkage_numpy(d).linkage, 25, k)
+    np.testing.assert_array_equal(labels_native, labels_py)
+
+
+def test_native_matches_scipy():
+    d = _random_dist(30, 4)
+    ours = native.average_linkage(d)
+    z = sch.linkage(ssd.squareform(d), method="average")
+    np.testing.assert_allclose(ours.linkage[:, 2], z[:, 2], rtol=1e-10)
+    np.testing.assert_allclose(pycoph.condensed(ours.coph), sch.cophenet(z),
+                               rtol=1e-10)
+
+
+def test_rank_selection_dispatch_parity(monkeypatch):
+    # rank_selection must give identical results native vs numpy
+    c = np.zeros((10, 10))
+    c[:5, :5] = 1.0
+    c[5:, 5:] = 1.0
+    rho_n, mem_n, ord_n = pycoph.rank_selection(c, 2)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setenv("NMFX_NATIVE", "0")
+    rho_p, mem_p, ord_p = pycoph.rank_selection(c, 2)
+    assert rho_n == rho_p
+    np.testing.assert_array_equal(mem_n, mem_p)
+    np.testing.assert_array_equal(ord_n, ord_p)
