@@ -136,6 +136,8 @@ def nmfconsensus(
     mesh=None,
     use_mesh: bool = True,
     output: OutputConfig | None = None,
+    checkpoint_dir: str | None = None,
+    profiler=None,
 ) -> ConsensusResult:
     """Full consensus-NMF rank sweep (the reference's ``runExample`` pipeline,
     nmf.r:6-14, minus the hardcoded paths).
@@ -143,6 +145,10 @@ def nmfconsensus(
     Runs `restarts` factorizations per rank in `ks`, reduces each rank's runs
     to a consensus matrix on-device, selects ranks by cophenetic correlation,
     and (optionally) writes GCT/plot outputs.
+
+    ``checkpoint_dir``: persist each finished rank there and resume an
+    interrupted sweep from the ranks already on disk (guarded by a fingerprint
+    of the data + configs, so a registry never serves a different run).
     """
     arr, col_names = _as_matrix(data)
     if (arr < 0).any():
@@ -153,14 +159,27 @@ def nmfconsensus(
     if mesh is None and use_mesh:
         mesh = default_mesh()
 
-    raw = sweep(arr, ccfg, scfg, icfg, mesh)
+    registry = None
+    if checkpoint_dir is not None:
+        from nmfx.registry import SweepRegistry
+
+        registry = SweepRegistry.open(checkpoint_dir, arr, scfg, icfg,
+                                      restarts, seed, label_rule)
+    if profiler is None:
+        from nmfx.profiling import NullProfiler
+
+        profiler = NullProfiler()
+
+    raw = sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
+                profiler=profiler)
 
     per_k: dict[int, KResult] = {}
     for k, out in raw.items():
-        cons = np.asarray(out.consensus, dtype=np.float64)
-        rho, membership, order = coph.rank_selection(cons, k)
-        rho = float(np.format_float_positional(
-            rho, precision=4, fractional=False))  # signif(rho, 4), nmf.r:172
+        with profiler.phase("rank_selection"):
+            cons = np.asarray(out.consensus, dtype=np.float64)
+            rho, membership, order = coph.rank_selection(cons, k)
+            rho = float(np.format_float_positional(
+                rho, precision=4, fractional=False))  # signif(rho,4) nmf.r:172
         per_k[k] = KResult(
             k=k, consensus=cons, rho=rho, membership=membership, order=order,
             iterations=np.asarray(out.iterations),
@@ -173,7 +192,8 @@ def nmfconsensus(
     result = ConsensusResult(ks=ccfg.ks, per_k=per_k,
                              col_names=tuple(col_names))
     if output is not None:
-        save_results(result, output)
+        with profiler.phase("write_outputs"):
+            save_results(result, output)
     return result
 
 

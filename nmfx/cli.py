@@ -56,11 +56,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the summary only, write nothing")
     p.add_argument("--no-mesh", action="store_true",
                    help="disable sharding over the local device mesh")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="persist per-rank results here and resume an "
+                        "interrupted sweep from completed ranks")
+    p.add_argument("--profile", action="store_true",
+                   help="print a per-phase wall-clock breakdown (replaces "
+                        "the reference's rebuild-to-instrument PROFILE_* "
+                        "macros, libnmf common.h:27-45)")
+    p.add_argument("--trace-dir", default=None,
+                   help="with --profile: also capture a jax.profiler device "
+                        "trace (TensorBoard/Perfetto) into this directory")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.trace_dir and not args.profile:
+        parser.error("--trace-dir requires --profile")
     from nmfx.api import nmfconsensus  # deferred: keeps --help fast
 
     output = None
@@ -68,21 +81,29 @@ def main(argv: list[str] | None = None) -> int:
         output = OutputConfig(directory=args.outdir,
                               write_plots=not args.no_plots)
     from nmfx.config import SolverConfig
+    from nmfx.profiling import NullProfiler, Profiler
 
-    result = nmfconsensus(
-        args.dataset,
-        ks=args.ks,
-        restarts=args.restarts,
-        seed=args.seed,
-        solver_cfg=SolverConfig(algorithm=args.algorithm,
-                                max_iter=args.maxiter,
-                                matmul_precision=args.precision),
-        init=args.init,
-        label_rule=args.label_rule,
-        use_mesh=not args.no_mesh,
-        output=output,
-    )
+    profiler = (Profiler(trace_dir=args.trace_dir) if args.profile
+                else NullProfiler())
+    with profiler:
+        result = nmfconsensus(
+            args.dataset,
+            ks=args.ks,
+            restarts=args.restarts,
+            seed=args.seed,
+            solver_cfg=SolverConfig(algorithm=args.algorithm,
+                                    max_iter=args.maxiter,
+                                    matmul_precision=args.precision),
+            init=args.init,
+            label_rule=args.label_rule,
+            use_mesh=not args.no_mesh,
+            output=output,
+            checkpoint_dir=args.checkpoint_dir,
+            profiler=profiler,
+        )
     print(result.summary())
+    if args.profile:
+        print(profiler.report())
     return 0
 
 

@@ -1,0 +1,94 @@
+"""Multi-host execution: one SPMD sweep over every device in the job.
+
+The reference's distributed story is BatchJobs job farming — independent R
+worker processes scattered over a cluster scheduler, results gathered back
+through a shared filesystem registry (reference ``nmf.r:63,112-113``,
+SURVEY.md §2c). The TPU-native replacement is single-program multiple-data:
+every host runs this same sweep; the restart axis is sharded over a *global*
+``Mesh`` spanning all hosts' devices, so each device solves its slice of the
+restarts and the consensus reduction and output replication become XLA
+collectives riding ICI within a slice and DCN across slices — no job queue,
+no filesystem gather, no idle coordinator.
+
+Launch on each host (or let the TPU runtime infer everything)::
+
+    import nmfx.distributed as dist
+    dist.initialize()                    # jax.distributed — env-driven
+    result = dist.consensus(data, ks=range(2, 11), restarts=400)
+
+Every host returns the identical ``ConsensusResult`` (outputs are
+constrained replicated inside jit — see ``sweep._build_sweep_fn``); host-side
+steps (cophenetic rank selection, file writes) are therefore pure replays,
+and only ``is_coordinator()`` should write files.
+
+Single-process runs degenerate cleanly: ``global_mesh()`` is then just the
+local-device mesh and no DCN traffic exists — which is how the multi-device
+CPU tests exercise this exact code path (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from nmfx.sweep import RESTART_AXIS
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Bring up the jax.distributed runtime. Call before any other JAX use.
+
+    On Cloud TPU pods / SLURM all three arguments are inferred from the
+    environment; elsewhere pass them explicitly (the analogue of a BatchJobs
+    site config, minus the filesystem registry). Idempotent: a second call is
+    a no-op. With no arguments in a plain single-process environment (no
+    cluster metadata to auto-detect), this degenerates to a no-op so the same
+    script runs unmodified on a laptop.
+
+    NOTE: must run before the XLA backend initializes — do not call
+    ``jax.devices()``/``jax.process_count()`` (or run any computation) first.
+    """
+    if jax.distributed.is_initialized():
+        return
+    explicit = {k: v for k, v in (
+        ("coordinator_address", coordinator_address),
+        ("num_processes", num_processes),
+        ("process_id", process_id)) if v is not None}
+    if explicit:
+        jax.distributed.initialize(**explicit)
+        return
+    try:
+        jax.distributed.initialize()  # env/cluster auto-detection
+    except (ValueError, RuntimeError):
+        return  # no cluster environment: single-process degenerate path
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def global_mesh() -> Mesh:
+    """1-D mesh over every device in the job (all hosts), restart axis.
+
+    ``jax.devices()`` is the *global* device list under multi-process JAX,
+    so jitting with this mesh is the cross-host SPMD program; with one
+    process it equals the local mesh.
+    """
+    return Mesh(np.array(jax.devices()), (RESTART_AXIS,))
+
+
+def consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, **kwargs):
+    """``nmfx.api.nmfconsensus`` over the global mesh.
+
+    File/plot outputs (``output=``, ``checkpoint_dir=``) are only honored on
+    the coordinator so hosts sharing a filesystem don't race on the same
+    paths; the returned in-memory result is identical on every host.
+    """
+    from nmfx.api import nmfconsensus
+
+    if not is_coordinator():
+        kwargs = dict(kwargs, output=None, checkpoint_dir=None)
+    return nmfconsensus(data, ks=ks, restarts=restarts, mesh=global_mesh(),
+                        **kwargs)

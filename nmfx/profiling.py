@@ -1,0 +1,115 @@
+"""Phase timing and device tracing.
+
+The reference instruments by recompiling: ``PROFILE_*`` macros (all shipped
+commented out, reference ``libnmf/include/common.h:27-45``) bracket each C
+routine with ``gettimeofday`` and print µs via ``outputTiming`` (reference
+``libnmf/outputtiming.c:27-35``); the R layer has only ``system.time``
+(reference ``test_nmf.r:27``). Here profiling is a runtime flag:
+
+* ``Profiler.phase(name)`` — wall-clock per pipeline phase, with
+  ``jax.block_until_ready`` on whatever the phase returns so async dispatch
+  can't hide device time in a later phase.
+* ``Profiler(trace_dir=...)`` — additionally captures a ``jax.profiler``
+  device trace (XLA op-level, viewable in TensorBoard/Perfetto) for the
+  wrapped region.
+
+Enabled from the CLI with ``--profile [--trace-dir D]``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any
+
+import jax
+
+
+class PhaseRecord:
+    __slots__ = ("name", "seconds", "count")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.seconds = 0.0
+        self.count = 0
+
+
+class Profiler:
+    """Accumulates per-phase wall-clock; optionally wraps a device trace."""
+
+    def __init__(self, trace_dir: str | None = None):
+        self.trace_dir = trace_dir
+        self.phases: dict[str, PhaseRecord] = {}
+        self._t0: float | None = None
+        self._t_total: float | None = None
+
+    # -- region ------------------------------------------------------------
+    def __enter__(self) -> "Profiler":
+        self._t0 = time.perf_counter()
+        if self.trace_dir is not None:
+            jax.profiler.start_trace(self.trace_dir)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.trace_dir is not None:
+            jax.profiler.stop_trace()
+        self._t_total = time.perf_counter() - self._t0
+
+    # -- phases ------------------------------------------------------------
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time one phase; call the yielded function on the phase's result
+        (or any array pytree) to block on device completion before the
+        timer stops — otherwise JAX's async dispatch attributes device time
+        to whichever later phase first touches the values."""
+        rec = self.phases.setdefault(name, PhaseRecord(name))
+        sync_target: list[Any] = []
+
+        def sync(x):
+            sync_target.append(x)
+            return x
+
+        t0 = time.perf_counter()
+        try:
+            yield sync
+        finally:
+            for x in sync_target:
+                jax.block_until_ready(x)
+            rec.seconds += time.perf_counter() - t0
+            rec.count += 1
+
+    # -- reporting ---------------------------------------------------------
+    def total_seconds(self) -> float:
+        if self._t_total is not None:
+            return self._t_total
+        return sum(r.seconds for r in self.phases.values())
+
+    def report(self) -> str:
+        total = self.total_seconds()
+        lines = [f"{'phase':<28}{'calls':>6}{'seconds':>10}{'share':>8}"]
+        for rec in sorted(self.phases.values(), key=lambda r: -r.seconds):
+            share = rec.seconds / total if total > 0 else 0.0
+            lines.append(f"{rec.name:<28}{rec.count:>6}{rec.seconds:>10.3f}"
+                         f"{share:>7.1%}")
+        lines.append(f"{'total':<28}{'':>6}{total:>10.3f}{'':>8}")
+        if self.trace_dir is not None:
+            lines.append(f"device trace written to {self.trace_dir} "
+                         "(tensorboard --logdir, or load in Perfetto)")
+        return "\n".join(lines)
+
+
+class NullProfiler(Profiler):
+    """No-op drop-in so call sites need no ``if profiler`` branching."""
+
+    def __enter__(self) -> "NullProfiler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        yield lambda x: x
+
+    def report(self) -> str:
+        return "profiling disabled"

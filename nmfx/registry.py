@@ -1,0 +1,109 @@
+"""Sweep checkpoint/resume registry.
+
+The reference gets crash restartability for free from its BatchJobs
+filesystem registry — every job's (W, H, iter) result is persisted as a
+serialized file under ``file.dir`` (reference ``nmf.r:63``, SURVEY.md §2c) —
+but never exploits it: ``runNMFinJobs`` is fire-and-wait (reference
+``nmf.r:112-113``). Here the same durability exists at the natural TPU
+granularity, the per-rank reduced result (SURVEY.md §5: "per-(k,seed-block)
+result checkpointing gives the same restartability"): after each rank k
+finishes, its ``KSweepOutput`` is written as one ``.npz``; a re-run of the
+same sweep loads finished ranks instead of recomputing them.
+
+A fingerprint of everything that determines the numbers — data, solver and
+init configs, restart count, seed, label rule — guards the cache: a registry
+written under one configuration refuses to serve another (the reference's
+registry has no such guard; a stale ``file.dir`` silently mixes runs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import numpy as np
+
+_META_NAME = "registry.json"
+_FORMAT_VERSION = 1
+
+
+def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
+                 seed: int, label_rule: str) -> str:
+    """Hash of every input that affects sweep numerics."""
+    h = hashlib.sha256()
+    arr = np.ascontiguousarray(np.asarray(a))
+    h.update(str(arr.shape).encode())
+    h.update(str(arr.dtype).encode())
+    h.update(arr.tobytes())
+    payload = {
+        "solver": dataclasses.asdict(solver_cfg),
+        "init": dataclasses.asdict(init_cfg),
+        "restarts": restarts,
+        "seed": seed,
+        "label_rule": label_rule,
+        "format": _FORMAT_VERSION,
+    }
+    h.update(json.dumps(payload, sort_keys=True).encode())
+    return h.hexdigest()
+
+
+class SweepRegistry:
+    """Directory of per-rank sweep results, keyed by a config fingerprint."""
+
+    def __init__(self, directory: str, fingerprint: str):
+        self.directory = directory
+        self.fingerprint = fingerprint
+        os.makedirs(directory, exist_ok=True)
+        meta_path = os.path.join(directory, _META_NAME)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("fingerprint") != fingerprint:
+                raise ValueError(
+                    f"registry at {directory!r} was written for a different "
+                    "(data, config, seed) combination — refusing to mix "
+                    "results; point checkpoint_dir at a fresh directory")
+        else:
+            with open(meta_path, "wt") as f:
+                json.dump({"fingerprint": fingerprint,
+                           "format": _FORMAT_VERSION}, f)
+
+    @classmethod
+    def open(cls, directory: str, a, solver_cfg, init_cfg,
+             restarts: int, seed: int, label_rule: str) -> "SweepRegistry":
+        return cls(directory, _fingerprint(a, solver_cfg, init_cfg,
+                                           restarts, seed, label_rule))
+
+    def _path(self, k: int) -> str:
+        return os.path.join(self.directory, f"k{k}.npz")
+
+    def completed_ks(self) -> list[int]:
+        ks = []
+        for name in os.listdir(self.directory):
+            if name.startswith("k") and name.endswith(".npz"):
+                try:
+                    ks.append(int(name[1:-4]))
+                except ValueError:
+                    continue
+        return sorted(ks)
+
+    def has(self, k: int) -> bool:
+        return os.path.exists(self._path(k))
+
+    def save(self, k: int, out) -> None:
+        """Persist one rank's KSweepOutput atomically (write + rename, so a
+        crash mid-write never leaves a half-result that resume would trust)."""
+        path = self._path(k)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:  # file handle: savez won't append ".npz"
+            np.savez(f, **{n: np.asarray(v) for n, v in zip(out._fields, out)})
+        os.replace(tmp, path)
+
+    def load(self, k: int):
+        """Load one rank's result as a KSweepOutput of host numpy arrays."""
+        from nmfx.sweep import KSweepOutput
+
+        with np.load(self._path(k)) as z:
+            return KSweepOutput(**{f: z[f] for f in KSweepOutput._fields})

@@ -67,10 +67,20 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         labels = labels[:restarts]  # drop padding lanes before the reduction
         cons = consensus_matrix(labels, k)
         best = jnp.argmin(res.dnorm[:restarts])
-        return KSweepOutput(cons, res.iterations[:restarts],
-                            res.dnorm[:restarts],
-                            res.stop_reason[:restarts], labels,
-                            res.w[best], res.h[best])
+        out = KSweepOutput(cons, res.iterations[:restarts],
+                           res.dnorm[:restarts],
+                           res.stop_reason[:restarts], labels,
+                           res.w[best], res.h[best])
+        if mesh is not None and RESTART_AXIS in mesh.axis_names:
+            # replicate every output across the mesh (XLA all_gathers over
+            # ICI/DCN): under multi-process execution this makes each field
+            # fully addressable on every host, so the host-side pipeline
+            # (rank selection, checkpointing, file outputs) needs no
+            # process-level gather — the collective rode the interconnect
+            rep = NamedSharding(mesh, P())
+            out = jax.tree.map(
+                lambda x: lax.with_sharding_constraint(x, rep), out)
+        return out
 
     return jax.jit(impl)
 
@@ -89,19 +99,73 @@ def sweep_one_k(a, key, k: int, restarts: int,
 def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
           solver_cfg: SolverConfig = SolverConfig(),
           init_cfg: InitConfig = InitConfig(),
-          mesh: Mesh | None = None) -> dict[int, KSweepOutput]:
+          mesh: Mesh | None = None,
+          registry=None, profiler=None) -> dict[int, KSweepOutput]:
     """Full (k × restart) grid. k values run sequentially (their shapes
     differ); each k uses every device via the sharded restart batch —
-    the TPU analogue of the reference's shuffled job chunks (nmf.r:111)."""
+    the TPU analogue of the reference's shuffled job chunks (nmf.r:111).
+
+    With a ``registry`` (nmfx.registry.SweepRegistry), each finished rank is
+    checkpointed and a re-run resumes from the completed ranks instead of
+    recomputing them (SURVEY.md §5 checkpoint/resume)."""
+    if profiler is None:
+        from nmfx.profiling import NullProfiler
+
+        profiler = NullProfiler()
+    # Multi-host discipline: every process must take the same compute-vs-skip
+    # branch for each k, or the skippers never join the collectives compiled
+    # into the sharded sweep and the job deadlocks. The coordinator (the only
+    # process expected to hold a registry — see distributed.consensus) decides
+    # and broadcasts; loaded results are broadcast to the other hosts.
+    multi = jax.process_count() > 1
     root = jax.random.key(cfg.seed)
     out: dict[int, KSweepOutput] = {}
     for k in cfg.ks:
+        have = registry is not None and registry.has(k)
+        if multi:
+            from jax.experimental import multihost_utils
+
+            have = bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(have)))
+        if have:
+            loaded = (registry.load(k)
+                      if registry is not None and registry.has(k)
+                      else _template(a, k, cfg.restarts, solver_cfg))
+            if multi:
+                loaded = KSweepOutput(*(
+                    np.asarray(x) for x in
+                    multihost_utils.broadcast_one_to_all(tuple(loaded))))
+            out[k] = loaded
+            continue
         # fold in k itself (not its position) so a given (seed, k) always
         # yields the same factorizations regardless of sweep composition
         key = jax.random.fold_in(root, k)
-        out[k] = sweep_one_k(a, key, k, cfg.restarts, solver_cfg, init_cfg,
-                             cfg.label_rule, mesh)
+        with profiler.phase(f"solve.k={k}") as sync:
+            out[k] = sync(sweep_one_k(a, key, k, cfg.restarts, solver_cfg,
+                                      init_cfg, cfg.label_rule, mesh))
+        if registry is not None and (not multi or jax.process_index() == 0):
+            with profiler.phase("checkpoint"):
+                registry.save(k, out[k])
     return out
+
+
+def _template(a, k: int, restarts: int,
+              solver_cfg: SolverConfig) -> KSweepOutput:
+    """Zero-valued KSweepOutput with the exact shapes/dtypes sweep_one_k
+    produces — the broadcast skeleton a registry-less host contributes when
+    the coordinator resumes a rank from checkpoint (structures must match on
+    every process for broadcast_one_to_all)."""
+    m, n = np.asarray(a).shape
+    f = jnp.dtype(solver_cfg.dtype)
+    return KSweepOutput(
+        consensus=np.zeros((n, n), np.float32),
+        iterations=np.zeros((restarts,), np.int32),
+        dnorms=np.zeros((restarts,), f),
+        stop_reasons=np.zeros((restarts,), np.int32),
+        labels=np.zeros((restarts, n), np.int32),
+        best_w=np.zeros((m, k), f),
+        best_h=np.zeros((k, n), f),
+    )
 
 
 def default_mesh() -> Mesh | None:

@@ -1,0 +1,67 @@
+"""Multi-host path tests on the 8-device virtual CPU platform.
+
+Single-process degenerate execution of the exact SPMD code multi-host runs
+(SURVEY.md §4's multi-device test plan): the global mesh spans all 8 virtual
+devices, restart sharding + replicated outputs compile and execute, and the
+sharded result matches the unsharded one bit-for-bit (same keys, same math,
+different device placement only).
+"""
+
+import jax
+import numpy as np
+
+from nmfx import distributed as dist
+from nmfx.config import SolverConfig
+from nmfx.sweep import RESTART_AXIS, sweep_one_k
+
+
+def test_global_mesh_covers_all_devices():
+    mesh = dist.global_mesh()
+    assert mesh.shape[RESTART_AXIS] == len(jax.devices()) == 8
+
+
+def test_initialize_single_process_noop():
+    dist.initialize()  # must not raise or try to reach a coordinator
+    assert dist.is_coordinator()
+
+
+def test_outputs_replicated_and_addressable(two_group_data):
+    cfg = SolverConfig(algorithm="mu", max_iter=40)
+    out = sweep_one_k(two_group_data, jax.random.key(0), k=2, restarts=16,
+                      solver_cfg=cfg, mesh=dist.global_mesh())
+    for name, x in zip(out._fields, out):
+        assert x.sharding.is_fully_replicated, name
+        np.asarray(x)  # fully addressable on this (every) host
+
+
+def test_global_mesh_matches_single_device(two_group_data):
+    cfg = SolverConfig(algorithm="mu", max_iter=40)
+    plain = sweep_one_k(two_group_data, jax.random.key(3), k=3, restarts=16,
+                        solver_cfg=cfg, mesh=None)
+    meshed = sweep_one_k(two_group_data, jax.random.key(3), k=3, restarts=16,
+                         solver_cfg=cfg, mesh=dist.global_mesh())
+    np.testing.assert_allclose(np.asarray(plain.consensus),
+                               np.asarray(meshed.consensus), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(plain.labels),
+                                  np.asarray(meshed.labels))
+
+
+def test_template_matches_real_output(two_group_data):
+    """The broadcast skeleton must mirror sweep_one_k's structure exactly,
+    or multi-host resume would die in broadcast_one_to_all."""
+    from nmfx.sweep import _template
+
+    cfg = SolverConfig(algorithm="mu", max_iter=20)
+    real = sweep_one_k(two_group_data, jax.random.key(0), k=3, restarts=5,
+                       solver_cfg=cfg)
+    tmpl = _template(two_group_data, k=3, restarts=5, solver_cfg=cfg)
+    for name, r, t in zip(real._fields, real, tmpl):
+        assert np.asarray(r).shape == t.shape, name
+        assert np.asarray(r).dtype == t.dtype, name
+
+
+def test_distributed_consensus_end_to_end(two_group_data, tmp_path):
+    res = dist.consensus(two_group_data, ks=(2, 3), restarts=8, max_iter=40,
+                         seed=11)
+    assert res.best_k == 2  # two planted groups
+    assert set(res.per_k) == {2, 3}

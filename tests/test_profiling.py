@@ -1,0 +1,66 @@
+"""Profiling subsystem tests (SURVEY.md §5: runtime flag replacing the
+reference's compile-time PROFILE_* macros, libnmf common.h:27-45)."""
+
+import jax.numpy as jnp
+
+from nmfx.api import nmfconsensus
+from nmfx.profiling import NullProfiler, Profiler
+
+
+def test_phase_accumulation():
+    prof = Profiler()
+    with prof:
+        with prof.phase("a") as sync:
+            sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        with prof.phase("a"):
+            pass
+        with prof.phase("b"):
+            pass
+    assert prof.phases["a"].count == 2
+    assert prof.phases["b"].count == 1
+    assert prof.total_seconds() > 0
+    report = prof.report()
+    assert "a" in report and "b" in report and "total" in report
+
+
+def test_phase_records_on_exception():
+    prof = Profiler()
+    try:
+        with prof.phase("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert prof.phases["boom"].count == 1
+
+
+def test_pipeline_with_profiler(two_group_data):
+    prof = Profiler()
+    with prof:
+        nmfconsensus(two_group_data, ks=(2,), restarts=2, max_iter=40,
+                     use_mesh=False, profiler=prof)
+    assert "solve.k=2" in prof.phases
+    assert "rank_selection" in prof.phases
+    assert prof.phases["solve.k=2"].seconds > 0
+
+
+def test_null_profiler_is_transparent(two_group_data):
+    prof = NullProfiler()
+    with prof:
+        r = nmfconsensus(two_group_data, ks=(2,), restarts=2, max_iter=40,
+                         use_mesh=False, profiler=prof)
+    assert r.per_k[2].consensus.shape[0] == two_group_data.shape[1]
+    assert prof.report() == "profiling disabled"
+
+
+def test_trace_capture(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    prof = Profiler(trace_dir=trace_dir)
+    with prof:
+        with prof.phase("mm") as sync:
+            sync(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
+    import os
+
+    # jax writes plugins/profile/<ts>/*.xplane.pb under the trace dir
+    found = [f for _, _, fs in os.walk(trace_dir) for f in fs]
+    assert any(f.endswith(".pb") or f.endswith(".json.gz") for f in found)
+    assert "device trace" in prof.report()

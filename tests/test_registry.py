@@ -1,0 +1,73 @@
+"""Checkpoint/resume registry tests (SURVEY.md §5: the restartability the
+reference's BatchJobs registry provides but never exploits, nmf.r:112-113)."""
+
+import numpy as np
+import pytest
+
+from nmfx.api import nmfconsensus
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.registry import SweepRegistry
+from nmfx.sweep import sweep_one_k
+
+
+SCFG = SolverConfig(algorithm="mu", max_iter=60)
+ICFG = InitConfig()
+
+
+def _open(tmp_path, a, **kw):
+    args = dict(a=a, solver_cfg=SCFG, init_cfg=ICFG, restarts=4, seed=1,
+                label_rule="argmax")
+    args.update(kw)
+    return SweepRegistry.open(str(tmp_path), **args)
+
+
+def test_save_load_roundtrip(tmp_path, two_group_data):
+    import jax
+
+    reg = _open(tmp_path / "reg", two_group_data)
+    out = sweep_one_k(two_group_data, jax.random.key(0), k=2, restarts=4,
+                      solver_cfg=SCFG)
+    assert not reg.has(2)
+    reg.save(2, out)
+    assert reg.has(2)
+    assert reg.completed_ks() == [2]
+    loaded = reg.load(2)
+    for name, orig, back in zip(out._fields, out, loaded):
+        np.testing.assert_array_equal(np.asarray(orig), back, err_msg=name)
+
+
+def test_fingerprint_guard(tmp_path, two_group_data):
+    _open(tmp_path / "reg", two_group_data)
+    # same dir, different seed -> refuse
+    with pytest.raises(ValueError, match="different"):
+        _open(tmp_path / "reg", two_group_data, seed=2)
+    # same everything -> reopen fine
+    _open(tmp_path / "reg", two_group_data)
+
+
+def test_nmfconsensus_resume(tmp_path, two_group_data):
+    ckpt = str(tmp_path / "ckpt")
+    r1 = nmfconsensus(two_group_data, ks=(2, 3), restarts=3, seed=5,
+                      max_iter=60, use_mesh=False, checkpoint_dir=ckpt)
+    # second run resumes entirely from disk and reproduces the result
+    r2 = nmfconsensus(two_group_data, ks=(2, 3), restarts=3, seed=5,
+                      max_iter=60, use_mesh=False, checkpoint_dir=ckpt)
+    for k in (2, 3):
+        np.testing.assert_array_equal(r1.per_k[k].consensus,
+                                      r2.per_k[k].consensus)
+        assert r1.per_k[k].rho == r2.per_k[k].rho
+    # widening the sweep reuses finished ranks and computes only the new one
+    r3 = nmfconsensus(two_group_data, ks=(2, 3, 4), restarts=3, seed=5,
+                      max_iter=60, use_mesh=False, checkpoint_dir=ckpt)
+    np.testing.assert_array_equal(r3.per_k[2].consensus, r1.per_k[2].consensus)
+    assert set(r3.per_k) == {2, 3, 4}
+
+
+def test_checkpoint_matches_uncheckpointed(tmp_path, two_group_data):
+    plain = nmfconsensus(two_group_data, ks=(2,), restarts=3, seed=9,
+                         max_iter=60, use_mesh=False)
+    ckpt = nmfconsensus(two_group_data, ks=(2,), restarts=3, seed=9,
+                        max_iter=60, use_mesh=False,
+                        checkpoint_dir=str(tmp_path / "c"))
+    np.testing.assert_allclose(plain.per_k[2].consensus,
+                               ckpt.per_k[2].consensus)
