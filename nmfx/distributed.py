@@ -61,8 +61,46 @@ def initialize(coordinator_address: str | None = None,
         return
     try:
         jax.distributed.initialize()  # env/cluster auto-detection
-    except (ValueError, RuntimeError):
-        return  # no cluster environment: single-process degenerate path
+    except ValueError:
+        # no coordinator address detectable ⇒ genuinely not a cluster job;
+        # degenerate to single-process. Connection failures (RuntimeError)
+        # must propagate — swallowing one would leave every host believing
+        # it is process 0, redundantly computing the sweep and racing on
+        # coordinator-only file writes.
+        return
+    except RuntimeError:
+        if not _cluster_env_detected():
+            # single-process program that touched JAX before calling us
+            # (the "must be called before any JAX calls" case) — with no
+            # cluster environment, distribution was never possible; no-op
+            return
+        # inside a real multi-process job every failure mode here (late
+        # call, unreachable coordinator, ...) would otherwise make every
+        # host act as coordinator — always fatal
+        raise
+
+
+def _cluster_env_detected() -> bool:
+    """Best-effort: does the environment look like a multi-process job?
+
+    Mirrors the markers jax.distributed auto-detection keys off (explicit
+    coordinator, SLURM/Open MPI/PMI world sizes, multi-worker Cloud TPU).
+    """
+    import os
+
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h]) > 1:
+        return True
+    for var in ("SLURM_NTASKS", "SLURM_NPROCS", "OMPI_COMM_WORLD_SIZE",
+                "PMI_SIZE"):
+        try:
+            if int(os.environ.get(var, "")) > 1:
+                return True
+        except ValueError:
+            continue
+    return False
 
 
 def is_coordinator() -> bool:

@@ -58,17 +58,26 @@ class SweepRegistry:
         os.makedirs(directory, exist_ok=True)
         meta_path = os.path.join(directory, _META_NAME)
         if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                meta = json.load(f)
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            except (json.JSONDecodeError, OSError) as e:
+                raise ValueError(
+                    f"registry metadata at {meta_path!r} is unreadable "
+                    f"({e}) — the directory is corrupt; delete it (or point "
+                    "checkpoint_dir at a fresh directory) to start over") \
+                    from e
             if meta.get("fingerprint") != fingerprint:
                 raise ValueError(
                     f"registry at {directory!r} was written for a different "
                     "(data, config, seed) combination — refusing to mix "
                     "results; point checkpoint_dir at a fresh directory")
         else:
-            with open(meta_path, "wt") as f:
+            tmp = meta_path + ".tmp"
+            with open(tmp, "wt") as f:
                 json.dump({"fingerprint": fingerprint,
                            "format": _FORMAT_VERSION}, f)
+            os.replace(tmp, meta_path)
 
     @classmethod
     def open(cls, directory: str, a, solver_cfg, init_cfg,
