@@ -78,8 +78,22 @@ class SolverConfig:
     #: ~2.6x slower per iteration but stabilizes class labels in ~3x fewer
     #: iterations — matmul noise resets the stability counter)
     matmul_precision: str = "default"
+    #: restart-batch execution strategy for the sweep layer:
+    #: "auto" picks the restart-packed GEMM formulation (nmfx.ops.packed_mu)
+    #: where it exists (mu), else the vmapped generic driver; "packed" forces
+    #: it (error for other algorithms); "vmap" forces the generic driver.
+    #: Measured ~3.5x faster per iteration at k=10 on the north-star config.
+    backend: str = "auto"
 
     def __post_init__(self):
+        if self.backend not in ("auto", "vmap", "packed"):
+            raise ValueError(
+                f"backend must be 'auto', 'vmap' or 'packed', got "
+                f"{self.backend!r}")
+        if self.backend == "packed" and self.algorithm != "mu":
+            raise ValueError(
+                "backend='packed' is only implemented for algorithm='mu'; "
+                "use 'auto' to fall back per algorithm")
         if self.algorithm not in ALGORITHMS:
             raise ValueError(
                 f"algorithm must be one of {ALGORITHMS}, got {self.algorithm!r}"

@@ -1,0 +1,7 @@
+"""TPU-shaped compute kernels.
+
+Modules here restructure the framework's hot loops for the MXU/VMEM rather
+than expressing them per-restart: ``packed_mu`` lays the whole restart batch
+out as one set of large GEMMs; ``pallas_mu`` lowers the same iteration to a
+hand-scheduled Pallas kernel.
+"""

@@ -31,14 +31,24 @@ _FORMAT_VERSION = 1
 
 def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
                  seed: int, label_rule: str) -> str:
-    """Hash of every input that affects sweep numerics."""
+    """Hash of every input that affects sweep numerics.
+
+    The execution-strategy knob ``backend`` is hashed by its *resolved*
+    value ("auto" picks a concrete path per algorithm), since packed and
+    vmapped execution group matmul reductions differently and are therefore
+    not bit-identical — but "auto" vs an explicit equivalent choice is.
+    """
+    from nmfx.sweep import _use_packed
+
     h = hashlib.sha256()
     arr = np.ascontiguousarray(np.asarray(a))
     h.update(str(arr.shape).encode())
     h.update(str(arr.dtype).encode())
     h.update(arr.tobytes())
+    solver = dataclasses.asdict(solver_cfg)
+    solver["backend"] = "packed" if _use_packed(solver_cfg) else "vmap"
     payload = {
-        "solver": dataclasses.asdict(solver_cfg),
+        "solver": solver,
         "init": dataclasses.asdict(init_cfg),
         "restarts": restarts,
         "seed": seed,
@@ -70,7 +80,8 @@ class SweepRegistry:
             if meta.get("fingerprint") != fingerprint:
                 raise ValueError(
                     f"registry at {directory!r} was written for a different "
-                    "(data, config, seed) combination — refusing to mix "
+                    "(data, config, seed) combination — or by an older nmfx "
+                    "whose fingerprint scheme differs. Refusing to mix "
                     "results; point checkpoint_dir at a fresh directory")
         else:
             tmp = meta_path + ".tmp"
