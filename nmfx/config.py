@@ -81,19 +81,21 @@ class SolverConfig:
     #: restart-batch execution strategy for the sweep layer:
     #: "auto" picks the restart-packed GEMM formulation (nmfx.ops.packed_mu)
     #: where it exists (mu), else the vmapped generic driver; "packed" forces
-    #: it (error for other algorithms); "vmap" forces the generic driver.
-    #: Measured ~3.5x faster per iteration at k=10 on the north-star config.
+    #: it (error for other algorithms); "pallas" runs the packed iteration
+    #: through the fused Pallas TPU kernels (nmfx.ops.pallas_mu); "vmap"
+    #: forces the generic driver. Measured ~3.5x faster per iteration at
+    #: k=10 on the north-star config (packed vs vmap).
     backend: str = "auto"
 
     def __post_init__(self):
-        if self.backend not in ("auto", "vmap", "packed"):
+        if self.backend not in ("auto", "vmap", "packed", "pallas"):
             raise ValueError(
-                f"backend must be 'auto', 'vmap' or 'packed', got "
-                f"{self.backend!r}")
-        if self.backend == "packed" and self.algorithm != "mu":
+                f"backend must be 'auto', 'vmap', 'packed' or 'pallas', "
+                f"got {self.backend!r}")
+        if self.backend in ("packed", "pallas") and self.algorithm != "mu":
             raise ValueError(
-                "backend='packed' is only implemented for algorithm='mu'; "
-                "use 'auto' to fall back per algorithm")
+                f"backend={self.backend!r} is only implemented for "
+                "algorithm='mu'; use 'auto' to fall back per algorithm")
         if self.algorithm not in ALGORITHMS:
             raise ValueError(
                 f"algorithm must be one of {ALGORITHMS}, got {self.algorithm!r}"

@@ -99,8 +99,7 @@ def _block_sums(x: jax.Array, r: int) -> jax.Array:
 def _diag_blocks(g: jax.Array, r: int) -> jax.Array:
     """(R·k, R·k) full Gram → (R, k, k) diagonal blocks."""
     k = g.shape[0] // r
-    return jnp.einsum("rksl,rs->rkl", g.reshape(r, k, r, k),
-                      jnp.eye(r, dtype=g.dtype))
+    return jnp.einsum("rkrl->rkl", g.reshape(r, k, r, k))
 
 
 def residual_norms(a: jax.Array, wp: jax.Array, hp: jax.Array,
@@ -127,24 +126,40 @@ def _labels(hp: jax.Array, r: int) -> jax.Array:
 
 
 def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
-          check: bool) -> PackedState:
+          check: bool, use_pallas: bool = False, block_m: int = 512,
+          interpret: bool = False) -> PackedState:
     m, n = a.shape
     k = state.hp.shape[0] // r
     wp0, hp0 = state.wp, state.hp
     it = state.iteration + 1
 
-    # H update — numerator GEMM plus the full W-Gram (cross-restart blocks
-    # masked off; see module docstring for the FLOP/utilization trade)
-    numerh = wp0.T @ a  # (R·k, n)
-    gw = wp0.T @ wp0  # (R·k, R·k)
-    denomh = (gw * bd) @ hp0
-    hp = _mu_update(hp0, numerh, denomh, cfg)
+    if use_pallas:
+        # fused kernels (nmfx.ops.pallas_mu): numerators, Grams, and
+        # epilogues never leave VMEM; only the updated factors hit HBM
+        from nmfx.ops.pallas_mu import fused_h_update, fused_w_update
 
-    # W update with the fresh H (reference order, nmf_mu.c:198-216)
-    gh = (hp @ hp.T) * bd
-    numerw = a @ hp.T
-    denomw = wp0 @ gh
-    wp = _mu_update(wp0, numerw, denomw, cfg)
+        hp = fused_h_update(
+            a, wp0, hp0, k=k, block_m=block_m, eps=cfg.div_eps,
+            zero_threshold=cfg.zero_threshold,
+            matmul_precision=cfg.matmul_precision, interpret=interpret)
+        gh = (hp @ hp.T) * bd  # tiny; stays in XLA
+        wp = fused_w_update(
+            a, wp0, hp, gh, block_m=block_m, eps=cfg.div_eps,
+            zero_threshold=cfg.zero_threshold,
+            matmul_precision=cfg.matmul_precision, interpret=interpret)
+    else:
+        # H update — numerator GEMM plus the full W-Gram (cross-restart
+        # blocks masked off; see module docstring for the FLOP trade)
+        numerh = wp0.T @ a  # (R·k, n)
+        gw = wp0.T @ wp0  # (R·k, R·k)
+        denomh = (gw * bd) @ hp0
+        hp = _mu_update(hp0, numerh, denomh, cfg)
+
+        # W update with the fresh H (reference order, nmf_mu.c:198-216)
+        gh = (hp @ hp.T) * bd
+        numerw = a @ hp.T
+        denomw = wp0 @ gh
+        wp = _mu_update(wp0, numerw, denomw, cfg)
 
     # freeze converged restarts (the vmapped while_loop does this masking
     # implicitly; here the restart axis lives inside one GEMM, so explicitly)
@@ -227,10 +242,30 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
     a = jnp.asarray(a, dtype)
     w0s = jnp.asarray(w0s, dtype)
     h0s = jnp.asarray(h0s, dtype)
-    r, _, k = w0s.shape
+    r, m, k = w0s.shape
     n = h0s.shape[2]
+    a_true = a  # unpadded, for the final residuals
+    use_pallas = cfg.backend == "pallas"
+    block_m = 512
+    interpret = False
+    if use_pallas:
+        # the fused kernels stream A/Wp in m-tiles; pad m up to the tile
+        # size (zero rows are invariant under the MU epilogue's exact-zero
+        # short-circuit and contribute nothing to numerators or Grams).
+        # Mosaic masks the unaligned n and R·k dims itself. The tile count
+        # is fixed first so block_m shrinks to fit m (padding stays < one
+        # sublane row per tile instead of up to a whole 512-row tile).
+        ceil_div = lambda x, d: -(-x // d)
+        tiles = ceil_div(m, 512)
+        block_m = ceil_div(ceil_div(m, tiles), 8) * 8
+        m_pad = tiles * block_m
+        if m_pad != m:
+            a = jnp.pad(a, ((0, m_pad - m), (0, 0)))
+        interpret = jax.default_backend() != "tpu"
     with base.matmul_precision_ctx(cfg.matmul_precision):
         wp, hp = pack(w0s, h0s)
+        if use_pallas and a.shape[0] != m:
+            wp = jnp.pad(wp, ((0, a.shape[0] - m), (0, 0)))
         bd = block_diag_mask(r, k, dtype)
         def vary(x):
             for ax in varying_axes:
@@ -247,7 +282,8 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
             stop_reason=vary(jnp.full((r,), base.StopReason.MAX_ITER,
                                       jnp.int32)),
         )
-        step = partial(_step, a, bd)
+        step = partial(_step, a, bd, use_pallas=use_pallas,
+                       block_m=block_m, interpret=interpret)
 
         def cond(s: PackedState):
             return jnp.any(~s.done) & (s.iteration + cfg.check_every
@@ -267,7 +303,8 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
                                lambda s: step(s, cfg, r, check=True), final)
 
         iterations = jnp.where(final.done, final.done_iter, final.iteration)
-        dnorm = residual_norms(a, final.wp, final.hp, r)
-    return PackedMUResult(wp=final.wp, hp=final.hp,
+        wp_final = final.wp[:m]  # drop pallas m-padding rows, if any
+        dnorm = residual_norms(a_true, wp_final, final.hp, r)
+    return PackedMUResult(wp=wp_final, hp=final.hp,
                           iterations=iterations.astype(jnp.int32),
                           dnorm=dnorm, stop_reason=final.stop_reason)

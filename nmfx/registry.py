@@ -46,7 +46,8 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     h.update(str(arr.dtype).encode())
     h.update(arr.tobytes())
     solver = dataclasses.asdict(solver_cfg)
-    solver["backend"] = "packed" if _use_packed(solver_cfg) else "vmap"
+    if solver_cfg.backend != "pallas":  # pallas is already concrete
+        solver["backend"] = "packed" if _use_packed(solver_cfg) else "vmap"
     payload = {
         "solver": solver,
         "init": dataclasses.asdict(init_cfg),

@@ -49,7 +49,7 @@ def _pad_count(restarts: int, mesh: Mesh | None) -> int:
 
 def _use_packed(solver_cfg: SolverConfig) -> bool:
     return (solver_cfg.algorithm == "mu"
-            and solver_cfg.backend in ("auto", "packed"))
+            and solver_cfg.backend in ("auto", "packed", "pallas"))
 
 
 @lru_cache(maxsize=64)

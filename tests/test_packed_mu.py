@@ -138,3 +138,42 @@ def test_sweep_mesh_parity(two_group_data, restarts):
                                    np.asarray(getattr(got, f)),
                                    rtol=2e-4, atol=2e-5)
     assert np.asarray(got.consensus).shape[0] == two_group_data.shape[1]
+
+
+def test_pallas_backend_matches_packed(problem):
+    """backend='pallas' (interpret mode off-TPU) reproduces the packed
+    iteration: same convergence path and factors to matmul tolerance."""
+    a, w0s, h0s = problem
+    r = w0s.shape[0]
+    cfg_ref = SolverConfig(algorithm="mu", max_iter=40, stable_checks=5,
+                           backend="packed")
+    cfg_pl = SolverConfig(algorithm="mu", max_iter=40, stable_checks=5,
+                          backend="pallas")
+    ref = mu_packed(a, w0s, h0s, cfg_ref)
+    got = mu_packed(a, w0s, h0s, cfg_pl)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    np.testing.assert_allclose(np.asarray(unpack_w(ref.wp, r)),
+                               np.asarray(unpack_w(got.wp, r)),
+                               rtol=5e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ref.hp), np.asarray(got.hp),
+                               rtol=5e-3, atol=1e-4)
+
+
+def test_pallas_m_padding(problem):
+    """m not a multiple of the kernel tile: zero-row padding must be
+    invariant and invisible in the outputs."""
+    a, w0s, h0s = problem  # m=96 -> block_m=96? force an uneven tile
+    m = 70
+    a2 = a[:m]
+    w2 = w0s[:, :m, :]
+    cfg = SolverConfig(algorithm="mu", max_iter=30, backend="pallas")
+    got = mu_packed(a2, w2, h0s, cfg)
+    assert got.wp.shape[0] == m
+    ref = mu_packed(a2, w2, h0s,
+                    SolverConfig(algorithm="mu", max_iter=30,
+                                 backend="packed"))
+    np.testing.assert_allclose(np.asarray(got.hp), np.asarray(ref.hp),
+                               rtol=5e-3, atol=1e-4)
