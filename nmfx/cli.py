@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--precision", default="default",
                    choices=("default", "bfloat16", "highest"),
                    help="TPU matmul precision for solver dots")
+    p.add_argument("--backend", default="auto",
+                   choices=("auto", "vmap", "packed", "pallas"),
+                   help="restart-batch execution strategy (auto = packed "
+                        "GEMMs for mu, vmapped driver otherwise)")
     p.add_argument("--init", choices=INIT_METHODS, default="random")
     p.add_argument("--label-rule", choices=("argmax", "argmin"),
                    default="argmax",
@@ -74,6 +78,9 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     if args.trace_dir and not args.profile:
         parser.error("--trace-dir requires --profile")
+    if args.backend in ("packed", "pallas") and args.algorithm != "mu":
+        parser.error(f"--backend {args.backend} is only implemented for "
+                     "--algorithm mu (use auto)")
     from nmfx.api import nmfconsensus  # deferred: keeps --help fast
 
     output = None
@@ -93,7 +100,8 @@ def main(argv: list[str] | None = None) -> int:
             seed=args.seed,
             solver_cfg=SolverConfig(algorithm=args.algorithm,
                                     max_iter=args.maxiter,
-                                    matmul_precision=args.precision),
+                                    matmul_precision=args.precision,
+                                    backend=args.backend),
             init=args.init,
             label_rule=args.label_rule,
             use_mesh=not args.no_mesh,

@@ -1,23 +1,24 @@
-"""Alternating least squares NMF (QR-based).
+"""Alternating least squares NMF.
 
 TPU-native re-design of the reference's QR-with-column-pivoting ALS (reference
 ``libnmf/nmf_als.c:209-360``): each half-step solves the unconstrained least
 squares problem and clamps negatives to zero.
 
-    H = argmin ‖W·X − A‖_F   → QR(W), triangular solve, clamp
-    W = argmin ‖Xᵀ·H − A‖_F  → QR(Hᵀ), triangular solve, clamp
+    H = argmin ‖W·X − A‖_F   → min-norm least squares, clamp
+    W = argmin ‖Xᵀ·H − A‖_F  → min-norm least squares, clamp
 
 The reference pivots (dgeqp3) and un-permutes with strided dcopy
 (nmf_als.c:216-298) purely for rank-deficiency robustness; XLA has no pivoted
-QR, so we use plain QR — for rank-deficient W/H the NEALS fallback path is the
-supported route. Convergence: delta < TolX or relative residual decrease
-below TolFun, every 2nd iteration (nmf_als.c:338-352; see SolverConfig for the
-fixed dnorm0 ordering quirk).
+QR, so the half-steps use SVD-based minimum-norm least squares — strictly
+more robust than pivoting (a rank-deficient W/H yields the min-norm
+solution instead of a division by a zero R diagonal), one code path under
+vmap. Convergence: delta < TolX or relative residual decrease below TolFun,
+every 2nd iteration (nmf_als.c:338-352; see SolverConfig for the fixed
+dnorm0 ordering quirk).
 """
 
 from __future__ import annotations
 
-import jax.scipy.linalg as jsl
 import jax.numpy as jnp
 
 from nmfx.config import SolverConfig
@@ -28,18 +29,17 @@ def init_aux(a, w0, h0, cfg: SolverConfig):
     return ()
 
 
-def lstsq_qr(f, b):
-    """min_X ||f @ X - b||_F for tall f (m×k, m>=k) via QR."""
-    q, r = jnp.linalg.qr(f)
-    return jsl.solve_triangular(r, q.T @ b, lower=False)
+def lstsq_min_norm(f, b):
+    """min_X ||f @ X - b||_F, minimum-norm for rank-deficient f."""
+    return jnp.linalg.lstsq(f, b)[0]
 
 
 def step(a, state: base.State, cfg: SolverConfig,
          check: bool = True) -> base.State:
     w0 = state.w
-    h = base.clamp(lstsq_qr(w0, a), cfg.zero_threshold)
+    h = base.clamp(lstsq_min_norm(w0, a), cfg.zero_threshold)
     # W: solve min ||H.T @ X - A.T|| for X = W.T
-    wt = lstsq_qr(h.T, a.T)
+    wt = lstsq_min_norm(h.T, a.T)
     w = base.clamp(wt.T, cfg.zero_threshold)
     state = state._replace(w=w, h=h)
     if not check:

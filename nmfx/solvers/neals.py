@@ -5,11 +5,14 @@ TPU-native re-design of reference ``libnmf/nmf_neals.c:180-470``:
     H = max((WᵀW) \\ (WᵀA), 0)
     W = max(((HHᵀ) \\ (HAᵀ))ᵀ, 0)
 
-solved by LU on the k×k Gram (reference dgesv, nmf_neals.c:200-204,302-306).
-When the Gram is singular the reference lazily switches that half-step to the
-QR least-squares path of nmf_als (nmf_neals.c:206-291,308-393); here the
-fallback is a ``lax.cond`` on non-finite solve output into the same QR solve
-als uses — no shape-changing branches (SURVEY.md §7 hard part #5).
+solved on the k×k Gram (reference dgesv LU, nmf_neals.c:200-204,302-306).
+When the Gram is singular the reference lazily switches that half-step to a
+QR least-squares path (nmf_neals.c:206-291,308-393) — which itself divides
+by a zero diagonal for exactly rank-deficient factors. Here the Gram gets a
+trace-scaled Tikhonov jitter before a Cholesky solve (SURVEY.md §7 hard
+part #5's plan): always well-posed, one code path under jit/vmap, and
+indistinguishable from the plain solve for healthy systems (the jitter is
+~10·eps relative to the Gram's scale).
 
 Convergence: TolX/TolFun checks every 2nd iteration as in als.
 """
@@ -17,33 +20,31 @@ Convergence: TolX/TolFun checks every 2nd iteration as in als.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
+import jax.scipy.linalg as jsl
 
 from nmfx.config import SolverConfig
 from nmfx.solvers import base
-from nmfx.solvers.als import lstsq_qr
 
 
 def init_aux(a, w0, h0, cfg: SolverConfig):
     return ()
 
 
-def _solve_normal(factor, rhs_gram, fallback_b):
-    """solve(factorᵀfactor, rhs_gram) with QR fallback on singularity.
-
-    ``rhs_gram`` is factorᵀ·B; ``fallback_b`` is B for the QR path.
-    """
+def _solve_normal(factor, rhs_gram):
+    """solve(factorᵀfactor + λI, rhs_gram), λ = 10·eps·mean(diag(Gram))."""
     gram = factor.T @ factor
-    sol = jnp.linalg.solve(gram, rhs_gram)
-    ok = jnp.all(jnp.isfinite(sol))
-    return lax.cond(ok, lambda: sol, lambda: lstsq_qr(factor, fallback_b))
+    k = gram.shape[0]
+    lam = 10 * jnp.finfo(gram.dtype).eps * (jnp.trace(gram) / k)
+    gram = gram + (lam + jnp.finfo(gram.dtype).tiny) * jnp.eye(
+        k, dtype=gram.dtype)
+    return jsl.cho_solve(jsl.cho_factor(gram), rhs_gram)
 
 
 def step(a, state: base.State, cfg: SolverConfig,
          check: bool = True) -> base.State:
     w0 = state.w
-    h = base.clamp(_solve_normal(w0, w0.T @ a, a), cfg.zero_threshold)
-    wt = _solve_normal(h.T, h @ a.T, a.T)
+    h = base.clamp(_solve_normal(w0, w0.T @ a), cfg.zero_threshold)
+    wt = _solve_normal(h.T, h @ a.T)
     w = base.clamp(wt.T, cfg.zero_threshold)
     state = state._replace(w=w, h=h)
     if not check:

@@ -1,0 +1,88 @@
+"""Golden-value tests: NumPy (f64) transliterations of the reference's
+update math driven lockstep against the framework's solvers (SURVEY.md §4's
+cross-implementation oracle, replacing the reference's dormant comparison
+against the original BROAD script, test_nmf.r:29)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nmfx.config import SolverConfig
+from nmfx.solvers.base import residual_norm, solve
+
+
+def _mu_numpy(a, w, h, iters, eps=1e-9):
+    """Reference mu update (libnmf/nmf_mu.c:174-216): H then W with the new
+    H, exact-zero short-circuit, in f64."""
+    a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
+    for _ in range(iters):
+        numerh = w.T @ a
+        h_new = h * numerh / ((w.T @ w) @ h + eps)
+        h_new[(h == 0) | (numerh == 0)] = 0.0
+        h = h_new
+        numerw = a @ h.T
+        w_new = w * numerw / (w @ (h @ h.T) + eps)
+        w_new[(w == 0) | (numerw == 0)] = 0.0
+        w = w_new
+    return w, h
+
+
+def _als_numpy(a, w, h, iters):
+    """Reference ALS half-steps (libnmf/nmf_als.c:216-298): least squares
+    then clamp negatives to zero, H first, W with the new H."""
+    a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
+    for _ in range(iters):
+        h = np.maximum(np.linalg.lstsq(w, a, rcond=None)[0], 0.0)
+        w = np.maximum(np.linalg.lstsq(h.T, a.T, rcond=None)[0].T, 0.0)
+    return w, h
+
+
+def _problem(seed=12, m=60, n=22, k=3):
+    rng = np.random.default_rng(seed)
+    a = rng.uniform(0.1, 1.0, (m, n))
+    w0 = rng.uniform(0.1, 1.0, (m, k))
+    h0 = rng.uniform(0.1, 1.0, (k, n))
+    return a, w0, h0
+
+
+def _run(algo, a, w0, h0, iters):
+    cfg = SolverConfig(algorithm=algo, max_iter=iters, use_class_stop=False,
+                       use_tol_checks=False)
+    return solve(jnp.asarray(a, jnp.float32), jnp.asarray(w0, jnp.float32),
+                 jnp.asarray(h0, jnp.float32), cfg)
+
+
+def test_mu_matches_numpy_reference_math():
+    a, w0, h0 = _problem()
+    w_ref, h_ref = _mu_numpy(a, w0, h0, iters=25)
+    res = _run("mu", a, w0, h0, iters=25)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=2e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=2e-3,
+                               atol=1e-4)
+
+
+def test_als_matches_numpy_reference_math():
+    a, w0, h0 = _problem(seed=5)
+    w_ref, h_ref = _als_numpy(a, w0, h0, iters=10)
+    res = _run("als", a, w0, h0, iters=10)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=5e-3,
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=5e-3,
+                               atol=5e-4)
+
+
+def test_als_rank_deficient_stays_finite():
+    """Duplicate W columns: the reference leans on dgeqp3 pivoting here; our
+    min-norm least squares must stay finite and reduce the residual."""
+    rng = np.random.default_rng(2)
+    m, n, k = 40, 15, 3
+    a = jnp.asarray(rng.uniform(0.5, 1.5, (m, k)) @
+                    rng.uniform(0.5, 1.5, (k, n)), jnp.float32)
+    col = rng.uniform(0.1, 1.0, (m, 1))
+    w0 = jnp.asarray(np.concatenate([col] * k, axis=1), jnp.float32)
+    h0 = jnp.asarray(rng.uniform(0.1, 1.0, (k, n)), jnp.float32)
+    res = solve(a, w0, h0, SolverConfig(algorithm="als", max_iter=40))
+    assert np.isfinite(np.asarray(res.w)).all()
+    assert np.isfinite(np.asarray(res.h)).all()
+    assert float(res.dnorm) < float(residual_norm(a, w0, h0))

@@ -1,0 +1,113 @@
+"""Initialization (nmfx/init.py) unit tests: random ranges/reproducibility
+and the NNDSVD scheme against a direct NumPy construction of the reference
+algorithm (libnmf/generatematrix.c:145-247), plus neals robustness on
+singular Grams (the case the reference handles with a lazy QR fallback,
+libnmf/nmf_neals.c:206-291; here a Tikhonov-jittered Cholesky)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.init import initialize, nndsvd_init, random_init
+from nmfx.solvers.base import StopReason, residual_norm, solve
+
+
+def test_random_init_range_and_reproducibility():
+    cfg = InitConfig(minval=0.25, maxval=0.75)
+    w1, h1 = random_init(jax.random.key(4), 50, 20, 3, cfg)
+    w2, h2 = random_init(jax.random.key(4), 50, 20, 3, cfg)
+    w3, _ = random_init(jax.random.key(5), 50, 20, 3, cfg)
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+    assert not np.array_equal(np.asarray(w1), np.asarray(w3))
+    for arr, shape in ((w1, (50, 3)), (h1, (3, 20))):
+        a = np.asarray(arr)
+        assert a.shape == shape
+        assert a.min() >= 0.25 and a.max() < 0.75
+
+
+def _nndsvd_numpy(a: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Direct NumPy transliteration of Boutsidis NNDSVD as the reference
+    implements it (generatematrix.c:172-247): leading pair from |u0|,|v0|;
+    later pairs keep the dominant sign-split side scaled by sqrt(s*term)."""
+    u, s, vt = np.linalg.svd(a, full_matrices=False)
+    u, s, vt = u[:, :k], s[:k], vt[:k, :]
+    m, n = a.shape
+    w = np.zeros((m, k))
+    h = np.zeros((k, n))
+    w[:, 0] = np.sqrt(s[0]) * np.abs(u[:, 0])
+    h[0, :] = np.sqrt(s[0]) * np.abs(vt[0, :])
+    for j in range(1, k):
+        uj, vj = u[:, j], vt[j, :]
+        up, un = np.maximum(uj, 0), np.maximum(-uj, 0)
+        vp, vn = np.maximum(vj, 0), np.maximum(-vj, 0)
+        nup, nun = np.linalg.norm(up), np.linalg.norm(un)
+        nvp, nvn = np.linalg.norm(vp), np.linalg.norm(vn)
+        if nup * nvp >= nun * nvn:
+            term = nup * nvp
+            wj, hj = up / max(nup, 1e-30), vp / max(nvp, 1e-30)
+        else:
+            term = nun * nvn
+            wj, hj = un / max(nun, 1e-30), vn / max(nvn, 1e-30)
+        w[:, j] = np.sqrt(s[j] * term) * wj
+        h[j, :] = np.sqrt(s[j] * term) * hj
+    return w, h
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_nndsvd_matches_numpy_reference(k):
+    rng = np.random.default_rng(9)
+    a = rng.uniform(0.0, 2.0, (40, 18))
+    w_ref, h_ref = _nndsvd_numpy(a, k)
+    w, h = nndsvd_init(jnp.asarray(a, jnp.float32), k)
+    # SVD sign/column conventions can differ only where singular values are
+    # degenerate; this fixture has well-separated spectrum
+    np.testing.assert_allclose(np.asarray(w), w_ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(h), h_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_nndsvd_nonneg_deterministic_and_better_than_random(low_rank_data):
+    a, k = low_rank_data
+    a = jnp.asarray(a, jnp.float32)
+    w1, h1 = nndsvd_init(a, k)
+    w2, h2 = nndsvd_init(a, k)
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+    assert (np.asarray(w1) >= 0).all() and (np.asarray(h1) >= 0).all()
+    # NNDSVD should start much closer to A than a random init on low-rank A
+    wr, hr = random_init(jax.random.key(0), *a.shape, k)
+    assert float(residual_norm(a, w1, h1)) < 0.5 * float(
+        residual_norm(a, wr, hr))
+
+
+def test_initialize_dispatch(low_rank_data):
+    a, k = low_rank_data
+    a = jnp.asarray(a, jnp.float32)
+    w, h = initialize(jax.random.key(0), a, k, InitConfig(method="nndsvd"),
+                      jnp.float32)
+    w2, _ = nndsvd_init(a, k)
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w2))
+    assert h.shape == (k, a.shape[1])
+
+
+def test_neals_singular_gram_fallback():
+    """Rank-deficient W (duplicate columns) makes WᵀW singular — the case
+    the reference meets with its lazy QR switch (nmf_neals.c:206-291) and
+    nmfx with the jittered Cholesky: the solve must produce finite factors
+    and still reduce the residual."""
+    rng = np.random.default_rng(1)
+    m, n, k = 40, 15, 3
+    a = jnp.asarray(rng.uniform(0.5, 1.5, (m, k)) @
+                    rng.uniform(0.5, 1.5, (k, n)), jnp.float32)
+    col = rng.uniform(0.1, 1.0, (m, 1))
+    w0 = jnp.asarray(np.concatenate([col] * k, axis=1), jnp.float32)  # rank 1
+    h0 = jnp.asarray(rng.uniform(0.1, 1.0, (k, n)), jnp.float32)
+    cfg = SolverConfig(algorithm="neals", max_iter=60)
+    res = solve(a, w0, h0, cfg)
+    w, h = np.asarray(res.w), np.asarray(res.h)
+    assert np.isfinite(w).all() and np.isfinite(h).all()
+    assert (w >= 0).all() and (h >= 0).all()
+    assert float(res.dnorm) < float(residual_norm(a, w0, h0))
+    assert int(res.stop_reason) in (StopReason.MAX_ITER, StopReason.TOL_X,
+                                    StopReason.TOL_FUN)
