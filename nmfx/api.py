@@ -135,6 +135,7 @@ def nmfconsensus(
     init_cfg: InitConfig | None = None,
     mesh=None,
     use_mesh: bool = True,
+    rank_selection: str = "host",
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
@@ -149,7 +150,15 @@ def nmfconsensus(
     ``checkpoint_dir``: persist each finished rank there and resume an
     interrupted sweep from the ranks already on disk (guarded by a fingerprint
     of the data + configs, so a registry never serves a different run).
+
+    ``rank_selection``: "host" (default) runs hclust/cophenetic/cutree in
+    host numpy or native C++ (``nmfx/cophenetic.py``); "device" keeps the
+    whole step on the accelerator (``nmfx/ops/hclust_jax.py``) so only
+    ρ/membership scalars leave HBM.
     """
+    if rank_selection not in ("host", "device"):
+        raise ValueError("rank_selection must be 'host' or 'device', got "
+                         f"{rank_selection!r}")
     arr, col_names = _as_matrix(data)
     if (arr < 0).any():
         raise ValueError("input matrix must be non-negative")
@@ -175,9 +184,20 @@ def nmfconsensus(
 
     per_k: dict[int, KResult] = {}
     for k, out in raw.items():
-        with profiler.phase("rank_selection"):
+        with profiler.phase("rank_selection") as sync:
             cons = np.asarray(out.consensus, dtype=np.float64)
-            rho, membership, order = coph.rank_selection(cons, k)
+            if rank_selection == "device":
+                import jax.numpy as jnp
+
+                from nmfx.ops.hclust_jax import rank_selection_jax
+
+                rho, membership, order = sync(
+                    rank_selection_jax(jnp.asarray(out.consensus), k))
+                rho = float(rho)
+                membership = np.asarray(membership)
+                order = np.asarray(order)
+            else:
+                rho, membership, order = coph.rank_selection(cons, k)
             rho = float(np.format_float_positional(
                 rho, precision=4, fractional=False))  # signif(rho,4) nmf.r:172
         per_k[k] = KResult(

@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "vmap", "packed", "pallas"),
                    help="restart-batch execution strategy (auto = packed "
                         "GEMMs for mu, vmapped driver otherwise)")
+    p.add_argument("--rank-selection", default="host",
+                   choices=("host", "device"),
+                   help="where hclust/cophenetic/cutree run: host numpy/C++ "
+                        "or fully on the accelerator")
     p.add_argument("--init", choices=INIT_METHODS, default="random")
     p.add_argument("--label-rule", choices=("argmax", "argmin"),
                    default="argmax",
@@ -105,6 +109,7 @@ def main(argv: list[str] | None = None) -> int:
             init=args.init,
             label_rule=args.label_rule,
             use_mesh=not args.no_mesh,
+            rank_selection=args.rank_selection,
             output=output,
             checkpoint_dir=args.checkpoint_dir,
             profiler=profiler,

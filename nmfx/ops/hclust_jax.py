@@ -1,0 +1,157 @@
+"""On-device average-linkage clustering, cophenetic distances, and cut-tree.
+
+The reference delegates rank selection to base R on the host —
+``hclust(as.dist(1-C), "average")`` → ``cophenetic`` → ``cor`` → ``cutree``
+(reference ``nmf.r:165-177``); nmfx's default does the same small-n work in
+host numpy / native C++ (``nmfx/cophenetic.py``). This module is the fully
+TPU-resident alternative (SURVEY.md §7 build step 3): the n−1 inherently
+sequential merge steps run as a ``lax.fori_loop`` over a masked distance
+matrix, so an entire per-rank pipeline — solve → consensus → ρ/membership —
+can execute under one jit with nothing but scalars returning to the host.
+
+Algorithmic conventions match ``nmfx/cophenetic.py`` exactly (scipy-style
+cluster ids, first-minimum tie-breaking in row-major order, R ``cutree``
+label numbering by first appearance, left-child-first dendrogram leaf
+order), and the two implementations are cross-tested.
+
+O(n³) total work on the VPU — for consensus matrices (n = #samples ≤ a few
+thousand) this is negligible next to the NMF iterations.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("k",))
+def average_linkage_jax(dist: jax.Array, k: int | None = None):
+    """UPGMA clustering of an (n, n) distance matrix, on device.
+
+    Returns ``(linkage, coph, order, membership)``:
+
+    * ``linkage`` — (n−1, 4) scipy-style merge table
+    * ``coph`` — (n, n) cophenetic distances
+    * ``order`` — (n,) dendrogram leaf order (DFS, left child first)
+    * ``membership`` — (n,) labels 1..k from cutting at k clusters
+      (1s if ``k`` is None)
+    """
+    n = dist.shape[0]
+    if dist.shape != (n, n):
+        raise ValueError("dist must be square")
+    kcut = 1 if k is None else k
+    if not 1 <= kcut <= n:
+        raise ValueError(f"k must be in [1, {n}]")
+    f = jnp.promote_types(dist.dtype, jnp.float32)
+    d = jnp.asarray(dist, f)
+    d = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d)
+
+    # carry: working distances, active mask, sizes, slot cluster-ids,
+    # per-slot member masks, cophenetic accumulator, linkage rows, and the
+    # per-sample slot snapshot taken when exactly `kcut` clusters remain
+    mem0 = jnp.eye(n, dtype=bool)
+    init = (d, jnp.ones(n, bool), jnp.ones(n, f), jnp.arange(n),
+            mem0, jnp.zeros((n, n), f), jnp.zeros((n - 1, 4), f),
+            jnp.arange(n))
+
+    def merge(t, carry):
+        d, active, size, cid, mem, coph, linkage, cut_slot = carry
+        pair_ok = active[:, None] & active[None, :]
+        masked = jnp.where(pair_ok, d, jnp.inf)
+        idx = jnp.argmin(masked.ravel())  # first minimum, row-major
+        i, j = jnp.minimum(idx // n, idx % n), jnp.maximum(idx // n, idx % n)
+        height = masked.ravel()[idx]
+        ci, cj = cid[i], cid[j]
+        a, b = jnp.minimum(ci, cj), jnp.maximum(ci, cj)
+        new_size = size[i] + size[j]
+        linkage = linkage.at[t].set(
+            jnp.stack([a.astype(f), b.astype(f), height, new_size]))
+        cross = mem[i][:, None] & mem[j][None, :]
+        coph = coph + height * (cross | cross.T).astype(f)
+        merged = (size[i] * d[i] + size[j] * d[j]) / new_size
+        d = d.at[i, :].set(merged).at[:, i].set(merged).at[i, i].set(jnp.inf)
+        active = active.at[j].set(False)
+        mem = mem.at[i].set(mem[i] | mem[j])
+        size = size.at[i].set(new_size)
+        cid = cid.at[i].set(n + t)
+        # snapshot sample→slot when kcut clusters remain (after this merge
+        # there are n-(t+1) clusters)
+        slot_of_sample = jnp.argmax(mem.T, axis=1)  # each sample: one slot
+        take = (n - (t + 1)) == kcut
+        cut_slot = jnp.where(take, slot_of_sample, cut_slot)
+        return d, active, size, cid, mem, coph, linkage, cut_slot
+
+    (_, _, _, _, _, coph, linkage,
+     cut_slot) = lax.fori_loop(0, n - 1, merge, init)
+
+    order = _leaf_order(linkage, n)
+    membership = _first_appearance_labels(cut_slot)
+    return linkage, coph, order, membership
+
+
+def _leaf_order(linkage: jax.Array, n: int) -> jax.Array:
+    """Dendrogram leaf order via an explicit-stack DFS (left child first),
+    as a fori_loop — every node is popped exactly once (2n−1 pops)."""
+    if n == 1:
+        return jnp.zeros((1,), jnp.int32)
+    stack = jnp.zeros((2 * n,), jnp.int32).at[0].set(2 * n - 2)
+    order = jnp.zeros((n,), jnp.int32)
+
+    def pop(_, carry):
+        stack, sp, order, no = carry
+        node = stack[sp - 1]
+        sp = sp - 1
+        is_leaf = node < n
+        # leaf: append to order
+        order = jnp.where(is_leaf, order.at[no].set(node), order)
+        no = no + is_leaf.astype(jnp.int32)
+        # internal: push right then left (left is popped first)
+        t = jnp.maximum(node - n, 0)
+        left = linkage[t, 0].astype(jnp.int32)
+        right = linkage[t, 1].astype(jnp.int32)
+        stack = jnp.where(is_leaf, stack,
+                          stack.at[sp].set(right).at[sp + 1].set(left))
+        sp = jnp.where(is_leaf, sp, sp + 2)
+        return stack, sp, order, no
+
+    _, _, order, _ = lax.fori_loop(
+        0, 2 * n - 1, pop,
+        (stack, jnp.int32(1), order, jnp.int32(0)))
+    return order
+
+
+def _first_appearance_labels(raw: jax.Array) -> jax.Array:
+    """Renumber arbitrary integer labels 1..k by first appearance in index
+    order (R cutree convention, reference nmf.r:177)."""
+    n = raw.shape[0]
+    idx = jnp.arange(n)
+    # first occurrence position of each sample's label
+    same = raw[:, None] == raw[None, :]
+    first_pos = jnp.min(jnp.where(same, idx[None, :], n), axis=1)
+    # label = 1 + number of distinct first-positions strictly before ours
+    distinct_before = jnp.sum(
+        (jnp.unique(first_pos, size=n, fill_value=n)[None, :]
+         < first_pos[:, None]), axis=1)
+    return (distinct_before + 1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def rank_selection_jax(consensus: jax.Array, k: int):
+    """Fully on-device analogue of ``nmfx.cophenetic.rank_selection``:
+    (ρ, membership 1..k, dendrogram leaf order) from one consensus matrix."""
+    n = consensus.shape[0]
+    f = jnp.promote_types(consensus.dtype, jnp.float32)
+    dist = (1.0 - jnp.asarray(consensus, f))
+    dist = jnp.where(jnp.eye(n, dtype=bool), 0.0, dist)
+    _, coph, order, membership = average_linkage_jax(dist, k)
+    iu = jnp.triu_indices(n, k=1)
+    x = dist[iu]
+    y = coph[iu]
+    xc = x - x.mean()
+    yc = y - y.mean()
+    denom = jnp.sqrt((xc @ xc) * (yc @ yc))
+    rho = jnp.where(denom == 0, 1.0, (xc @ yc) / denom)
+    return rho, membership, order
