@@ -153,8 +153,9 @@ def nmfconsensus(
 
     ``rank_selection``: "host" (default) runs hclust/cophenetic/cutree in
     host numpy or native C++ (``nmfx/cophenetic.py``); "device" keeps the
-    whole step on the accelerator (``nmfx/ops/hclust_jax.py``) so only
-    ρ/membership scalars leave HBM.
+    clustering itself on the accelerator (``nmfx/ops/hclust_jax.py``) —
+    the consensus matrix still comes to host once, for the returned
+    ``KResult``, overlapped with the device clustering.
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
@@ -185,18 +186,21 @@ def nmfconsensus(
     per_k: dict[int, KResult] = {}
     for k, out in raw.items():
         with profiler.phase("rank_selection") as sync:
-            cons = np.asarray(out.consensus, dtype=np.float64)
             if rank_selection == "device":
                 import jax.numpy as jnp
 
                 from nmfx.ops.hclust_jax import rank_selection_jax
 
+                # dispatch the device clustering before the (blocking)
+                # host transfer of the consensus matrix so they overlap
                 rho, membership, order = sync(
                     rank_selection_jax(jnp.asarray(out.consensus), k))
+                cons = np.asarray(out.consensus, dtype=np.float64)
                 rho = float(rho)
                 membership = np.asarray(membership)
                 order = np.asarray(order)
             else:
+                cons = np.asarray(out.consensus, dtype=np.float64)
                 rho, membership, order = coph.rank_selection(cons, k)
             rho = float(np.format_float_positional(
                 rho, precision=4, fractional=False))  # signif(rho,4) nmf.r:172

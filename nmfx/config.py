@@ -124,12 +124,24 @@ class InitConfig:
     method: str = "random"
     minval: float = 0.0
     maxval: float = 1.0
+    #: how NNDSVD obtains its rank-k SVD: "dense" (jnp.linalg.svd — fine at
+    #: consensus-NMF sizes) or "lanczos" (on-device Lanczos on the Gram
+    #: operator, the analogue of the reference's ARPACK path,
+    #: libnmf/calculatesvd.c:38-267 — for k ≪ min(m, n) at scale)
+    svd_method: str = "dense"
+    #: Lanczos subspace size; None = reference-style defaulting
+    #: (generatematrix.c:107-120)
+    ncv: int | None = None
 
     def __post_init__(self):
         if self.method not in INIT_METHODS:
             raise ValueError(
                 f"init method must be one of {INIT_METHODS}, got {self.method!r}"
             )
+        if self.svd_method not in ("dense", "lanczos"):
+            raise ValueError(
+                f"svd_method must be 'dense' or 'lanczos', got "
+                f"{self.svd_method!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -38,11 +38,20 @@ def random_init(key: jax.Array, m: int, n: int, k: int,
 
 
 def nndsvd_init(a: jax.Array, k: int, zero_threshold: float = 0.0,
-                dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+                dtype=jnp.float32, svd_method: str = "dense",
+                ncv: int | None = None) -> tuple[jax.Array, jax.Array]:
     """NNDSVD initialization (deterministic in A)."""
     a = jnp.asarray(a, dtype)
-    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
-    u, s, vt = u[:, :k], s[:k], vt[:k, :]
+    if svd_method == "lanczos":
+        from nmfx.ops.lanczos_svd import truncated_svd
+
+        u, s, vt = truncated_svd(a, k, ncv)
+    elif svd_method == "dense":
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        u, s, vt = u[:, :k], s[:k], vt[:k, :]
+    else:
+        raise ValueError(
+            f"svd_method must be 'dense' or 'lanczos', got {svd_method!r}")
 
     # leading pair: W[:,0] = sqrt(s0)*|u0|, H[0,:] = sqrt(s0)*|v0|
     # (generatematrix.c:172-175; sign-ambiguous SVD made non-negative by abs)
@@ -84,4 +93,5 @@ def initialize(key: jax.Array, a: jax.Array, k: int, cfg: InitConfig,
     m, n = a.shape
     if cfg.method == "random":
         return random_init(key, m, n, k, cfg, dtype)
-    return nndsvd_init(a, k, dtype=dtype)
+    return nndsvd_init(a, k, dtype=dtype, svd_method=cfg.svd_method,
+                       ncv=cfg.ncv)

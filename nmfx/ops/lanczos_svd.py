@@ -1,0 +1,145 @@
+"""On-device truncated SVD via Lanczos on the Gram operator.
+
+TPU-native equivalent of the reference's ARPACK path (reference
+``libnmf/calculatesvd.c:38-267``): dsaupd reverse-communication Lanczos on
+the smaller Gram operator — the caller supplies y = Aᵀ(Ax) per iteration —
+followed by Ritz extraction, σ = √λ, and the other-side vectors via
+u = Av/‖Av‖. Here the reverse-communication loop becomes a ``lax.scan`` of
+matvec pairs with full reorthogonalization (numerically stronger than
+ARPACK's selective scheme at the small subspace sizes NNDSVD needs), and
+the tridiagonal eigenproblem is solved with ``jnp.linalg.eigh``.
+
+Used by NNDSVD initialization (``nmfx/init.py``) when requested
+(``InitConfig.svd_method="lanczos"``): at consensus-NMF sizes the dense
+``jnp.linalg.svd`` is fine, but it factors the full min(m,n)-dimensional
+spectrum — for tall-and-wide matrices where only k ≪ min(m,n) pairs are
+needed, the Lanczos path does O(ncv) matvec pairs instead.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("k", "ncv"))
+def truncated_svd(a: jax.Array, k: int, ncv: int | None = None):
+    """Leading-k SVD of A (m×n): returns (U m×k, S k, Vt k×n).
+
+    ``ncv``: Lanczos subspace size (reference defaulting: 2k+1 capped to
+    the operator dimension, ``libnmf/generatematrix.c:107-120``).
+    """
+    m, n = a.shape
+    big_m = m >= n  # iterate on the smaller Gram, as the reference does
+    dim = n if big_m else m
+    if not 1 <= k <= dim:
+        raise ValueError(f"k must be in [1, {dim}]")
+    if ncv is None:
+        ncv = min(max(2 * k + 1, 20), dim)
+    ncv = min(max(ncv, k + 1), dim)
+    f = jnp.promote_types(a.dtype, jnp.float32)
+    a = jnp.asarray(a, f)
+
+    def gram_matvec(x):
+        # y = Aᵀ(Ax) or A(Aᵀx) — two dense matvecs (calculatesvd.c:141-164)
+        return a.T @ (a @ x) if big_m else a @ (a.T @ x)
+
+    # Lanczos with full reorthogonalization, fixed ncv steps.
+    # basis Q (ncv, dim), tridiagonal (alpha, beta).
+    key = jax.random.key(0)  # deterministic start vector (reference uses
+    # ARPACK's internal default start; any non-degenerate vector works)
+    q0 = jax.random.normal(key, (dim,), f)
+    q0 = q0 / jnp.linalg.norm(q0)
+
+    # Breakdown handling: when β falls below a relative tolerance the
+    # Krylov space is (numerically) invariant — ARPACK would stop; a scan
+    # has a fixed trip count, so a latched `dead` flag zeroes the rest of
+    # the recurrence instead. Without it the post-breakdown noise vectors
+    # reintroduce ghost copies of the top eigenvalues into T.
+    tol_rel = 25 * jnp.finfo(f).eps
+
+    def step(carry, _):
+        q_prev, q, beta_prev, basis, i, dead, scale = carry
+        w = gram_matvec(q) - beta_prev * q_prev
+        alpha = w @ q
+        w = w - alpha * q
+        # full reorthogonalization, two passes (f32 cancellation at large
+        # spectral range leaves O(eps·λmax) residue after one)
+        w = w - basis.T @ (basis @ w)
+        w = w - basis.T @ (basis @ w)
+        beta = jnp.linalg.norm(w)
+        scale = jnp.maximum(scale, jnp.maximum(jnp.abs(alpha), beta))
+        dead_next = dead | (beta <= tol_rel * scale)
+        alpha = jnp.where(dead, 0.0, alpha)
+        beta = jnp.where(dead_next, 0.0, beta)
+        q_next = jnp.where(dead_next, jnp.zeros_like(w),
+                           w / jnp.where(beta > 0, beta, 1.0))
+        basis = basis.at[i].set(q)
+        return (q, q_next, beta, basis, i + 1, dead_next,
+                scale), (alpha, beta)
+
+    basis0 = jnp.zeros((ncv, dim), f)
+    (_, _, _, basis, _, _, _), (alphas, betas) = lax.scan(
+        step, (jnp.zeros((dim,), f), q0, jnp.zeros((), f), basis0,
+               jnp.int32(0), jnp.zeros((), bool), jnp.zeros((), f)),
+        None, length=ncv)
+
+    # tridiagonal T = diag(alphas) + offdiag(betas[:-1])
+    t = (jnp.diag(alphas) + jnp.diag(betas[:-1], 1) + jnp.diag(betas[:-1],
+                                                               -1))
+    evals, evecs = jnp.linalg.eigh(t)  # ascending
+    # top-k Ritz pairs, descending (reference reorders with dswap,
+    # calculatesvd.c:229-246)
+    sel = jnp.argsort(evals)[::-1][:k]
+    lam = jnp.maximum(evals[sel], 0.0)
+    ritz = basis.T @ evecs[:, sel]  # (dim, k) eigenvectors of the Gram
+    s = jnp.sqrt(lam)
+
+    safe = jnp.where(s > 0, s, 1.0)
+    if big_m:
+        v = ritz  # (n, k)
+        u = (a @ v) / safe[None, :]  # u = Av/σ (calculatesvd.c:198-224)
+        u = jnp.where(s[None, :] > 0, u, 0.0)
+    else:
+        u = ritz  # (m, k)
+        v = (a.T @ u) / safe[None, :]
+        v = jnp.where(s[None, :] > 0, v, 0.0)
+
+    # Degenerate-multiplet guard: a single-start-vector Krylov space holds
+    # only ONE Ritz copy per distinct eigenvalue, so for a repeated σ the
+    # top-k list is missing the second copy — and every *returned* pair is
+    # still a genuine singular pair, so per-pair residuals can't tell.
+    # What can: the deflated operator A − U S Vᵀ must have spectral norm
+    # ≤ σ_k if the returned set really is the top k. Estimate it with a
+    # few power iterations (operator form, nothing materialized) and fall
+    # back to the dense factorization when it exceeds the smallest
+    # returned σ.
+    vt = v.T
+
+    def deflated_matvec(x):
+        return a @ x - u @ (s * (vt @ x))
+
+    def deflated_rmatvec(y):
+        return a.T @ y - vt.T @ (s * (u.T @ y))
+
+    x0 = jax.random.normal(jax.random.fold_in(key, 1), (n,), f)
+    x0 = x0 / jnp.linalg.norm(x0)
+
+    def power(i, x):
+        z = deflated_rmatvec(deflated_matvec(x))
+        nz = jnp.linalg.norm(z)
+        return z / jnp.where(nz > 0, nz, 1.0)
+
+    x = lax.fori_loop(0, 12, power, x0)
+    est = jnp.linalg.norm(deflated_matvec(x))
+    ok = est <= s[k - 1] * 1.01 + 1e-3 * jnp.maximum(s[0],
+                                                     jnp.finfo(f).tiny)
+
+    def dense():
+        ud, sd, vtd = jnp.linalg.svd(a, full_matrices=False)
+        return ud[:, :k], sd[:k], vtd[:k, :]
+
+    return lax.cond(ok, lambda: (u, s, vt), dense)

@@ -111,3 +111,62 @@ def test_neals_singular_gram_fallback():
     assert float(res.dnorm) < float(residual_norm(a, w0, h0))
     assert int(res.stop_reason) in (StopReason.MAX_ITER, StopReason.TOL_X,
                                     StopReason.TOL_FUN)
+
+
+def test_lanczos_svd_matches_dense():
+    from nmfx.ops.lanczos_svd import truncated_svd
+
+    rng = np.random.default_rng(11)
+    for m, n in ((80, 30), (30, 80)):
+        a = jnp.asarray(rng.uniform(0.0, 2.0, (m, n)), jnp.float32)
+        u, s, vt = truncated_svd(a, 4)
+        ud, sd, vtd = np.linalg.svd(np.asarray(a, np.float64))
+        np.testing.assert_allclose(np.asarray(s), sd[:4], rtol=1e-3)
+        # vectors match up to sign
+        for j in range(4):
+            dot_u = abs(np.dot(np.asarray(u[:, j]), ud[:, j]))
+            dot_v = abs(np.dot(np.asarray(vt[j]), vtd[j]))
+            assert dot_u > 0.999, (j, dot_u)
+            assert dot_v > 0.999, (j, dot_v)
+        # reconstruction quality equals the dense rank-4 truncation
+        rec = np.asarray(u) * np.asarray(s) @ np.asarray(vt)
+        rec_d = (ud[:, :4] * sd[:4]) @ vtd[:4]
+        assert np.linalg.norm(rec - rec_d) <= 1e-2 * np.linalg.norm(rec_d)
+
+
+def test_nndsvd_lanczos_matches_dense(low_rank_data):
+    a, k = low_rank_data
+    a = jnp.asarray(a, jnp.float32)
+    w_d, h_d = nndsvd_init(a, k, svd_method="dense")
+    w_l, h_l = nndsvd_init(a, k, svd_method="lanczos")
+    np.testing.assert_allclose(np.asarray(w_l), np.asarray(w_d),
+                               rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(h_l), np.asarray(h_d),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_init_config_svd_validation():
+    with pytest.raises(ValueError, match="svd_method"):
+        InitConfig(svd_method="arpack")
+
+
+def test_lanczos_svd_degenerate_spectrum_falls_back():
+    """Repeated singular values: single-vector Lanczos holds one Ritz copy
+    per distinct eigenvalue; the residual guard must detect the missing
+    multiplet copy and fall back to the dense factorization."""
+    from nmfx.ops.lanczos_svd import truncated_svd
+
+    rng = np.random.default_rng(21)
+    q1, _ = np.linalg.qr(rng.normal(size=(60, 4)))
+    q2, _ = np.linalg.qr(rng.normal(size=(40, 4)))
+    a = jnp.asarray((q1 * np.array([5.0, 5.0, 3.0, 1.0])) @ q2.T,
+                    jnp.float32)
+    _, s, _ = truncated_svd(a, 4)
+    np.testing.assert_allclose(np.asarray(s), [5.0, 5.0, 3.0, 1.0],
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_nndsvd_bad_svd_method_rejected(low_rank_data):
+    a, k = low_rank_data
+    with pytest.raises(ValueError, match="svd_method"):
+        nndsvd_init(jnp.asarray(a, jnp.float32), k, svd_method="Lanczos")
