@@ -29,8 +29,11 @@ from jax import lax
 def truncated_svd(a: jax.Array, k: int, ncv: int | None = None):
     """Leading-k SVD of A (m×n): returns (U m×k, S k, Vt k×n).
 
-    ``ncv``: Lanczos subspace size (reference defaulting: 2k+1 capped to
-    the operator dimension, ``libnmf/generatematrix.c:107-120``).
+    ``ncv``: Lanczos subspace size. Default: 2k+1 with a floor of 20 (full
+    reorthogonalization converges in one restart with a modest cushion;
+    ARPACK instead iterates with restarts), capped to the operator
+    dimension — cf. the reference's ncv defaulting at
+    ``libnmf/generatematrix.c:107-120``.
     """
     m, n = a.shape
     big_m = m >= n  # iterate on the smaller Gram, as the reference does

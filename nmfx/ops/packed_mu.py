@@ -147,6 +147,25 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
             a, wp0, hp, gh, block_m=block_m, eps=cfg.div_eps,
             zero_threshold=cfg.zero_threshold,
             matmul_precision=cfg.matmul_precision, interpret=interpret)
+    elif a.dtype == jnp.bfloat16:
+        # bandwidth-lean bf16 path (mu_packed pre-truncated A): under
+        # matmul_precision="bfloat16" the MXU rounds every GEMM operand to
+        # bf16 anyway, so feeding explicitly-truncated operands with f32
+        # accumulation is bit-identical to the f32-operand GEMMs below while
+        # halving the HBM bytes read for A (the largest array, reread twice
+        # per iteration) and the factor matrices
+        f32 = hp0.dtype
+        wb = wp0.astype(jnp.bfloat16)
+        numerh = jnp.matmul(wb.T, a, preferred_element_type=f32)
+        gw = jnp.matmul(wb.T, wb, preferred_element_type=f32)
+        denomh = (gw * bd) @ hp0
+        hp = _mu_update(hp0, numerh, denomh, cfg)
+
+        hb = hp.astype(jnp.bfloat16)
+        gh = jnp.matmul(hb, hb.T, preferred_element_type=f32) * bd
+        numerw = jnp.matmul(a, hb.T, preferred_element_type=f32)
+        denomw = wp0 @ gh
+        wp = _mu_update(wp0, numerw, denomw, cfg)
     else:
         # H update — numerator GEMM plus the full W-Gram (cross-restart
         # blocks masked off; see module docstring for the FLOP trade)
@@ -282,7 +301,16 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
             stop_reason=vary(jnp.full((r,), base.StopReason.MAX_ITER,
                                       jnp.int32)),
         )
-        step = partial(_step, a, bd, use_pallas=use_pallas,
+        a_loop = a
+        if (not use_pallas and cfg.matmul_precision == "bfloat16"
+                and dtype == jnp.float32 and jax.default_backend() == "tpu"):
+            # one-time truncation: every loop GEMM reads A in the exact bf16
+            # form the MXU would round it to anyway (see _step's bf16 branch);
+            # the full-precision a_true still feeds the final residuals.
+            # TPU-only: other backends ignore the bfloat16 precision hint and
+            # run full-f32 GEMMs, so truncating there would change results
+            a_loop = a.astype(jnp.bfloat16)
+        step = partial(_step, a_loop, bd, use_pallas=use_pallas,
                        block_m=block_m, interpret=interpret)
 
         def cond(s: PackedState):

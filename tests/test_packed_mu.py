@@ -177,3 +177,37 @@ def test_pallas_m_padding(problem):
                                  backend="packed"))
     np.testing.assert_allclose(np.asarray(got.hp), np.asarray(ref.hp),
                                rtol=5e-3, atol=1e-4)
+
+
+def test_bf16_operand_step_close_to_f32(problem):
+    """The bandwidth-lean _step branch (A pre-truncated to bf16, factors cast
+    per GEMM; taken by mu_packed on TPU under matmul_precision='bfloat16')
+    tracks the f32-operand iteration within bf16 rounding and keeps the
+    f32 carry dtypes."""
+    from nmfx.ops.packed_mu import PackedState, _step, block_diag_mask, pack
+
+    a, w0s, h0s = problem
+    r, _, k = w0s.shape
+    n = h0s.shape[2]
+    cfg = SolverConfig(algorithm="mu")
+    wp, hp = pack(w0s, h0s)
+    bd = block_diag_mask(r, k, jnp.float32)
+    state = PackedState(
+        wp=wp, hp=hp, wp_prev=wp, hp_prev=hp,
+        iteration=jnp.zeros((), jnp.int32),
+        classes=jnp.full((r, n), -1, jnp.int32),
+        stable=jnp.zeros((r,), jnp.int32),
+        done=jnp.zeros((r,), bool),
+        done_iter=jnp.zeros((r,), jnp.int32),
+        stop_reason=jnp.zeros((r,), jnp.int32))
+    ref = state
+    got = state
+    for _ in range(5):
+        ref = _step(a, bd, ref, cfg, r, check=False)
+        got = _step(a.astype(jnp.bfloat16), bd, got, cfg, r, check=False)
+    assert got.wp.dtype == jnp.float32
+    assert got.hp.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got.hp), np.asarray(ref.hp),
+                               rtol=0.1, atol=0.02)
+    np.testing.assert_allclose(np.asarray(got.wp), np.asarray(ref.wp),
+                               rtol=0.1, atol=0.02)
