@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence
 
-ALGORITHMS = ("mu", "als", "neals", "pg", "alspg")
+ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl")
 INIT_METHODS = ("random", "nndsvd")
 
 
@@ -129,8 +129,10 @@ class InitConfig:
     #: operator, the analogue of the reference's ARPACK path,
     #: libnmf/calculatesvd.c:38-267 — for k ≪ min(m, n) at scale)
     svd_method: str = "dense"
-    #: Lanczos subspace size; None = reference-style defaulting
-    #: (generatematrix.c:107-120)
+    #: Lanczos subspace size; None = 2k+1 with a floor of 20, capped to the
+    #: operator dimension (cf. the reference's ncv defaulting,
+    #: generatematrix.c:107-120; the floor is ours — full
+    #: reorthogonalization in one restart wants a small cushion)
     ncv: int | None = None
 
     def __post_init__(self):

@@ -1,13 +1,14 @@
-"""NMF solver family — five update rules sharing one while_loop driver.
+"""NMF solver family — six update rules sharing one while_loop driver.
 
 TPU-native re-designs of the reference's five C solvers
-(reference ``libnmf/nmf_{mu,als,neals,pg,alspg}.c``): each solver is a pure
-``step`` function over arrays, jit-compiled into a ``lax.while_loop`` and
-vmappable over the restart axis.
+(reference ``libnmf/nmf_{mu,als,neals,pg,alspg}.c``) plus the BROAD
+original's Brunet divergence rule (``kl``): each solver is a pure ``step``
+function over arrays, jit-compiled into a ``lax.while_loop`` and vmappable
+over the restart axis.
 """
 
 from nmfx.solvers.base import SolverResult, StopReason, solve
-from nmfx.solvers import als, alspg, mu, neals, pg
+from nmfx.solvers import als, alspg, kl, mu, neals, pg
 
 SOLVERS = {
     "mu": mu,
@@ -15,7 +16,10 @@ SOLVERS = {
     "neals": neals,
     "pg": pg,
     "alspg": alspg,
+    # beyond the reference: the BROAD original's Brunet divergence updates
+    # (the reference replaces them with Euclidean mu — solvers/kl.py)
+    "kl": kl,
 }
 
 __all__ = ["SOLVERS", "SolverResult", "StopReason", "solve", "mu", "als",
-           "neals", "pg", "alspg"]
+           "neals", "pg", "alspg", "kl"]

@@ -86,3 +86,40 @@ def test_als_rank_deficient_stays_finite():
     assert np.isfinite(np.asarray(res.w)).all()
     assert np.isfinite(np.asarray(res.h)).all()
     assert float(res.dnorm) < float(residual_norm(a, w0, h0))
+
+
+def _kl_numpy(a, w, h, iters, eps=1e-9):
+    """Brunet (2004) divergence updates in f64 — the BROAD nmfconsensus.R
+    model family the reference replaced with Euclidean mu (see
+    nmfx/solvers/kl.py); H first, W with the fresh H."""
+    a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
+    for _ in range(iters):
+        h = h * (w.T @ (a / (w @ h + eps))) / (w.sum(axis=0)[:, None] + eps)
+        w = w * ((a / (w @ h + eps)) @ h.T) / (h.sum(axis=1)[None, :] + eps)
+    return w, h
+
+
+def test_kl_matches_numpy_brunet_math():
+    a, w0, h0 = _problem(seed=9)
+    w_ref, h_ref = _kl_numpy(a, w0, h0, iters=25)
+    res = _run("kl", a, w0, h0, iters=25)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=2e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=2e-3,
+                               atol=1e-4)
+
+
+def test_kl_monotone_divergence():
+    """Brunet guarantee: D(A || WH) never increases across iterations."""
+    from nmfx.solvers.kl import kl_divergence
+
+    a, w0, h0 = _problem(seed=4)
+    a, w, h = (jnp.asarray(x, jnp.float32) for x in (a, w0, h0))
+    cfg = SolverConfig(algorithm="kl", use_class_stop=False,
+                       use_tol_checks=False, max_iter=1)
+    divs = [float(kl_divergence(a, w, h))]
+    for _ in range(30):
+        res = solve(a, w, h, cfg)
+        w, h = res.w, res.h
+        divs.append(float(kl_divergence(a, w, h)))
+    assert all(b <= d + 1e-4 * abs(d) for d, b in zip(divs, divs[1:])), divs
