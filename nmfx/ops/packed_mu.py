@@ -102,17 +102,34 @@ def _diag_blocks(g: jax.Array, r: int) -> jax.Array:
     return jnp.einsum("rkrl->rkl", g.reshape(r, k, r, k))
 
 
-def residual_norms(a: jax.Array, wp: jax.Array, hp: jax.Array,
-                   r: int) -> jax.Array:
+def residual_norms(a: jax.Array, wp: jax.Array, hp: jax.Array, r: int,
+                   feature_axis: str | None = None,
+                   m_total: int | None = None) -> jax.Array:
     """Per-restart RMS residual ‖A − WᵣHᵣ‖_F/√(mn) without materializing any
     m×n reconstruction: ‖A−WH‖² = ‖A‖² − 2⟨WᵀA, H⟩ + ⟨WᵀW, HHᵀ⟩, with every
     term read off packed Grams (reference calculateNorm materializes the full
-    m×n difference per restart, ``libnmf/calculatenorm.c:44-78``)."""
+    m×n difference per restart, ``libnmf/calculatenorm.c:44-78``).
+
+    With ``feature_axis`` (inside ``shard_map``, A/Wp row-sharded over that
+    mesh axis) the m-contracted terms are partial sums reduced with one
+    ``psum``; ``m_total`` is the unsharded (unpadded) row count for the RMS
+    normalizer."""
     m, n = a.shape
     numerh = wp.T @ a  # (R·k, n)
-    gw = _diag_blocks(wp.T @ wp, r)  # (R, k, k)
-    gh = _diag_blocks(hp @ hp.T, r)
+    gw_full = wp.T @ wp
     a2 = jnp.sum(a * a)
+    if feature_axis is not None:
+        if m_total is None:
+            raise ValueError(
+                "residual_norms with feature_axis needs m_total (the "
+                "unsharded row count); the local shard's row count would "
+                "silently inflate the RMS by sqrt(#shards)")
+        numerh = lax.psum(numerh, feature_axis)
+        gw_full = lax.psum(gw_full, feature_axis)
+        a2 = lax.psum(a2, feature_axis)
+        m = m_total
+    gw = _diag_blocks(gw_full, r)  # (R, k, k)
+    gh = _diag_blocks(hp @ hp.T, r)
     cross = _block_sums(numerh * hp, r)
     quad = jnp.sum(gw * gh, axis=(1, 2))
     sq = jnp.maximum(a2 - 2.0 * cross + quad, 0.0)
@@ -127,7 +144,8 @@ def _labels(hp: jax.Array, r: int) -> jax.Array:
 
 def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
           check: bool, use_pallas: bool = False, block_m: int = 512,
-          interpret: bool = False) -> PackedState:
+          interpret: bool = False,
+          feature_axis: str | None = None) -> PackedState:
     m, n = a.shape
     k = state.hp.shape[0] // r
     wp0, hp0 = state.wp, state.hp
@@ -158,6 +176,10 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
         wb = wp0.astype(jnp.bfloat16)
         numerh = jnp.matmul(wb.T, a, preferred_element_type=f32)
         gw = jnp.matmul(wb.T, wb, preferred_element_type=f32)
+        if feature_axis is not None:
+            # A/Wp are row shards: the m-contracted terms are partial sums
+            numerh = lax.psum(numerh, feature_axis)
+            gw = lax.psum(gw, feature_axis)
         denomh = (gw * bd) @ hp0
         hp = _mu_update(hp0, numerh, denomh, cfg)
 
@@ -171,6 +193,9 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
         # blocks masked off; see module docstring for the FLOP trade)
         numerh = wp0.T @ a  # (R·k, n)
         gw = wp0.T @ wp0  # (R·k, R·k)
+        if feature_axis is not None:
+            numerh = lax.psum(numerh, feature_axis)
+            gw = lax.psum(gw, feature_axis)
         denomh = (gw * bd) @ hp0
         hp = _mu_update(hp0, numerh, denomh, cfg)
 
@@ -190,10 +215,11 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
                            iteration=it)
     if not check:
         return state
-    return _check(state, cfg, r)
+    return _check(state, cfg, r, feature_axis)
 
 
-def _check(state: PackedState, cfg: SolverConfig, r: int) -> PackedState:
+def _check(state: PackedState, cfg: SolverConfig, r: int,
+           feature_axis: str | None = None) -> PackedState:
     """Per-restart convergence tests, mirroring base.check_convergence for
     the mu solver (class stability first, then TolX) with (R,)-shaped
     bookkeeping instead of vmapped scalars."""
@@ -226,7 +252,18 @@ def _check(state: PackedState, cfg: SolverConfig, r: int) -> PackedState:
 
         m = state.wp.shape[0]
         n = state.hp.shape[1]
-        dw = _delta(state.wp, state.wp_prev, (0, 2), (m, r, k))
+        if feature_axis is None:
+            dw = _delta(state.wp, state.wp_prev, (0, 2), (m, r, k))
+        else:
+            # W rows are sharded: maxchange is a ratio of global maxima, so
+            # pmax the ratio's ingredients before dividing
+            diff = lax.pmax(
+                jnp.max(jnp.abs(state.wp - state.wp_prev)
+                        .reshape(m, r, k), axis=(0, 2)), feature_axis)
+            ref = lax.pmax(
+                jnp.max(jnp.abs(state.wp_prev).reshape(m, r, k),
+                        axis=(0, 2)), feature_axis)
+            dw = diff / (sqrteps + ref)
         dh = _delta(state.hp, state.hp_prev, (1, 2), (r, k, n))
         delta = jnp.maximum(dw, dh)  # (R,)
         hit = active & (delta < cfg.tol_x) & ~done
@@ -239,10 +276,13 @@ def _check(state: PackedState, cfg: SolverConfig, r: int) -> PackedState:
                           done_iter=done_iter, stop_reason=reason)
 
 
-@partial(jax.jit, static_argnames=("cfg", "varying_axes"))
+@partial(jax.jit, static_argnames=("cfg", "varying_axes", "feature_axis",
+                                   "m_total"))
 def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
               cfg: SolverConfig = SolverConfig(),
-              varying_axes: tuple[str, ...] = ()) -> PackedMUResult:
+              varying_axes: tuple[str, ...] = (),
+              feature_axis: str | None = None,
+              m_total: int | None = None) -> PackedMUResult:
     """Solve the whole restart batch with packed GEMM iterations.
 
     Semantically equivalent to ``vmap(solve)`` with ``algorithm='mu'``
@@ -254,9 +294,21 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
     the constant-initialized carry components (counters, done masks) must be
     lifted to device-varying so the while_loop carry types match the body's
     outputs, which inherit the varying tag from the sharded factors.
+
+    ``feature_axis``: name of a mesh axis over which A and Wp are
+    *row*-sharded (this workload's tensor-parallel dimension — SURVEY.md §5
+    "feature-dimension sharding"). The two m-contracted terms of the H
+    update (WpᵀA and WpᵀWp) become one fused ``psum`` pair per iteration
+    over that axis; the entire W half-step stays device-local. ``m_total``
+    is the unsharded row count (for RMS normalization). H and all
+    convergence bookkeeping are replicated across the feature axis.
     """
     if cfg.algorithm != "mu":
         raise ValueError("mu_packed only implements the mu algorithm")
+    if feature_axis is not None and cfg.backend == "pallas":
+        raise ValueError("feature-axis sharding is not supported with the "
+                         "pallas backend (the fused kernels have no "
+                         "collective stage); use backend='packed'")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0s = jnp.asarray(w0s, dtype)
@@ -311,7 +363,8 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
             # run full-f32 GEMMs, so truncating there would change results
             a_loop = a.astype(jnp.bfloat16)
         step = partial(_step, a_loop, bd, use_pallas=use_pallas,
-                       block_m=block_m, interpret=interpret)
+                       block_m=block_m, interpret=interpret,
+                       feature_axis=feature_axis)
 
         def cond(s: PackedState):
             return jnp.any(~s.done) & (s.iteration + cfg.check_every
@@ -332,7 +385,8 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
 
         iterations = jnp.where(final.done, final.done_iter, final.iteration)
         wp_final = final.wp[:m]  # drop pallas m-padding rows, if any
-        dnorm = residual_norms(a_true, wp_final, final.hp, r)
+        dnorm = residual_norms(a_true, wp_final, final.hp, r,
+                               feature_axis=feature_axis, m_total=m_total)
     return PackedMUResult(wp=wp_final, hp=final.hp,
                           iterations=iterations.astype(jnp.int32),
                           dnorm=dnorm, stop_reason=final.stop_reason)

@@ -21,11 +21,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
 from nmfx.consensus import consensus_matrix, labels_from_h
-from nmfx.init import initialize
+from nmfx.init import initialize, random_init
 from nmfx.solvers.base import solve
 
 #: mesh axis name for the restart batch dimension
 RESTART_AXIS = "restarts"
+
+#: mesh axis name for the feature (gene/row) dimension of A and W — this
+#: workload's tensor-parallel axis (SURVEY.md §5: "shard A's rows across
+#: devices ... the analogue of sequence parallelism for this workload").
+#: Use when m is too large for one device's HBM; restarts×features compose
+#: in one 2-D mesh (see feature_mesh)
+FEATURE_AXIS = "features"
 
 
 class KSweepOutput(NamedTuple):
@@ -55,6 +62,20 @@ def _use_packed(solver_cfg: SolverConfig) -> bool:
 @lru_cache(maxsize=64)
 def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                     init_cfg: InitConfig, label_rule: str, mesh: Mesh | None):
+    if (mesh is not None and FEATURE_AXIS in mesh.axis_names
+            and mesh.shape[FEATURE_AXIS] > 1):
+        if not _use_packed(solver_cfg) or solver_cfg.backend == "pallas":
+            raise ValueError(
+                "feature-axis sharding requires the packed mu backend "
+                f"(algorithm='mu', backend='packed'/'auto'); got "
+                f"algorithm={solver_cfg.algorithm!r}, "
+                f"backend={solver_cfg.backend!r}")
+        if init_cfg.method != "random":
+            raise ValueError(
+                "feature-axis sharding supports init method 'random' only "
+                "(NNDSVD needs the full matrix on every device)")
+        return _build_feature_sharded_sweep_fn(
+            k, restarts, solver_cfg, init_cfg, label_rule, mesh)
     if _use_packed(solver_cfg):
         return _build_packed_sweep_fn(k, restarts, solver_cfg, init_cfg,
                                       label_rule, mesh)
@@ -191,6 +212,137 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         return sharded(a, keys)
 
     return jax.jit(impl)
+
+
+def _build_feature_sharded_sweep_fn(k: int, restarts: int,
+                                    solver_cfg: SolverConfig,
+                                    init_cfg: InitConfig, label_rule: str,
+                                    mesh: Mesh):
+    """Sweep builder for a mesh with a feature (row) axis — optionally
+    composed with the restart axis in a 2-D ``restarts×features`` mesh.
+
+    SPMD layout: A and Wp are row-sharded over ``FEATURE_AXIS`` (the
+    tensor-parallel dimension for a workload whose model state is W); H,
+    labels, and all convergence bookkeeping are replicated across it. Per
+    iteration the packed solver psums exactly two m-contracted terms (WpᵀA,
+    WpᵀWp) over the feature axis (see ``mu_packed``); the consensus
+    reduction psums over the restart axis as in the 1-D path. W0 is drawn
+    from the same per-restart keys as every other execution path and then
+    row-sliced, so a given (seed, k, restart) yields the same factorization
+    on any mesh shape (modulo float reduction order).
+    """
+    from nmfx.ops.packed_mu import mu_packed, unpack_w
+
+    has_restart = (RESTART_AXIS in mesh.axis_names
+                   and mesh.shape[RESTART_AXIS] > 1)
+    n_rshards = mesh.shape[RESTART_AXIS] if has_restart else 1
+    f_shards = mesh.shape[FEATURE_AXIS]
+    padded = _pad_count(restarts, mesh)
+    r_local = padded // n_rshards
+    dtype = jnp.dtype(solver_cfg.dtype)
+    vary_axes = ((RESTART_AXIS, FEATURE_AXIS) if has_restart
+                 else (FEATURE_AXIS,))
+
+    def shard_body(a_loc: jax.Array, keys: jax.Array,
+                   m_true: int) -> KSweepOutput:
+        m_loc = a_loc.shape[0]
+        m_pad = m_loc * f_shards
+        n = a_loc.shape[1]
+        fidx = lax.axis_index(FEATURE_AXIS)
+        # full-m W0 from the canonical per-restart keys (identical draws on
+        # every mesh shape), immediately row-sliced to this shard's block so
+        # peak transient memory is one restart's m×k, not r_local·m×k; rows
+        # past the true m (padding) are zeroed so they stay exactly zero
+        # under the mu update and contribute nothing to the psummed Grams
+        def init_one(kk):
+            w0, h0 = random_init(kk, m_true, n, k, init_cfg, dtype)
+            w0 = jnp.pad(w0, ((0, m_pad - m_true), (0, 0)))
+            return (lax.dynamic_slice_in_dim(w0, fidx * m_loc, m_loc,
+                                             axis=0), h0)
+
+        w0s_loc, h0s = lax.map(init_one, keys)
+        res = mu_packed(a_loc, w0s_loc, h0s, solver_cfg,
+                        varying_axes=vary_axes, feature_axis=FEATURE_AXIS,
+                        m_total=m_true)
+        hs = res.hp.reshape(r_local, k, -1)
+        labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hs)
+
+        gidx = ((lax.axis_index(RESTART_AXIS) if has_restart else 0)
+                * r_local + jnp.arange(r_local))
+        valid = gidx < restarts
+        onehot = (jax.nn.one_hot(labels, k, dtype=jnp.float32)
+                  * valid[:, None, None])
+        cons = jnp.einsum("rik,rjk->ij", onehot, onehot)
+        if has_restart:
+            cons = lax.psum(cons, RESTART_AXIS)
+        cons = cons / restarts
+
+        def rgather(x, tiled=True):
+            return (lax.all_gather(x, RESTART_AXIS, tiled=tiled)
+                    if has_restart else x)
+
+        iters_g = rgather(res.iterations)
+        dnorm_g = rgather(res.dnorm)
+        stop_g = rgather(res.stop_reason)
+        labels_g = rgather(labels)
+        # best restart: local candidate per restart shard; pick the global
+        # winner from gathered *scalars* only, select its (still feature-
+        # sharded) factors with a masked psum, and feature-gather the full-m
+        # W exactly once — at no point does any device hold more than one
+        # full-m factor matrix
+        best = jnp.argmin(jnp.where(valid, res.dnorm, jnp.inf))
+        bw_loc = unpack_w(res.wp, r_local)[best]  # (m_loc, k)
+        bh = hs[best]
+        bd = jnp.where(valid, res.dnorm, jnp.inf)[best]
+        if has_restart:
+            bds = lax.all_gather(bd, RESTART_AXIS)
+            gbest = jnp.argmin(bds)
+            win = (lax.axis_index(RESTART_AXIS) == gbest)
+            bw_loc = lax.psum(bw_loc * win.astype(bw_loc.dtype),
+                              RESTART_AXIS)
+            bh = lax.psum(bh * win.astype(bh.dtype), RESTART_AXIS)
+        bw = lax.all_gather(bw_loc, FEATURE_AXIS, tiled=True,
+                            axis=0)[:m_true]
+        return KSweepOutput(cons, iters_g[:restarts], dnorm_g[:restarts],
+                            stop_g[:restarts], labels_g[:restarts], bw, bh)
+
+    a_specs = P(FEATURE_AXIS)
+    key_specs = P(RESTART_AXIS) if has_restart else P()
+
+    def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
+        a = jnp.asarray(a, dtype)
+        m_true = a.shape[0]
+        m_pad = -(-m_true // f_shards) * f_shards
+        if m_pad != m_true:
+            a = jnp.pad(a, ((0, m_pad - m_true), (0, 0)))
+        keys = jax.random.split(key, padded)
+        sharded = jax.shard_map(partial(shard_body, m_true=m_true),
+                                mesh=mesh, in_specs=(a_specs, key_specs),
+                                out_specs=P(), check_vma=False)
+        return sharded(a, keys)
+
+    return jax.jit(impl)
+
+
+def feature_mesh(restart_shards: int | None = None,
+                 feature_shards: int = 1) -> Mesh:
+    """A 2-D ``restarts×features`` mesh over the local devices.
+
+    ``restart_shards=None`` uses all remaining devices on the restart axis.
+    With ``feature_shards=1`` this degenerates to the default 1-D restart
+    mesh; with ``restart_shards=1`` it is pure feature (tensor) parallelism
+    for a single huge factorization.
+    """
+    devices = jax.devices()
+    if restart_shards is None:
+        restart_shards = len(devices) // feature_shards
+    n = restart_shards * feature_shards
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {restart_shards}x{feature_shards} needs {n} devices, "
+            f"have {len(devices)}")
+    return Mesh(np.array(devices[:n]).reshape(restart_shards, feature_shards),
+                (RESTART_AXIS, FEATURE_AXIS))
 
 
 def sweep_one_k(a, key, k: int, restarts: int,

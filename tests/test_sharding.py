@@ -74,3 +74,63 @@ def test_initial_factors_actually_sharded(low_rank_data, mesh):
     w0s = jax.device_put(np.ones((8, a.shape[0], 3), np.float32), shard)
     out = batch_norms(w0s)
     assert len(out.sharding.device_set) == 8
+
+
+# --- feature-axis (tensor-parallel) sharding -------------------------------
+
+from nmfx.sweep import FEATURE_AXIS, feature_mesh  # noqa: E402
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2), (1, 8)])
+def test_feature_sharded_matches_unsharded(low_rank_data, shape):
+    """Row-sharding A/W over the feature axis (optionally composed with the
+    restart axis in a 2-D mesh) must reproduce the unsharded sweep exactly:
+    same labels and iteration counts, same consensus, factors to reduction-
+    order tolerance."""
+    a, _ = low_rank_data
+    cfg = SolverConfig(max_iter=150)
+    key = jax.random.key(5)
+    ref = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg, mesh=None)
+    got = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg,
+                      mesh=feature_mesh(*shape))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.dnorms),
+                               np.asarray(ref.dnorms), rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got.best_w),
+                               np.asarray(ref.best_w), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(got.best_h),
+                               np.asarray(ref.best_h), rtol=5e-3, atol=5e-4)
+
+
+def test_feature_sharded_uneven_m(low_rank_data):
+    """m not divisible by the feature shards: zero-row padding must be
+    invisible (padded W rows stay exactly zero under the mu update)."""
+    a, _ = low_rank_data
+    a = a[:53]  # 53 rows across 4 feature shards -> pad to 56
+    cfg = SolverConfig(max_iter=100)
+    key = jax.random.key(2)
+    ref = sweep_one_k(a, key, k=3, restarts=4, solver_cfg=cfg, mesh=None)
+    got = sweep_one_k(a, key, k=3, restarts=4, solver_cfg=cfg,
+                      mesh=feature_mesh(2, 4))
+    assert got.best_w.shape == (53, 3)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+
+
+def test_feature_sharding_rejects_unsupported_configs(low_rank_data):
+    a, _ = low_rank_data
+    mesh = feature_mesh(2, 4)
+    with pytest.raises(ValueError, match="packed mu"):
+        sweep_one_k(a, jax.random.key(0), k=2, restarts=4,
+                    solver_cfg=SolverConfig(algorithm="als"), mesh=mesh)
+    with pytest.raises(ValueError, match="random"):
+        sweep_one_k(a, jax.random.key(0), k=2, restarts=4,
+                    solver_cfg=SolverConfig(),
+                    init_cfg=InitConfig(method="nndsvd"), mesh=mesh)
