@@ -104,18 +104,21 @@ def _diag_blocks(g: jax.Array, r: int) -> jax.Array:
 
 def residual_norms(a: jax.Array, wp: jax.Array, hp: jax.Array, r: int,
                    feature_axis: str | None = None,
-                   m_total: int | None = None) -> jax.Array:
+                   m_total: int | None = None,
+                   sample_axis: str | None = None,
+                   n_total: int | None = None) -> jax.Array:
     """Per-restart RMS residual ‖A − WᵣHᵣ‖_F/√(mn) without materializing any
     m×n reconstruction: ‖A−WH‖² = ‖A‖² − 2⟨WᵀA, H⟩ + ⟨WᵀW, HHᵀ⟩, with every
     term read off packed Grams (reference calculateNorm materializes the full
     m×n difference per restart, ``libnmf/calculatenorm.c:44-78``).
 
-    With ``feature_axis`` (inside ``shard_map``, A/Wp row-sharded over that
-    mesh axis) the m-contracted terms are partial sums reduced with one
-    ``psum``; ``m_total`` is the unsharded (unpadded) row count for the RMS
+    With ``feature_axis``/``sample_axis`` (inside ``shard_map``, A row- and/or
+    column-sharded, Wp row-sharded, Hp column-sharded accordingly) the m- and
+    n-contracted terms are partial sums reduced with psums;
+    ``m_total``/``n_total`` are the unsharded (unpadded) dims for the RMS
     normalizer."""
     m, n = a.shape
-    numerh = wp.T @ a  # (R·k, n)
+    numerh = wp.T @ a  # (R·k, n_local)
     gw_full = wp.T @ wp
     a2 = jnp.sum(a * a)
     if feature_axis is not None:
@@ -128,9 +131,19 @@ def residual_norms(a: jax.Array, wp: jax.Array, hp: jax.Array, r: int,
         gw_full = lax.psum(gw_full, feature_axis)
         a2 = lax.psum(a2, feature_axis)
         m = m_total
-    gw = _diag_blocks(gw_full, r)  # (R, k, k)
-    gh = _diag_blocks(hp @ hp.T, r)
+    gh_full = hp @ hp.T
     cross = _block_sums(numerh * hp, r)
+    if sample_axis is not None:
+        if n_total is None:
+            raise ValueError(
+                "residual_norms with sample_axis needs n_total (the "
+                "unsharded column count)")
+        gh_full = lax.psum(gh_full, sample_axis)
+        cross = lax.psum(cross, sample_axis)
+        a2 = lax.psum(a2, sample_axis)
+        n = n_total
+    gw = _diag_blocks(gw_full, r)  # (R, k, k)
+    gh = _diag_blocks(gh_full, r)
     quad = jnp.sum(gw * gh, axis=(1, 2))
     sq = jnp.maximum(a2 - 2.0 * cross + quad, 0.0)
     return jnp.sqrt(sq / (m * n))
@@ -145,7 +158,8 @@ def _labels(hp: jax.Array, r: int) -> jax.Array:
 def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
           check: bool, use_pallas: bool = False, block_m: int = 512,
           interpret: bool = False,
-          feature_axis: str | None = None) -> PackedState:
+          feature_axis: str | None = None,
+          sample_axis: str | None = None) -> PackedState:
     m, n = a.shape
     k = state.hp.shape[0] // r
     wp0, hp0 = state.wp, state.hp
@@ -184,9 +198,13 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
         hp = _mu_update(hp0, numerh, denomh, cfg)
 
         hb = hp.astype(jnp.bfloat16)
-        gh = jnp.matmul(hb, hb.T, preferred_element_type=f32) * bd
+        gh = jnp.matmul(hb, hb.T, preferred_element_type=f32)
         numerw = jnp.matmul(a, hb.T, preferred_element_type=f32)
-        denomw = wp0 @ gh
+        if sample_axis is not None:
+            # A/Hp are column shards: the n-contracted terms are partials
+            gh = lax.psum(gh, sample_axis)
+            numerw = lax.psum(numerw, sample_axis)
+        denomw = wp0 @ (gh * bd)
         wp = _mu_update(wp0, numerw, denomw, cfg)
     else:
         # H update — numerator GEMM plus the full W-Gram (cross-restart
@@ -200,9 +218,12 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
         hp = _mu_update(hp0, numerh, denomh, cfg)
 
         # W update with the fresh H (reference order, nmf_mu.c:198-216)
-        gh = (hp @ hp.T) * bd
+        gh = hp @ hp.T
         numerw = a @ hp.T
-        denomw = wp0 @ gh
+        if sample_axis is not None:
+            gh = lax.psum(gh, sample_axis)
+            numerw = lax.psum(numerw, sample_axis)
+        denomw = wp0 @ (gh * bd)
         wp = _mu_update(wp0, numerw, denomw, cfg)
 
     # freeze converged restarts (the vmapped while_loop does this masking
@@ -215,11 +236,12 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
                            iteration=it)
     if not check:
         return state
-    return _check(state, cfg, r, feature_axis)
+    return _check(state, cfg, r, feature_axis, sample_axis)
 
 
 def _check(state: PackedState, cfg: SolverConfig, r: int,
-           feature_axis: str | None = None) -> PackedState:
+           feature_axis: str | None = None,
+           sample_axis: str | None = None) -> PackedState:
     """Per-restart convergence tests, mirroring base.check_convergence for
     the mu solver (class stability first, then TolX) with (R,)-shaped
     bookkeeping instead of vmapped scalars."""
@@ -234,7 +256,14 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
 
     if cfg.use_class_stop:
         new_classes = _labels(state.hp, r)
-        same = jnp.all(new_classes == state.classes, axis=1)  # (R,)
+        if sample_axis is None:
+            same = jnp.all(new_classes == state.classes, axis=1)  # (R,)
+        else:
+            # labels are column shards: "all columns unchanged" is a global
+            # AND — count local mismatches, psum, compare to zero
+            mism = jnp.sum((new_classes != state.classes).astype(jnp.int32),
+                           axis=1)
+            same = lax.psum(mism, sample_axis) == 0
         stable = jnp.where(active, jnp.where(same, state.stable + 1, 0),
                            state.stable)
         classes = jnp.where(active[:, None], new_classes, state.classes)
@@ -252,19 +281,26 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
 
         m = state.wp.shape[0]
         n = state.hp.shape[1]
+
+        def _delta_sharded(cur, prev, axes, shape, mesh_axis):
+            # sharded maxchange is a ratio of *global* maxima: pmax the
+            # ratio's ingredients before dividing
+            diff = lax.pmax(jnp.max(jnp.abs(cur - prev).reshape(shape),
+                                    axis=axes), mesh_axis)
+            ref = lax.pmax(jnp.max(jnp.abs(prev).reshape(shape), axis=axes),
+                           mesh_axis)
+            return diff / (sqrteps + ref)
+
         if feature_axis is None:
             dw = _delta(state.wp, state.wp_prev, (0, 2), (m, r, k))
         else:
-            # W rows are sharded: maxchange is a ratio of global maxima, so
-            # pmax the ratio's ingredients before dividing
-            diff = lax.pmax(
-                jnp.max(jnp.abs(state.wp - state.wp_prev)
-                        .reshape(m, r, k), axis=(0, 2)), feature_axis)
-            ref = lax.pmax(
-                jnp.max(jnp.abs(state.wp_prev).reshape(m, r, k),
-                        axis=(0, 2)), feature_axis)
-            dw = diff / (sqrteps + ref)
-        dh = _delta(state.hp, state.hp_prev, (1, 2), (r, k, n))
+            dw = _delta_sharded(state.wp, state.wp_prev, (0, 2), (m, r, k),
+                                feature_axis)
+        if sample_axis is None:
+            dh = _delta(state.hp, state.hp_prev, (1, 2), (r, k, n))
+        else:
+            dh = _delta_sharded(state.hp, state.hp_prev, (1, 2), (r, k, n),
+                                sample_axis)
         delta = jnp.maximum(dw, dh)  # (R,)
         hit = active & (delta < cfg.tol_x) & ~done
         done = done | hit
@@ -277,12 +313,14 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
 
 
 @partial(jax.jit, static_argnames=("cfg", "varying_axes", "feature_axis",
-                                   "m_total"))
+                                   "m_total", "sample_axis", "n_total"))
 def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
               cfg: SolverConfig = SolverConfig(),
               varying_axes: tuple[str, ...] = (),
               feature_axis: str | None = None,
-              m_total: int | None = None) -> PackedMUResult:
+              m_total: int | None = None,
+              sample_axis: str | None = None,
+              n_total: int | None = None) -> PackedMUResult:
     """Solve the whole restart batch with packed GEMM iterations.
 
     Semantically equivalent to ``vmap(solve)`` with ``algorithm='mu'``
@@ -300,15 +338,22 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
     "feature-dimension sharding"). The two m-contracted terms of the H
     update (WpᵀA and WpᵀWp) become one fused ``psum`` pair per iteration
     over that axis; the entire W half-step stays device-local. ``m_total``
-    is the unsharded row count (for RMS normalization). H and all
-    convergence bookkeeping are replicated across the feature axis.
+    is the unsharded row count (for RMS normalization).
+
+    ``sample_axis``: the mirror image for A's columns and Hp (this
+    workload's sequence/context-parallel dimension): the two n-contracted
+    terms of the W update (AHpᵀ and HpHpᵀ) psum over it while the H
+    half-step stays local. Both axes compose — a 2-D (feature × sample)
+    shard of A is SUMMA-style parallelism for a single huge factorization,
+    and either composes with the restart (data-parallel) axis.
     """
     if cfg.algorithm != "mu":
         raise ValueError("mu_packed only implements the mu algorithm")
-    if feature_axis is not None and cfg.backend == "pallas":
-        raise ValueError("feature-axis sharding is not supported with the "
-                         "pallas backend (the fused kernels have no "
-                         "collective stage); use backend='packed'")
+    if (feature_axis is not None or sample_axis is not None) \
+            and cfg.backend == "pallas":
+        raise ValueError("feature/sample-axis sharding is not supported "
+                         "with the pallas backend (the fused kernels have "
+                         "no collective stage); use backend='packed'")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0s = jnp.asarray(w0s, dtype)
@@ -364,7 +409,7 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
             a_loop = a.astype(jnp.bfloat16)
         step = partial(_step, a_loop, bd, use_pallas=use_pallas,
                        block_m=block_m, interpret=interpret,
-                       feature_axis=feature_axis)
+                       feature_axis=feature_axis, sample_axis=sample_axis)
 
         def cond(s: PackedState):
             return jnp.any(~s.done) & (s.iteration + cfg.check_every
@@ -386,7 +431,8 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
         iterations = jnp.where(final.done, final.done_iter, final.iteration)
         wp_final = final.wp[:m]  # drop pallas m-padding rows, if any
         dnorm = residual_norms(a_true, wp_final, final.hp, r,
-                               feature_axis=feature_axis, m_total=m_total)
+                               feature_axis=feature_axis, m_total=m_total,
+                               sample_axis=sample_axis, n_total=n_total)
     return PackedMUResult(wp=wp_final, hp=final.hp,
                           iterations=iterations.astype(jnp.int32),
                           dnorm=dnorm, stop_reason=final.stop_reason)

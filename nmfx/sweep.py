@@ -34,6 +34,11 @@ RESTART_AXIS = "restarts"
 #: in one 2-D mesh (see feature_mesh)
 FEATURE_AXIS = "features"
 
+#: mesh axis name for the sample (column) dimension of A and H — the
+#: sequence/context-parallel axis. Composable with both other axes into the
+#: full 3-D restarts×features×samples mesh (see grid_mesh)
+SAMPLE_AXIS = "samples"
+
 
 class KSweepOutput(NamedTuple):
     consensus: jax.Array  # (n, n)
@@ -62,19 +67,22 @@ def _use_packed(solver_cfg: SolverConfig) -> bool:
 @lru_cache(maxsize=64)
 def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                     init_cfg: InitConfig, label_rule: str, mesh: Mesh | None):
-    if (mesh is not None and FEATURE_AXIS in mesh.axis_names
-            and mesh.shape[FEATURE_AXIS] > 1):
+    grid = (mesh is not None
+            and any(ax in mesh.axis_names and mesh.shape[ax] > 1
+                    for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
+    if grid:
         if not _use_packed(solver_cfg) or solver_cfg.backend == "pallas":
             raise ValueError(
-                "feature-axis sharding requires the packed mu backend "
-                f"(algorithm='mu', backend='packed'/'auto'); got "
+                "feature/sample-axis sharding requires the packed mu "
+                f"backend (algorithm='mu', backend='packed'/'auto'); got "
                 f"algorithm={solver_cfg.algorithm!r}, "
                 f"backend={solver_cfg.backend!r}")
         if init_cfg.method != "random":
             raise ValueError(
-                "feature-axis sharding supports init method 'random' only "
-                "(NNDSVD needs the full matrix on every device)")
-        return _build_feature_sharded_sweep_fn(
+                "feature/sample-axis sharding supports init method "
+                "'random' only (NNDSVD needs the full matrix on every "
+                "device)")
+        return _build_grid_sharded_sweep_fn(
             k, restarts, solver_cfg, init_cfg, label_rule, mesh)
     if _use_packed(solver_cfg):
         return _build_packed_sweep_fn(k, restarts, solver_cfg, init_cfg,
@@ -214,58 +222,81 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     return jax.jit(impl)
 
 
-def _build_feature_sharded_sweep_fn(k: int, restarts: int,
-                                    solver_cfg: SolverConfig,
-                                    init_cfg: InitConfig, label_rule: str,
-                                    mesh: Mesh):
-    """Sweep builder for a mesh with a feature (row) axis — optionally
-    composed with the restart axis in a 2-D ``restarts×features`` mesh.
+def _build_grid_sharded_sweep_fn(k: int, restarts: int,
+                                 solver_cfg: SolverConfig,
+                                 init_cfg: InitConfig, label_rule: str,
+                                 mesh: Mesh):
+    """Sweep builder for a mesh with feature (row) and/or sample (column)
+    axes, optionally composed with the restart axis — up to the full 3-D
+    ``restarts×features×samples`` (data × tensor × sequence) mesh.
 
-    SPMD layout: A and Wp are row-sharded over ``FEATURE_AXIS`` (the
-    tensor-parallel dimension for a workload whose model state is W); H,
-    labels, and all convergence bookkeeping are replicated across it. Per
-    iteration the packed solver psums exactly two m-contracted terms (WpᵀA,
-    WpᵀWp) over the feature axis (see ``mu_packed``); the consensus
-    reduction psums over the restart axis as in the 1-D path. W0 is drawn
-    from the same per-restart keys as every other execution path and then
-    row-sliced, so a given (seed, k, restart) yields the same factorization
-    on any mesh shape (modulo float reduction order).
+    SPMD layout: A is tiled over (FEATURE_AXIS, SAMPLE_AXIS); Wp is
+    row-sharded over features (replicated over samples); Hp is
+    column-sharded over samples (replicated over features). Per iteration
+    the packed solver psums exactly two m-contracted terms of the H update
+    over features and two n-contracted terms of the W update over samples
+    (SUMMA-style — see ``mu_packed``); labels are computed on local columns
+    with the class-stability AND reduced by one tiny psum. The consensus
+    reduction psums over the restart axis as in the 1-D path. W0/H0 are
+    drawn from the canonical per-restart keys and then row/column-sliced,
+    so a given (seed, k, restart) yields the same factorization on any mesh
+    shape (modulo float reduction order).
     """
     from nmfx.ops.packed_mu import mu_packed, unpack_w
 
-    has_restart = (RESTART_AXIS in mesh.axis_names
-                   and mesh.shape[RESTART_AXIS] > 1)
-    n_rshards = mesh.shape[RESTART_AXIS] if has_restart else 1
-    f_shards = mesh.shape[FEATURE_AXIS]
+    def axis_size(name):
+        return mesh.shape[name] if name in mesh.axis_names else 1
+
+    has_restart = axis_size(RESTART_AXIS) > 1
+    has_feature = axis_size(FEATURE_AXIS) > 1
+    has_sample = axis_size(SAMPLE_AXIS) > 1
+    n_rshards = axis_size(RESTART_AXIS) if has_restart else 1
+    f_shards = axis_size(FEATURE_AXIS)
+    s_shards = axis_size(SAMPLE_AXIS)
     padded = _pad_count(restarts, mesh)
     r_local = padded // n_rshards
     dtype = jnp.dtype(solver_cfg.dtype)
-    vary_axes = ((RESTART_AXIS, FEATURE_AXIS) if has_restart
-                 else (FEATURE_AXIS,))
+    vary_axes = tuple(ax for ax, has in
+                      ((RESTART_AXIS, has_restart),
+                       (FEATURE_AXIS, has_feature),
+                       (SAMPLE_AXIS, has_sample)) if has)
 
     def shard_body(a_loc: jax.Array, keys: jax.Array,
-                   m_true: int) -> KSweepOutput:
-        m_loc = a_loc.shape[0]
+                   m_true: int, n_true: int) -> KSweepOutput:
+        m_loc, n_loc = a_loc.shape
         m_pad = m_loc * f_shards
-        n = a_loc.shape[1]
-        fidx = lax.axis_index(FEATURE_AXIS)
-        # full-m W0 from the canonical per-restart keys (identical draws on
-        # every mesh shape), immediately row-sliced to this shard's block so
-        # peak transient memory is one restart's m×k, not r_local·m×k; rows
-        # past the true m (padding) are zeroed so they stay exactly zero
-        # under the mu update and contribute nothing to the psummed Grams
-        def init_one(kk):
-            w0, h0 = random_init(kk, m_true, n, k, init_cfg, dtype)
-            w0 = jnp.pad(w0, ((0, m_pad - m_true), (0, 0)))
-            return (lax.dynamic_slice_in_dim(w0, fidx * m_loc, m_loc,
-                                             axis=0), h0)
+        n_pad = n_loc * s_shards
+        fidx = lax.axis_index(FEATURE_AXIS) if has_feature else 0
+        sidx = lax.axis_index(SAMPLE_AXIS) if has_sample else 0
 
-        w0s_loc, h0s = lax.map(init_one, keys)
-        res = mu_packed(a_loc, w0s_loc, h0s, solver_cfg,
-                        varying_axes=vary_axes, feature_axis=FEATURE_AXIS,
-                        m_total=m_true)
-        hs = res.hp.reshape(r_local, k, -1)
-        labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hs)
+        # full W0/H0 from the canonical per-restart keys (identical draws on
+        # every mesh shape), immediately sliced to this shard's row/column
+        # blocks so peak transient memory is one restart's m×k + k×n, not
+        # r_local times that; rows/columns past the true dims (padding) are
+        # zeroed so they stay exactly zero under the mu update and
+        # contribute nothing to the psummed Grams
+        def init_one(kk):
+            w0, h0 = random_init(kk, m_true, n_true, k, init_cfg, dtype)
+            w0 = jnp.pad(w0, ((0, m_pad - m_true), (0, 0)))
+            h0 = jnp.pad(h0, ((0, 0), (0, n_pad - n_true)))
+            return (lax.dynamic_slice_in_dim(w0, fidx * m_loc, m_loc,
+                                             axis=0),
+                    lax.dynamic_slice_in_dim(h0, sidx * n_loc, n_loc,
+                                             axis=1))
+
+        w0s_loc, h0s_loc = lax.map(init_one, keys)
+        res = mu_packed(a_loc, w0s_loc, h0s_loc, solver_cfg,
+                        varying_axes=vary_axes,
+                        feature_axis=FEATURE_AXIS if has_feature else None,
+                        m_total=m_true,
+                        sample_axis=SAMPLE_AXIS if has_sample else None,
+                        n_total=n_true)
+        hs_loc = res.hp.reshape(r_local, k, -1)
+        labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hs_loc)
+        if has_sample:
+            labels = lax.all_gather(labels, SAMPLE_AXIS, tiled=True,
+                                    axis=1)  # (r_local, n_pad)
+        labels = labels[:, :n_true]
 
         gidx = ((lax.axis_index(RESTART_AXIS) if has_restart else 0)
                 * r_local + jnp.arange(r_local))
@@ -286,62 +317,89 @@ def _build_feature_sharded_sweep_fn(k: int, restarts: int,
         stop_g = rgather(res.stop_reason)
         labels_g = rgather(labels)
         # best restart: local candidate per restart shard; pick the global
-        # winner from gathered *scalars* only, select its (still feature-
-        # sharded) factors with a masked psum, and feature-gather the full-m
-        # W exactly once — at no point does any device hold more than one
-        # full-m factor matrix
-        best = jnp.argmin(jnp.where(valid, res.dnorm, jnp.inf))
+        # winner from gathered *scalars* only, select its (still sharded)
+        # factors with a masked psum, then one feature/sample gather into
+        # the full factors — at no point does any device hold more than one
+        # full-size factor matrix
+        masked_dnorm = jnp.where(valid, res.dnorm, jnp.inf)
+        best = jnp.argmin(masked_dnorm)
         bw_loc = unpack_w(res.wp, r_local)[best]  # (m_loc, k)
-        bh = hs[best]
-        bd = jnp.where(valid, res.dnorm, jnp.inf)[best]
+        bh_loc = hs_loc[best]  # (k, n_loc)
+        bd = masked_dnorm[best]
         if has_restart:
             bds = lax.all_gather(bd, RESTART_AXIS)
             gbest = jnp.argmin(bds)
             win = (lax.axis_index(RESTART_AXIS) == gbest)
             bw_loc = lax.psum(bw_loc * win.astype(bw_loc.dtype),
                               RESTART_AXIS)
-            bh = lax.psum(bh * win.astype(bh.dtype), RESTART_AXIS)
-        bw = lax.all_gather(bw_loc, FEATURE_AXIS, tiled=True,
-                            axis=0)[:m_true]
+            bh_loc = lax.psum(bh_loc * win.astype(bh_loc.dtype),
+                              RESTART_AXIS)
+        bw = bw_loc
+        if has_feature:
+            bw = lax.all_gather(bw, FEATURE_AXIS, tiled=True, axis=0)
+        bw = bw[:m_true]
+        bh = bh_loc
+        if has_sample:
+            bh = lax.all_gather(bh, SAMPLE_AXIS, tiled=True, axis=1)
+        bh = bh[:, :n_true]
         return KSweepOutput(cons, iters_g[:restarts], dnorm_g[:restarts],
                             stop_g[:restarts], labels_g[:restarts], bw, bh)
 
-    a_specs = P(FEATURE_AXIS)
+    a_specs = P(FEATURE_AXIS if has_feature else None,
+                SAMPLE_AXIS if has_sample else None)
     key_specs = P(RESTART_AXIS) if has_restart else P()
 
     def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
         a = jnp.asarray(a, dtype)
-        m_true = a.shape[0]
+        m_true, n_true = a.shape
         m_pad = -(-m_true // f_shards) * f_shards
-        if m_pad != m_true:
-            a = jnp.pad(a, ((0, m_pad - m_true), (0, 0)))
+        n_pad = -(-n_true // s_shards) * s_shards
+        if (m_pad, n_pad) != (m_true, n_true):
+            a = jnp.pad(a, ((0, m_pad - m_true), (0, n_pad - n_true)))
         keys = jax.random.split(key, padded)
-        sharded = jax.shard_map(partial(shard_body, m_true=m_true),
-                                mesh=mesh, in_specs=(a_specs, key_specs),
-                                out_specs=P(), check_vma=False)
+        sharded = jax.shard_map(
+            partial(shard_body, m_true=m_true, n_true=n_true),
+            mesh=mesh, in_specs=(a_specs, key_specs),
+            out_specs=P(), check_vma=False)
         return sharded(a, keys)
 
     return jax.jit(impl)
 
 
-def feature_mesh(restart_shards: int | None = None,
-                 feature_shards: int = 1) -> Mesh:
-    """A 2-D ``restarts×features`` mesh over the local devices.
+def grid_mesh(restart_shards: int | None = None,
+              feature_shards: int = 1,
+              sample_shards: int = 1) -> Mesh:
+    """A mesh over the local devices with up to three axes:
+    ``restarts`` (data parallel) × ``features`` (tensor parallel, rows of
+    A/W) × ``samples`` (sequence parallel, columns of A/H).
 
     ``restart_shards=None`` uses all remaining devices on the restart axis.
-    With ``feature_shards=1`` this degenerates to the default 1-D restart
-    mesh; with ``restart_shards=1`` it is pure feature (tensor) parallelism
-    for a single huge factorization.
+    Any axis of size 1 is effectively off; (R,1,1) is the default restart
+    mesh, (1,F,S) is pure SUMMA-style 2-D parallelism for one huge
+    factorization.
     """
     devices = jax.devices()
     if restart_shards is None:
-        restart_shards = len(devices) // feature_shards
-    n = restart_shards * feature_shards
+        restart_shards = len(devices) // (feature_shards * sample_shards)
+    n = restart_shards * feature_shards * sample_shards
     if n > len(devices):
         raise ValueError(
-            f"mesh {restart_shards}x{feature_shards} needs {n} devices, "
-            f"have {len(devices)}")
-    return Mesh(np.array(devices[:n]).reshape(restart_shards, feature_shards),
+            f"mesh {restart_shards}x{feature_shards}x{sample_shards} needs "
+            f"{n} devices, have {len(devices)}")
+    return Mesh(
+        np.array(devices[:n]).reshape(restart_shards, feature_shards,
+                                      sample_shards),
+        (RESTART_AXIS, FEATURE_AXIS, SAMPLE_AXIS))
+
+
+def feature_mesh(restart_shards: int | None = None,
+                 feature_shards: int = 1) -> Mesh:
+    """A 2-D ``restarts×features`` mesh: ``grid_mesh`` without a sample
+    axis (kept for the common tall-matrix case)."""
+    if restart_shards is None:
+        restart_shards = len(jax.devices()) // feature_shards
+    mesh = grid_mesh(restart_shards, feature_shards, 1)
+    return Mesh(mesh.devices.reshape(restart_shards, feature_shards),
                 (RESTART_AXIS, FEATURE_AXIS))
 
 
@@ -419,16 +477,30 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
 
 
 def place_input(a, solver_cfg: SolverConfig, mesh: Mesh | None) -> jax.Array:
-    """Transfer A to device in the solver dtype, replicated across the mesh.
+    """Transfer A to device in the solver dtype: replicated across a
+    restart-only mesh, *tiled* over any feature/sample axes — so an A whose
+    m or n outgrows one device's HBM is never materialized whole on any
+    single device (the point of the grid axes). Host arrays are dtype-cast
+    host-side before placement for the same reason.
 
     Idempotent: an already-placed array passes through untouched, so callers
     that loop over ranks (``sweep``) pay the host→device transfer exactly
     once instead of once per rank.
     """
-    a = jnp.asarray(a, jnp.dtype(solver_cfg.dtype))
-    if mesh is not None:
-        a = jax.device_put(a, NamedSharding(mesh, P()))
-    return a
+    dtype = jnp.dtype(solver_cfg.dtype)
+    if mesh is None:
+        return jnp.asarray(a, dtype)
+
+    def ax(name):
+        on = name in mesh.axis_names and mesh.shape[name] > 1
+        return name if on else None
+
+    spec = P(ax(FEATURE_AXIS), ax(SAMPLE_AXIS))
+    if not isinstance(a, jax.Array):
+        a = np.asarray(a, dtype)
+    elif a.dtype != dtype:
+        a = jnp.asarray(a, dtype)
+    return jax.device_put(a, NamedSharding(mesh, spec))
 
 
 def _template(a, k: int, restarts: int,

@@ -134,3 +134,38 @@ def test_feature_sharding_rejects_unsupported_configs(low_rank_data):
         sweep_one_k(a, jax.random.key(0), k=2, restarts=4,
                     solver_cfg=SolverConfig(),
                     init_cfg=InitConfig(method="nndsvd"), mesh=mesh)
+
+
+# --- full 3-axis grid: restarts (dp) x features (tp) x samples (sp) --------
+
+from nmfx.sweep import SAMPLE_AXIS, grid_mesh  # noqa: E402
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 2, 4), (2, 1, 4),
+                                   (1, 1, 8)])
+def test_grid_sharded_matches_unsharded(low_rank_data, shape):
+    """SUMMA-style 2-D sharding of each factorization (A tiled over
+    features x samples, W row-sharded, H column-sharded) composed with the
+    restart axis must reproduce the unsharded sweep exactly: same labels
+    and iteration counts on every mesh shape."""
+    a, _ = low_rank_data
+    a = a[:53, :21]  # both dims uneven across every shard count used here
+    cfg = SolverConfig(max_iter=120)
+    key = jax.random.key(5)
+    ref = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg, mesh=None)
+    got = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg,
+                      mesh=grid_mesh(*shape))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.dnorms),
+                               np.asarray(ref.dnorms), rtol=1e-3)
+    assert got.best_w.shape == (53, 3)
+    assert got.best_h.shape == (3, 21)
+    np.testing.assert_allclose(np.asarray(got.best_w),
+                               np.asarray(ref.best_w), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(got.best_h),
+                               np.asarray(ref.best_h), rtol=5e-3, atol=5e-4)
