@@ -64,6 +64,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the summary only, write nothing")
     p.add_argument("--no-mesh", action="store_true",
                    help="disable sharding over the local device mesh")
+    p.add_argument("--feature-shards", type=int, default=1,
+                   help="tile each factorization's rows (A, W) across this "
+                        "many devices — tensor parallelism for m too large "
+                        "for one device (default 1 = off)")
+    p.add_argument("--sample-shards", type=int, default=1,
+                   help="tile each factorization's columns (A, H) across "
+                        "this many devices — sequence parallelism for huge "
+                        "n (default 1 = off)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="persist per-rank results here and resume an "
                         "interrupted sweep from completed ranks")
@@ -96,6 +104,25 @@ def main(argv: list[str] | None = None) -> int:
 
     profiler = (Profiler(trace_dir=args.trace_dir) if args.profile
                 else NullProfiler())
+    if args.feature_shards < 1 or args.sample_shards < 1:
+        parser.error("--feature-shards/--sample-shards must be >= 1")
+    mesh = None
+    if args.feature_shards > 1 or args.sample_shards > 1:
+        if args.no_mesh:
+            parser.error("--feature-shards/--sample-shards conflict with "
+                         "--no-mesh")
+        if args.algorithm != "mu" or args.backend == "pallas":
+            parser.error("--feature-shards/--sample-shards require "
+                         "--algorithm mu with --backend auto or packed")
+        if args.init != "random":
+            parser.error("--feature-shards/--sample-shards require "
+                         "--init random")
+        from nmfx.sweep import grid_mesh
+
+        try:
+            mesh = grid_mesh(None, args.feature_shards, args.sample_shards)
+        except ValueError as e:
+            parser.error(str(e))
     with profiler:
         result = nmfconsensus(
             args.dataset,
@@ -108,6 +135,7 @@ def main(argv: list[str] | None = None) -> int:
                                     backend=args.backend),
             init=args.init,
             label_rule=args.label_rule,
+            mesh=mesh,
             use_mesh=not args.no_mesh,
             rank_selection=args.rank_selection,
             output=output,

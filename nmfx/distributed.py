@@ -32,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from nmfx.sweep import RESTART_AXIS
+from nmfx.sweep import FEATURE_AXIS, RESTART_AXIS, SAMPLE_AXIS
 
 
 def initialize(coordinator_address: str | None = None,
@@ -107,26 +107,47 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
-def global_mesh() -> Mesh:
-    """1-D mesh over every device in the job (all hosts), restart axis.
+def global_mesh(feature_shards: int = 1, sample_shards: int = 1) -> Mesh:
+    """Mesh over every device in the job (all hosts): restart axis by
+    default, optionally a 3-D restarts×features×samples grid.
 
     ``jax.devices()`` is the *global* device list under multi-process JAX,
     so jitting with this mesh is the cross-host SPMD program; with one
-    process it equals the local mesh.
+    process it equals the local mesh. The grid axes are laid out innermost
+    (the global device list is process-major), so the per-iteration psums
+    of the feature/sample axes ride ICI within a host/slice while the
+    collective-light restart axis spans DCN — the layout
+    jax-ml.github.io/scaling-book prescribes for bandwidth-hungry axes.
     """
-    return Mesh(np.array(jax.devices()), (RESTART_AXIS,))
+    devices = jax.devices()
+    if feature_shards == 1 and sample_shards == 1:
+        return Mesh(np.array(devices), (RESTART_AXIS,))
+    grid = feature_shards * sample_shards
+    if len(devices) % grid:
+        raise ValueError(
+            f"{len(devices)} devices don't divide into "
+            f"features×samples={feature_shards}×{sample_shards}")
+    from nmfx.sweep import grid_mesh
+
+    return grid_mesh(len(devices) // grid, feature_shards, sample_shards,
+                     devices=devices)
 
 
-def consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, **kwargs):
+def consensus(data, ks=(2, 3, 4, 5), restarts: int = 10,
+              feature_shards: int = 1, sample_shards: int = 1, **kwargs):
     """``nmfx.api.nmfconsensus`` over the global mesh.
 
-    File/plot outputs (``output=``, ``checkpoint_dir=``) are only honored on
-    the coordinator so hosts sharing a filesystem don't race on the same
-    paths; the returned in-memory result is identical on every host.
+    ``feature_shards``/``sample_shards`` tile each factorization across
+    devices (tensor/sequence parallelism — for A too large for one device's
+    HBM); the remaining devices parallelize restarts. File/plot outputs
+    (``output=``, ``checkpoint_dir=``) are only honored on the coordinator
+    so hosts sharing a filesystem don't race on the same paths; the
+    returned in-memory result is identical on every host.
     """
     from nmfx.api import nmfconsensus
 
     if not is_coordinator():
         kwargs = dict(kwargs, output=None, checkpoint_dir=None)
-    return nmfconsensus(data, ks=ks, restarts=restarts, mesh=global_mesh(),
+    return nmfconsensus(data, ks=ks, restarts=restarts,
+                        mesh=global_mesh(feature_shards, sample_shards),
                         **kwargs)

@@ -368,24 +368,31 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
 
 def grid_mesh(restart_shards: int | None = None,
               feature_shards: int = 1,
-              sample_shards: int = 1) -> Mesh:
-    """A mesh over the local devices with up to three axes:
-    ``restarts`` (data parallel) × ``features`` (tensor parallel, rows of
-    A/W) × ``samples`` (sequence parallel, columns of A/H).
+              sample_shards: int = 1,
+              devices=None) -> Mesh:
+    """A mesh over ``devices`` (default: the local devices) with up to
+    three axes: ``restarts`` (data parallel) × ``features`` (tensor
+    parallel, rows of A/W) × ``samples`` (sequence parallel, columns of
+    A/H).
 
     ``restart_shards=None`` uses all remaining devices on the restart axis.
     Any axis of size 1 is effectively off; (R,1,1) is the default restart
     mesh, (1,F,S) is pure SUMMA-style 2-D parallelism for one huge
     factorization.
     """
-    devices = jax.devices()
+    if feature_shards < 1 or sample_shards < 1:
+        raise ValueError(
+            f"shard counts must be >= 1, got features={feature_shards}, "
+            f"samples={sample_shards}")
+    devices = list(jax.devices() if devices is None else devices)
     if restart_shards is None:
         restart_shards = len(devices) // (feature_shards * sample_shards)
     n = restart_shards * feature_shards * sample_shards
-    if n > len(devices):
+    if restart_shards < 1 or n > len(devices):
         raise ValueError(
             f"mesh {restart_shards}x{feature_shards}x{sample_shards} needs "
-            f"{n} devices, have {len(devices)}")
+            f"{max(n, feature_shards * sample_shards)} devices, have "
+            f"{len(devices)}")
     return Mesh(
         np.array(devices[:n]).reshape(restart_shards, feature_shards,
                                       sample_shards),

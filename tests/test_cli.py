@@ -1,0 +1,81 @@
+"""CLI surface tests (reference entry semantics: runNMFinJobs args,
+nmf.r:106) — run in-process on the 8-device virtual CPU platform."""
+
+import numpy as np
+import pytest
+
+from nmfx.cli import build_parser, main, parse_ks
+from nmfx.io import write_gct
+
+
+@pytest.fixture(scope="module")
+def gct_path(tmp_path_factory):
+    from nmfx.datasets import two_group_matrix
+
+    a = two_group_matrix(n_genes=60, n_per_group=8, seed=1)
+    path = tmp_path_factory.mktemp("cli") / "demo.gct"
+    write_gct(a, str(path), row_names=[f"g{i}" for i in range(60)],
+              col_names=[f"s{i}" for i in range(16)])
+    return str(path)
+
+
+def test_parse_ks():
+    assert parse_ks("2-5") == (2, 3, 4, 5)
+    assert parse_ks("2,4,8") == (2, 4, 8)
+    assert parse_ks("3") == (3,)
+
+
+def test_cli_smoke(gct_path, capsys):
+    rc = main([gct_path, "--ks", "2-3", "--restarts", "4",
+               "--maxiter", "150", "--no-files"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "best k = 2" in out
+
+
+def test_cli_grid_shards(gct_path, capsys):
+    rc = main([gct_path, "--ks", "2", "--restarts", "4", "--maxiter", "100",
+               "--no-files", "--feature-shards", "2", "--sample-shards", "2"])
+    assert rc == 0
+    assert "best k = 2" in capsys.readouterr().out
+
+
+def test_cli_rejects_bad_combos(gct_path):
+    with pytest.raises(SystemExit):
+        main([gct_path, "--feature-shards", "2", "--no-mesh", "--no-files"])
+    with pytest.raises(SystemExit):
+        main([gct_path, "--backend", "packed", "--algorithm", "als",
+              "--no-files"])
+    with pytest.raises(SystemExit):
+        main([gct_path, "--trace-dir", "/tmp/x", "--no-files"])
+
+
+def test_cli_writes_outputs(gct_path, tmp_path, capsys):
+    outdir = tmp_path / "out"
+    rc = main([gct_path, "--ks", "2", "--restarts", "3", "--maxiter", "100",
+               "--outdir", str(outdir), "--no-plots"])
+    assert rc == 0
+    names = {p.name for p in outdir.iterdir()}
+    assert "cophenetic.txt" in names
+    assert "consensus.k.2.gct" in names
+
+
+def test_cli_shard_flag_validation(gct_path):
+    for argv in (
+        [gct_path, "--feature-shards", "0", "--no-files"],
+        [gct_path, "--feature-shards", "16", "--no-files"],  # > devices
+        [gct_path, "--feature-shards", "2", "--algorithm", "als",
+         "--no-files"],
+        [gct_path, "--sample-shards", "2", "--init", "nndsvd", "--no-files"],
+    ):
+        with pytest.raises(SystemExit):
+            main(argv)
+
+
+def test_grid_mesh_validation():
+    from nmfx.sweep import grid_mesh
+
+    with pytest.raises(ValueError, match="devices"):
+        grid_mesh(None, 16, 1)  # f*s exceeds the 8 test devices
+    with pytest.raises(ValueError, match=">= 1"):
+        grid_mesh(2, 0, 1)
