@@ -35,6 +35,7 @@ class KResult:
     k: int
     consensus: np.ndarray  # (n, n) mean connectivity
     rho: float  # cophenetic correlation
+    dispersion: float  # Kim & Park (2007): mean (2C-1)^2, 1.0 = crisp
     membership: np.ndarray  # (n,) labels 1..k from cutree
     order: np.ndarray  # (n,) dendrogram leaf order
     iterations: np.ndarray  # (restarts,)
@@ -61,15 +62,23 @@ class ConsensusResult:
         return np.array([self.per_k[k].rho for k in self.ks])
 
     @property
+    def dispersions(self) -> np.ndarray:
+        """Kim & Park (2007) dispersion per k — a secondary rank-selection
+        signal alongside the reference's cophenetic rho (1.0 = every
+        consensus entry is 0 or 1, i.e. perfectly stable clustering)."""
+        return np.array([self.per_k[k].dispersion for k in self.ks])
+
+    @property
     def best_k(self) -> int:
         """Rank with the highest cophenetic correlation."""
         return self.ks[int(np.argmax(self.rhos))]
 
     def summary(self) -> str:
-        lines = ["k\trho\tmean_iters"]
+        lines = ["k\trho\tdispersion\tmean_iters"]
         for k in self.ks:
             r = self.per_k[k]
-            lines.append(f"{k}\t{r.rho:.4f}\t{r.iterations.mean():.1f}")
+            lines.append(f"{k}\t{r.rho:.4f}\t{r.dispersion:.4f}"
+                         f"\t{r.iterations.mean():.1f}")
         lines.append(f"best k = {self.best_k}")
         return "\n".join(lines)
 
@@ -206,7 +215,9 @@ def nmfconsensus(
             rho = float(np.format_float_positional(
                 rho, precision=4, fractional=False))  # signif(rho,4) nmf.r:172
         per_k[k] = KResult(
-            k=k, consensus=cons, rho=rho, membership=membership, order=order,
+            k=k, consensus=cons, rho=rho,
+            dispersion=float(np.mean((2.0 * cons - 1.0) ** 2)),
+            membership=membership, order=order,
             iterations=np.asarray(out.iterations),
             dnorms=np.asarray(out.dnorms),
             stop_reasons=np.asarray(out.stop_reasons),

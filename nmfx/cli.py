@@ -111,7 +111,7 @@ def main(argv: list[str] | None = None) -> int:
         if args.no_mesh:
             parser.error("--feature-shards/--sample-shards conflict with "
                          "--no-mesh")
-        if args.algorithm != "mu" or args.backend == "pallas":
+        if args.algorithm != "mu" or args.backend not in ("auto", "packed"):
             parser.error("--feature-shards/--sample-shards require "
                          "--algorithm mu with --backend auto or packed")
         if args.init != "random":

@@ -388,11 +388,16 @@ def grid_mesh(restart_shards: int | None = None,
     if restart_shards is None:
         restart_shards = len(devices) // (feature_shards * sample_shards)
     n = restart_shards * feature_shards * sample_shards
-    if restart_shards < 1 or n > len(devices):
+    if restart_shards < 1:
+        raise ValueError(
+            f"mesh {restart_shards}x{feature_shards}x{sample_shards}: "
+            "restart_shards must be >= 1 (auto-computed 0 means "
+            f"features×samples={feature_shards * sample_shards} exceeds the "
+            f"{len(devices)} available devices)")
+    if n > len(devices):
         raise ValueError(
             f"mesh {restart_shards}x{feature_shards}x{sample_shards} needs "
-            f"{max(n, feature_shards * sample_shards)} devices, have "
-            f"{len(devices)}")
+            f"{n} devices, have {len(devices)}")
     return Mesh(
         np.array(devices[:n]).reshape(restart_shards, feature_shards,
                                       sample_shards),

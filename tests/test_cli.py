@@ -79,3 +79,9 @@ def test_grid_mesh_validation():
         grid_mesh(None, 16, 1)  # f*s exceeds the 8 test devices
     with pytest.raises(ValueError, match=">= 1"):
         grid_mesh(2, 0, 1)
+
+
+def test_cli_rejects_vmap_with_shards(gct_path):
+    with pytest.raises(SystemExit):
+        main([gct_path, "--feature-shards", "2", "--backend", "vmap",
+              "--no-files"])

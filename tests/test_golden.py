@@ -123,3 +123,132 @@ def test_kl_monotone_divergence():
         w, h = res.w, res.h
         divs.append(float(kl_divergence(a, w, h)))
     assert all(b <= d + 1e-4 * abs(d) for d, b in zip(divs, divs[1:])), divs
+
+
+# --- projected-gradient family (Lin 2007) ----------------------------------
+
+def _pg_subprob_np(gram, ctc, x, tol, sub_max_iter=1000, sigma=0.01,
+                   beta=0.1, max_ls=20):
+    """f64 transliteration of the shared NNLS subsolver
+    (nmfx/solvers/pg_common.py; reference pg_subprob_{h,w}.c) including the
+    persistent step size, first-trial direction choice, and grow-mode
+    equality bailout."""
+    alpha = 1.0
+    it = 0
+    while it < sub_max_iter:
+        it += 1
+        grad = gram @ x - ctc
+        mask = (grad < 0) | (x > 0)
+        if np.sqrt(np.sum(np.where(mask, grad * grad, 0.0))) < tol:
+            break
+        xres, xp, decrease = x, x, None
+        for t in range(1, max_ls + 1):
+            xn = np.maximum(x - alpha * grad, 0.0)
+            d = xn - x
+            suff = ((1 - sigma) * np.vdot(grad, d)
+                    + 0.5 * np.vdot(gram @ d, d)) < 0
+            if t == 1:
+                decrease = not suff
+                xp = x
+            eq = np.array_equal(xp, xn)
+            if decrease and suff:
+                xres = xn
+                break
+            if (not decrease) and ((not suff) or eq):
+                xres = xp
+                break
+            if decrease:
+                alpha *= beta
+            else:
+                alpha /= beta
+                xp = xn
+        x = xres
+    return x, gram @ x - ctc, it
+
+
+def _alspg_numpy(a, w, h, iters, tol_pg=0.0):
+    """f64 transliteration of alspg (nmfx/solvers/alspg.py; reference
+    nmf_alspg.c): W-then-H subproblems with x0.1 tolerance tightening on
+    1-iteration returns."""
+    a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
+    gradw = w @ (h @ h.T) - a @ h.T
+    gradh = (w.T @ w) @ h - w.T @ a
+    initgrad = np.sqrt(np.sum(gradw**2) + np.sum(gradh**2))
+    tolw = tolh = max(tol_pg, 0.001) * initgrad
+    for _ in range(iters):
+        x, gw, itw = _pg_subprob_np(h @ h.T, h @ a.T, w.T, tolw)
+        w = x.T
+        if itw == 1:
+            tolw *= 0.1
+        x, gradh, ith = _pg_subprob_np(w.T @ w, w.T @ a, h, tolh)
+        h = x
+        if ith == 1:
+            tolh *= 0.1
+        gradw = gw.T
+    return w, h
+
+
+def _pg_numpy(a, w, h, iters, sigma=0.01, beta=0.1, max_trials=40):
+    """f64 transliteration of the direct pg solver (nmfx/solvers/pg.py;
+    reference nmf_pg.c): first-iteration H polish + objective seed, then
+    joint adaptive-step projected line searches."""
+    a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
+    h, _, _ = _pg_subprob_np(w.T @ w, w.T @ a, h, 0.001)
+    obj = 0.5 * np.sum((a - w @ h) ** 2)
+    alpha = 1.0
+    for _ in range(2, iters + 1):
+        gradw = w @ (h @ h.T) - a @ h.T
+        gradh = (w.T @ w) @ h - w.T @ a
+
+        def trial(al):
+            wn = np.maximum(w - al * gradw, 0.0)
+            hn = np.maximum(h - al * gradh, 0.0)
+            newobj = 0.5 * np.sum((a - wn @ hn) ** 2)
+            compval = np.vdot(gradw, wn - w) + np.vdot(gradh, hn - h)
+            return wn, hn, newobj, (newobj - obj) > sigma * compval
+
+        wp, hp, objp, fail0 = trial(alpha)
+        decrease = fail0
+        wres, hres, objres = w, h, obj
+        for _t in range(1, max_trials + 1):
+            alpha = alpha * beta if decrease else alpha / beta
+            wn, hn, newobj, fail = trial(alpha)
+            eq = np.array_equal(wn, wp) and np.array_equal(hn, hp)
+            if decrease and not fail:
+                wres, hres, objres = wn, hn, newobj
+                break
+            if (not decrease) and (fail or eq):
+                wres, hres, objres = wp, hp, objp
+                alpha *= beta  # back off to the accepted candidate's step
+                break
+            if not decrease:
+                wp, hp, objp = wn, hn, newobj
+        w, h, obj = wres, hres, objres
+    return w, h
+
+
+def _run_pg(algo, a, w0, h0, iters):
+    cfg = SolverConfig(algorithm=algo, max_iter=iters, tol_pg=0.0,
+                       use_class_stop=False, use_tol_checks=False)
+    return solve(jnp.asarray(a, jnp.float32), jnp.asarray(w0, jnp.float32),
+                 jnp.asarray(h0, jnp.float32), cfg)
+
+
+def test_alspg_matches_numpy_reference_math():
+    a, w0, h0 = _problem(seed=21)
+    w_ref, h_ref = _alspg_numpy(a, w0, h0, iters=5)
+    res = _run_pg("alspg", a, w0, h0, iters=5)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=5e-3,
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=5e-3,
+                               atol=5e-4)
+
+
+def test_pg_matches_numpy_reference_math():
+    a, w0, h0 = _problem(seed=31)
+    w_ref, h_ref = _pg_numpy(a, w0, h0, iters=6)
+    res = _run_pg("pg", a, w0, h0, iters=6)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=5e-3,
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=5e-3,
+                               atol=5e-4)

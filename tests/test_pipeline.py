@@ -117,3 +117,19 @@ def test_k_below_two_rejected(two_group_data):
     # reference guard: nmf.r:107-108
     with pytest.raises(ValueError):
         nmfconsensus(two_group_data, ks=(1, 2), restarts=2)
+
+
+def test_dispersion_metric(two_group_result):
+    """Kim & Park dispersion: 1.0 iff the consensus is crisp (all 0/1);
+    the clean two-group design at k=2 should be essentially crisp, and
+    every k's value must lie in (0, 1]."""
+    res = two_group_result
+    d = res.dispersions
+    assert d.shape == (3,)
+    assert np.all(d > 0) and np.all(d <= 1.0 + 1e-12)
+    assert res.per_k[2].dispersion > 0.95
+    # hand-check the definition on one consensus matrix
+    c = res.per_k[3].consensus
+    np.testing.assert_allclose(res.per_k[3].dispersion,
+                               np.mean((2 * c - 1) ** 2))
+    assert "dispersion" in res.summary().splitlines()[0]
