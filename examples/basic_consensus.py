@@ -1,0 +1,29 @@
+"""Minimal end-to-end consensus-NMF run on synthetic two-group data.
+
+Generates a 500-gene × 30-sample matrix with two planted groups, sweeps
+k = 2..5 × 20 restarts, and prints the rank-selection table — the
+cophenetic rho should peak at k = 2 with a crisp (dispersion ≈ 1.0)
+consensus matrix.
+
+    python examples/basic_consensus.py
+"""
+
+import nmfx
+from nmfx.datasets import two_group_matrix
+
+a = two_group_matrix(n_genes=500, n_per_group=15, seed=42)
+
+result = nmfx.nmfconsensus(
+    a,
+    ks=range(2, 6),
+    restarts=20,
+    seed=123,
+    solver_cfg=nmfx.SolverConfig(algorithm="mu",
+                                 matmul_precision="bfloat16"),
+    output=nmfx.OutputConfig(directory="out_basic"),
+)
+
+print(result.summary())
+print(f"\nbest k = {result.best_k}; outputs in out_basic/")
+print("consensus matrix for k=2, dendrogram-ordered:")
+print(result.per_k[2].ordered_consensus.round(2))

@@ -1,0 +1,24 @@
+"""Run the pipeline on a GCT file — e.g. the reference's bundled dataset.
+
+The reference ships ``20+20x1000.gct`` (1000 genes × 40 samples, two
+20-sample groups; reference ``nmf.r:11``). Point this script at any GCT:
+
+    python examples/reference_dataset.py path/to/data.gct
+"""
+
+import sys
+
+import nmfx
+
+path = sys.argv[1] if len(sys.argv) > 1 else "20+20x1000.gct"
+ds = nmfx.read_gct(path)
+print(f"{path}: {ds.values.shape[0]} genes x {ds.values.shape[1]} samples")
+
+result = nmfx.nmfconsensus(
+    ds,
+    ks=range(2, 6),
+    restarts=10,
+    seed=123,  # the reference example's seed (nmf.r:13)
+    output=nmfx.OutputConfig(directory="out_gct"),
+)
+print(result.summary())

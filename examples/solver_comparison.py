@@ -1,0 +1,29 @@
+"""Compare all six solver families on one factorization problem.
+
+Runs each algorithm on the same matrix/seed and reports the final RMS
+residual, iterations, and stop reason — the single-factorization API
+(``nmfx.nmf``, the analogue of the reference's ``doNMF``).
+
+    python examples/solver_comparison.py
+"""
+
+import time
+
+import numpy as np
+
+import nmfx
+from nmfx.config import ALGORITHMS
+from nmfx.datasets import grouped_matrix
+from nmfx.solvers import StopReason
+
+a = grouped_matrix(n_genes=800, group_sizes=(20, 20, 20), effect=2.0, seed=1)
+
+print(f"{'algorithm':10s} {'rms residual':>13s} {'iters':>6s} "
+      f"{'stop':>13s} {'wall s':>7s}")
+for algo in ALGORITHMS:
+    t0 = time.perf_counter()
+    res = nmfx.nmf(a, k=3, algorithm=algo, seed=0, max_iter=2000)
+    dnorm = float(np.asarray(res.dnorm))  # materialization = sync
+    wall = time.perf_counter() - t0
+    print(f"{algo:10s} {dnorm:13.5f} {int(res.iterations):6d} "
+          f"{StopReason(int(res.stop_reason)).name:>13s} {wall:7.2f}")

@@ -385,15 +385,16 @@ def grid_mesh(restart_shards: int | None = None,
             f"shard counts must be >= 1, got features={feature_shards}, "
             f"samples={sample_shards}")
     devices = list(jax.devices() if devices is None else devices)
-    if restart_shards is None:
+    auto = restart_shards is None
+    if auto:
         restart_shards = len(devices) // (feature_shards * sample_shards)
     n = restart_shards * feature_shards * sample_shards
     if restart_shards < 1:
+        why = (f"features×samples={feature_shards * sample_shards} exceeds "
+               f"the {len(devices)} available devices" if auto
+               else "restart_shards must be >= 1")
         raise ValueError(
-            f"mesh {restart_shards}x{feature_shards}x{sample_shards}: "
-            "restart_shards must be >= 1 (auto-computed 0 means "
-            f"features×samples={feature_shards * sample_shards} exceeds the "
-            f"{len(devices)} available devices)")
+            f"mesh {restart_shards}x{feature_shards}x{sample_shards}: {why}")
     if n > len(devices):
         raise ValueError(
             f"mesh {restart_shards}x{feature_shards}x{sample_shards} needs "
