@@ -2,10 +2,12 @@
 
 A brand-new JAX/XLA framework with the capabilities of mschubert/NMFconsensus
 (reference layer map in /root/repo/SURVEY.md): randomly-restarted non-negative
-matrix factorization (mu / als / neals / pg / alspg solvers, random or NNDSVD
-init), connectivity/consensus aggregation across restarts, and rank selection
-by cophenetic correlation — with the restart axis vmapped, the sweep sharded
-over a TPU device mesh, and consensus accumulation kept on-device.
+matrix factorization (mu / als / neals / pg / alspg solvers plus the BROAD
+original's Brunet kl rule, random or NNDSVD init), connectivity/consensus
+aggregation across restarts, and rank selection by cophenetic correlation —
+with the restart axis packed into MXU-dense GEMM batches, the sweep sharded
+over a TPU device mesh (up to restarts × features × samples), and consensus
+accumulation kept on-device.
 """
 
 from nmfx.config import (
@@ -16,6 +18,7 @@ from nmfx.config import (
 )
 from nmfx.io import read_dataset, read_gct, read_res, write_gct
 from nmfx.api import ConsensusResult, nmf, nmfconsensus
+from nmfx.sweep import default_mesh, feature_mesh, grid_mesh
 
 __version__ = "0.1.0"
 
@@ -25,6 +28,9 @@ __all__ = [
     "InitConfig",
     "OutputConfig",
     "SolverConfig",
+    "default_mesh",
+    "feature_mesh",
+    "grid_mesh",
     "nmf",
     "nmfconsensus",
     "read_dataset",
