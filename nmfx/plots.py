@@ -50,6 +50,42 @@ def metagene_plot(h: np.ndarray, path: str, title: str = "") -> None:
     plt.close(fig)
 
 
+def matrix_plot(mat: np.ndarray, path: str, title: str = "") -> None:
+    """Generic matrix-magnitude heatmap (reference ``matrix.abs.plot``'s
+    value-inverted rainbow, nmf.r:271-292 — here |values| on a perceptually
+    uniform map)."""
+    fig, ax = plt.subplots(figsize=(6, 6))
+    im = ax.imshow(np.abs(np.asarray(mat)), cmap="viridis", aspect="auto",
+                   interpolation="nearest")
+    ax.set_title(title)
+    fig.colorbar(im, ax=ax, shrink=0.8)
+    fig.savefig(path, bbox_inches="tight")
+    plt.close(fig)
+
+
+def pca_plot(a: np.ndarray, path: str,
+             labels: np.ndarray | None = None, title: str = "") -> None:
+    """Samples scattered on the first two principal components, optionally
+    colored by cluster label (reference ``plotPCA``, test_nmf.r:9-23 —
+    defined for eyeballing group structure, never wired into the flow)."""
+    a = np.asarray(a, np.float64)
+    centered = a - a.mean(axis=1, keepdims=True)
+    _, _, vt = np.linalg.svd(centered, full_matrices=False)
+    pcs = vt[:2].T  # (n_samples, 2)
+    fig, ax = plt.subplots(figsize=(6, 5))
+    if labels is None:
+        ax.scatter(pcs[:, 0], pcs[:, 1], s=30)
+    else:
+        sc = ax.scatter(pcs[:, 0], pcs[:, 1], c=np.asarray(labels),
+                        cmap="tab10", s=30)
+        fig.colorbar(sc, ax=ax, shrink=0.8, label="cluster")
+    ax.set_xlabel("PC1")
+    ax.set_ylabel("PC2")
+    ax.set_title(title)
+    fig.savefig(path, bbox_inches="tight")
+    plt.close(fig)
+
+
 def cophenetic_curve(ks, rhos, path: str) -> None:
     """rho-vs-k selection curve (reference nmf.r:227-231; same y-range rule
     ``[1 - 2*(1 - min(rho)), 1]``)."""

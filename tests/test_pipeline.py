@@ -133,3 +133,19 @@ def test_dispersion_metric(two_group_result):
     np.testing.assert_allclose(res.per_k[3].dispersion,
                                np.mean((2 * c - 1) ** 2))
     assert "dispersion" in res.summary().splitlines()[0]
+
+
+def test_standalone_plots(two_group_data, two_group_result, tmp_path):
+    """matrix_plot / pca_plot (reference matrix.abs.plot and the never-wired
+    plotPCA, test_nmf.r:9-23) write valid files."""
+    from nmfx import plots
+
+    p1 = tmp_path / "mat.pdf"
+    plots.matrix_plot(two_group_data, str(p1), title="A")
+    p2 = tmp_path / "pca.pdf"
+    plots.pca_plot(two_group_data, str(p2),
+                   labels=two_group_result.per_k[2].membership)
+    p3 = tmp_path / "pca_nolabels.pdf"
+    plots.pca_plot(two_group_data, str(p3))
+    for p in (p1, p2, p3):
+        assert p.exists() and p.stat().st_size > 500
