@@ -118,6 +118,8 @@ def nmf(a, k: int, *, seed: int = 0, algorithm: str | None = None,
         init_cfg: InitConfig | None = None) -> SolverResult:
     """One non-negative factorization A ≈ W·H at rank k."""
     arr, _ = _as_matrix(a)
+    if not np.isfinite(arr).all():
+        raise ValueError("input matrix contains non-finite values")
     if (arr < 0).any():
         # reference-side validation lives in dead C code (checkmatrices.c:43-81);
         # here it is a real error
@@ -171,8 +173,17 @@ def nmfconsensus(
         raise ValueError("rank_selection must be 'host' or 'device', got "
                          f"{rank_selection!r}")
     arr, col_names = _as_matrix(data)
+    if not np.isfinite(arr).all():
+        raise ValueError("input matrix contains non-finite values")
     if (arr < 0).any():
         raise ValueError("input matrix must be non-negative")
+    n_samples = arr.shape[1]
+    if max(ks) > n_samples:
+        # cutree cannot yield more clusters than samples; fail clearly here
+        # instead of deep inside the clustering (reference guards only k>=2,
+        # nmf.r:107-108)
+        raise ValueError(
+            f"k={max(ks)} exceeds the number of samples ({n_samples})")
     ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
                            label_rule=label_rule)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)

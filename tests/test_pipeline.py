@@ -149,3 +149,21 @@ def test_standalone_plots(two_group_data, two_group_result, tmp_path):
     plots.pca_plot(two_group_data, str(p3))
     for p in (p1, p2, p3):
         assert p.exists() and p.stat().st_size > 500
+
+
+def test_k_exceeding_samples_rejected(two_group_data):
+    n = two_group_data.shape[1]
+    with pytest.raises(ValueError, match="exceeds the number of samples"):
+        nmfconsensus(two_group_data, ks=(2, n + 1), restarts=2,
+                     max_iter=20, use_mesh=False)
+
+
+def test_nonfinite_input_rejected(two_group_data):
+    from nmfx.api import nmf
+
+    bad = np.array(two_group_data, copy=True)
+    bad[0, 0] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        nmfconsensus(bad, ks=(2,), restarts=2, max_iter=20, use_mesh=False)
+    with pytest.raises(ValueError, match="non-finite"):
+        nmf(bad, k=2)
