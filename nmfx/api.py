@@ -177,6 +177,9 @@ def nmfconsensus(
         raise ValueError("input matrix contains non-finite values")
     if (arr < 0).any():
         raise ValueError("input matrix must be non-negative")
+    ks = tuple(ks)
+    if not ks:
+        raise ValueError("ks must be non-empty")
     n_samples = arr.shape[1]
     if max(ks) > n_samples:
         # cutree cannot yield more clusters than samples; fail clearly here

@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "vmap", "packed", "pallas"),
                    help="restart-batch execution strategy (auto = packed "
                         "GEMMs for mu, vmapped driver otherwise)")
+    p.add_argument("--restart-chunk", type=int, default=None,
+                   help="cap on restarts solved concurrently in the vmapped "
+                        "driver (bounds peak memory for kl's m*n "
+                        "intermediates; results are identical)")
     p.add_argument("--rank-selection", default="host",
                    choices=("host", "device"),
                    help="where hclust/cophenetic/cutree run: host numpy/C++ "
@@ -132,7 +136,8 @@ def main(argv: list[str] | None = None) -> int:
             solver_cfg=SolverConfig(algorithm=args.algorithm,
                                     max_iter=args.maxiter,
                                     matmul_precision=args.precision,
-                                    backend=args.backend),
+                                    backend=args.backend,
+                                    restart_chunk=args.restart_chunk),
             init=args.init,
             label_rule=args.label_rule,
             mesh=mesh,

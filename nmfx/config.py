@@ -86,6 +86,15 @@ class SolverConfig:
     #: forces the generic driver. Measured ~3.5x faster per iteration at
     #: k=10 on the north-star config (packed vs vmap).
     backend: str = "auto"
+    #: cap on restarts solved concurrently in the vmapped driver (chunks run
+    #: sequentially). Bounds peak memory for solvers with O(m·n) per-restart
+    #: intermediates — kl materializes the A/(WH) quotient per lane, so an
+    #: unchunked 200-restart sweep on a large matrix OOMs where chunks of 16
+    #: sail through. Composes with a restart-sharded mesh (the chunk rounds
+    #: up to a mesh-size multiple; per-device concurrency = chunk / #devices).
+    #: None = all restarts at once; ignored by the packed/pallas mu backends
+    #: (no m·n intermediates)
+    restart_chunk: int | None = None
 
     def __post_init__(self):
         if self.backend not in ("auto", "vmap", "packed", "pallas"):
@@ -108,6 +117,8 @@ class SolverConfig:
             raise ValueError(
                 "matmul_precision must be 'default', 'bfloat16' or 'highest',"
                 f" got {self.matmul_precision!r}")
+        if self.restart_chunk is not None and self.restart_chunk < 1:
+            raise ValueError("restart_chunk must be >= 1 or None")
 
 
 @dataclasses.dataclass(frozen=True)

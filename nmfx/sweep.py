@@ -89,18 +89,52 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                                       label_rule, mesh)
     padded = _pad_count(restarts, mesh)
     dtype = jnp.dtype(solver_cfg.dtype)
+    mesh_size = (mesh.shape[RESTART_AXIS]
+                 if mesh is not None and RESTART_AXIS in mesh.axis_names
+                 else 1)
+    # effective chunk: rounded up to the mesh's restart-axis size so every
+    # chunk still shards evenly across devices (per-device concurrency
+    # becomes chunk_eff / mesh_size)
+    chunk_eff = None
+    if solver_cfg.restart_chunk is not None:
+        chunk_eff = -(-solver_cfg.restart_chunk // mesh_size) * mesh_size
+    use_chunks = chunk_eff is not None and chunk_eff < padded
 
-    def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
-        a = jnp.asarray(a, dtype)
-        keys = jax.random.split(key, padded)
+    def _solve_batch(a: jax.Array, keys: jax.Array):
+        """Init + solve + labels for one concurrent batch of restarts."""
         w0s, h0s = jax.vmap(
             lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys)
-        if mesh is not None and RESTART_AXIS in mesh.axis_names:
+        if mesh_size > 1:
             shard = NamedSharding(mesh, P(RESTART_AXIS))
             w0s = lax.with_sharding_constraint(w0s, shard)
             h0s = lax.with_sharding_constraint(h0s, shard)
         res = jax.vmap(lambda w0, h0: solve(a, w0, h0, solver_cfg))(w0s, h0s)
         labels = jax.vmap(partial(labels_from_h, rule=label_rule))(res.h)
+        return res, labels
+
+    def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
+        a = jnp.asarray(a, dtype)
+        keys = jax.random.split(key, padded)
+        if use_chunks:
+            # bound peak memory for solvers with O(m·n) per-lane
+            # intermediates (kl's A/(WH) quotient): chunks of chunk_eff
+            # restarts run sequentially (lax.map over full chunks, one
+            # smaller batch for the remainder — no wasted solves); only the
+            # small per-restart outputs persist across chunks
+            n_full = padded // chunk_eff
+            split_at = n_full * chunk_eff
+            parts = []
+            if n_full:
+                full = lax.map(lambda kc: _solve_batch(a, kc),
+                               keys[:split_at].reshape(n_full, chunk_eff))
+                parts.append(jax.tree.map(
+                    lambda x: x.reshape((split_at,) + x.shape[2:]), full))
+            if split_at < padded:
+                parts.append(_solve_batch(a, keys[split_at:]))
+            res, labels = jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+        else:
+            res, labels = _solve_batch(a, keys)
         labels = labels[:restarts]  # drop padding lanes before the reduction
         cons = consensus_matrix(labels, k)
         best = jnp.argmin(res.dnorm[:restarts])

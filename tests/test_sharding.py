@@ -169,3 +169,23 @@ def test_grid_sharded_matches_unsharded(low_rank_data, shape):
                                np.asarray(ref.best_w), rtol=5e-3, atol=5e-4)
     np.testing.assert_allclose(np.asarray(got.best_h),
                                np.asarray(ref.best_h), rtol=5e-3, atol=5e-4)
+
+
+def test_restart_chunking_composes_with_mesh(low_rank_data, mesh):
+    """restart_chunk on a restart-sharded mesh: chunk rounds up to the mesh
+    size, chunks run sequentially, results match the unchunked mesh sweep."""
+    a, _ = low_rank_data
+    key = jax.random.key(4)
+    ref = sweep_one_k(a, key, k=3, restarts=16,
+                      solver_cfg=SolverConfig(algorithm="mu", backend="vmap",
+                                              max_iter=100), mesh=mesh)
+    got = sweep_one_k(a, key, k=3, restarts=16,
+                      solver_cfg=SolverConfig(algorithm="mu", backend="vmap",
+                                              max_iter=100, restart_chunk=5),
+                      mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))

@@ -118,3 +118,31 @@ def test_f64_parity_mode(low_rank_data):
         pytest.skip("x64 not enabled in this environment")
     if res.w.dtype == jnp.float64:
         assert np.isfinite(float(res.dnorm))
+
+
+@pytest.mark.parametrize("algo,backend", [("kl", "auto"), ("mu", "vmap")])
+def test_restart_chunking_matches_unchunked(low_rank_data, algo, backend):
+    """restart_chunk bounds concurrent lanes without changing results:
+    per-restart keys are prefix-stable under jax.random.split, so chunked
+    and unchunked sweeps see identical initializations."""
+    from nmfx.sweep import sweep_one_k
+
+    a, _ = low_rank_data
+    cfg_full = SolverConfig(algorithm=algo, max_iter=80, backend=backend)
+    cfg_chunk = SolverConfig(algorithm=algo, max_iter=80, backend=backend,
+                             restart_chunk=3)
+    key = jax.random.key(11)
+    ref = sweep_one_k(a, key, k=3, restarts=7, solver_cfg=cfg_full,
+                      mesh=None)
+    got = sweep_one_k(a, key, k=3, restarts=7, solver_cfg=cfg_chunk,
+                      mesh=None)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.dnorms),
+                               np.asarray(ref.dnorms), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got.best_w),
+                               np.asarray(ref.best_w), rtol=1e-4, atol=1e-5)
