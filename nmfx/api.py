@@ -82,6 +82,47 @@ class ConsensusResult:
         lines.append(f"best k = {self.best_k}")
         return "\n".join(lines)
 
+    def save(self, path: str) -> None:
+        """Persist the whole result as one compressed ``.npz`` so analyses
+        (plots, rank comparisons, factor inspection) can resume later
+        without rerunning the sweep — the reference keeps results only as
+        transient BatchJobs registry files plus rendered outputs."""
+        arrays: dict[str, np.ndarray] = {
+            "ks": np.asarray(self.ks, np.int64),
+            "col_names": np.asarray(self.col_names, np.str_),
+        }
+        for k in self.ks:
+            r = self.per_k[k]
+            for f in dataclasses.fields(KResult):
+                arrays[f"k{k}_{f.name}"] = np.asarray(getattr(r, f.name))
+        # write through a handle (savez would append .npz to a bare path,
+        # breaking load's path symmetry) into a tmp file, then atomically
+        # replace — a crash mid-write never leaves a truncated result
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as fh:
+            np.savez_compressed(fh, **arrays)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "ConsensusResult":
+        """Inverse of :meth:`save`."""
+        with np.load(path, allow_pickle=False) as z:
+            ks = tuple(int(k) for k in z["ks"])
+            per_k = {}
+            for k in ks:
+                kwargs = {}
+                for f in dataclasses.fields(KResult):
+                    v = z[f"k{k}_{f.name}"]
+                    if f.type == "int":
+                        v = int(v)
+                    elif f.type == "float":
+                        v = float(v)
+                    kwargs[f.name] = v
+                per_k[k] = KResult(**kwargs)
+            return ConsensusResult(ks=ks, per_k=per_k,
+                                   col_names=tuple(str(c)
+                                                   for c in z["col_names"]))
+
 
 def _as_matrix(data) -> tuple[np.ndarray, list[str]]:
     if isinstance(data, str):

@@ -167,3 +167,28 @@ def test_nonfinite_input_rejected(two_group_data):
         nmfconsensus(bad, ks=(2,), restarts=2, max_iter=20, use_mesh=False)
     with pytest.raises(ValueError, match="non-finite"):
         nmf(bad, k=2)
+
+
+def test_result_save_load_roundtrip(two_group_result, tmp_path):
+    from nmfx.api import ConsensusResult, KResult
+    import dataclasses
+
+    path = str(tmp_path / "result.npz")
+    two_group_result.save(path)
+    loaded = ConsensusResult.load(path)
+    assert loaded.ks == two_group_result.ks
+    assert loaded.col_names == two_group_result.col_names
+    assert loaded.best_k == two_group_result.best_k
+    for k in loaded.ks:
+        a, b = loaded.per_k[k], two_group_result.per_k[k]
+        for f in dataclasses.fields(KResult):
+            got, ref = getattr(a, f.name), getattr(b, f.name)
+            if isinstance(ref, np.ndarray):
+                np.testing.assert_array_equal(got, ref)
+            else:
+                assert got == ref and type(got) is type(ref)
+    assert loaded.summary() == two_group_result.summary()
+    # extensionless path: save/load stay symmetric (savez would append .npz)
+    bare = str(tmp_path / "result_bare")
+    two_group_result.save(bare)
+    assert ConsensusResult.load(bare).best_k == two_group_result.best_k
