@@ -1,0 +1,50 @@
+"""f64 parity mode in a dedicated subprocess (jax_enable_x64 is global and
+must be set before any JAX use, so the in-process suite can only skip it —
+SolverConfig.dtype='float64' is the documented parity path vs the
+reference's f64 BLAS)."""
+
+import subprocess
+import sys
+import textwrap
+
+
+def test_f64_solver_runs_in_subprocess():
+    code = textwrap.dedent("""
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import jax.numpy as jnp
+        from nmfx.config import SolverConfig
+        from nmfx.solvers import solve
+
+        rng = np.random.default_rng(0)
+        a = rng.uniform(0.1, 1.0, (60, 22))
+        w0 = rng.uniform(0.1, 1.0, (60, 3))
+        h0 = rng.uniform(0.1, 1.0, (3, 22))
+        res = solve(a, w0, h0, SolverConfig(algorithm="mu", max_iter=25,
+                                            dtype="float64",
+                                            use_class_stop=False,
+                                            use_tol_checks=False))
+        assert res.w.dtype == jnp.float64, res.w.dtype
+
+        # lockstep vs the identical update in NumPy f64: agreement must be
+        # at f64 level, far beyond anything f32 could produce
+        w, h = np.asarray(w0, np.float64), np.asarray(h0, np.float64)
+        for _ in range(25):
+            numerh = w.T @ a
+            hn = h * numerh / ((w.T @ w) @ h + 1e-9)
+            hn[(h == 0) | (numerh == 0)] = 0.0
+            h = hn
+            numerw = a @ h.T
+            wn = w * numerw / (w @ (h @ h.T) + 1e-9)
+            wn[(w == 0) | (numerw == 0)] = 0.0
+            w = wn
+        np.testing.assert_allclose(np.asarray(res.w), w, rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(res.h), h, rtol=1e-10)
+        print("OK")
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
