@@ -32,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from nmfx.sweep import FEATURE_AXIS, RESTART_AXIS, SAMPLE_AXIS
+from nmfx.sweep import RESTART_AXIS
 
 
 def initialize(coordinator_address: str | None = None,

@@ -1,10 +1,9 @@
 """CLI surface tests (reference entry semantics: runNMFinJobs args,
 nmf.r:106) — run in-process on the 8-device virtual CPU platform."""
 
-import numpy as np
 import pytest
 
-from nmfx.cli import build_parser, main, parse_ks
+from nmfx.cli import main, parse_ks
 from nmfx.io import write_gct
 
 

@@ -3,7 +3,6 @@ update math driven lockstep against the framework's solvers (SURVEY.md §4's
 cross-implementation oracle, replacing the reference's dormant comparison
 against the original BROAD script, test_nmf.r:29)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -5,7 +5,7 @@ import os
 import numpy as np
 import pytest
 
-from nmfx.io import Dataset, read_dataset, read_gct, read_res, write_gct
+from nmfx.io import read_dataset, read_gct, read_res, write_gct
 
 REFERENCE_GCT = "/root/reference/20+20x1000.gct"
 

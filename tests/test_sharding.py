@@ -78,7 +78,7 @@ def test_initial_factors_actually_sharded(low_rank_data, mesh):
 
 # --- feature-axis (tensor-parallel) sharding -------------------------------
 
-from nmfx.sweep import FEATURE_AXIS, feature_mesh  # noqa: E402
+from nmfx.sweep import feature_mesh  # noqa: E402
 
 
 @pytest.mark.parametrize("shape", [(2, 4), (4, 2), (1, 8)])
@@ -138,7 +138,7 @@ def test_feature_sharding_rejects_unsupported_configs(low_rank_data):
 
 # --- full 3-axis grid: restarts (dp) x features (tp) x samples (sp) --------
 
-from nmfx.sweep import SAMPLE_AXIS, grid_mesh  # noqa: E402
+from nmfx.sweep import grid_mesh  # noqa: E402
 
 
 @pytest.mark.parametrize("shape", [(2, 2, 2), (1, 2, 4), (2, 1, 4),
