@@ -1,0 +1,78 @@
+"""True multi-process distributed execution: two OS processes form one
+jax.distributed job (4 virtual CPU devices each -> 8 global), run the same
+SPMD consensus sweep, and must return identical replicated results with
+coordinator-only file writes — the cross-host contract documented in
+nmfx/distributed.py, which single-process mesh tests cannot exercise."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    coord, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    import nmfx
+    import nmfx.distributed as dist
+    dist.initialize(coordinator_address=coord, num_processes=2,
+                    process_id=pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8
+    import numpy as np
+    from nmfx.config import SolverConfig
+    from nmfx.datasets import two_group_matrix
+    a = two_group_matrix(n_genes=80, n_per_group=8, seed=1)
+    result = dist.consensus(
+        a, ks=(2, 3), restarts=8, seed=5,
+        solver_cfg=SolverConfig(max_iter=150),
+        output=nmfx.OutputConfig(directory=os.path.join(outdir, "files"),
+                                 write_plots=False))
+    payload = {"summary": result.summary(),
+               "consensus2": np.asarray(result.per_k[2].consensus).tolist()}
+    with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
+        json.dump(payload, f)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_consensus(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    coord = f"localhost:{_free_port()}"
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), coord, str(i), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=str(tmp_path)) for i in range(2)]
+    errs = []
+    for p in procs:
+        try:
+            _, e = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            _, e = p.communicate()
+        if p.returncode != 0:
+            errs.append(e[-3000:])
+    assert not errs, errs
+    r0 = json.loads((tmp_path / "proc0.json").read_text())
+    r1 = json.loads((tmp_path / "proc1.json").read_text())
+    # replicated-output contract: every host computes the identical result
+    assert r0["summary"] == r1["summary"]
+    assert r0["consensus2"] == r1["consensus2"]
+    assert "best k = 2" in r0["summary"]
+    # coordinator-only writes: files exist exactly once, from process 0
+    files = os.listdir(tmp_path / "files")
+    assert "cophenetic.txt" in files
