@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence
 
-ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl")
+ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf")
 INIT_METHODS = ("random", "nndsvd")
 
 
@@ -86,6 +86,10 @@ class SolverConfig:
     #: forces the generic driver. Measured ~3.5x faster per iteration at
     #: k=10 on the north-star config (packed vs vmap).
     backend: str = "auto"
+    #: snmf only — Kim & Park L1 penalty on H's columns (larger = sparser)
+    sparsity_beta: float = 0.01
+    #: snmf only — ridge on W; None = max(A)^2 (the Kim & Park default)
+    ridge_eta: float | None = None
     #: cap on restarts solved concurrently in the vmapped driver (chunks run
     #: sequentially). Bounds peak memory for solvers with O(m·n) per-restart
     #: intermediates — kl materializes the A/(WH) quotient per lane, so an
@@ -119,6 +123,12 @@ class SolverConfig:
                 f" got {self.matmul_precision!r}")
         if self.restart_chunk is not None and self.restart_chunk < 1:
             raise ValueError("restart_chunk must be >= 1 or None")
+        if self.sparsity_beta < 0:
+            # a negative beta makes the H Gram indefinite -> NaNs from the
+            # Cholesky under jit instead of an error
+            raise ValueError("sparsity_beta must be >= 0")
+        if self.ridge_eta is not None and self.ridge_eta < 0:
+            raise ValueError("ridge_eta must be >= 0 or None")
 
 
 @dataclasses.dataclass(frozen=True)

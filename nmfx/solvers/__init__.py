@@ -2,13 +2,14 @@
 
 TPU-native re-designs of the reference's five C solvers
 (reference ``libnmf/nmf_{mu,als,neals,pg,alspg}.c``) plus the BROAD
-original's Brunet divergence rule (``kl``): each solver is a pure ``step``
+original's Brunet divergence rule (``kl``) and Kim & Park sparse NMF
+(``snmf``): each solver is a pure ``step``
 function over arrays, jit-compiled into a ``lax.while_loop`` and vmappable
 over the restart axis.
 """
 
 from nmfx.solvers.base import SolverResult, StopReason, solve
-from nmfx.solvers import als, alspg, kl, mu, neals, pg
+from nmfx.solvers import als, alspg, kl, mu, neals, pg, snmf
 
 SOLVERS = {
     "mu": mu,
@@ -19,7 +20,9 @@ SOLVERS = {
     # beyond the reference: the BROAD original's Brunet divergence updates
     # (the reference replaces them with Euclidean mu — solvers/kl.py)
     "kl": kl,
+    # beyond the reference: Kim & Park sparse NMF (solvers/snmf.py)
+    "snmf": snmf,
 }
 
 __all__ = ["SOLVERS", "SolverResult", "StopReason", "solve", "mu", "als",
-           "neals", "pg", "alspg", "kl"]
+           "neals", "pg", "alspg", "kl", "snmf"]

@@ -115,6 +115,21 @@ def clamp(x: jax.Array, zero_threshold: float) -> jax.Array:
     return jnp.where(x <= zero_threshold, jnp.zeros_like(x), x)
 
 
+def solve_gram_reg(gram: jax.Array, rhs: jax.Array) -> jax.Array:
+    """Cholesky-solve ``(gram + λI) x = rhs`` with a trace-scaled Tikhonov
+    jitter λ = 10·eps·mean(diag): always well-posed under jit/vmap, and
+    indistinguishable from the plain solve for healthy systems — the shared
+    shape-stable answer to the reference's lazy singular-fallback
+    (``libnmf/nmf_neals.c:206-291``). Used by neals and snmf."""
+    import jax.scipy.linalg as jsl
+
+    k = gram.shape[0]
+    lam = 10 * jnp.finfo(gram.dtype).eps * (jnp.trace(gram) / k)
+    gram = gram + (lam + jnp.finfo(gram.dtype).tiny) * jnp.eye(
+        k, dtype=gram.dtype)
+    return jsl.cho_solve(jsl.cho_factor(gram), rhs)
+
+
 def check_convergence(
     state: State,
     cfg: SolverConfig,

@@ -19,9 +19,6 @@ Convergence: TolX/TolFun checks every 2nd iteration as in als.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-import jax.scipy.linalg as jsl
-
 from nmfx.config import SolverConfig
 from nmfx.solvers import base
 
@@ -31,13 +28,9 @@ def init_aux(a, w0, h0, cfg: SolverConfig):
 
 
 def _solve_normal(factor, rhs_gram):
-    """solve(factorᵀfactor + λI, rhs_gram), λ = 10·eps·mean(diag(Gram))."""
-    gram = factor.T @ factor
-    k = gram.shape[0]
-    lam = 10 * jnp.finfo(gram.dtype).eps * (jnp.trace(gram) / k)
-    gram = gram + (lam + jnp.finfo(gram.dtype).tiny) * jnp.eye(
-        k, dtype=gram.dtype)
-    return jsl.cho_solve(jsl.cho_factor(gram), rhs_gram)
+    """solve(factorᵀfactor + λI, rhs_gram) via the shared jittered Cholesky
+    (base.solve_gram_reg)."""
+    return base.solve_gram_reg(factor.T @ factor, rhs_gram)
 
 
 def step(a, state: base.State, cfg: SolverConfig,

@@ -251,3 +251,56 @@ def test_pg_matches_numpy_reference_math():
                                atol=5e-4)
     np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=5e-3,
                                atol=5e-4)
+
+
+# --- sparse NMF (Kim & Park 2007) ------------------------------------------
+
+def _snmf_numpy(a, w, h, iters, beta, eta):
+    """f64 transliteration of SNMF/R (nmfx/solvers/snmf.py): regularized
+    normal-equation half-steps with clamp."""
+    a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
+    k = w.shape[1]
+    for _ in range(iters):
+        h = np.maximum(np.linalg.solve(w.T @ w + beta * np.ones((k, k)),
+                                       w.T @ a), 0.0)
+        w = np.maximum(np.linalg.solve(h @ h.T + eta * np.eye(k),
+                                       h @ a.T).T, 0.0)
+    return w, h
+
+
+def test_snmf_matches_numpy_reference_math():
+    a, w0, h0 = _problem(seed=17)
+    beta, eta = 0.05, float(np.max(a)) ** 2
+    w_ref, h_ref = _snmf_numpy(a, w0, h0, iters=15, beta=beta, eta=eta)
+    cfg = SolverConfig(algorithm="snmf", max_iter=15, sparsity_beta=beta,
+                       use_class_stop=False, use_tol_checks=False)
+    res = solve(jnp.asarray(a, jnp.float32), jnp.asarray(w0, jnp.float32),
+                jnp.asarray(h0, jnp.float32), cfg)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=5e-3,
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=5e-3,
+                               atol=5e-4)
+
+
+def test_snmf_sparsity_increases_with_beta():
+    a, w0, h0 = _problem(seed=23, m=80, n=30)
+
+    def zero_frac(beta):
+        cfg = SolverConfig(algorithm="snmf", max_iter=300,
+                           sparsity_beta=beta)
+        res = solve(jnp.asarray(a, jnp.float32),
+                    jnp.asarray(w0, jnp.float32),
+                    jnp.asarray(h0, jnp.float32), cfg)
+        assert np.isfinite(float(res.dnorm))
+        return float((np.asarray(res.h) < 1e-6).mean())
+
+    assert zero_frac(1.0) > zero_frac(0.0)
+
+
+def test_snmf_config_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="sparsity_beta"):
+        SolverConfig(algorithm="snmf", sparsity_beta=-0.1)
+    with pytest.raises(ValueError, match="ridge_eta"):
+        SolverConfig(algorithm="snmf", ridge_eta=-1.0)

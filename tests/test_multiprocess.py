@@ -29,11 +29,13 @@ _WORKER = textwrap.dedent("""
     from nmfx.config import SolverConfig
     from nmfx.datasets import two_group_matrix
     a = two_group_matrix(n_genes=80, n_per_group=8, seed=1)
+    # per-process output dir: only the coordinator's may appear
     result = dist.consensus(
         a, ks=(2, 3), restarts=8, seed=5,
         solver_cfg=SolverConfig(max_iter=150),
-        output=nmfx.OutputConfig(directory=os.path.join(outdir, "files"),
-                                 write_plots=False))
+        output=nmfx.OutputConfig(
+            directory=os.path.join(outdir, f"files{pid}"),
+            write_plots=False))
     payload = {"summary": result.summary(),
                "consensus2": np.asarray(result.per_k[2].consensus).tolist()}
     with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
@@ -73,6 +75,8 @@ def test_two_process_distributed_consensus(tmp_path):
     assert r0["summary"] == r1["summary"]
     assert r0["consensus2"] == r1["consensus2"]
     assert "best k = 2" in r0["summary"]
-    # coordinator-only writes: files exist exactly once, from process 0
-    files = os.listdir(tmp_path / "files")
+    # coordinator-only writes: process 0's dir has the outputs, process 1's
+    # was never created (dist.consensus nulls output off-coordinator)
+    files = os.listdir(tmp_path / "files0")
     assert "cophenetic.txt" in files
+    assert not (tmp_path / "files1").exists()
