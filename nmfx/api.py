@@ -184,6 +184,7 @@ def nmfconsensus(
     max_iter: int | None = None,
     init: str | None = None,
     label_rule: str = "argmax",
+    linkage: str = "average",
     solver_cfg: SolverConfig | None = None,
     init_cfg: InitConfig | None = None,
     mesh=None,
@@ -213,6 +214,11 @@ def nmfconsensus(
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
                          f"{rank_selection!r}")
+    if rank_selection == "device" and linkage != "average":
+        raise ValueError(
+            "rank_selection='device' implements average linkage only "
+            f"(the reference's hclust method); got linkage={linkage!r} — "
+            "use rank_selection='host'")
     arr, col_names = _as_matrix(data)
     if not np.isfinite(arr).all():
         raise ValueError("input matrix contains non-finite values")
@@ -229,7 +235,7 @@ def nmfconsensus(
         raise ValueError(
             f"k={max(ks)} exceeds the number of samples ({n_samples})")
     ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
-                           label_rule=label_rule)
+                           label_rule=label_rule, linkage=linkage)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
     if mesh is None and use_mesh:
         mesh = default_mesh()
@@ -266,7 +272,8 @@ def nmfconsensus(
                 order = np.asarray(order)
             else:
                 cons = np.asarray(out.consensus, dtype=np.float64)
-                rho, membership, order = coph.rank_selection(cons, k)
+                rho, membership, order = coph.rank_selection(
+                    cons, k, ccfg.linkage)
             rho = float(np.format_float_positional(
                 rho, precision=4, fractional=False))  # signif(rho,4) nmf.r:172
         per_k[k] = KResult(

@@ -12,7 +12,8 @@ from __future__ import annotations
 import argparse
 import sys
 
-from nmfx.config import ALGORITHMS, INIT_METHODS, OutputConfig, SolverConfig
+from nmfx.config import (ALGORITHMS, INIT_METHODS, LINKAGE_METHODS,
+                         OutputConfig, SolverConfig)
 
 
 def parse_ks(spec: str) -> tuple[int, ...]:
@@ -58,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="where hclust/cophenetic/cutree run: host numpy/C++ "
                         "or fully on the accelerator")
     p.add_argument("--init", choices=INIT_METHODS, default="random")
+    p.add_argument("--linkage", choices=LINKAGE_METHODS,
+                   default="average",
+                   help="hclust linkage for rank selection (reference: "
+                        "average)")
     p.add_argument("--label-rule", choices=("argmax", "argmin"),
                    default="argmax",
                    help="cluster label rule; argmin reproduces the reference "
@@ -140,6 +145,7 @@ def main(argv: list[str] | None = None) -> int:
                                     restart_chunk=args.restart_chunk),
             init=args.init,
             label_rule=args.label_rule,
+            linkage=args.linkage,
             mesh=mesh,
             use_mesh=not args.no_mesh,
             rank_selection=args.rank_selection,

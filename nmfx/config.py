@@ -13,6 +13,7 @@ from typing import Sequence
 
 ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf")
 INIT_METHODS = ("random", "nndsvd")
+LINKAGE_METHODS = ("average", "complete", "single")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,8 +180,8 @@ class ConsensusConfig:
     #: "argmin" reproduces the reference R layer's observed behavior
     #: (`apply(H, 2, order)[1,]` picks the SMALLEST loading, nmf.r:128 — Q3).
     label_rule: str = "argmax"
-    #: hierarchical clustering linkage for rank selection (reference
-    #: hclust(method="average"), nmf.r:166)
+    #: hierarchical clustering linkage for rank selection: "average" (the
+    #: reference's hclust method, nmf.r:166), "complete", or "single"
     linkage: str = "average"
 
     def __post_init__(self):
@@ -193,6 +194,10 @@ class ConsensusConfig:
             raise ValueError("restarts must be >= 1")
         if self.label_rule not in ("argmax", "argmin"):
             raise ValueError("label_rule must be 'argmax' or 'argmin'")
+        if self.linkage not in LINKAGE_METHODS:
+            raise ValueError(
+                f"linkage must be one of {LINKAGE_METHODS}, got "
+                f"{self.linkage!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,4 +1,5 @@
-"""Average-linkage hierarchical clustering, cophenetic correlation, cut-tree.
+"""Hierarchical clustering (average/complete/single linkage), cophenetic
+correlation, cut-tree.
 
 Framework-owned host implementation of the rank-selection step the reference
 delegates to base R: ``hclust(as.dist(1-C), method="average")`` →
@@ -30,14 +31,24 @@ class HClust(NamedTuple):
     order: np.ndarray  # (n,) dendrogram leaf order
 
 
-def average_linkage(dist: np.ndarray) -> HClust:
-    """UPGMA agglomerative clustering (native C++ when available)."""
+def hierarchical_linkage(dist: np.ndarray,
+                         method: str = "average") -> HClust:
+    """Agglomerative clustering of a distance matrix. ``method`` is the
+    Lance-Williams rule: "average" (UPGMA — the reference's
+    hclust(method="average"), nmf.r:166), "complete", or "single". The
+    native C++ path implements average only; other methods use the numpy
+    implementation (n is tiny here)."""
     from nmfx import native
 
-    if native.available():
+    if method == "average" and native.available():
         nat = native.average_linkage(dist)
         return HClust(nat.linkage, nat.coph, nat.order)
-    return average_linkage_numpy(dist)
+    return linkage_numpy(dist, method)
+
+
+def average_linkage(dist: np.ndarray) -> HClust:
+    """UPGMA agglomerative clustering (native C++ when available)."""
+    return hierarchical_linkage(dist, "average")
 
 
 def cut_tree(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
@@ -51,12 +62,24 @@ def cut_tree(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
 
 
 def average_linkage_numpy(dist: np.ndarray) -> HClust:
-    """UPGMA agglomerative clustering (pure-numpy reference implementation).
+    """UPGMA clustering, pure numpy (kept as the named entry the native
+    path is cross-tested against)."""
+    return linkage_numpy(dist, "average")
+
+
+def linkage_numpy(dist: np.ndarray, method: str = "average") -> HClust:
+    """Agglomerative clustering (pure-numpy reference implementation) under
+    the "average", "complete", or "single" Lance-Williams update.
 
     Cluster ids follow the scipy convention: leaves are 0..n-1, the cluster
     created at merge t is n+t. Cophenetic distance of a cross pair = height
     of the merge that first joins them.
     """
+    from nmfx.config import LINKAGE_METHODS
+
+    if method not in LINKAGE_METHODS:
+        raise ValueError(
+            f"linkage must be one of {LINKAGE_METHODS}, got {method!r}")
     d = np.array(dist, dtype=np.float64, copy=True)
     n = d.shape[0]
     if d.shape != (n, n):
@@ -82,8 +105,13 @@ def average_linkage_numpy(dist: np.ndarray) -> HClust:
         mi, mj = members[i], members[j]
         coph[np.ix_(mi, mj)] = height
         coph[np.ix_(mj, mi)] = height
-        # UPGMA update: weighted average of the two merged rows
-        merged = (size[i] * d[i] + size[j] * d[j]) / new_size
+        # Lance-Williams update of the merged cluster's distances
+        if method == "average":
+            merged = (size[i] * d[i] + size[j] * d[j]) / new_size
+        elif method == "complete":
+            merged = np.maximum(d[i], d[j])
+        else:  # single
+            merged = np.minimum(d[i], d[j])
         d[i] = merged
         d[:, i] = merged
         d[i, i] = np.inf
@@ -155,12 +183,13 @@ def cut_tree_numpy(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
     return labels
 
 
-def rank_selection(consensus: np.ndarray, k: int):
+def rank_selection(consensus: np.ndarray, k: int,
+                   linkage: str = "average"):
     """Full per-k rank-selection step on one consensus matrix: returns
     (rho, memberships, leaf order), mirroring reference nmf.r:165-177."""
     dist = 1.0 - np.asarray(consensus)
     np.fill_diagonal(dist, 0.0)
-    hc = average_linkage(dist)
+    hc = hierarchical_linkage(dist, linkage)
     rho = cophenetic_rho(dist, hc.coph)
     membership = cut_tree(hc.linkage, dist.shape[0], k)
     return rho, membership, hc.order

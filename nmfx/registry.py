@@ -26,7 +26,27 @@ import os
 import numpy as np
 
 _META_NAME = "registry.json"
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
+
+
+def _nondefault_fields(cfg) -> dict:
+    """Config fields that differ from their dataclass defaults.
+
+    Hashing only non-default fields makes the fingerprint forward-
+    compatible: adding a new config field (with a default) to a future nmfx
+    does not invalidate registries written before the field existed, since
+    neither hash contains the key."""
+    out = {}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if f.default is not dataclasses.MISSING:
+            if v == f.default:
+                continue
+        elif (f.default_factory is not dataclasses.MISSING
+              and v == f.default_factory()):
+            continue
+        out[f.name] = v
+    return out
 
 
 def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
@@ -37,6 +57,9 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     value ("auto" picks a concrete path per algorithm), since packed and
     vmapped execution group matmul reductions differently and are therefore
     not bit-identical — but "auto" vs an explicit equivalent choice is.
+    ``restart_chunk`` is excluded entirely: chunked and unchunked sweeps
+    are bit-identical by construction (prefix-stable PRNG keys; see
+    tests/test_solvers.py::test_restart_chunking_matches_unchunked).
     """
     from nmfx.sweep import _use_packed
 
@@ -45,12 +68,14 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     h.update(str(arr.shape).encode())
     h.update(str(arr.dtype).encode())
     h.update(arr.tobytes())
-    solver = dataclasses.asdict(solver_cfg)
-    if solver_cfg.backend != "pallas":  # pallas is already concrete
-        solver["backend"] = "packed" if _use_packed(solver_cfg) else "vmap"
+    solver = _nondefault_fields(solver_cfg)
+    solver.pop("restart_chunk", None)
+    resolved = ("pallas" if solver_cfg.backend == "pallas"
+                else "packed" if _use_packed(solver_cfg) else "vmap")
+    solver["backend"] = resolved
     payload = {
         "solver": solver,
-        "init": dataclasses.asdict(init_cfg),
+        "init": _nondefault_fields(init_cfg),
         "restarts": restarts,
         "seed": seed,
         "label_rule": label_rule,

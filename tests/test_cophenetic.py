@@ -80,3 +80,32 @@ def test_perfect_consensus_rho_is_one():
     assert len(set(membership[:4])) == 1
     assert len(set(membership[4:])) == 1
     assert membership[0] != membership[7]
+
+
+# --- complete/single linkage (beyond the reference's average) --------------
+
+@pytest.mark.parametrize("method,scipy_name", [("complete", "complete"),
+                                               ("single", "single")])
+def test_other_linkages_match_scipy(method, scipy_name):
+    from scipy.cluster.hierarchy import cophenet, linkage as scipy_linkage
+    from scipy.spatial.distance import squareform
+
+    from nmfx.cophenetic import condensed, linkage_numpy
+
+    rng = np.random.default_rng(8)
+    n = 24
+    x = rng.uniform(0, 1, (n, 5))
+    dist = np.sqrt(((x[:, None] - x[None, :]) ** 2).sum(-1))
+    np.fill_diagonal(dist, 0.0)
+    hc = linkage_numpy(dist, method)
+    z = scipy_linkage(squareform(dist, checks=False), method=scipy_name)
+    np.testing.assert_allclose(hc.linkage[:, 2], z[:, 2], rtol=1e-10)
+    coph_ref = cophenet(z)
+    np.testing.assert_allclose(condensed(hc.coph), coph_ref, rtol=1e-10)
+
+
+def test_linkage_validation():
+    from nmfx.cophenetic import linkage_numpy
+
+    with pytest.raises(ValueError, match="linkage"):
+        linkage_numpy(np.zeros((3, 3)), "ward")

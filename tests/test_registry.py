@@ -71,3 +71,28 @@ def test_checkpoint_matches_uncheckpointed(tmp_path, two_group_data):
                         checkpoint_dir=str(tmp_path / "c"))
     np.testing.assert_allclose(plain.per_k[2].consensus,
                                ckpt.per_k[2].consensus)
+
+
+def test_fingerprint_forward_compatible_with_default_fields():
+    """Only non-default config fields are hashed: adding future config
+    fields (with defaults) must not invalidate existing registries, and
+    numerics-neutral knobs (restart_chunk) never enter the hash."""
+    import dataclasses
+
+    import numpy as np
+
+    from nmfx.config import InitConfig, SolverConfig
+    from nmfx.registry import _fingerprint
+
+    a = np.ones((4, 3))
+    base_cfg = SolverConfig(algorithm="mu", max_iter=50)
+    fp = _fingerprint(a, base_cfg, InitConfig(), 4, 1, "argmax")
+    # explicitly passing a default value hashes identically
+    same = dataclasses.replace(base_cfg, sparsity_beta=0.01)
+    assert _fingerprint(a, same, InitConfig(), 4, 1, "argmax") == fp
+    # restart_chunk is bit-identical by construction -> excluded
+    chunked = dataclasses.replace(base_cfg, restart_chunk=2)
+    assert _fingerprint(a, chunked, InitConfig(), 4, 1, "argmax") == fp
+    # a numerics-affecting change does invalidate
+    other = dataclasses.replace(base_cfg, tol_x=1e-6)
+    assert _fingerprint(a, other, InitConfig(), 4, 1, "argmax") != fp
