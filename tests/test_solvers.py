@@ -165,3 +165,24 @@ def test_solvers_clean_under_debug_nans(low_rank_data, algo):
         assert np.isfinite(np.asarray(res.h)).all()
     finally:
         jax.config.update("jax_debug_nans", prev)
+
+
+@pytest.mark.parametrize("shape,k", [((7, 31), 2), ((31, 7), 3),
+                                     ((129, 5), 4), ((3, 3), 2),
+                                     ((64, 2), 2)])
+def test_solver_shapes_fuzz(shape, k):
+    """Odd/tall/wide/tiny shapes through every solver: finite outputs,
+    correct shapes, non-negativity (shape-specialization bugs — padding,
+    reshapes, tile assumptions — surface here)."""
+    m, n = shape
+    if k > n:
+        pytest.skip("k > n is rejected by the pipeline")
+    rng = np.random.default_rng(m * 100 + n)
+    a = jnp.asarray(rng.uniform(0.1, 1.0, (m, n)), jnp.float32)
+    w0, h0 = random_init(jax.random.key(0), m, n, k)
+    for algo in ALGOS:
+        res = solve(a, w0, h0, SolverConfig(algorithm=algo, max_iter=25))
+        assert res.w.shape == (m, k) and res.h.shape == (k, n), algo
+        assert np.isfinite(np.asarray(res.w)).all(), algo
+        assert np.isfinite(np.asarray(res.h)).all(), algo
+        assert bool(jnp.all(res.w >= 0) & jnp.all(res.h >= 0)), algo
