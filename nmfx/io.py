@@ -48,34 +48,63 @@ def read_gct(path: str) -> Dataset:
     gene: name, description, values. The Description column is dropped, as the
     reference does (``ds <- ds[-1]``, nmf.r:376).
     """
-    with open(path, "rt") as f:
-        version = f.readline().strip()
+    # binary end to end: the multi-hundred-MB data block of a large GCT is
+    # never str-decoded — only the three header lines and the row names are
+    with open(path, "rb") as f:
+        version = f.readline().decode().strip()
         if not version.startswith("#"):
             raise ValueError(f"{path}: missing GCT version line, got {version!r}")
-        dims = f.readline().split()
+        dims = f.readline().decode().split()
         if len(dims) < 2:
             raise ValueError(f"{path}: malformed GCT dimension line")
         n_rows, n_cols = int(dims[0]), int(dims[1])
-        header = f.readline().rstrip("\n").split("\t")
+        header = f.readline().decode().rstrip("\n").split("\t")
         col_names = [c for c in header[2:] if c != ""]
-        row_names: list[str] = []
-        values = np.empty((n_rows, n_cols), dtype=np.float64)
-        r = 0
-        for line in f:
-            line = line.rstrip("\n")
-            if not line:
-                continue
-            fields = line.split("\t")
-            row_names.append(fields[0])
-            row = fields[2 : 2 + n_cols]
-            if len(row) != n_cols:
+        # bulk-parse the numeric block: native C++ from_chars when the host
+        # library is built (nmfx/native/gct_io.cpp), else numpy's tokenizer
+        # — the per-value Python float() loop both replace was ~6x slower
+        # at 20000x1000 (the data loader must not dwarf the few-second
+        # on-TPU solve)
+        tail = f.read()
+        # single scan for line bounds and names — no full copy of the
+        # multi-hundred-MB block (only the short name slices are decoded)
+        spans: list[tuple[int, int]] = []
+        row_names = []
+        pos, total = 0, len(tail)
+        while pos < total:
+            nl = tail.find(b"\n", pos)
+            if nl == -1:
+                nl = total
+            end = nl - 1 if nl > pos and tail[nl - 1:nl] == b"\r" else nl
+            if end > pos:  # skip blank lines
+                spans.append((pos, end))
+                tab = tail.find(b"\t", pos, end)
+                row_names.append(
+                    tail[pos:tab if tab != -1 else end].decode())
+            pos = nl + 1
+        if len(spans) != n_rows:
+            raise ValueError(
+                f"{path}: found {len(spans)} data rows, header said {n_rows}")
+        from nmfx import native
+
+        if native.available():
+            try:
+                values, _ = native.parse_gct_rows(tail, n_rows, n_cols)
+            except ValueError as e:
                 raise ValueError(
-                    f"{path}: row {r} has {len(row)} values, expected {n_cols}"
-                )
-            values[r] = [float(v) for v in row]
-            r += 1
-        if r != n_rows:
-            raise ValueError(f"{path}: found {r} data rows, header said {n_rows}")
+                    f"{path}: {e}; expected name<TAB>description<TAB>"
+                    f"{n_cols} numeric values per row") from e
+        else:
+            try:
+                values = np.loadtxt(
+                    [tail[s:e].decode() for s, e in spans],
+                    delimiter="\t", dtype=np.float64, comments=None,
+                    usecols=range(2, 2 + n_cols), ndmin=2)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}: malformed GCT data row ({e}); expected "
+                    f"name<TAB>description<TAB>{n_cols} numeric values per "
+                    "row") from e
     if len(col_names) != n_cols:
         # tolerate headers with trailing junk; fall back to numbered columns
         col_names = (col_names + [str(i + 1) for i in range(n_cols)])[:n_cols]
@@ -137,15 +166,34 @@ def write_gct(
         descriptions = row_names
     if len(row_names) != n_rows or len(col_names) != n_cols:
         raise ValueError("row/col name lengths do not match matrix shape")
+    if len(descriptions) != n_rows:
+        raise ValueError("descriptions length does not match matrix rows")
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    with open(path, "wt") as f:
-        f.write("#1.2\n")
-        f.write(f"{n_rows}\t{n_cols}\n")
-        f.write("Name\tDescription\t" + "\t".join(map(str, col_names)) + "\n")
-        for name, desc, row in zip(row_names, descriptions, values):
-            vals = "\t".join(
-                str(int(v)) if float(v).is_integer() else repr(float(v))
-                for v in row)
-            f.write(f"{name}\t{desc}\t{vals}\n")
+    from nmfx import native
+
+    vals = np.ascontiguousarray(values, dtype=np.float64)
+    header = ("#1.2\n" + f"{n_rows}\t{n_cols}\n"
+              + "Name\tDescription\t" + "\t".join(map(str, col_names))
+              + "\n")
+    if native.available():
+        # shortest exact float64 repr via C++ to_chars (bit-roundtrip,
+        # compact): C interleaves the name/description prefixes and the
+        # formatted values into one buffer, written in binary — the data
+        # block never round-trips through Python str
+        prefs = [f"{name}\t{desc}\t".encode()
+                 for name, desc in zip(row_names, descriptions)]
+        ends = np.cumsum([len(p) for p in prefs], dtype=np.int64)
+        body = native.format_gct_body(vals, b"".join(prefs), ends)
+        with open(path, "wb") as f:
+            f.write(header.encode())
+            f.write(body)
+    else:
+        with open(path, "wt") as f:
+            f.write(header)
+            # one C-level printf per row ("%.17g" roundtrips float64
+            # exactly and prints integral values without a decimal point)
+            rowfmt = "\t".join(["%.17g"] * n_cols)
+            for name, desc, row in zip(row_names, descriptions, vals):
+                f.write(f"{name}\t{desc}\t{rowfmt % tuple(row)}\n")

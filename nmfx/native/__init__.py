@@ -34,8 +34,21 @@ def _try_load() -> ctypes.CDLL | None:
             return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
+        return _bind(lib)
     except OSError:
         return None
+    except AttributeError:
+        # stale prebuilt .so missing newer symbols: rebuild once, retry;
+        # any further failure degrades to the numpy fallback as documented
+        try:
+            subprocess.run(["make", "-C", _DIR, "-s", "-B"], check=True,
+                           capture_output=True, timeout=120)
+            return _bind(ctypes.CDLL(_LIB_PATH))
+        except (OSError, AttributeError, subprocess.SubprocessError):
+            return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     c_double_p = ctypes.POINTER(ctypes.c_double)
     c_int32_p = ctypes.POINTER(ctypes.c_int32)
     lib.nmfx_average_linkage.restype = ctypes.c_int
@@ -44,6 +57,14 @@ def _try_load() -> ctypes.CDLL | None:
     lib.nmfx_cut_tree.restype = ctypes.c_int
     lib.nmfx_cut_tree.argtypes = [c_double_p, ctypes.c_int64,
                                   ctypes.c_int64, c_int32_p]
+    lib.nmfx_parse_gct_rows.restype = ctypes.c_int64
+    lib.nmfx_parse_gct_rows.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        c_double_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.nmfx_format_gct_body.restype = ctypes.c_int64
+    lib.nmfx_format_gct_body.argtypes = [
+        c_double_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p, ctypes.c_int64]
     return lib
 
 
@@ -94,3 +115,40 @@ def cut_tree(linkage: np.ndarray, n: int, k: int) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"nmfx_cut_tree failed with code {rc}")
     return labels.astype(np.int64)
+
+
+def parse_gct_rows(data: bytes, n_rows: int, n_cols: int):
+    """Parse the numeric block of GCT data rows (bytes after the header
+    lines) into an (n_rows, n_cols) float64 array. Returns (values, n_seen);
+    raises ValueError naming the first malformed row."""
+    assert available(), "native library not loaded"
+    out = np.empty((n_rows, n_cols), dtype=np.float64)
+    n_seen = ctypes.c_int64(0)
+    rc = _lib.nmfx_parse_gct_rows(
+        data, len(data), n_rows, n_cols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(n_seen))
+    if rc != 0:
+        raise ValueError(f"malformed GCT data row {rc}")
+    return out, int(n_seen.value)
+
+
+def format_gct_body(values: np.ndarray, prefixes: bytes,
+                    prefix_ends: np.ndarray) -> memoryview:
+    """The complete GCT data block: per row, its prefix bytes (caller joins
+    "name\tdescription\t") followed by shortest-exact-repr tab-separated
+    values and a newline — one C pass, one buffer, no Python-side copies."""
+    assert available(), "native library not loaded"
+    vals = np.ascontiguousarray(values, dtype=np.float64)
+    n_rows, n_cols = vals.shape
+    ends = np.ascontiguousarray(prefix_ends, dtype=np.int64)
+    cap = n_rows * (n_cols * 32 + 1) + len(prefixes) + 64
+    buf = np.empty(cap, dtype=np.uint8)
+    written = _lib.nmfx_format_gct_body(
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n_rows, n_cols, prefixes,
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        buf.ctypes.data_as(ctypes.c_char_p), cap)
+    if written < 0:
+        raise RuntimeError("nmfx_format_gct_body: buffer overflow")
+    return memoryview(buf[:written])

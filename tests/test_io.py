@@ -64,3 +64,47 @@ def test_write_gct_creates_dirs(tmp_path):
 def test_write_gct_shape_validation(tmp_path):
     with pytest.raises(ValueError):
         write_gct(np.zeros((2, 2)), str(tmp_path / "bad.gct"), row_names=["a"])
+
+
+@pytest.fixture(params=["native", "numpy"])
+def io_backend(request, monkeypatch):
+    """Run I/O tests under both the native C++ path and the numpy fallback."""
+    from nmfx import native
+
+    if request.param == "native":
+        if not native.available():
+            pytest.skip("native library unavailable")
+    else:
+        monkeypatch.setattr(native, "available", lambda: False)
+    return request.param
+
+
+def test_gct_lenient_parsing(tmp_path, io_backend):
+    """Both parse paths accept what the reference reader accepted: extra
+    trailing fields (ignored), leading '+', and '#' inside names."""
+    p = str(tmp_path / "lenient.gct")
+    with open(p, "w") as f:
+        f.write("#1.2\n2\t3\nName\tDescription\ts1\ts2\ts3\n")
+        f.write("g#1\tdesc # hash\t1.5\t+2\t3\textra\tfields\n")
+        f.write("g2\td\t4\t5e-1\t6.25\n")
+    ds = read_gct(p)
+    np.testing.assert_array_equal(ds.values, [[1.5, 2.0, 3.0],
+                                              [4.0, 0.5, 6.25]])
+    assert ds.row_names == ["g#1", "g2"]
+
+
+def test_gct_roundtrip_both_backends(tmp_path, io_backend):
+    rng = np.random.default_rng(3)
+    vals = rng.uniform(0, 10, size=(9, 4))
+    path = str(tmp_path / "rt.gct")
+    write_gct(vals, path, row_names=[f"r{i}" for i in range(9)],
+              col_names=list("abcd"))
+    ds = read_gct(path)
+    np.testing.assert_array_equal(ds.values, vals)
+
+
+def test_write_gct_descriptions_validated(tmp_path):
+    with pytest.raises(ValueError, match="descriptions"):
+        write_gct(np.ones((3, 2)), str(tmp_path / "x.gct"),
+                  row_names=list("abc"), col_names=list("xy"),
+                  descriptions=["only-one"])
