@@ -125,15 +125,17 @@ def read_res(path: str) -> Dataset:
         f.readline()  # per-sample description line, unused
         n_rows = int(f.readline().split()[0])
         row_names: list[str] = []
-        rows: list[list[float]] = []
+        numeric: list[str] = []
         for line in f:
             line = line.rstrip("\n")
             if not line:
                 continue
             fields = line.split("\t")
             row_names.append(fields[1])
-            rows.append([float(v) for v in fields[2::2]])
-    values = np.asarray(rows, dtype=np.float64)
+            numeric.append("\t".join(fields[2::2]))
+    values = (np.loadtxt(numeric, delimiter="\t", dtype=np.float64,
+                         comments=None, ndmin=2)
+              if numeric else np.empty((0, len(col_names))))
     if values.shape[0] != n_rows:
         raise ValueError(
             f"{path}: found {values.shape[0]} data rows, header said {n_rows}"
