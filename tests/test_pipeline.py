@@ -192,3 +192,19 @@ def test_result_save_load_roundtrip(two_group_result, tmp_path):
     bare = str(tmp_path / "result_bare")
     two_group_result.save(bare)
     assert ConsensusResult.load(bare).best_k == two_group_result.best_k
+
+
+def test_reference_dataset_end_to_end():
+    """Full pipeline on the reference's own bundled fixture (1000 genes x
+    40 samples, two 20-sample groups — the filename encodes the design):
+    rho must peak at k=2 and the k=2 membership must split the two groups
+    exactly (reference runExample's data, nmf.r:11)."""
+    path = "/root/reference/20+20x1000.gct"
+    if not os.path.exists(path):
+        pytest.skip("reference fixture not mounted")
+    res = nmfconsensus(path, ks=(2, 3), restarts=6, seed=123, max_iter=800,
+                       use_mesh=False)
+    assert res.best_k == 2
+    assert res.per_k[2].rho >= 0.99
+    m = res.per_k[2].membership
+    assert set(m[:20]) != set(m[20:]) and len(set(m)) == 2
