@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default="argmax",
                    help="cluster label rule; argmin reproduces the reference "
                         "R layer's observed (buggy) assignment")
+    p.add_argument("--verbose", action="store_true",
+                   help="log per-rank progress while the sweep runs (turns "
+                        "off async dispatch pipelining across ranks)")
     p.add_argument("--outdir", default="./nmfx_out")
     p.add_argument("--no-plots", action="store_true")
     p.add_argument("--no-files", action="store_true",
@@ -102,6 +105,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.backend in ("packed", "pallas") and args.algorithm != "mu":
         parser.error(f"--backend {args.backend} is only implemented for "
                      "--algorithm mu (use auto)")
+    if args.verbose:
+        import logging
+
+        logging.basicConfig(format="%(message)s")
+        logging.getLogger("nmfx").setLevel(logging.INFO)
     from nmfx.api import nmfconsensus  # deferred: keeps --help fast
 
     output = None

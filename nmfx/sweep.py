@@ -10,6 +10,8 @@ across TPU cores over ICI, and the per-k consensus reduction happens on-device
 
 from __future__ import annotations
 
+import logging
+import time
 from functools import lru_cache, partial
 from typing import NamedTuple
 
@@ -23,6 +25,8 @@ from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
 from nmfx.consensus import consensus_matrix, labels_from_h
 from nmfx.init import initialize, random_init
 from nmfx.solvers.base import solve
+
+_log = logging.getLogger("nmfx")
 
 #: mesh axis name for the restart batch dimension
 RESTART_AXIS = "restarts"
@@ -514,9 +518,21 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
         # fold in k itself (not its position) so a given (seed, k) always
         # yields the same factorizations regardless of sweep composition
         key = jax.random.fold_in(root, k)
+        t0 = time.perf_counter()
         with profiler.phase(f"solve.k={k}") as sync:
             out[k] = sync(sweep_one_k(a, key, k, cfg.restarts, solver_cfg,
                                       init_cfg, cfg.label_rule, mesh))
+        if (0 < _log.level <= logging.INFO
+                and (not multi or jax.process_index() == 0)):
+            # reading the stats forces a device sync, trading the k-grid's
+            # async dispatch pipelining for live progress. Gated on a level
+            # set explicitly on the "nmfx" logger (CLI --verbose does this)
+            # — inherited app-wide INFO must not silently serialize the
+            # sweep; coordinator-only under multi-host
+            iters = np.asarray(out[k].iterations)
+            _log.info("k=%d: %d restarts in %.2fs (mean %.0f iters)",
+                      k, cfg.restarts, time.perf_counter() - t0,
+                      float(iters.mean()))
         if registry is not None and (not multi or jax.process_index() == 0):
             with profiler.phase("checkpoint"):
                 registry.save(k, out[k])

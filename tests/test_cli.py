@@ -84,3 +84,13 @@ def test_cli_rejects_vmap_with_shards(gct_path):
     with pytest.raises(SystemExit):
         main([gct_path, "--feature-shards", "2", "--backend", "vmap",
               "--no-files"])
+
+
+def test_cli_verbose_progress(gct_path, caplog):
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="nmfx"):
+        rc = main([gct_path, "--ks", "2", "--restarts", "3",
+                   "--maxiter", "100", "--no-files", "--verbose"])
+    assert rc == 0
+    assert any("k=2:" in r.message for r in caplog.records)

@@ -199,12 +199,15 @@ def test_reference_dataset_end_to_end():
     40 samples, two 20-sample groups — the filename encodes the design):
     rho must peak at k=2 and the k=2 membership must split the two groups
     exactly (reference runExample's data, nmf.r:11)."""
-    path = "/root/reference/20+20x1000.gct"
+    path = os.environ.get("NMFX_REFERENCE_GCT",
+                          "/root/reference/20+20x1000.gct")
     if not os.path.exists(path):
-        pytest.skip("reference fixture not mounted")
+        pytest.skip(f"reference fixture not found at {path} "
+                    "(set NMFX_REFERENCE_GCT)")
     res = nmfconsensus(path, ks=(2, 3), restarts=6, seed=123, max_iter=800,
                        use_mesh=False)
     assert res.best_k == 2
     assert res.per_k[2].rho >= 0.99
     m = res.per_k[2].membership
-    assert set(m[:20]) != set(m[20:]) and len(set(m)) == 2
+    assert len(set(m[:20])) == 1 and len(set(m[20:])) == 1
+    assert m[0] != m[20]
