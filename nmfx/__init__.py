@@ -17,7 +17,7 @@ from nmfx.config import (
     SolverConfig,
 )
 from nmfx.io import read_dataset, read_gct, read_res, write_gct
-from nmfx.api import ConsensusResult, nmf, nmfconsensus
+from nmfx.api import ConsensusResult, nmf, nmfconsensus, run_example
 from nmfx.sweep import default_mesh, feature_mesh, grid_mesh
 
 __version__ = "0.1.0"
@@ -34,6 +34,7 @@ __all__ = [
     "nmf",
     "nmfconsensus",
     "read_dataset",
+    "run_example",
     "read_gct",
     "read_res",
     "write_gct",

@@ -211,3 +211,13 @@ def test_reference_dataset_end_to_end():
     m = res.per_k[2].membership
     assert len(set(m[:20])) == 1 and len(set(m[20:])) == 1
     assert m[0] != m[20]
+
+
+def test_run_example():
+    """nmfx.run_example mirrors the reference's runExample (nmf.r:6-14) on
+    the equivalent synthetic design; shrunk here via kwargs for test speed."""
+    import nmfx
+
+    res = nmfx.run_example(outdir=None, ks=(2, 3), restarts=4, max_iter=300,
+                           use_mesh=False)
+    assert res.best_k == 2
