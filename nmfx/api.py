@@ -229,11 +229,6 @@ def nmfconsensus(
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
                          f"{rank_selection!r}")
-    if rank_selection == "device" and linkage != "average":
-        raise ValueError(
-            "rank_selection='device' implements average linkage only "
-            f"(the reference's hclust method); got linkage={linkage!r} — "
-            "use rank_selection='host'")
     arr, col_names = _as_matrix(data)
     if not np.isfinite(arr).all():
         raise ValueError("input matrix contains non-finite values")
@@ -280,7 +275,8 @@ def nmfconsensus(
                 # dispatch the device clustering before the (blocking)
                 # host transfer of the consensus matrix so they overlap
                 rho, membership, order = sync(
-                    rank_selection_jax(jnp.asarray(out.consensus), k))
+                    rank_selection_jax(jnp.asarray(out.consensus), k,
+                                       ccfg.linkage))
                 cons = np.asarray(out.consensus, dtype=np.float64)
                 rho = float(rho)
                 membership = np.asarray(membership)

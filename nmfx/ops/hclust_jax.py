@@ -1,4 +1,5 @@
-"""On-device average-linkage clustering, cophenetic distances, and cut-tree.
+"""On-device hierarchical clustering (average/complete/single linkage),
+cophenetic distances, and cut-tree.
 
 The reference delegates rank selection to base R on the host —
 ``hclust(as.dist(1-C), "average")`` → ``cophenetic`` → ``cor`` → ``cutree``
@@ -27,9 +28,11 @@ import jax.numpy as jnp
 from jax import lax
 
 
-@partial(jax.jit, static_argnames=("k",))
-def average_linkage_jax(dist: jax.Array, k: int | None = None):
-    """UPGMA clustering of an (n, n) distance matrix, on device.
+@partial(jax.jit, static_argnames=("k", "method"))
+def linkage_jax(dist: jax.Array, k: int | None = None,
+                method: str = "average"):
+    """Agglomerative clustering of an (n, n) distance matrix, on device,
+    under the "average", "complete", or "single" Lance-Williams update.
 
     Returns ``(linkage, coph, order, membership)``:
 
@@ -42,6 +45,11 @@ def average_linkage_jax(dist: jax.Array, k: int | None = None):
     n = dist.shape[0]
     if dist.shape != (n, n):
         raise ValueError("dist must be square")
+    from nmfx.config import LINKAGE_METHODS
+
+    if method not in LINKAGE_METHODS:
+        raise ValueError(
+            f"linkage must be one of {LINKAGE_METHODS}, got {method!r}")
     kcut = 1 if k is None else k
     if not 1 <= kcut <= n:
         raise ValueError(f"k must be in [1, {n}]")
@@ -71,7 +79,12 @@ def average_linkage_jax(dist: jax.Array, k: int | None = None):
             jnp.stack([a.astype(f), b.astype(f), height, new_size]))
         cross = mem[i][:, None] & mem[j][None, :]
         coph = coph + height * (cross | cross.T).astype(f)
-        merged = (size[i] * d[i] + size[j] * d[j]) / new_size
+        if method == "average":
+            merged = (size[i] * d[i] + size[j] * d[j]) / new_size
+        elif method == "complete":
+            merged = jnp.maximum(d[i], d[j])
+        else:  # single
+            merged = jnp.minimum(d[i], d[j])
         d = d.at[i, :].set(merged).at[:, i].set(merged).at[i, i].set(jnp.inf)
         active = active.at[j].set(False)
         mem = mem.at[i].set(mem[i] | mem[j])
@@ -138,15 +151,22 @@ def _first_appearance_labels(raw: jax.Array) -> jax.Array:
     return (distinct_before + 1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("k",))
-def rank_selection_jax(consensus: jax.Array, k: int):
+def average_linkage_jax(dist: jax.Array, k: int | None = None):
+    """UPGMA clustering on device (kept as the named average-linkage
+    entry; see ``linkage_jax`` for the general method)."""
+    return linkage_jax(dist, k, "average")
+
+
+@partial(jax.jit, static_argnames=("k", "method"))
+def rank_selection_jax(consensus: jax.Array, k: int,
+                       method: str = "average"):
     """Fully on-device analogue of ``nmfx.cophenetic.rank_selection``:
     (ρ, membership 1..k, dendrogram leaf order) from one consensus matrix."""
     n = consensus.shape[0]
     f = jnp.promote_types(consensus.dtype, jnp.float32)
     dist = (1.0 - jnp.asarray(consensus, f))
     dist = jnp.where(jnp.eye(n, dtype=bool), 0.0, dist)
-    _, coph, order, membership = average_linkage_jax(dist, k)
+    _, coph, order, membership = linkage_jax(dist, k, method)
     iu = jnp.triu_indices(n, k=1)
     x = dist[iu]
     y = coph[iu]

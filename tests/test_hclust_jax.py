@@ -92,3 +92,40 @@ def test_rank_selection_arg_validated(two_group_data):
     with pytest.raises(ValueError, match="rank_selection"):
         nmfconsensus(two_group_data, ks=(2,), restarts=2,
                      rank_selection="gpu")
+
+
+@pytest.mark.parametrize("method", ["complete", "single"])
+def test_other_linkages_match_numpy(method):
+    """Device complete/single linkage reproduce the (scipy-cross-tested)
+    numpy implementation exactly: heights, cophenetic, order, memberships."""
+    from nmfx.cophenetic import cut_tree_numpy, linkage_numpy
+    from nmfx.ops.hclust_jax import linkage_jax
+
+    rng = np.random.default_rng(13)
+    n, k = 19, 4
+    x = rng.uniform(0, 1, (n, 4))
+    dist = np.sqrt(((x[:, None] - x[None, :]) ** 2).sum(-1))
+    np.fill_diagonal(dist, 0.0)
+    ref = linkage_numpy(dist, method)
+    linkage, coph, order, membership = linkage_jax(
+        jnp.asarray(dist), k, method)
+    np.testing.assert_allclose(np.asarray(linkage), ref.linkage, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(coph), ref.coph, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(order), ref.order)
+    np.testing.assert_array_equal(np.asarray(membership),
+                                  cut_tree_numpy(ref.linkage, n, k))
+
+
+def test_device_rank_selection_nonaverage_linkage():
+    from nmfx.api import nmfconsensus
+    from nmfx.datasets import two_group_matrix
+
+    a = two_group_matrix(n_genes=60, n_per_group=6, seed=2)
+    host = nmfconsensus(a, ks=(2,), restarts=3, max_iter=150,
+                        linkage="complete", use_mesh=False)
+    dev = nmfconsensus(a, ks=(2,), restarts=3, max_iter=150,
+                       linkage="complete", use_mesh=False,
+                       rank_selection="device")
+    assert abs(host.per_k[2].rho - dev.per_k[2].rho) < 1e-4
+    np.testing.assert_array_equal(host.per_k[2].membership,
+                                  dev.per_k[2].membership)
