@@ -1,0 +1,108 @@
+"""Benchmark harness beyond the single north-star number (SURVEY.md §7
+build step 8): per-solver restart throughput and sweep wall-clock across
+problem sizes. Prints a table and emits one JSON document; bench.py at the
+repo root remains the driver-facing single-line harness.
+
+    python benchmarks/run.py            # full table (TPU, ~2-4 min)
+    python benchmarks/run.py --quick    # smaller sizes
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time_sweep(a, ks, restarts, scfg, warm_seed=999, seed=123):
+    import jax
+
+    from nmfx.config import ConsensusConfig, InitConfig
+    from nmfx.sweep import default_mesh, sweep
+
+    mesh = default_mesh()
+    icfg = InitConfig()
+
+    def run(seed):
+        out = sweep(a, ConsensusConfig(ks=ks, restarts=restarts, seed=seed),
+                    scfg, icfg, mesh)
+        for k in ks:
+            np.asarray(out[k].consensus)  # host materialization = sync
+        return out
+
+    run(warm_seed)  # compile
+    t0 = time.perf_counter()
+    out = run(seed)
+    wall = time.perf_counter() - t0
+    iters = float(np.mean([np.asarray(out[k].iterations).mean()
+                           for k in ks]))
+    return wall, iters
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--maxiter", type=int, default=2000)
+    args = p.parse_args()
+
+    import jax
+
+    from nmfx.config import ALGORITHMS, SolverConfig
+    from nmfx.datasets import grouped_matrix
+
+    m, n = (1000, 120) if args.quick else (5000, 500)
+    restarts = 8 if args.quick else 20
+    ks = (2, 4) if args.quick else (2, 4, 6)
+    a = grouped_matrix(m, tuple([n // 4] * 4), effect=2.0, seed=0)
+
+    results = {"device": str(jax.devices()[0]), "shape": [m, n],
+               "restarts_per_k": restarts, "ks": list(ks),
+               "maxiter": args.maxiter, "solvers": {}, "scaling": []}
+
+    # the projected-gradient family pays nested line searches per outer
+    # iteration (~50 ms/iter at this size) — cap it so the table stays
+    # minutes, and record the caps in the output
+    per_solver = {
+        "pg": dict(max_iter=100),
+        "alspg": dict(max_iter=20, sub_max_iter=100),
+    }
+    print(f"# per-solver: {m}x{n}, k={list(ks)}, {restarts} restarts/k, "
+          f"maxiter={args.maxiter} (pg: 100; alspg: 20x100 sub)")
+    print(f"{'solver':8s} {'wall s':>8s} {'restarts/s':>11s} "
+          f"{'mean iters':>11s}")
+    for algo in ALGORITHMS:
+        kw = dict(max_iter=args.maxiter)
+        kw.update(per_solver.get(algo, {}))
+        scfg = SolverConfig(algorithm=algo, matmul_precision="bfloat16",
+                            **kw)
+        wall, iters = _time_sweep(a, ks, restarts, scfg)
+        rps = len(ks) * restarts / wall
+        results["solvers"][algo] = {"wall_s": round(wall, 3),
+                                    "restarts_per_s": round(rps, 2),
+                                    "mean_iters": round(iters, 1),
+                                    "max_iter": kw["max_iter"]}
+        print(f"{algo:8s} {wall:8.2f} {rps:11.1f} {iters:11.0f}")
+
+    sizes = ([(500, 60), (1000, 120)] if args.quick
+             else [(1000, 100), (5000, 500), (20000, 1000)])
+    print(f"\n# mu sweep scaling (k={list(ks)}, {restarts} restarts/k)")
+    print(f"{'genes x samples':>16s} {'wall s':>8s} {'restarts/s':>11s}")
+    for sm, sn in sizes:
+        sa = grouped_matrix(sm, tuple([sn // 4] * 4), effect=2.0, seed=0)
+        scfg = SolverConfig(algorithm="mu", max_iter=args.maxiter,
+                            matmul_precision="bfloat16")
+        wall, _ = _time_sweep(sa, ks, restarts, scfg)
+        results["scaling"].append({"shape": [sm, sn],
+                                   "wall_s": round(wall, 3)})
+        print(f"{f'{sm}x{sn}':>16s} {wall:8.2f} "
+              f"{len(ks) * restarts / wall:11.1f}")
+
+    print("\n" + json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
