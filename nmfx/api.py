@@ -171,8 +171,14 @@ def _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg):
 def nmf(a, k: int, *, seed: int = 0, algorithm: str | None = None,
         max_iter: int | None = None, init: str | None = None,
         solver_cfg: SolverConfig | None = None,
-        init_cfg: InitConfig | None = None) -> SolverResult:
-    """One non-negative factorization A ≈ W·H at rank k."""
+        init_cfg: InitConfig | None = None,
+        w0=None, h0=None) -> SolverResult:
+    """One non-negative factorization A ≈ W·H at rank k.
+
+    ``w0``/``h0``: explicit initial factors (both or neither) — warm-start
+    from a previous solve or a custom scheme; otherwise initialization
+    follows ``init``/``init_cfg`` with the given ``seed``.
+    """
     arr, _ = _as_matrix(a)
     if not np.isfinite(arr).all():
         raise ValueError("input matrix contains non-finite values")
@@ -184,8 +190,26 @@ def nmf(a, k: int, *, seed: int = 0, algorithm: str | None = None,
     import jax.numpy as jnp
 
     dtype = jnp.dtype(scfg.dtype)
-    w0, h0 = initialize(jax.random.key(seed), jnp.asarray(arr, dtype), k,
-                        icfg, dtype)
+    if (w0 is None) != (h0 is None):
+        raise ValueError("pass both w0 and h0, or neither")
+    if w0 is None:
+        w0, h0 = initialize(jax.random.key(seed), jnp.asarray(arr, dtype),
+                            k, icfg, dtype)
+    else:
+        if init is not None or init_cfg is not None:
+            raise ValueError(
+                "pass either explicit w0/h0 or an init scheme, not both")
+        w0 = np.asarray(w0)
+        h0 = np.asarray(h0)
+        m, n = arr.shape
+        if w0.shape != (m, k) or h0.shape != (k, n):
+            raise ValueError(
+                f"w0/h0 shapes {w0.shape}/{h0.shape} don't match "
+                f"({m}, {k})/({k}, {n})")
+        if not (np.isfinite(w0).all() and np.isfinite(h0).all()):
+            raise ValueError("initial factors contain non-finite values")
+        if (w0 < 0).any() or (h0 < 0).any():
+            raise ValueError("initial factors must be non-negative")
     return solve(arr, w0, h0, scfg)
 
 

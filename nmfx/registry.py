@@ -141,10 +141,17 @@ class SweepRegistry:
     def save(self, k: int, out) -> None:
         """Persist one rank's KSweepOutput atomically (write + rename, so a
         crash mid-write never leaves a half-result that resume would trust)."""
+        import jax
+
         path = self._path(k)
         tmp = path + ".tmp"
+        # one batched device→host transfer for the whole pytree: per-field
+        # np.asarray paid one tunnel round trip each (~1 s/rank on a
+        # remote-attached TPU vs ~0.15 s batched)
+        host = jax.device_get(tuple(out))
         with open(tmp, "wb") as f:  # file handle: savez won't append ".npz"
-            np.savez(f, **{n: np.asarray(v) for n, v in zip(out._fields, out)})
+            np.savez(f, **{n: np.asarray(v)
+                           for n, v in zip(out._fields, host)})
         os.replace(tmp, path)
 
     def load(self, k: int):

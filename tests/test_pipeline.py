@@ -221,3 +221,26 @@ def test_run_example():
     res = nmfx.run_example(outdir=None, ks=(2, 3), restarts=4, max_iter=300,
                            use_mesh=False)
     assert res.best_k == 2
+
+
+def test_nmf_warm_start(two_group_data):
+    from nmfx.api import nmf
+
+    a = two_group_data
+    first = nmf(a, k=2, max_iter=100, seed=1)
+    warm = nmf(a, k=2, max_iter=50, w0=np.asarray(first.w),
+               h0=np.asarray(first.h))
+    assert float(warm.dnorm) <= float(first.dnorm) + 1e-5
+    with pytest.raises(ValueError, match="both w0 and h0"):
+        nmf(a, k=2, w0=np.asarray(first.w))
+    with pytest.raises(ValueError, match="shapes"):
+        nmf(a, k=2, w0=np.ones((3, 2)), h0=np.ones((2, 3)))
+    with pytest.raises(ValueError, match="non-negative"):
+        nmf(a, k=2, w0=-np.asarray(first.w), h0=np.asarray(first.h))
+    bad = np.array(first.w, copy=True)
+    bad[0, 0] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        nmf(a, k=2, w0=bad, h0=np.asarray(first.h))
+    with pytest.raises(ValueError, match="not both"):
+        nmf(a, k=2, init="nndsvd", w0=np.asarray(first.w),
+            h0=np.asarray(first.h))
