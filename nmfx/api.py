@@ -352,6 +352,13 @@ def save_results(result: ConsensusResult, out: OutputConfig) -> list[str]:
             write_gct(r.consensus, path, row_names=list(names),
                       col_names=list(names))
             written.append(path)
+            # metagenes of the lowest-residual restart (the H the reference
+            # returns per job, nmf.r:50, but never exports) — k × samples
+            path = f"{prefix}metagenes.k.{k}.gct"
+            write_gct(r.best_h, path,
+                      row_names=[f"metagene.{i + 1}" for i in range(k)],
+                      col_names=list(names))
+            written.append(path)
         all_membership = np.stack(
             [result.per_k[k].membership for k in result.ks], axis=1)
         path = f"{prefix}membership.gct"

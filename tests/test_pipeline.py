@@ -79,6 +79,14 @@ def test_save_results(two_group_result, tmp_path):
         assert os.path.exists(path), path
     assert any(p.endswith("cophenetic.txt") for p in written)
     assert any(p.endswith("membership.gct") for p in written)
+    meta = [p for p in written if p.endswith("metagenes.k.2.gct")]
+    assert meta
+    from nmfx.io import read_gct
+
+    ds = read_gct(meta[0])
+    assert ds.values.shape == (2, len(two_group_result.col_names))
+    np.testing.assert_allclose(ds.values,
+                               two_group_result.per_k[2].best_h, rtol=1e-6)
 
 
 def test_per_k_results_independent_of_sweep_composition(two_group_data):
