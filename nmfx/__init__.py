@@ -20,7 +20,7 @@ from nmfx.io import read_dataset, read_gct, read_res, write_gct
 from nmfx.api import ConsensusResult, nmf, nmfconsensus, run_example
 from nmfx.sweep import default_mesh, feature_mesh, grid_mesh
 
-__version__ = "0.1.0"
+from nmfx.config import VERSION as __version__
 
 __all__ = [
     "ConsensusConfig",

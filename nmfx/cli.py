@@ -13,7 +13,7 @@ import argparse
 import sys
 
 from nmfx.config import (ALGORITHMS, INIT_METHODS, LINKAGE_METHODS,
-                         OutputConfig, SolverConfig)
+                         VERSION, OutputConfig, SolverConfig)
 
 
 def parse_ks(spec: str) -> tuple[int, ...]:
@@ -70,6 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--verbose", action="store_true",
                    help="log per-rank progress while the sweep runs (turns "
                         "off async dispatch pipelining across ranks)")
+    p.add_argument("--save-result", default=None, metavar="PATH",
+                   help="also persist the full ConsensusResult as one npz "
+                        "(reload with nmfx.ConsensusResult.load)")
+    p.add_argument("--version", action="version",
+                   version="%(prog)s " + VERSION)
     p.add_argument("--outdir", default="./nmfx_out")
     p.add_argument("--no-plots", action="store_true")
     p.add_argument("--no-files", action="store_true",
@@ -161,6 +166,8 @@ def main(argv: list[str] | None = None) -> int:
             checkpoint_dir=args.checkpoint_dir,
             profiler=profiler,
         )
+    if args.save_result:
+        result.save(args.save_result)
     print(result.summary())
     if args.profile:
         print(profiler.report())

@@ -15,6 +15,10 @@ ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf")
 INIT_METHODS = ("random", "nndsvd")
 LINKAGE_METHODS = ("average", "complete", "single")
 
+#: canonical package version (lives here so light importers — the CLI's
+#: --help/--version path — don't pull the full jax-importing package)
+VERSION = "0.1.0"
+
 
 @dataclasses.dataclass(frozen=True)
 class SolverConfig:

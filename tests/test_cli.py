@@ -94,3 +94,23 @@ def test_cli_verbose_progress(gct_path, caplog):
                    "--maxiter", "100", "--no-files", "--verbose"])
     assert rc == 0
     assert any("k=2:" in r.message for r in caplog.records)
+
+
+def test_cli_save_result(gct_path, tmp_path, capsys):
+    from nmfx.api import ConsensusResult
+
+    path = str(tmp_path / "res.npz")
+    rc = main([gct_path, "--ks", "2", "--restarts", "3", "--maxiter", "100",
+               "--no-files", "--save-result", path])
+    assert rc == 0
+    loaded = ConsensusResult.load(path)
+    assert loaded.best_k == 2
+
+
+def test_cli_version(capsys):
+    import nmfx
+
+    with pytest.raises(SystemExit) as e:
+        main(["--version"])
+    assert e.value.code == 0
+    assert nmfx.__version__ in capsys.readouterr().out
