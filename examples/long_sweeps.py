@@ -1,0 +1,38 @@
+"""Long-sweep workflow: checkpoint/resume and whole-result persistence.
+
+Runs a sweep with a checkpoint registry, simulates an interruption by
+re-running (finished ranks load from disk instead of recomputing), and
+saves/reloads the final result for later analysis.
+
+    python examples/long_sweeps.py
+"""
+
+import time
+
+import nmfx
+from nmfx.datasets import grouped_matrix
+
+a = grouped_matrix(n_genes=800, group_sizes=(15, 15, 15), effect=2.0,
+                   seed=7)
+
+t0 = time.perf_counter()
+result = nmfx.nmfconsensus(a, ks=(2, 3, 4), restarts=10, seed=42,
+                           checkpoint_dir="ckpt_demo", output=None)
+print(f"cold sweep: {time.perf_counter() - t0:.2f}s")
+
+# a re-run with the same data+config resumes from the registry: every
+# rank loads from ckpt_demo/ instead of recomputing
+t0 = time.perf_counter()
+resumed = nmfx.nmfconsensus(a, ks=(2, 3, 4), restarts=10, seed=42,
+                            checkpoint_dir="ckpt_demo", output=None)
+print(f"resumed sweep: {time.perf_counter() - t0:.2f}s "
+      "(ranks loaded from checkpoint)")
+assert resumed.summary() == result.summary()
+
+# persist everything for later analysis without rerunning
+result.save("result_demo.npz")
+later = nmfx.ConsensusResult.load("result_demo.npz")
+print(f"\nreloaded from result_demo.npz: best k = {later.best_k}")
+print(later.summary())
+print("\nordered consensus at best k:")
+print(later.per_k[later.best_k].ordered_consensus.round(2))
