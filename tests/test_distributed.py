@@ -9,6 +9,7 @@ different device placement only).
 
 import jax
 import numpy as np
+import pytest
 
 from nmfx import distributed as dist
 from nmfx.config import SolverConfig
@@ -65,3 +66,15 @@ def test_distributed_consensus_end_to_end(two_group_data, tmp_path):
                          seed=11)
     assert res.best_k == 2  # two planted groups
     assert set(res.per_k) == {2, 3}
+
+
+def test_global_mesh_grid_axes():
+    from nmfx.sweep import FEATURE_AXIS, RESTART_AXIS, SAMPLE_AXIS
+
+    mesh = dist.global_mesh(feature_shards=2, sample_shards=2)
+    assert mesh.axis_names == (RESTART_AXIS, FEATURE_AXIS, SAMPLE_AXIS)
+    assert mesh.shape[RESTART_AXIS] == 2  # 8 devices / (2*2)
+    assert mesh.shape[FEATURE_AXIS] == 2
+    assert mesh.shape[SAMPLE_AXIS] == 2
+    with pytest.raises(ValueError, match="divide"):
+        dist.global_mesh(feature_shards=3)  # 8 % 3 != 0
