@@ -160,3 +160,21 @@ class SweepRegistry:
 
         with np.load(self._path(k)) as z:
             return KSweepOutput(**{f: z[f] for f in KSweepOutput._fields})
+
+    def try_load(self, k: int):
+        """``load`` that returns None for a missing OR unreadable rank file
+        (truncated by a crash predating the atomic-write scheme, external
+        corruption, a field-set mismatch from an older nmfx). The sweep
+        treats None as not-checkpointed: it recomputes and overwrites —
+        self-healing resume instead of an opaque zipfile traceback."""
+        if not self.has(k):
+            return None
+        try:
+            return self.load(k)
+        except Exception as e:  # noqa: BLE001 — any unreadable file heals
+            import logging
+
+            logging.getLogger("nmfx").warning(
+                "checkpoint for k=%d at %s is unreadable (%s); recomputing",
+                k, self._path(k), e)
+            return None

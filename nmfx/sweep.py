@@ -491,16 +491,16 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     placed = False  # transfer A lazily: a fully-checkpointed re-run never pays
     out: dict[int, KSweepOutput] = {}
     for k in cfg.ks:
-        have = registry is not None and registry.has(k)
+        loaded = registry.try_load(k) if registry is not None else None
+        have = loaded is not None
         if multi:
             from jax.experimental import multihost_utils
 
             have = bool(multihost_utils.broadcast_one_to_all(
                 np.asarray(have)))
         if have:
-            loaded = (registry.load(k)
-                      if registry is not None and registry.has(k)
-                      else _template(a, k, cfg.restarts, solver_cfg))
+            if loaded is None:  # registry-less host joining the broadcast
+                loaded = _template(a, k, cfg.restarts, solver_cfg)
             if multi:
                 loaded = KSweepOutput(*(
                     np.asarray(x) for x in

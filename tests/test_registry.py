@@ -1,6 +1,8 @@
 """Checkpoint/resume registry tests (SURVEY.md §5: the restartability the
 reference's BatchJobs registry provides but never exploits, nmf.r:112-113)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -96,3 +98,29 @@ def test_fingerprint_forward_compatible_with_default_fields():
     # a numerics-affecting change does invalidate
     other = dataclasses.replace(base_cfg, tol_x=1e-6)
     assert _fingerprint(a, other, InitConfig(), 4, 1, "argmax") != fp
+
+
+def test_corrupt_checkpoint_self_heals(low_rank_data, tmp_path, caplog):
+    """A truncated/garbage rank file must not crash resume: the sweep logs
+    a warning, recomputes the rank, and overwrites a good checkpoint."""
+    import logging
+
+    from nmfx.api import nmfconsensus
+
+    a, _ = low_rank_data
+    ck = str(tmp_path / "reg")
+    first = nmfconsensus(a, ks=(2, 3), restarts=3, max_iter=150,
+                         checkpoint_dir=ck, use_mesh=False)
+    # corrupt one rank's file in place
+    path = os.path.join(ck, "k3.npz")
+    with open(path, "wb") as f:
+        f.write(b"not an npz")
+    with caplog.at_level(logging.WARNING, logger="nmfx"):
+        second = nmfconsensus(a, ks=(2, 3), restarts=3, max_iter=150,
+                              checkpoint_dir=ck, use_mesh=False)
+    assert any("unreadable" in r.message for r in caplog.records)
+    assert second.summary() == first.summary()
+    # the overwritten checkpoint is good again: third run loads cleanly
+    third = nmfconsensus(a, ks=(2, 3), restarts=3, max_iter=150,
+                         checkpoint_dir=ck, use_mesh=False)
+    assert third.summary() == first.summary()
