@@ -252,3 +252,22 @@ def test_nmf_warm_start(two_group_data):
     with pytest.raises(ValueError, match="not both"):
         nmf(a, k=2, init="nndsvd", w0=np.asarray(first.w),
             h0=np.asarray(first.h))
+
+
+def test_save_results_with_plots(two_group_result, tmp_path):
+    """write_plots=True: the full artifact set incl. every PDF (per-k
+    consensus heatmaps, all-k grid, cophenetic curve, metagene plots) —
+    the reference's plotting outputs (nmf.r:191-249)."""
+    out = OutputConfig(directory=str(tmp_path))
+    written = save_results(two_group_result, out)
+    pdfs = [p for p in written if p.endswith(".pdf")]
+    names = {os.path.basename(p) for p in pdfs}
+    assert "consensus.all.k.plot.pdf" in names
+    assert "cophenetic.plot.pdf" in names
+    for k in two_group_result.ks:
+        assert f"consensus.plot.k{k}.pdf" in names
+        assert f"metagenes.k{k}.pdf" in names
+    for p in written:
+        assert os.path.getsize(p) > 20, p
+    for p in pdfs:
+        assert os.path.getsize(p) > 1000, p
