@@ -1,5 +1,7 @@
 """Native C++ hclust library vs the numpy reference implementation."""
 
+import os
+
 import numpy as np
 import pytest
 import scipy.cluster.hierarchy as sch
@@ -58,3 +60,42 @@ def test_rank_selection_dispatch_parity(monkeypatch):
     assert rho_n == rho_p
     np.testing.assert_array_equal(mem_n, mem_p)
     np.testing.assert_array_equal(ord_n, ord_p)
+
+
+def test_stale_library_rebuilds_or_degrades(tmp_path, monkeypatch):
+    """A prebuilt .so missing the current symbols must never crash
+    available(): with the sources present it rebuilds and binds; without
+    them it degrades to the numpy fallback."""
+    import shutil
+    import subprocess
+
+    from nmfx import native
+
+    src = tmp_path / "dummy.cpp"
+    src.write_text('extern "C" int unrelated() { return 0; }\n')
+
+    def make_stale(d):
+        d.mkdir(exist_ok=True)
+        subprocess.run(["g++", "-shared", "-fPIC", "-o",
+                        str(d / "libnmfx_native.so"), str(src)], check=True)
+
+    # case 1: sources + Makefile present -> rebuild heals
+    heal = tmp_path / "heal"
+    make_stale(heal)
+    pkg = os.path.dirname(native.__file__)
+    for f in ("Makefile", "hclust.cpp", "gct_io.cpp"):
+        shutil.copy(os.path.join(pkg, f), heal / f)
+    monkeypatch.setattr(native, "_DIR", str(heal))
+    monkeypatch.setattr(native, "_LIB_PATH", str(heal / "libnmfx_native.so"))
+    monkeypatch.setattr(native, "_load_attempted", False)
+    monkeypatch.setattr(native, "_lib", None)
+    assert native.available() is True
+
+    # case 2: no sources -> graceful degradation, no AttributeError
+    bare = tmp_path / "bare"
+    make_stale(bare)
+    monkeypatch.setattr(native, "_DIR", str(bare))
+    monkeypatch.setattr(native, "_LIB_PATH", str(bare / "libnmfx_native.so"))
+    monkeypatch.setattr(native, "_load_attempted", False)
+    monkeypatch.setattr(native, "_lib", None)
+    assert native.available() is False
