@@ -39,11 +39,20 @@ def _try_load() -> ctypes.CDLL | None:
         return None
     except AttributeError:
         # stale prebuilt .so missing newer symbols: rebuild once, retry;
-        # any further failure degrades to the numpy fallback as documented
+        # any further failure degrades to the numpy fallback as documented.
+        # dlopen caches by pathname, so reloading the rebuilt file at the
+        # same path would return the stale handle — load it through a
+        # uniquely-named temporary copy instead
+        import shutil
+        import tempfile
+
         try:
             subprocess.run(["make", "-C", _DIR, "-s", "-B"], check=True,
                            capture_output=True, timeout=120)
-            return _bind(ctypes.CDLL(_LIB_PATH))
+            with tempfile.NamedTemporaryFile(
+                    suffix=".so", delete=False) as tf:
+                shutil.copyfile(_LIB_PATH, tf.name)
+            return _bind(ctypes.CDLL(tf.name))
         except (OSError, AttributeError, subprocess.SubprocessError):
             return None
 
