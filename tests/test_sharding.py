@@ -189,3 +189,26 @@ def test_restart_chunking_composes_with_mesh(low_rank_data, mesh):
                                np.asarray(ref.consensus), atol=1e-6)
     np.testing.assert_array_equal(np.asarray(got.iterations),
                                   np.asarray(ref.iterations))
+
+
+def test_place_input_tiles_grid_axes():
+    """place_input must tile A over the feature/sample axes (never
+    materializing full A per device on a grid mesh), replicate on a
+    restart-only mesh, and be idempotent."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from nmfx.sweep import FEATURE_AXIS, SAMPLE_AXIS, place_input
+
+    a = np.arange(16 * 12, dtype=np.float32).reshape(16, 12)
+    cfg = SolverConfig()
+    gm = grid_mesh(2, 2, 2)
+    placed = place_input(a, cfg, gm)
+    want = NamedSharding(gm, P(FEATURE_AXIS, SAMPLE_AXIS))
+    assert placed.sharding.is_equivalent_to(want, 2)
+    again = place_input(placed, cfg, gm)
+    assert again.sharding.is_equivalent_to(want, 2)
+    np.testing.assert_array_equal(np.asarray(again), a)
+
+    rm = Mesh(np.array(jax.devices()), (RESTART_AXIS,))
+    rep = place_input(a, cfg, rm)
+    assert rep.sharding.is_equivalent_to(NamedSharding(rm, P()), 2)

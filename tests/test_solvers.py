@@ -186,3 +186,28 @@ def test_solver_shapes_fuzz(shape, k):
         assert np.isfinite(np.asarray(res.w)).all(), algo
         assert np.isfinite(np.asarray(res.h)).all(), algo
         assert bool(jnp.all(res.w >= 0) & jnp.all(res.h >= 0)), algo
+
+
+def test_base_helpers_units():
+    """Direct pins on the shared convergence helpers (reference
+    calculateMaxchange / the class-label rule)."""
+    from nmfx.solvers.base import class_labels, maxchange, solve_gram_reg
+
+    m0 = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    m1 = jnp.asarray([[1.0, 2.5], [3.0, 4.0]])
+    # max|Δ| / (sqrt(eps) + max|prev|) — non-destructive, exact value
+    expect = 0.5 / (np.sqrt(np.finfo(np.float32).eps) + 4.0)
+    np.testing.assert_allclose(float(maxchange(m1, m0)), expect, rtol=1e-6)
+
+    h = jnp.asarray([[0.1, 0.9, 0.5], [0.8, 0.2, 0.5]])
+    np.testing.assert_array_equal(np.asarray(class_labels(h)), [1, 0, 0])
+
+    # jittered Cholesky solve: healthy system matches plain solve
+    rng = np.random.default_rng(0)
+    g = rng.uniform(0.5, 1.0, (3, 3))
+    gram = jnp.asarray(g @ g.T + 3 * np.eye(3), jnp.float32)
+    rhs = jnp.asarray(rng.uniform(size=(3, 4)), jnp.float32)
+    np.testing.assert_allclose(np.asarray(solve_gram_reg(gram, rhs)),
+                               np.linalg.solve(np.asarray(gram),
+                                               np.asarray(rhs)),
+                               rtol=1e-4)
