@@ -3,8 +3,9 @@
 The public surface a user of the reference lands on:
 
 * ``nmf(...)``          ≈ one ``doNMF`` call (reference ``nmf.r:23-51``),
-  with all six solvers wired instead of only mu (the reference's five
-  plus the BROAD original's Brunet ``kl`` rule).
+  with all seven solvers wired instead of only mu (the reference's
+  five plus the BROAD original's Brunet ``kl`` rule and Kim & Park
+  ``snmf``).
 * ``nmfconsensus(...)`` ≈ ``runNMFinJobs`` + ``computeConsensusAndSaveFiles``
   (reference ``nmf.r:106-119, 146-253``): the (k × restart) sweep, consensus
   matrices, cophenetic rank selection, memberships, and optional file/plot

@@ -1,9 +1,9 @@
-"""NMF solver family — six update rules sharing one while_loop driver.
+"""NMF solver family — seven update rules sharing one while_loop driver.
 
 TPU-native re-designs of the reference's five C solvers
 (reference ``libnmf/nmf_{mu,als,neals,pg,alspg}.c``) plus the BROAD
 original's Brunet divergence rule (``kl``) and Kim & Park sparse NMF
-(``snmf``): each solver is a pure ``step``
+(``snmf``): seven in all, each a pure ``step``
 function over arrays, jit-compiled into a ``lax.while_loop`` and vmappable
 over the restart axis.
 """
