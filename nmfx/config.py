@@ -189,7 +189,9 @@ class ConsensusConfig:
     linkage: str = "average"
 
     def __post_init__(self):
-        ks = tuple(int(k) for k in self.ks)
+        # dedupe preserving order: a duplicated rank would be solved twice
+        # and reported twice for an identical result (same (seed, k) keys)
+        ks = tuple(dict.fromkeys(int(k) for k in self.ks))
         object.__setattr__(self, "ks", ks)
         if any(k < 2 for k in ks):
             # reference guard: "Need at least two clusters" (nmf.r:107-108)

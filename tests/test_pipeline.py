@@ -271,3 +271,10 @@ def test_save_results_with_plots(two_group_result, tmp_path):
         assert os.path.getsize(p) > 20, p
     for p in pdfs:
         assert os.path.getsize(p) > 1000, p
+
+
+def test_duplicate_ks_deduped(two_group_data):
+    res = nmfconsensus(two_group_data, ks=(2, 2, 3, 2), restarts=3,
+                       max_iter=100, use_mesh=False)
+    assert res.ks == (2, 3)
+    assert len(res.summary().splitlines()) == 4  # header + 2 ranks + best
