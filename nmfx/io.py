@@ -58,7 +58,7 @@ def read_gct(path: str) -> Dataset:
         if len(dims) < 2:
             raise ValueError(f"{path}: malformed GCT dimension line")
         n_rows, n_cols = int(dims[0]), int(dims[1])
-        header = f.readline().decode().rstrip("\n").split("\t")
+        header = f.readline().decode().rstrip("\r\n").split("\t")
         col_names = [c for c in header[2:] if c != ""]
         # bulk-parse the numeric block: native C++ from_chars when the host
         # library is built (nmfx/native/gct_io.cpp), else numpy's tokenizer

@@ -108,3 +108,18 @@ def test_write_gct_descriptions_validated(tmp_path):
         write_gct(np.ones((3, 2)), str(tmp_path / "x.gct"),
                   row_names=list("abc"), col_names=list("xy"),
                   descriptions=["only-one"])
+
+
+def test_gct_crlf_line_endings(tmp_path, io_backend):
+    """Windows line endings: values, row names, AND column names parse
+    clean (no stray carriage returns)."""
+    p = str(tmp_path / "crlf.gct")
+    with open(p, "wb") as f:
+        f.write(b"#1.2\r\n2\t3\r\nName\tDescription\ts1\ts2\ts3\r\n")
+        f.write(b"g1\td\t1.5\t2\t3\r\n")
+        f.write(b"g2\td\t4\t5\t6.25\r\n")
+    ds = read_gct(p)
+    np.testing.assert_array_equal(ds.values, [[1.5, 2.0, 3.0],
+                                              [4.0, 5.0, 6.25]])
+    assert ds.row_names == ["g1", "g2"]
+    assert ds.col_names == ["s1", "s2", "s3"]
