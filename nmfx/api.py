@@ -71,8 +71,15 @@ class ConsensusResult:
 
     @property
     def best_k(self) -> int:
-        """Rank with the highest cophenetic correlation."""
-        return self.ks[int(np.argmax(self.rhos))]
+        """Rank with the highest cophenetic correlation; exact rho ties
+        (common on clean designs, where several ranks hit 1.0 after the
+        reference's signif-4 rounding) break toward the higher dispersion —
+        the crisper consensus. The reference computes no best_k (it writes
+        the table for the user to eyeball), so the tie-break is free to be
+        the sensible one."""
+        return max(self.ks,
+                   key=lambda k: (self.per_k[k].rho,
+                                  self.per_k[k].dispersion))
 
     def summary(self) -> str:
         lines = ["k\trho\tdispersion\tmean_iters"]

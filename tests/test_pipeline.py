@@ -278,3 +278,24 @@ def test_duplicate_ks_deduped(two_group_data):
                        max_iter=100, use_mesh=False)
     assert res.ks == (2, 3)
     assert len(res.summary().splitlines()) == 4  # header + 2 ranks + best
+
+
+def test_best_k_breaks_rho_ties_by_dispersion():
+    """Exact rho ties (clean designs reach 1.0 at several ranks after
+    signif-4 rounding) resolve toward the crisper consensus."""
+    from nmfx.api import ConsensusResult, KResult
+
+    def kres(k, rho, disp):
+        n = 4
+        return KResult(k=k, consensus=np.eye(n), rho=rho, dispersion=disp,
+                       membership=np.ones(n, np.int64),
+                       order=np.arange(n), iterations=np.ones(2, np.int32),
+                       dnorms=np.ones(2), stop_reasons=np.ones(2, np.int32),
+                       best_w=np.ones((5, k)), best_h=np.ones((k, n)))
+
+    res = ConsensusResult(ks=(2, 3, 4),
+                          per_k={2: kres(2, 1.0, 0.56),
+                                 3: kres(3, 1.0, 1.0),
+                                 4: kres(4, 0.99, 1.0)},
+                          col_names=("a", "b", "c", "d"))
+    assert res.best_k == 3  # rho tie 2-vs-3 -> higher dispersion wins
