@@ -24,7 +24,9 @@ def labels_from_h(h: jax.Array, rule: str = "argmax") -> jax.Array:
     """
     if rule == "argmax":
         return jnp.argmax(h, axis=0).astype(jnp.int32)
-    return jnp.argmin(h, axis=0).astype(jnp.int32)
+    if rule == "argmin":
+        return jnp.argmin(h, axis=0).astype(jnp.int32)
+    raise ValueError(f"rule must be 'argmax' or 'argmin', got {rule!r}")
 
 
 def connectivity(labels: jax.Array) -> jax.Array:
