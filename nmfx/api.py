@@ -380,6 +380,17 @@ def save_results(result: ConsensusResult, out: OutputConfig) -> list[str]:
             f.write(f"{k}\t{result.per_k[k].rho}\n")
     written.append(path)
 
+    # richer companion table (cophenetic.txt keeps the reference's exact
+    # two-column format, nmf.r:251-252)
+    path = f"{prefix}rank_metrics.txt"
+    with open(path, "wt") as f:
+        f.write("k\trho\tdispersion\tmean_iters\tmean_dnorm\n")
+        for k in result.ks:
+            r = result.per_k[k]
+            f.write(f"{k}\t{r.rho}\t{r.dispersion:.6f}"
+                    f"\t{r.iterations.mean():.1f}\t{r.dnorms.mean():.6g}\n")
+    written.append(path)
+
     if out.write_plots:
         try:
             from nmfx import plots

@@ -79,6 +79,11 @@ def test_save_results(two_group_result, tmp_path):
         assert os.path.exists(path), path
     assert any(p.endswith("cophenetic.txt") for p in written)
     assert any(p.endswith("membership.gct") for p in written)
+    metrics = [p for p in written if p.endswith("rank_metrics.txt")][0]
+    lines = open(metrics).read().splitlines()
+    assert lines[0].split("\t") == ["k", "rho", "dispersion", "mean_iters",
+                                    "mean_dnorm"]
+    assert len(lines) == 1 + len(two_group_result.ks)
     meta = [p for p in written if p.endswith("metagenes.k.2.gct")]
     assert meta
     from nmfx.io import read_gct
