@@ -340,8 +340,9 @@ def nmfconsensus(
 
 def save_results(result: ConsensusResult, out: OutputConfig) -> list[str]:
     """Write the reference's output set (nmf.r:195-252) under a configurable
-    directory: per-k ordered membership GCTs, the all-k membership matrix,
-    `cophenetic.txt`, per-k consensus-matrix GCTs, and (optionally) plots."""
+    directory — per-k ordered membership GCTs, the all-k membership matrix,
+    `cophenetic.txt`, per-k consensus-matrix GCTs, optional plots — plus
+    per-k metagene GCTs and the `rank_metrics.txt` companion table."""
     os.makedirs(out.directory, exist_ok=True)
     doc = out.doc_string
     prefix = os.path.join(out.directory, f"{doc}." if doc else "")
