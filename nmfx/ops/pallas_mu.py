@@ -34,6 +34,13 @@ per-restart iteration counts come out identical to the packed backend on
 the real chip (and the CPU interpret-mode tests match tightly because
 interpret executes XLA's own arithmetic).
 
+VMEM budget: the H kernel holds the (R·k, n) numerator and (R·k, R·k)
+Gram accumulators plus three streamed blocks resident, ≈
+(rk² + 2·rk·n + 2·block_m·(n + rk))·4 bytes — ~6 MB at the north-star
+shapes (rk = n = 500, block_m = 512), comfortably inside a core's ~16 MB
+VMEM. Much larger R·k or n overflows VMEM and Mosaic rejects the kernel
+at compile time; use ``backend="packed"`` there (XLA tiles through HBM).
+
 Reference math: the six dgemms + elementwise updates of
 ``libnmf/nmf_mu.c:174-216``, restructured for MXU/VMEM rather than
 translated (SURVEY.md §7). Shapes must be pre-padded by the caller:
