@@ -159,7 +159,8 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
           check: bool, use_pallas: bool = False, block_m: int = 512,
           interpret: bool = False,
           feature_axis: str | None = None,
-          sample_axis: str | None = None) -> PackedState:
+          sample_axis: str | None = None,
+          n_total: int | None = None) -> PackedState:
     m, n = a.shape
     k = state.hp.shape[0] // r
     wp0, hp0 = state.wp, state.hp
@@ -236,12 +237,13 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
                            iteration=it)
     if not check:
         return state
-    return _check(state, cfg, r, feature_axis, sample_axis)
+    return _check(state, cfg, r, feature_axis, sample_axis, n_total)
 
 
 def _check(state: PackedState, cfg: SolverConfig, r: int,
            feature_axis: str | None = None,
-           sample_axis: str | None = None) -> PackedState:
+           sample_axis: str | None = None,
+           n_total: int | None = None) -> PackedState:
     """Per-restart convergence tests, mirroring base.check_convergence for
     the mu solver (class stability first, then TolX) with (R,)-shaped
     bookkeeping instead of vmapped scalars."""
@@ -255,18 +257,35 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
     classes, stable = state.classes, state.stable
 
     if cfg.use_class_stop:
+        # noise-tolerant snapshot rule (see base.check_convergence and
+        # SolverConfig.class_flip_tol): mismatches are counted against a held
+        # reference labeling that only updates on reset, so bounded label
+        # oscillation passes while genuine drift accumulates and resets.
+        # flip_tol=0 is bit-identical to the reference's consecutive-check
+        # rule (nmf_mu.c:253-282).
         new_classes = _labels(state.hp, r)
-        if sample_axis is None:
-            same = jnp.all(new_classes == state.classes, axis=1)  # (R,)
+        if sample_axis is not None:
+            if n_total is None:
+                raise ValueError(
+                    "class-stability check with sample_axis needs n_total "
+                    "(the unsharded column count); the local shard width "
+                    "would make the flip tolerance ~#shards too strict")
+            n_glob = n_total
         else:
-            # labels are column shards: "all columns unchanged" is a global
-            # AND — count local mismatches, psum, compare to zero
-            mism = jnp.sum((new_classes != state.classes).astype(jnp.int32),
-                           axis=1)
-            same = lax.psum(mism, sample_axis) == 0
+            n_glob = state.hp.shape[1]
+        # +eps before flooring: 0.3 * 10 is 2.999... in binary float and
+        # int() would land one flip below the documented floor(tol * n)
+        flip_tol = int(cfg.class_flip_tol * n_glob + 1e-9)
+        mism = jnp.sum((new_classes != state.classes).astype(jnp.int32),
+                       axis=1)  # (R,)
+        if sample_axis is not None:
+            # labels are column shards: the mismatch count is a global sum
+            mism = lax.psum(mism, sample_axis)
+        same = mism <= flip_tol
         stable = jnp.where(active, jnp.where(same, state.stable + 1, 0),
                            state.stable)
-        classes = jnp.where(active[:, None], new_classes, state.classes)
+        reset = active & ~same
+        classes = jnp.where(reset[:, None], new_classes, state.classes)
         hit = active & (stable >= cfg.stable_checks)
         done = done | hit
         reason = jnp.where(hit, base.StopReason.CLASS_STABLE, reason)
@@ -409,7 +428,8 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
             a_loop = a.astype(jnp.bfloat16)
         step = partial(_step, a_loop, bd, use_pallas=use_pallas,
                        block_m=block_m, interpret=interpret,
-                       feature_axis=feature_axis, sample_axis=sample_axis)
+                       feature_axis=feature_axis, sample_axis=sample_axis,
+                       n_total=n_total)
 
         def cond(s: PackedState):
             return jnp.any(~s.done) & (s.iteration + cfg.check_every

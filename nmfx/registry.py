@@ -26,27 +26,22 @@ import os
 import numpy as np
 
 _META_NAME = "registry.json"
-_FORMAT_VERSION = 2
+#: v3: fingerprint hashes ALL config field values (not just non-default
+#: ones), so changing a field's default invalidates pre-change registries
+_FORMAT_VERSION = 3
 
 
-def _nondefault_fields(cfg) -> dict:
-    """Config fields that differ from their dataclass defaults.
+def _all_fields(cfg) -> dict:
+    """Every config field by value — including default-valued ones.
 
-    Hashing only non-default fields makes the fingerprint forward-
-    compatible: adding a new config field (with a default) to a future nmfx
-    does not invalidate registries written before the field existed, since
-    neither hash contains the key."""
-    out = {}
-    for f in dataclasses.fields(cfg):
-        v = getattr(cfg, f.name)
-        if f.default is not dataclasses.MISSING:
-            if v == f.default:
-                continue
-        elif (f.default_factory is not dataclasses.MISSING
-              and v == f.default_factory()):
-            continue
-        out[f.name] = v
-    return out
+    An earlier scheme hashed only non-default fields for forward
+    compatibility (old registries survive new fields), but that lets a
+    release that *changes a default value* silently match registries
+    computed under the old default and resume stale numbers. Hashing all
+    values is the conservative choice: a default change (or a new field)
+    invalidates old registries, which then recompute — correctness over
+    cache retention."""
+    return dataclasses.asdict(cfg)
 
 
 def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
@@ -68,14 +63,14 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     h.update(str(arr.shape).encode())
     h.update(str(arr.dtype).encode())
     h.update(arr.tobytes())
-    solver = _nondefault_fields(solver_cfg)
+    solver = _all_fields(solver_cfg)
     solver.pop("restart_chunk", None)
     resolved = ("pallas" if solver_cfg.backend == "pallas"
                 else "packed" if _use_packed(solver_cfg) else "vmap")
     solver["backend"] = resolved
     payload = {
         "solver": solver,
-        "init": _nondefault_fields(init_cfg),
+        "init": _all_fields(init_cfg),
         "restarts": restarts,
         "seed": seed,
         "label_rule": label_rule,

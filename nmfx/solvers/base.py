@@ -154,11 +154,23 @@ def check_convergence(
     classes = state.classes
     stable = state.stable
     if use_class:
+        # noise-tolerant snapshot rule: count label mismatches against a held
+        # reference labeling (state.classes); within tolerance -> counter up,
+        # snapshot kept; beyond -> counter reset, snapshot := current labels.
+        # At flip_tol=0 this is exactly the reference's consecutive-check
+        # rule (nmf_mu.c:253-282): after every check the snapshot equals the
+        # current labels (either reset to them, or unchanged with zero
+        # mismatch, i.e. already equal), so each comparison is against the
+        # previous check. See SolverConfig.class_flip_tol.
         new_classes = class_labels(state.h)
-        same = jnp.all(new_classes == state.classes)
+        # +eps before flooring: 0.3 * 10 is 2.999... in binary float and
+        # int() would land one flip below the documented floor(tol * n)
+        flip_tol = int(cfg.class_flip_tol * new_classes.shape[0] + 1e-9)
+        mism = jnp.sum((new_classes != state.classes).astype(jnp.int32))
+        same = mism <= flip_tol
         stable = jnp.where(is_check, jnp.where(same, state.stable + 1, 0),
                            state.stable)
-        classes = jnp.where(is_check, new_classes, state.classes)
+        classes = jnp.where(is_check & ~same, new_classes, state.classes)
         hit = is_check & (stable >= cfg.stable_checks)
         done = done | hit
         reason = jnp.where(hit, StopReason.CLASS_STABLE, reason)

@@ -1,0 +1,270 @@
+"""Semantics of the noise-tolerant class-stability stop (SolverConfig.
+class_flip_tol): the snapshot rule must (a) reproduce the reference's
+consecutive-check rule exactly at tolerance 0 (reference nmf_mu.c:253-282),
+(b) tolerate bounded label oscillation, and (c) still reset on slow genuine
+drift — the case a naive "allow <= delta flips vs the previous check" rule
+gets wrong (drift of 1 sample/check would count as stable forever).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.config import SolverConfig
+from nmfx.ops import packed_mu as pm
+from nmfx.solvers import base
+
+N, K = 10, 3
+
+
+def _packed_state(labels: np.ndarray, it: int, prev: pm.PackedState | None,
+                  r: int) -> pm.PackedState:
+    """PackedState whose hp one-hot encodes `labels` (r, N); bookkeeping
+    carried over from `prev`."""
+    hp = np.zeros((r * K, N), np.float32)
+    for lane in range(r):
+        for j, lab in enumerate(labels[lane]):
+            hp[lane * K + lab, j] = 1.0
+    z = jnp.zeros((r,), jnp.int32)
+    return pm.PackedState(
+        wp=jnp.zeros((4, r * K)), hp=jnp.asarray(hp),
+        wp_prev=jnp.zeros((4, r * K)), hp_prev=jnp.asarray(hp),
+        iteration=jnp.asarray(it, jnp.int32),
+        classes=(prev.classes if prev is not None
+                 else jnp.full((r, N), -1, jnp.int32)),
+        stable=prev.stable if prev is not None else z,
+        done=prev.done if prev is not None else jnp.zeros((r,), bool),
+        done_iter=prev.done_iter if prev is not None else z,
+        stop_reason=prev.stop_reason if prev is not None else z)
+
+
+def drive(label_frames, cfg: SolverConfig) -> np.ndarray:
+    """Feed a sequence of (r, N) label frames through _check (one frame per
+    check, iteration = 2, 4, 6, ...); return per-lane fire check index (the
+    1-based frame at which done flipped) or -1."""
+    r = label_frames[0].shape[0]
+    state = None
+    fired = np.full((r,), -1)
+    for i, frame in enumerate(label_frames):
+        state = _packed_state(np.asarray(frame), 2 * (i + 1), state, r)
+        state = pm._check(state, cfg, r)
+        newly = np.asarray(state.done) & (fired < 0)
+        fired[newly] = i + 1
+    return fired
+
+
+def frames_oscillate(n_frames):
+    """One boundary sample (column 0) alternates labels every check; the
+    rest are fixed."""
+    out = []
+    for i in range(n_frames):
+        lab = np.array([0, 0, 0, 1, 1, 1, 2, 2, 2, 2])
+        lab[0] = i % 2
+        out.append(lab[None, :])
+    return out
+
+
+def frames_drift(n_frames):
+    """One additional sample migrates to label 2 every check — slow genuine
+    drift at exactly 1 flip/check."""
+    out = []
+    for i in range(n_frames):
+        lab = np.array([0, 0, 0, 1, 1, 1, 2, 2, 2, 2])
+        lab[:min(i, 6)] = 2
+        out.append(lab[None, :])
+    return out
+
+
+def test_strict_matches_consecutive_rule():
+    """tol=0: stable frames fire after exactly stable_checks checks; a
+    single flip anywhere resets the counter."""
+    cfg = SolverConfig(stable_checks=5, check_every=2, class_flip_tol=0.0,
+                       use_tol_checks=False)
+    const = np.tile(np.array([0, 0, 0, 1, 1, 1, 2, 2, 2, 2]), (1, 1))
+    # frame 1 resets the initial -1 snapshot; stable hits 5 at frame 6
+    assert drive([const] * 8, cfg)[0] == 6
+    # a flip at frame 3 resets twice (entering and leaving the flipped
+    # state — frames 3 and 4 each differ from their predecessor), exactly
+    # like the reference's consecutive-check rule: fire at 4 + 5
+    frames = [const] * 10
+    flipped = const.copy()
+    flipped[0, 0] = 1
+    frames[2] = flipped
+    assert drive(frames, cfg)[0] == 9
+
+
+def test_strict_never_fires_under_oscillation():
+    cfg = SolverConfig(stable_checks=5, check_every=2, class_flip_tol=0.0,
+                       use_tol_checks=False)
+    assert drive(frames_oscillate(40), cfg)[0] == -1
+
+
+def test_tolerant_fires_under_bounded_oscillation():
+    # floor(0.2 * 10) = 2 tolerated flips
+    cfg = SolverConfig(stable_checks=5, check_every=2, class_flip_tol=0.2,
+                       use_tol_checks=False)
+    # first frame resets the -1 snapshot; fire 5 checks later
+    assert drive(frames_oscillate(40), cfg)[0] == 6
+
+
+def test_tolerant_resets_on_genuine_drift():
+    """1 flip/check cumulative drift must NOT count as stable even though
+    each check is within tolerance of the *previous* one: mismatch vs the
+    held snapshot accumulates past floor(0.2*10)=2 and resets."""
+    cfg = SolverConfig(stable_checks=5, check_every=2, class_flip_tol=0.2,
+                       use_tol_checks=False)
+    fired = drive(frames_drift(7), cfg)
+    assert fired[0] == -1
+
+
+def test_tolerant_fires_after_drift_settles():
+    cfg = SolverConfig(stable_checks=5, check_every=2, class_flip_tol=0.2,
+                       use_tol_checks=False)
+    frames = frames_drift(20)  # drift ends at frame 6, stable afterwards
+    fired = drive(frames, cfg)
+    assert fired[0] > 6  # fired only after the drift settled
+
+
+def test_per_lane_independence():
+    """A stable lane fires while an oscillating lane in the same packed
+    batch does not (strict rule)."""
+    cfg = SolverConfig(stable_checks=5, check_every=2, class_flip_tol=0.0,
+                       use_tol_checks=False)
+    osc = frames_oscillate(12)
+    const = np.array([0, 0, 0, 1, 1, 1, 2, 2, 2, 2])
+    frames = [np.stack([const, o[0]]) for o in osc]
+    fired = drive(frames, cfg)
+    assert fired[0] == 6 and fired[1] == -1
+
+
+def test_base_driver_same_semantics():
+    """The vmapped generic driver's check_convergence implements the same
+    snapshot rule (scalar per restart)."""
+    cfg = SolverConfig(stable_checks=4, check_every=2, class_flip_tol=0.2,
+                       use_tol_checks=False)
+
+    def h_of(lab):
+        h = np.zeros((K, N), np.float32)
+        h[lab, np.arange(N)] = 1.0
+        return jnp.asarray(h)
+
+    state = base.init_state(jnp.zeros((4, N)), jnp.zeros((4, K)),
+                            h_of(np.zeros(N, int)), aux=None)
+    fired_at = -1
+    for i, frame in enumerate(frames_oscillate(30)):
+        state = state._replace(h=h_of(frame[0]),
+                               iteration=jnp.asarray(2 * (i + 1), jnp.int32))
+        state = base.check_convergence(state, cfg, use_class=True)
+        if bool(state.done) and fired_at < 0:
+            fired_at = i + 1
+    assert fired_at == 5  # snapshot set at frame 1, 4 stable checks after
+
+    # strict never fires on the same sequence
+    cfg0 = SolverConfig(stable_checks=4, check_every=2, class_flip_tol=0.0,
+                        use_tol_checks=False)
+    state = base.init_state(jnp.zeros((4, N)), jnp.zeros((4, K)),
+                            h_of(np.zeros(N, int)), aux=None)
+    for i, frame in enumerate(frames_oscillate(30)):
+        state = state._replace(h=h_of(frame[0]),
+                               iteration=jnp.asarray(2 * (i + 1), jnp.int32))
+        state = base.check_convergence(state, cfg0, use_class=True)
+    assert not bool(state.done)
+
+
+def test_flip_tol_validation():
+    with pytest.raises(ValueError):
+        SolverConfig(class_flip_tol=1.0)
+    with pytest.raises(ValueError):
+        SolverConfig(class_flip_tol=-0.1)
+
+
+def test_flip_tol_floor_float_rounding():
+    """int(0.3 * 10) is 2 in binary float; the documented floor(tol*n) is 3.
+    Exactly 3 mismatches at tol=0.3, n=10 must count as stable."""
+    cfg = SolverConfig(stable_checks=3, check_every=2, class_flip_tol=0.3,
+                       use_tol_checks=False)
+    base_lab = np.array([0, 0, 0, 1, 1, 1, 2, 2, 2, 2])
+    osc = base_lab.copy()
+    osc[:3] = (osc[:3] + 1) % K  # 3 mismatches vs base
+    frames = [base_lab[None, :]]
+    frames += [osc[None, :] if i % 2 else base_lab[None, :]
+               for i in range(8)]
+    assert drive(frames, cfg)[0] > 0
+
+
+def test_sharded_check_counts_global_mismatches():
+    """Under shard_map with a sample axis, the mismatch count must be the
+    global psum and the tolerance computed from the global n. The case is
+    crafted so each shard's local count is within tolerance while the global
+    sum exceeds it — a bug comparing local counts would pass."""
+    import jax
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n_glob = 16
+    r = 1
+    devices = jax.devices()[:2]
+    mesh = Mesh(np.array(devices), ("s",))
+    # flip_tol = floor(0.15 * 16) = 2: 2 mismatches per shard -> global 4 > 2
+    # must reset; a local-count bug would see 2 <= 2 on every shard and fire
+    cfg = SolverConfig(stable_checks=3, check_every=2, class_flip_tol=0.15,
+                       use_tol_checks=False)
+
+    snap = np.zeros((r, n_glob), np.int32)
+    cur = snap.copy()
+    cur[0, [0, 1, 8, 9]] = 1  # 2 mismatches on each 8-column shard
+
+    def one_hot_hp(labels):  # (r, n) -> (r*K, n)
+        hp = np.zeros((r * K, labels.shape[1]), np.float32)
+        for lane in range(r):
+            for j, lab in enumerate(labels[lane]):
+                hp[lane * K + lab, j] = 1.0
+        return hp
+
+    hp = jnp.asarray(one_hot_hp(cur))
+    snap_j = jnp.asarray(snap)
+
+    def body(hp_loc, snap_loc):
+        st = pm.PackedState(
+            wp=jnp.zeros((4, r * K)), hp=hp_loc,
+            wp_prev=jnp.zeros((4, r * K)), hp_prev=hp_loc,
+            iteration=jnp.asarray(4, jnp.int32),
+            classes=snap_loc,
+            stable=jnp.full((r,), 2, jnp.int32),  # one good check from firing
+            done=jnp.zeros((r,), bool),
+            done_iter=jnp.zeros((r,), jnp.int32),
+            stop_reason=jnp.zeros((r,), jnp.int32))
+        out = pm._check(st, cfg, r, sample_axis="s", n_total=n_glob)
+        return out.stable, out.done
+
+    stable, done = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(None, "s"), P(None, "s")),
+        out_specs=(P(), P()), check_vma=False))(hp, snap_j)
+    # 4 global mismatches > flip_tol=2: reset, no fire
+    assert int(np.asarray(stable)[0]) == 0
+    assert not bool(np.asarray(done)[0])
+
+    # control: 2 global mismatches (1 per shard) <= 2: counter advances, fires
+    cur2 = snap.copy()
+    cur2[0, [0, 8]] = 1
+    hp2 = jnp.asarray(one_hot_hp(cur2))
+    stable2, done2 = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(None, "s"), P(None, "s")),
+        out_specs=(P(), P()), check_vma=False))(hp2, snap_j)
+    assert int(np.asarray(stable2)[0]) == 3
+    assert bool(np.asarray(done2)[0])
+
+
+def test_check_sharded_requires_n_total():
+    cfg = SolverConfig(use_tol_checks=False)
+    st = pm.PackedState(
+        wp=jnp.zeros((4, K)), hp=jnp.zeros((K, N)),
+        wp_prev=jnp.zeros((4, K)), hp_prev=jnp.zeros((K, N)),
+        iteration=jnp.asarray(4, jnp.int32),
+        classes=jnp.zeros((1, N), jnp.int32),
+        stable=jnp.zeros((1,), jnp.int32),
+        done=jnp.zeros((1,), bool),
+        done_iter=jnp.zeros((1,), jnp.int32),
+        stop_reason=jnp.zeros((1,), jnp.int32))
+    with pytest.raises(ValueError, match="n_total"):
+        pm._check(st, cfg, 1, sample_axis="s")
