@@ -17,8 +17,22 @@ from nmfx.config import (
     SolverConfig,
 )
 from nmfx.io import read_dataset, read_gct, read_res, write_gct
-from nmfx.api import ConsensusResult, nmf, nmfconsensus, run_example
-from nmfx.sweep import default_mesh, feature_mesh, grid_mesh
+from nmfx.api import (
+    ConsensusResult,
+    nmf,
+    nmfconsensus,
+    restart_factors,
+    run_example,
+)
+from nmfx.sweep import (
+    RestartResult,
+    consensus_from_cells,
+    default_mesh,
+    feature_mesh,
+    grid_cells,
+    grid_mesh,
+    reduce_grid,
+)
 
 from nmfx.config import VERSION as __version__
 
@@ -27,13 +41,18 @@ __all__ = [
     "ConsensusResult",
     "InitConfig",
     "OutputConfig",
+    "RestartResult",
     "SolverConfig",
+    "consensus_from_cells",
     "default_mesh",
     "feature_mesh",
+    "grid_cells",
     "grid_mesh",
     "nmf",
     "nmfconsensus",
     "read_dataset",
+    "reduce_grid",
+    "restart_factors",
     "run_example",
     "read_gct",
     "read_res",

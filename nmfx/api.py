@@ -44,12 +44,22 @@ class KResult:
     stop_reasons: np.ndarray  # (restarts,)
     best_w: np.ndarray  # (m, k) factors of the lowest-residual restart
     best_h: np.ndarray  # (k, n) — the "metagenes" (reference H, nmf.r:50)
+    #: every restart's factors — populated only under ``keep_factors=True``
+    #: (the reference registry's per-job retention, nmf.r:50)
+    all_w: np.ndarray | None = None  # (restarts, m, k)
+    all_h: np.ndarray | None = None  # (restarts, k, n)
 
     @property
     def ordered_consensus(self) -> np.ndarray:
         """Consensus matrix reordered by the dendrogram (reference
         ``connect.matrix[HC$order, HC$order]``, nmf.r:174)."""
         return self.consensus[np.ix_(self.order, self.order)]
+
+
+#: KResult fields that may legitimately be absent from a saved result (their
+#: dataclass default is None); every other field missing from a file is
+#: corruption / a version mismatch and must fail fast on load
+_OPTIONAL_KRESULT = frozenset(("all_w", "all_h"))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,7 +112,9 @@ class ConsensusResult:
         for k in self.ks:
             r = self.per_k[k]
             for f in dataclasses.fields(KResult):
-                arrays[f"k{k}_{f.name}"] = np.asarray(getattr(r, f.name))
+                v = getattr(r, f.name)
+                if v is not None:  # optional all_w/all_h: absent = None
+                    arrays[f"k{k}_{f.name}"] = np.asarray(v)
         # write through a handle (savez would append .npz to a bare path,
         # breaking load's path symmetry) into a tmp file, then atomically
         # replace — a crash mid-write never leaves a truncated result
@@ -120,7 +132,11 @@ class ConsensusResult:
             for k in ks:
                 kwargs = {}
                 for f in dataclasses.fields(KResult):
-                    v = z[f"k{k}_{f.name}"]
+                    name = f"k{k}_{f.name}"
+                    if name not in z.files and f.name in _OPTIONAL_KRESULT:
+                        kwargs[f.name] = None  # optional field not retained
+                        continue
+                    v = z[name]  # missing REQUIRED field: fail fast
                     if f.type == "int":
                         v = int(v)
                     elif f.type == "float":
@@ -221,6 +237,46 @@ def nmf(a, k: int, *, seed: int = 0, algorithm: str | None = None,
     return solve(arr, w0, h0, scfg)
 
 
+def restart_factors(a, k: int, restart: int, *, restarts: int,
+                    seed: int = 123, algorithm: str | None = None,
+                    max_iter: int | None = None, init: str | None = None,
+                    solver_cfg: SolverConfig | None = None,
+                    init_cfg: InitConfig | None = None) -> SolverResult:
+    """Recompute one sweep restart's full (W, H, iterations) from its key.
+
+    The sweep derives every restart's PRNG key deterministically —
+    ``fold_in(key(seed), k)`` split over the restart axis — so any single
+    job of a ``nmfconsensus(seed=..., restarts=...)`` run is exactly
+    reproducible in isolation, without the sweep having retained its
+    factors. This is the bounded-memory counterpart to
+    ``keep_factors=True``: the reference keeps every job's ``list(W, H,
+    iter)`` on disk in its BatchJobs registry (nmf.r:50) and hands the full
+    list to ``reduceGridBy`` (nmf.r:72-98); here retention is opt-in and
+    recomputation is the always-available fallback (restarts are
+    seconds-long; a re-solve is cheaper than holding every factor of a
+    large sweep resident).
+
+    Key-chain note: the sweep may split the restart axis to a padded
+    multiple of the device mesh, but ``jax.random.split`` is prefix-stable
+    (split(key, n)[:r] == split(key, r') prefixes agree), so restart r's
+    key — and therefore its factors — is independent of mesh shape and
+    padding. Guarded by tests/test_grid.py.
+    """
+    if not 0 <= restart < restarts:
+        raise ValueError(
+            f"restart index {restart} outside [0, {restarts})")
+    arr, _ = _as_matrix(a)
+    scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg,
+                               init_cfg)
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(scfg.dtype)
+    key = jax.random.fold_in(jax.random.key(seed), k)
+    kk = jax.random.split(key, restarts)[restart]
+    w0, h0 = initialize(kk, jnp.asarray(arr, dtype), k, icfg, dtype)
+    return solve(arr, w0, h0, scfg)
+
+
 def nmfconsensus(
     data,
     ks: Sequence[int] = (2, 3, 4, 5),
@@ -237,6 +293,7 @@ def nmfconsensus(
     mesh=None,
     use_mesh: bool = True,
     rank_selection: str = "host",
+    keep_factors: bool = False,
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
@@ -257,6 +314,11 @@ def nmfconsensus(
     clustering itself on the accelerator (``nmfx/ops/hclust_jax.py``) —
     the consensus matrix still comes to host once, for the returned
     ``KResult``, overlapped with the device clustering.
+
+    ``keep_factors``: retain every restart's (W, H) in each ``KResult``
+    (``all_w``/``all_h``) — the reference registry's per-job retention
+    (nmf.r:50). Off by default; any single restart is also recomputable
+    exactly via :func:`restart_factors`.
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
@@ -277,7 +339,8 @@ def nmfconsensus(
         raise ValueError(
             f"k={max(ks)} exceeds the number of samples ({n_samples})")
     ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
-                           label_rule=label_rule, linkage=linkage)
+                           label_rule=label_rule, linkage=linkage,
+                           keep_factors=keep_factors)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
     if mesh is None and use_mesh:
         mesh = default_mesh()
@@ -287,7 +350,8 @@ def nmfconsensus(
         from nmfx.registry import SweepRegistry
 
         registry = SweepRegistry.open(checkpoint_dir, arr, scfg, icfg,
-                                      restarts, seed, label_rule)
+                                      restarts, seed, label_rule,
+                                      keep_factors)
     if profiler is None:
         from nmfx.profiling import NullProfiler
 
@@ -328,6 +392,8 @@ def nmfconsensus(
             stop_reasons=np.asarray(out.stop_reasons),
             best_w=np.asarray(out.best_w),
             best_h=np.asarray(out.best_h),
+            all_w=None if out.all_w is None else np.asarray(out.all_w),
+            all_h=None if out.all_h is None else np.asarray(out.all_h),
         )
 
     result = ConsensusResult(ks=ccfg.ks, per_k=per_k,

@@ -209,6 +209,13 @@ class ConsensusConfig:
     #: hierarchical clustering linkage for rank selection: "average" (the
     #: reference's hclust method, nmf.r:166), "complete", or "single"
     linkage: str = "average"
+    #: retain every restart's (W, H) in the sweep output (the reference
+    #: registry keeps each job's full result, nmf.r:50) — enables
+    #: ``reduce_grid`` custom reductions and restart-level analyses at the
+    #: cost of holding restarts×(m·k + k·n) extra values. Off by default:
+    #: the recompute-by-key route (``nmfx.restart_factors``) reconstructs
+    #: any single restart exactly without retention
+    keep_factors: bool = False
 
     def __post_init__(self):
         # dedupe preserving order: a duplicated rank would be solved twice

@@ -27,8 +27,10 @@ import numpy as np
 
 _META_NAME = "registry.json"
 #: v3: fingerprint hashes ALL config field values (not just non-default
-#: ones), so changing a field's default invalidates pre-change registries
-_FORMAT_VERSION = 3
+#: ones), so changing a field's default invalidates pre-change registries.
+#: v4: keep_factors joins the payload — a registry written without
+#: per-restart factors must not silently serve a keep_factors sweep
+_FORMAT_VERSION = 4
 
 
 def _all_fields(cfg) -> dict:
@@ -45,7 +47,8 @@ def _all_fields(cfg) -> dict:
 
 
 def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
-                 seed: int, label_rule: str) -> str:
+                 seed: int, label_rule: str,
+                 keep_factors: bool = False) -> str:
     """Hash of every input that affects sweep numerics.
 
     The execution-strategy knob ``backend`` is hashed by its *resolved*
@@ -74,6 +77,7 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
         "restarts": restarts,
         "seed": seed,
         "label_rule": label_rule,
+        "keep_factors": keep_factors,
         "format": _FORMAT_VERSION,
     }
     h.update(json.dumps(payload, sort_keys=True).encode())
@@ -113,9 +117,11 @@ class SweepRegistry:
 
     @classmethod
     def open(cls, directory: str, a, solver_cfg, init_cfg,
-             restarts: int, seed: int, label_rule: str) -> "SweepRegistry":
+             restarts: int, seed: int, label_rule: str,
+             keep_factors: bool = False) -> "SweepRegistry":
         return cls(directory, _fingerprint(a, solver_cfg, init_cfg,
-                                           restarts, seed, label_rule))
+                                           restarts, seed, label_rule,
+                                           keep_factors))
 
     def _path(self, k: int) -> str:
         return os.path.join(self.directory, f"k{k}.npz")
@@ -146,15 +152,23 @@ class SweepRegistry:
         host = jax.device_get(tuple(out))
         with open(tmp, "wb") as f:  # file handle: savez won't append ".npz"
             np.savez(f, **{n: np.asarray(v)
-                           for n, v in zip(out._fields, host)})
+                           for n, v in zip(out._fields, host)
+                           if v is not None})
         os.replace(tmp, path)
 
     def load(self, k: int):
-        """Load one rank's result as a KSweepOutput of host numpy arrays."""
+        """Load one rank's result as a KSweepOutput of host numpy arrays;
+        only the optional factor fields (all_w/all_h of a sweep without
+        keep_factors) may be absent — any other missing field is a
+        version/corruption problem and raises (which try_load's self-heal
+        then turns into a recompute)."""
         from nmfx.sweep import KSweepOutput
 
+        optional = ("all_w", "all_h")
         with np.load(self._path(k)) as z:
-            return KSweepOutput(**{f: z[f] for f in KSweepOutput._fields})
+            return KSweepOutput(**{
+                f: None if f in optional and f not in z.files else z[f]
+                for f in KSweepOutput._fields})
 
     def try_load(self, k: int):
         """``load`` that returns None for a missing OR unreadable rank file

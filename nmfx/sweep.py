@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import time
 from functools import lru_cache, partial
-from typing import NamedTuple
+from typing import Mapping, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +52,11 @@ class KSweepOutput(NamedTuple):
     labels: jax.Array  # (restarts, n)
     best_w: jax.Array  # (m, k) factors of the lowest-residual restart
     best_h: jax.Array  # (k, n)
+    #: every restart's factors, retained only under ``keep_factors=True``
+    #: (the reference's registry keeps each job's full (W, H, iter),
+    #: nmf.r:50; see also restart_factors for the recompute-by-key route)
+    all_w: jax.Array | None = None  # (restarts, m, k) or None
+    all_h: jax.Array | None = None  # (restarts, k, n) or None
 
 
 def _pad_count(restarts: int, mesh: Mesh | None) -> int:
@@ -70,7 +75,8 @@ def _use_packed(solver_cfg: SolverConfig) -> bool:
 
 @lru_cache(maxsize=64)
 def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
-                    init_cfg: InitConfig, label_rule: str, mesh: Mesh | None):
+                    init_cfg: InitConfig, label_rule: str, mesh: Mesh | None,
+                    keep_factors: bool = False):
     grid = (mesh is not None
             and any(ax in mesh.axis_names and mesh.shape[ax] > 1
                     for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
@@ -86,11 +92,20 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                 "feature/sample-axis sharding supports init method "
                 "'random' only (NNDSVD needs the full matrix on every "
                 "device)")
+        if keep_factors:
+            # the point of grid axes is that no device ever holds a full
+            # factor; gathering every restart's W would defeat it. The
+            # recompute-by-key route (api.restart_factors) still works.
+            raise ValueError(
+                "keep_factors is not supported on feature/sample-sharded "
+                "meshes (it would gather every restart's full factors onto "
+                "each device); use nmfx.restart_factors to recompute any "
+                "restart's factors from its key instead")
         return _build_grid_sharded_sweep_fn(
             k, restarts, solver_cfg, init_cfg, label_rule, mesh)
     if _use_packed(solver_cfg):
         return _build_packed_sweep_fn(k, restarts, solver_cfg, init_cfg,
-                                      label_rule, mesh)
+                                      label_rule, mesh, keep_factors)
     padded = _pad_count(restarts, mesh)
     dtype = jnp.dtype(solver_cfg.dtype)
     mesh_size = (mesh.shape[RESTART_AXIS]
@@ -142,10 +157,23 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         labels = labels[:restarts]  # drop padding lanes before the reduction
         cons = consensus_matrix(labels, k)
         best = jnp.argmin(res.dnorm[:restarts])
+        all_w = all_h = None
+        if keep_factors:
+            all_w, all_h = res.w, res.h  # padded; sliced after replication
+            if mesh is not None and RESTART_AXIS in mesh.axis_names:
+                # replicate BEFORE slicing off the padding lanes: slicing
+                # the restart-sharded (padded, m, k) stack to an uneven
+                # prefix and then re-constraining trips XLA's SPMD
+                # partitioner (shape mismatch after partitioning); the
+                # gather-then-slice order is also the natural collective
+                rep = NamedSharding(mesh, P())
+                all_w = lax.with_sharding_constraint(all_w, rep)
+                all_h = lax.with_sharding_constraint(all_h, rep)
+            all_w, all_h = all_w[:restarts], all_h[:restarts]
         out = KSweepOutput(cons, res.iterations[:restarts],
                            res.dnorm[:restarts],
                            res.stop_reason[:restarts], labels,
-                           res.w[best], res.h[best])
+                           res.w[best], res.h[best], all_w, all_h)
         if mesh is not None and RESTART_AXIS in mesh.axis_names:
             # replicate every output across the mesh (XLA all_gathers over
             # ICI/DCN): under multi-process execution this makes each field
@@ -162,7 +190,7 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
 
 def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                            init_cfg: InitConfig, label_rule: str,
-                           mesh: Mesh | None):
+                           mesh: Mesh | None, keep_factors: bool = False):
     """Sweep builder for the restart-packed GEMM path (nmfx.ops.packed_mu).
 
     Without a mesh the whole batch runs as one packed solve. With a mesh the
@@ -205,10 +233,12 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
             best_w, best_h, _ = _best(
                 res, hs, jnp.where(jnp.arange(padded) < restarts, res.dnorm,
                                    jnp.inf), padded)
+            extra = ((unpack_w(res.wp, padded)[:restarts], hs[:restarts])
+                     if keep_factors else (None, None))
             return KSweepOutput(cons, res.iterations[:restarts],
                                 res.dnorm[:restarts],
                                 res.stop_reason[:restarts], labels,
-                                best_w, best_h)
+                                best_w, best_h, *extra)
 
         return jax.jit(impl)
 
@@ -239,9 +269,19 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         bhs = lax.all_gather(bh, RESTART_AXIS)
         bds = lax.all_gather(bd, RESTART_AXIS)
         gbest = jnp.argmin(bds)
+        extra = (None, None)
+        if keep_factors:
+            # every restart's factors, replicated on each device — fine at
+            # restart-mesh scale (factors are small); grid meshes refuse
+            # keep_factors upstream precisely because this gather would
+            # defeat their memory bound
+            extra = (
+                lax.all_gather(unpack_w(res.wp, r_local), RESTART_AXIS,
+                               tiled=True)[:restarts],
+                lax.all_gather(hs, RESTART_AXIS, tiled=True)[:restarts])
         return KSweepOutput(cons, iters_g[:restarts], dnorm_g[:restarts],
                             stop_g[:restarts], labels_g[:restarts],
-                            bws[gbest], bhs[gbest])
+                            bws[gbest], bhs[gbest], *extra)
 
     # check_vma=False: every output IS replicated (psum for the consensus,
     # all_gather + identical replicated epilogues for the rest), but the
@@ -458,10 +498,17 @@ def sweep_one_k(a, key, k: int, restarts: int,
                 solver_cfg: SolverConfig = SolverConfig(),
                 init_cfg: InitConfig = InitConfig(),
                 label_rule: str = "argmax",
-                mesh: Mesh | None = None) -> KSweepOutput:
+                mesh: Mesh | None = None,
+                keep_factors: bool = False) -> KSweepOutput:
     """Run `restarts` independent factorizations at rank k and reduce them to
-    one consensus matrix, entirely on-device."""
-    fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh)
+    one consensus matrix, entirely on-device.
+
+    ``keep_factors=True`` additionally returns every restart's (W, H) in
+    ``all_w``/``all_h`` — the reference registry's per-job retention
+    (nmf.r:50) — enabling restart-level analyses and custom ``reduce_grid``
+    reductions without re-solving."""
+    fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh,
+                         keep_factors)
     return fn(jnp.asarray(a), key)
 
 
@@ -500,10 +547,11 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
                 np.asarray(have)))
         if have:
             if loaded is None:  # registry-less host joining the broadcast
-                loaded = _template(a, k, cfg.restarts, solver_cfg)
+                loaded = _template(a, k, cfg.restarts, solver_cfg,
+                                   cfg.keep_factors)
             if multi:
                 loaded = KSweepOutput(*(
-                    np.asarray(x) for x in
+                    None if x is None else np.asarray(x) for x in
                     multihost_utils.broadcast_one_to_all(tuple(loaded))))
             out[k] = loaded
             continue
@@ -521,7 +569,8 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
         t0 = time.perf_counter()
         with profiler.phase(f"solve.k={k}") as sync:
             out[k] = sync(sweep_one_k(a, key, k, cfg.restarts, solver_cfg,
-                                      init_cfg, cfg.label_rule, mesh))
+                                      init_cfg, cfg.label_rule, mesh,
+                                      cfg.keep_factors))
         if (0 < _log.level <= logging.INFO
                 and (not multi or jax.process_index() == 0)):
             # reading the stats forces a device sync, trading the k-grid's
@@ -566,8 +615,8 @@ def place_input(a, solver_cfg: SolverConfig, mesh: Mesh | None) -> jax.Array:
     return jax.device_put(a, NamedSharding(mesh, spec))
 
 
-def _template(a, k: int, restarts: int,
-              solver_cfg: SolverConfig) -> KSweepOutput:
+def _template(a, k: int, restarts: int, solver_cfg: SolverConfig,
+              keep_factors: bool = False) -> KSweepOutput:
     """Zero-valued KSweepOutput with the exact shapes/dtypes sweep_one_k
     produces — the broadcast skeleton a registry-less host contributes when
     the coordinator resumes a rank from checkpoint (structures must match on
@@ -582,7 +631,89 @@ def _template(a, k: int, restarts: int,
         labels=np.zeros((restarts, n), np.int32),
         best_w=np.zeros((m, k), f),
         best_h=np.zeros((k, n), f),
+        all_w=np.zeros((restarts, m, k), f) if keep_factors else None,
+        all_h=np.zeros((restarts, k, n), f) if keep_factors else None,
     )
+
+
+class RestartResult(NamedTuple):
+    """One grid cell's full result — the reference's per-job
+    ``list(W, H, iter)`` (nmf.r:50), plus the residual and stop reason the
+    reference never surfaces."""
+
+    k: int
+    restart: int
+    w: np.ndarray  # (m, k)
+    h: np.ndarray  # (k, n)
+    iterations: int
+    dnorm: float
+    stop_reason: int
+
+
+def grid_cells(results: Mapping[int, KSweepOutput]) -> list[RestartResult]:
+    """Flatten a ``sweep(..., keep_factors=True)`` output into the (k ×
+    restart) grid of per-job results the reference's registry holds."""
+    cells: list[RestartResult] = []
+    for k in sorted(results):
+        out = results[k]
+        if out.all_w is None or out.all_h is None:
+            raise ValueError(
+                f"per-restart factors for k={k} were not retained; run the "
+                "sweep with keep_factors=True (or recompute a single "
+                "restart with nmfx.restart_factors)")
+        all_w = np.asarray(out.all_w)
+        all_h = np.asarray(out.all_h)
+        iters = np.asarray(out.iterations)
+        dnorms = np.asarray(out.dnorms)
+        stops = np.asarray(out.stop_reasons)
+        for r in range(all_w.shape[0]):
+            cells.append(RestartResult(k, r, all_w[r], all_h[r],
+                                       int(iters[r]), float(dnorms[r]),
+                                       int(stops[r])))
+    return cells
+
+
+def reduce_grid(results: Mapping[int, KSweepOutput], fun=None,
+                by: str = "k") -> dict[int, object]:
+    """Generic axis-grouped reduction over the (k × restart) job grid — the
+    reference's ``reduceGridBy`` (nmf.r:72-98), which groups job results by
+    the kept grid axis and applies ``fun`` to each group's list of per-job
+    results. ``fun=None`` uses the reference's own reduction,
+    :func:`consensus_from_cells` (the default ``fun`` in ``runNMFinJobs``,
+    nmf.r:117).
+
+    ``by="k"``: ``fun`` receives all restarts at one rank (the reference's
+    only actual use, ``by="k"`` with the consensus reduction, nmf.r:117);
+    ``by="restart"``: the transpose grouping — one restart index across all
+    ranks (the reference's ``num.clusterings`` axis). Returns
+    ``{axis_value: fun(cells)}`` sorted by axis value. Host-side by design:
+    this is the flexibility hook for custom analyses; the performance path
+    is the on-device consensus reduction inside ``sweep_one_k``.
+    """
+    if fun is None:
+        fun = consensus_from_cells
+    axes = {"k": 0, "restart": 1}
+    if by not in axes:
+        raise ValueError(f"by must be 'k' or 'restart', got {by!r}")
+    groups: dict[int, list[RestartResult]] = {}
+    for cell in grid_cells(results):
+        groups.setdefault(cell[axes[by]], []).append(cell)
+    return {g: fun(groups[g]) for g in sorted(groups)}
+
+
+def consensus_from_cells(cells: Sequence[RestartResult],
+                         label_rule: str = "argmax") -> np.ndarray:
+    """Host-numpy ``computeConsensusMatrixFromClusterings`` (nmf.r:121-144)
+    over a group of grid cells — the reference's default reduction, used by
+    :func:`reduce_grid` when no ``fun`` is given. The on-device einsum in
+    ``nmfx.consensus`` is the performance path; this one exists so custom
+    grid reductions have the reference reduction to compose with."""
+    if label_rule not in ("argmax", "argmin"):
+        raise ValueError(
+            f"label_rule must be 'argmax' or 'argmin', got {label_rule!r}")
+    pick = np.argmax if label_rule == "argmax" else np.argmin
+    labels = np.stack([pick(c.h, axis=0) for c in cells])  # (R, n)
+    return (labels[:, :, None] == labels[:, None, :]).mean(axis=0)
 
 
 def default_mesh() -> Mesh | None:

@@ -1,0 +1,229 @@
+"""Per-restart factor retention, recompute-by-key, and the generic grid
+reduction — parity with the reference's job registry + ``reduceGridBy``
+(reference ``nmf.r:50, 72-98``): the registry keeps every job's full
+``list(W, H, iter)`` and the reduction groups those results by a grid axis.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from nmfx import (
+    ConsensusConfig,
+    InitConfig,
+    SolverConfig,
+    consensus_from_cells,
+    grid_cells,
+    nmfconsensus,
+    reduce_grid,
+    restart_factors,
+)
+from nmfx.api import ConsensusResult
+from nmfx.sweep import grid_mesh, sweep, sweep_one_k
+
+RESTARTS = 5
+KS = (2, 3)
+
+
+def _cfg(backend):
+    return SolverConfig(algorithm="mu", max_iter=300, backend=backend)
+
+
+def _sweep(a, k, backend, mesh=None, keep=True):
+    key = jax.random.fold_in(jax.random.key(123), k)
+    return sweep_one_k(a, key, k, RESTARTS, _cfg(backend), InitConfig(),
+                       mesh=mesh, keep_factors=keep)
+
+
+def test_split_prefix_stability():
+    """The sweep pads the restart axis to the mesh size; restart r's key
+    must not depend on the padding (restart_factors relies on this)."""
+    key = jax.random.key(42)
+    long = jax.random.split(key, 56)
+    short = jax.random.split(key, 50)
+    np.testing.assert_array_equal(
+        jax.random.key_data(long[:50]), jax.random.key_data(short))
+
+
+@pytest.mark.parametrize("backend", ["vmap", "packed"])
+def test_keep_factors_match_solo_run(two_group_data, backend):
+    """all_w[r]/all_h[r] reproduce a solo nmf() run with restart r's
+    seed-derived key — the VERDICT acceptance test for retention."""
+    out = _sweep(two_group_data, 2, backend)
+    assert out.all_w.shape == (RESTARTS, two_group_data.shape[0], 2)
+    assert out.all_h.shape == (RESTARTS, 2, two_group_data.shape[1])
+    for r in (0, RESTARTS - 1):
+        solo = restart_factors(two_group_data, 2, r, restarts=RESTARTS,
+                               seed=123, solver_cfg=_cfg(backend))
+        np.testing.assert_allclose(np.asarray(out.all_w[r]),
+                                   np.asarray(solo.w), rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out.all_h[r]),
+                                   np.asarray(solo.h), rtol=2e-4, atol=1e-5)
+        assert int(out.iterations[r]) == int(solo.iterations)
+
+
+@pytest.mark.parametrize("backend", ["vmap", "packed"])
+def test_best_factors_are_the_lowest_residual_restart(two_group_data,
+                                                      backend):
+    out = _sweep(two_group_data, 3, backend)
+    best = int(np.argmin(np.asarray(out.dnorms)))
+    np.testing.assert_array_equal(np.asarray(out.best_w),
+                                  np.asarray(out.all_w[best]))
+    np.testing.assert_array_equal(np.asarray(out.best_h),
+                                  np.asarray(out.all_h[best]))
+
+
+@pytest.mark.parametrize("backend", ["vmap", "packed"])
+def test_keep_factors_mesh_invariance(two_group_data, backend):
+    """Retained factors agree with and without a restart mesh: labels and
+    iteration counts exactly, factor values to f32 GEMM-blocking noise (the
+    padded batch width differs between mesh shapes, so XLA tiles the
+    reductions differently — measured max rel diff ~5e-5 over 300 iters)."""
+    ref = _sweep(two_group_data, 2, backend, mesh=None)
+    mesh = grid_mesh(8)
+    got = _sweep(two_group_data, 2, backend, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(ref.labels),
+                                  np.asarray(got.labels))
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_allclose(np.asarray(ref.all_w),
+                               np.asarray(got.all_w), rtol=5e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref.all_h),
+                               np.asarray(got.all_h), rtol=5e-4, atol=1e-5)
+
+
+def test_keep_factors_off_returns_none(two_group_data):
+    out = _sweep(two_group_data, 2, "packed", keep=False)
+    assert out.all_w is None and out.all_h is None
+    with pytest.raises(ValueError, match="keep_factors=True"):
+        grid_cells({2: out})
+
+
+def test_keep_factors_grid_mesh_raises(two_group_data):
+    mesh = grid_mesh(1, feature_shards=2)
+    with pytest.raises(ValueError, match="feature/sample-sharded"):
+        _sweep(two_group_data, 2, "packed", mesh=mesh)
+
+
+def _full_sweep(a, keep=True):
+    ccfg = ConsensusConfig(ks=KS, restarts=RESTARTS, seed=123,
+                           keep_factors=keep)
+    return sweep(a, ccfg, _cfg("packed"), InitConfig())
+
+
+def test_reduce_grid_by_k_reproduces_consensus(two_group_data):
+    """reduce_grid with the reference's own reduction (nmf.r:117) agrees
+    with the on-device einsum consensus."""
+    raw = _full_sweep(two_group_data)
+    host = reduce_grid(raw, consensus_from_cells, by="k")
+    assert sorted(host) == sorted(KS)
+    for k in KS:
+        np.testing.assert_allclose(host[k], np.asarray(raw[k].consensus),
+                                   atol=1e-6)
+
+
+def test_reduce_grid_by_restart(two_group_data):
+    """The transpose grouping: one group per restart index, each holding
+    every rank's cell for that restart (the reference's num.clusterings
+    axis, nmf.r:64-68)."""
+    raw = _full_sweep(two_group_data)
+    got = reduce_grid(raw, lambda cells: [(c.k, c.restart) for c in cells],
+                      by="restart")
+    assert sorted(got) == list(range(RESTARTS))
+    for r in range(RESTARTS):
+        assert got[r] == [(k, r) for k in sorted(KS)]
+
+
+def test_reduce_grid_custom_fun(two_group_data):
+    """A reduction the hardcoded pipeline can't express: per-k mean W
+    across restarts (restart-level stability analysis)."""
+    raw = _full_sweep(two_group_data)
+    mean_w = reduce_grid(
+        raw, lambda cells: np.mean([c.w for c in cells], axis=0), by="k")
+    for k in KS:
+        assert mean_w[k].shape == (two_group_data.shape[0], k)
+        np.testing.assert_allclose(
+            mean_w[k], np.asarray(raw[k].all_w).mean(axis=0), rtol=1e-6)
+
+
+def test_reduce_grid_default_fun_is_reference_reduction(two_group_data):
+    raw = _full_sweep(two_group_data)
+    got = reduce_grid(raw)  # fun defaults to consensus_from_cells
+    want = reduce_grid(raw, consensus_from_cells)
+    for k in KS:
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+def test_result_load_fails_fast_on_missing_required_field(two_group_data,
+                                                          tmp_path):
+    """Only the optional factor fields may be absent from a saved result;
+    a required field missing (version mismatch / corruption) must raise at
+    load, not surface as None deep in later analysis."""
+    res = nmfconsensus(two_group_data, ks=(2,), restarts=2,
+                       solver_cfg=_cfg("packed"))
+    path = str(tmp_path / "res.npz")
+    res.save(path)
+    with np.load(path) as z:
+        arrays = {n: z[n] for n in z.files if n != "k2_consensus"}
+    np.savez(path, **arrays)
+    with pytest.raises(KeyError):
+        ConsensusResult.load(path)
+
+
+def test_reduce_grid_rejects_unknown_axis(two_group_data):
+    raw = _full_sweep(two_group_data)
+    with pytest.raises(ValueError, match="'k' or 'restart'"):
+        reduce_grid(raw, consensus_from_cells, by="job")
+
+
+def test_restart_factors_bounds():
+    with pytest.raises(ValueError, match="outside"):
+        restart_factors(np.ones((4, 4)), 2, 5, restarts=5)
+
+
+def test_nmfconsensus_keep_factors_and_save_roundtrip(two_group_data,
+                                                      tmp_path):
+    res = nmfconsensus(two_group_data, ks=KS, restarts=RESTARTS,
+                       solver_cfg=_cfg("packed"), keep_factors=True)
+    for k in KS:
+        r = res.per_k[k]
+        assert r.all_w.shape == (RESTARTS, two_group_data.shape[0], k)
+        best = int(np.argmin(r.dnorms))
+        np.testing.assert_array_equal(r.best_h, r.all_h[best])
+    path = str(tmp_path / "res.npz")
+    res.save(path)
+    loaded = ConsensusResult.load(path)
+    for k in KS:
+        np.testing.assert_array_equal(loaded.per_k[k].all_w,
+                                      res.per_k[k].all_w)
+
+    # without retention the optional fields stay None through save/load
+    res2 = nmfconsensus(two_group_data, ks=(2,), restarts=2,
+                        solver_cfg=_cfg("packed"))
+    assert res2.per_k[2].all_w is None
+    path2 = str(tmp_path / "res2.npz")
+    res2.save(path2)
+    assert ConsensusResult.load(path2).per_k[2].all_w is None
+
+
+def test_registry_roundtrip_with_factors(two_group_data, tmp_path):
+    """Checkpointed keep_factors sweeps persist and resume the factor
+    arrays; a registry written without factors refuses a keep_factors run
+    (fingerprint mismatch) instead of silently serving factor-less
+    results."""
+    from nmfx.registry import SweepRegistry
+
+    scfg = _cfg("packed")
+    d = str(tmp_path / "reg")
+    reg = SweepRegistry.open(d, two_group_data, scfg, InitConfig(),
+                             RESTARTS, 123, "argmax", keep_factors=True)
+    out = _sweep(two_group_data, 2, "packed")
+    reg.save(2, out)
+    loaded = reg.try_load(2)
+    np.testing.assert_array_equal(loaded.all_w, np.asarray(out.all_w))
+    np.testing.assert_array_equal(loaded.all_h, np.asarray(out.all_h))
+
+    with pytest.raises(ValueError, match="different"):
+        SweepRegistry.open(d, two_group_data, scfg, InitConfig(),
+                           RESTARTS, 123, "argmax", keep_factors=False)
