@@ -133,12 +133,13 @@ def main(argv: list[str] | None = None) -> int:
         if args.no_mesh:
             parser.error("--feature-shards/--sample-shards conflict with "
                          "--no-mesh")
-        if args.algorithm != "mu" or args.backend not in ("auto", "packed"):
+        grid_ok = (args.algorithm == "mu"
+                   and args.backend in ("auto", "packed")) \
+            or args.algorithm == "kl"
+        if not grid_ok:
             parser.error("--feature-shards/--sample-shards require "
-                         "--algorithm mu with --backend auto or packed")
-        if args.init != "random":
-            parser.error("--feature-shards/--sample-shards require "
-                         "--init random")
+                         "--algorithm mu with --backend auto or packed, "
+                         "or --algorithm kl")
         from nmfx.sweep import grid_mesh
 
         try:

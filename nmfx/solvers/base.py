@@ -40,6 +40,22 @@ def matmul_precision_ctx(precision: str):
     return jax.default_matmul_precision(precision)
 
 
+class ShardInfo(NamedTuple):
+    """Mesh-axis names + unsharded dims for a solver running inside
+    ``shard_map`` on a feature/sample-tiled A (the workload's tensor- and
+    sequence-parallel axes, SURVEY.md §5). ``None`` axes are off. Hashable,
+    so it can ride static args. Used by solvers whose per-restart
+    intermediates are O(m·n) and therefore *need* the grid axes at scale —
+    kl's quotient (solvers/kl.py) — with the same psum placement as the
+    packed mu path (ops/packed_mu.py): m-contracted terms reduce over
+    ``feature_axis``, n-contracted terms over ``sample_axis``."""
+
+    feature_axis: str | None = None
+    sample_axis: str | None = None
+    m_total: int | None = None  # unsharded (unpadded) row count
+    n_total: int | None = None  # unsharded (unpadded) column count
+
+
 class StopReason(enum.IntEnum):
     MAX_ITER = 0
     #: per-column argmax of H unchanged for `stable_checks` consecutive checks
@@ -80,22 +96,42 @@ class SolverResult(NamedTuple):
     stop_reason: jax.Array
 
 
-def residual_norm(a: jax.Array, w: jax.Array, h: jax.Array) -> jax.Array:
-    """RMS residual ||A - W H||_F / sqrt(m*n).
+def residual_norm(a: jax.Array, w: jax.Array, h: jax.Array,
+                  shard: ShardInfo | None = None) -> jax.Array:
+    """RMS residual ||A - W H|| / sqrt(m*n).
 
     The reference materializes an m*n scratch D = A - W*H for this
     (calculatenorm.c:44-78); XLA fuses the subtraction into the reduction so
-    no scratch ever hits HBM.
+    no scratch ever hits HBM. Under ``shard`` the local block's square-sum
+    psums over the grid axes (zero-padded rows/columns contribute exact
+    zeros) and the RMS normalizer uses the unsharded dims.
     """
     m, n = a.shape
     d = a - w @ h
-    return jnp.sqrt(jnp.sum(d * d) / (m * n))
+    sq = jnp.sum(d * d)
+    if shard is not None:
+        if shard.feature_axis is not None:
+            sq = lax.psum(sq, shard.feature_axis)
+            m = shard.m_total
+        if shard.sample_axis is not None:
+            sq = lax.psum(sq, shard.sample_axis)
+            n = shard.n_total
+    return jnp.sqrt(sq / (m * n))
 
 
-def maxchange(mat: jax.Array, mat0: jax.Array) -> jax.Array:
-    """max|mat - mat0| / (sqrt(eps) + max|mat0|) (calculatemaxchange.c:42-71)."""
+def maxchange(mat: jax.Array, mat0: jax.Array,
+              axis_name: str | None = None) -> jax.Array:
+    """max|mat - mat0| / (sqrt(eps) + max|mat0|) (calculatemaxchange.c:42-71).
+
+    ``axis_name``: mesh axis the matrix is sharded over — the ratio is of
+    *global* maxima, so both ingredients pmax before dividing."""
     sqrteps = jnp.sqrt(jnp.finfo(mat.dtype).eps)
-    return jnp.max(jnp.abs(mat - mat0)) / (sqrteps + jnp.max(jnp.abs(mat0)))
+    diff = jnp.max(jnp.abs(mat - mat0))
+    ref = jnp.max(jnp.abs(mat0))
+    if axis_name is not None:
+        diff = lax.pmax(diff, axis_name)
+        ref = lax.pmax(ref, axis_name)
+    return diff / (sqrteps + ref)
 
 
 def class_labels(h: jax.Array) -> jax.Array:
@@ -138,6 +174,7 @@ def check_convergence(
     use_class: bool = False,
     use_tolx: bool = False,
     use_tolfun: bool = False,
+    shard: ShardInfo | None = None,
 ) -> State:
     """Apply the generic convergence tests after a step.
 
@@ -145,11 +182,19 @@ def check_convergence(
     (reference: even iterations only, nmf_mu.c:253 / nmf_als.c:338). All
     bookkeeping is branchless (jnp.where on scalars) so it vmaps and keeps the
     while_loop body a single fused XLA computation.
+
+    Under ``shard`` every test reduces to the same *global* decision on each
+    device of a factorization's grid group (label mismatches psum over the
+    sample axis, max-change pmaxes over the axis each factor is sharded on,
+    the residual psums over both), so the batched while_loop stays in
+    lockstep SPMD across the group.
     """
     it = state.iteration
     is_check = (it > 1) & (it % cfg.check_every == 0) & (~state.done)
     done = state.done
     reason = state.stop_reason
+    f_ax = shard.feature_axis if shard is not None else None
+    s_ax = shard.sample_axis if shard is not None else None
 
     classes = state.classes
     stable = state.stable
@@ -163,10 +208,21 @@ def check_convergence(
         # mismatch, i.e. already equal), so each comparison is against the
         # previous check. See SolverConfig.class_flip_tol.
         new_classes = class_labels(state.h)
+        n_glob = new_classes.shape[0]
+        if s_ax is not None:
+            if shard.n_total is None:
+                raise ValueError(
+                    "class-stability check with sample_axis needs n_total "
+                    "(the unsharded column count); the local shard width "
+                    "would make the flip tolerance ~#shards too strict")
+            n_glob = shard.n_total
         # +eps before flooring: 0.3 * 10 is 2.999... in binary float and
         # int() would land one flip below the documented floor(tol * n)
-        flip_tol = int(cfg.class_flip_tol * new_classes.shape[0] + 1e-9)
+        flip_tol = int(cfg.class_flip_tol * n_glob + 1e-9)
         mism = jnp.sum((new_classes != state.classes).astype(jnp.int32))
+        if s_ax is not None:
+            # labels live on column shards: the mismatch count is global
+            mism = lax.psum(mism, s_ax)
         same = mism <= flip_tol
         stable = jnp.where(is_check, jnp.where(same, state.stable + 1, 0),
                            state.stable)
@@ -176,8 +232,10 @@ def check_convergence(
         reason = jnp.where(hit, StopReason.CLASS_STABLE, reason)
 
     if use_tolx and cfg.use_tol_checks:
-        delta = jnp.maximum(maxchange(state.w, state.w_prev),
-                            maxchange(state.h, state.h_prev))
+        # W is row-sharded over the feature axis (replicated over samples),
+        # H column-sharded over the sample axis (replicated over features)
+        delta = jnp.maximum(maxchange(state.w, state.w_prev, f_ax),
+                            maxchange(state.h, state.h_prev, s_ax))
         hit = is_check & (delta < cfg.tol_x) & ~done
         done = done | hit
         reason = jnp.where(hit, StopReason.TOL_X, reason)
@@ -185,7 +243,7 @@ def check_convergence(
     dnorm = state.dnorm
     if use_tolfun and cfg.use_tol_checks:
         assert a is not None
-        new_dnorm = residual_norm(a, state.w, state.h)
+        new_dnorm = residual_norm(a, state.w, state.h, shard)
         # relative decrease vs the residual at the previous check
         hit = (is_check & jnp.isfinite(state.dnorm)
                & (state.dnorm - new_dnorm <= cfg.tol_fun * state.dnorm) & ~done)
@@ -215,7 +273,8 @@ def init_state(a: jax.Array, w0: jax.Array, h0: jax.Array, aux: Any) -> State:
     )
 
 
-def run_loop(a, w0, h0, cfg: SolverConfig, step_fn, aux) -> SolverResult:
+def run_loop(a, w0, h0, cfg: SolverConfig, step_fn, aux,
+             shard: ShardInfo | None = None) -> SolverResult:
     """Drive ``step_fn`` to convergence under jit.
 
     The loop body unrolls ``check_every`` solver steps and only the last one
@@ -223,6 +282,11 @@ def run_loop(a, w0, h0, cfg: SolverConfig, step_fn, aux) -> SolverResult:
     check-every-2nd-iteration scheme structurally, so off-iterations never
     compute a residual that a ``where``/``cond`` would discard (under vmap a
     cond lowers to a select that executes both branches).
+
+    ``shard``: the step_fn is expected to have the same ShardInfo bound (its
+    collectives make every convergence decision identical across a
+    factorization's grid group, keeping this loop lockstep); here it scopes
+    only the final residual.
     """
     state0 = init_state(a, w0, h0, aux)
 
@@ -253,7 +317,7 @@ def run_loop(a, w0, h0, cfg: SolverConfig, step_fn, aux) -> SolverResult:
         w=final.w,
         h=final.h,
         iterations=final.iteration,
-        dnorm=residual_norm(a, final.w, final.h),
+        dnorm=residual_norm(a, final.w, final.h, shard),
         stop_reason=final.stop_reason,
     )
 

@@ -18,13 +18,21 @@ Convergence control reuses the shared driver: the class-stability stop (the
 same consensus-oriented criterion Brunet's script applies to its
 connectivity matrix) plus the optional TolX test. The m×n quotient
 A ⊘ (WH) is materialized per half-step as a GEMM operand — per-restart HBM
-cost is O(mn), so very large (m, n, restarts) sweeps should chunk the
-restart axis.
+cost is O(mn), which makes kl the one solver that *needs* the grid
+(feature/sample) mesh axes at scale: under ``shard`` the quotient is a
+purely local (m_loc × n_loc) block (W row-sharded × H column-sharded gives
+the local reconstruction directly), and each update's contracted term
+psums over the corresponding mesh axis — m-contractions (WᵀQ and W's
+column sums) over the feature axis, n-contractions (QHᵀ and H's row sums)
+over the sample axis — exactly where the packed mu path places its Gram
+psums (ops/packed_mu.py). Without a mesh, ``restart_chunk`` remains the
+fallback memory bound.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from nmfx.config import SolverConfig
 from nmfx.solvers import base
@@ -43,22 +51,34 @@ def kl_divergence(a, w, h, eps: float = 1e-9):
     return jnp.sum(a * logq - a + wh)
 
 
-def step(a, state: base.State, cfg: SolverConfig,
-         check: bool = True) -> base.State:
+def step(a, state: base.State, cfg: SolverConfig, check: bool = True,
+         shard: base.ShardInfo | None = None) -> base.State:
     w0, h0 = state.w, state.h
     eps = cfg.div_eps
-    # H update: quotient against the current reconstruction
+    f_ax = shard.feature_axis if shard is not None else None
+    s_ax = shard.sample_axis if shard is not None else None
+
+    def fsum(x):
+        return lax.psum(x, f_ax) if f_ax is not None else x
+
+    def ssum(x):
+        return lax.psum(x, s_ax) if s_ax is not None else x
+
+    # H update: quotient against the current reconstruction. Under shard the
+    # quotient block is local (row-shard of W × column-shard of H); the two
+    # m-contracted terms psum over the feature axis. Zero-padded rows of
+    # A/W contribute exact zeros to both.
     q = a / (w0 @ h0 + eps)
-    h = h0 * (w0.T @ q) / (jnp.sum(w0, axis=0)[:, None] + eps)
+    h = h0 * fsum(w0.T @ q) / (fsum(jnp.sum(w0, axis=0))[:, None] + eps)
     h = base.clamp(h, cfg.zero_threshold)
     # W update with the fresh H (same fresh-factor ordering as mu.step,
-    # reference nmf_mu.c:198-216)
+    # reference nmf_mu.c:198-216); n-contracted terms psum over samples
     q = a / (w0 @ h + eps)
-    w = w0 * (q @ h.T) / (jnp.sum(h, axis=1)[None, :] + eps)
+    w = w0 * ssum(q @ h.T) / (ssum(jnp.sum(h, axis=1))[None, :] + eps)
     w = base.clamp(w, cfg.zero_threshold)
 
     state = state._replace(w=w, h=h)
     if not check:
         return state
     return base.check_convergence(state, cfg, use_class=cfg.use_class_stop,
-                                  use_tolx=True)
+                                  use_tolx=True, shard=shard)

@@ -81,17 +81,15 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
             and any(ax in mesh.axis_names and mesh.shape[ax] > 1
                     for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
     if grid:
-        if not _use_packed(solver_cfg) or solver_cfg.backend == "pallas":
+        grid_ok = ((_use_packed(solver_cfg)
+                    and solver_cfg.backend != "pallas")
+                   or solver_cfg.algorithm == "kl")
+        if not grid_ok:
             raise ValueError(
                 "feature/sample-axis sharding requires the packed mu "
-                f"backend (algorithm='mu', backend='packed'/'auto'); got "
-                f"algorithm={solver_cfg.algorithm!r}, "
+                "backend (algorithm='mu', backend='packed'/'auto') or "
+                f"algorithm='kl'; got algorithm={solver_cfg.algorithm!r}, "
                 f"backend={solver_cfg.backend!r}")
-        if init_cfg.method != "random":
-            raise ValueError(
-                "feature/sample-axis sharding supports init method "
-                "'random' only (NNDSVD needs the full matrix on every "
-                "device)")
         if keep_factors:
             # the point of grid axes is that no device ever holds a full
             # factor; gathering every restart's W would defeat it. The
@@ -308,19 +306,33 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     axes, optionally composed with the restart axis — up to the full 3-D
     ``restarts×features×samples`` (data × tensor × sequence) mesh.
 
-    SPMD layout: A is tiled over (FEATURE_AXIS, SAMPLE_AXIS); Wp is
-    row-sharded over features (replicated over samples); Hp is
+    SPMD layout: A is tiled over (FEATURE_AXIS, SAMPLE_AXIS); W is
+    row-sharded over features (replicated over samples); H is
     column-sharded over samples (replicated over features). Per iteration
-    the packed solver psums exactly two m-contracted terms of the H update
-    over features and two n-contracted terms of the W update over samples
-    (SUMMA-style — see ``mu_packed``); labels are computed on local columns
-    with the class-stability AND reduced by one tiny psum. The consensus
-    reduction psums over the restart axis as in the 1-D path. W0/H0 are
-    drawn from the canonical per-restart keys and then row/column-sliced,
-    so a given (seed, k, restart) yields the same factorization on any mesh
-    shape (modulo float reduction order).
+    the solver psums its m-contracted terms over features and its
+    n-contracted terms over samples (SUMMA-style): the packed mu path's
+    Gram pairs (see ``mu_packed``), or kl's quotient contractions — the
+    solver whose O(m·n) per-restart intermediate makes these axes a
+    *necessity* at scale (``solvers/kl.py``; its quotient block is purely
+    local under this layout). Labels are computed on local columns with the
+    class-stability AND reduced by one tiny psum. The consensus reduction
+    psums over the restart axis as in the 1-D path.
+
+    Init: random W0/H0 are drawn from the canonical per-restart keys and
+    then row/column-sliced, so a given (seed, k, restart) yields the same
+    factorization on any mesh shape (modulo float reduction order). NNDSVD
+    (deterministic in A, so every restart is identical — as in the
+    reference, generatematrix.c:145) is computed once from the full matrix
+    at the jit level and handed to the shards pre-sliced — the "host-side
+    SVD, broadcast factors" scheme: the transient full factors exist only
+    outside the solver loop, never per restart.
     """
     from nmfx.ops.packed_mu import mu_packed, unpack_w
+    from nmfx.solvers import base
+    from nmfx.solvers import kl as kl_mod
+
+    use_kl = solver_cfg.algorithm == "kl"
+    use_nndsvd = init_cfg.method == "nndsvd"
 
     def axis_size(name):
         return mesh.shape[name] if name in mesh.axis_names else 1
@@ -339,20 +351,23 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
                        (FEATURE_AXIS, has_feature),
                        (SAMPLE_AXIS, has_sample)) if has)
 
-    def shard_body(a_loc: jax.Array, keys: jax.Array,
-                   m_true: int, n_true: int) -> KSweepOutput:
+    def shard_body(a_loc: jax.Array, keys: jax.Array, w0_init: jax.Array,
+                   h0_init: jax.Array, m_true: int,
+                   n_true: int) -> KSweepOutput:
         m_loc, n_loc = a_loc.shape
         m_pad = m_loc * f_shards
         n_pad = n_loc * s_shards
         fidx = lax.axis_index(FEATURE_AXIS) if has_feature else 0
         sidx = lax.axis_index(SAMPLE_AXIS) if has_sample else 0
+        f_ax = FEATURE_AXIS if has_feature else None
+        s_ax = SAMPLE_AXIS if has_sample else None
 
         # full W0/H0 from the canonical per-restart keys (identical draws on
         # every mesh shape), immediately sliced to this shard's row/column
         # blocks so peak transient memory is one restart's m×k + k×n, not
         # r_local times that; rows/columns past the true dims (padding) are
-        # zeroed so they stay exactly zero under the mu update and
-        # contribute nothing to the psummed Grams
+        # zeroed so they stay exactly zero under the multiplicative updates
+        # and contribute nothing to the psummed contractions
         def init_one(kk):
             w0, h0 = random_init(kk, m_true, n_true, k, init_cfg, dtype)
             w0 = jnp.pad(w0, ((0, m_pad - m_true), (0, 0)))
@@ -362,14 +377,67 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
                     lax.dynamic_slice_in_dim(h0, sidx * n_loc, n_loc,
                                              axis=1))
 
-        w0s_loc, h0s_loc = lax.map(init_one, keys)
-        res = mu_packed(a_loc, w0s_loc, h0s_loc, solver_cfg,
-                        varying_axes=vary_axes,
-                        feature_axis=FEATURE_AXIS if has_feature else None,
-                        m_total=m_true,
-                        sample_axis=SAMPLE_AXIS if has_sample else None,
-                        n_total=n_true)
-        hs_loc = res.hp.reshape(r_local, k, -1)
+        if use_nndsvd:
+            # deterministic init, identical for every restart (reference
+            # generatematrix.c:145); already sliced to this shard's blocks
+            # at the jit level, so just broadcast over the restart lanes
+            w0s_loc = jnp.broadcast_to(w0_init,
+                                       (r_local,) + w0_init.shape)
+            h0s_loc = jnp.broadcast_to(h0_init,
+                                       (r_local,) + h0_init.shape)
+        else:
+            w0s_loc, h0s_loc = lax.map(init_one, keys)
+        if use_kl:
+            shard_info = base.ShardInfo(f_ax, s_ax, m_true, n_true)
+            step_fn = partial(kl_mod.step, shard=shard_info)
+
+            def solve_lanes(w0s, h0s):
+                with base.matmul_precision_ctx(solver_cfg.matmul_precision):
+                    return jax.vmap(
+                        lambda w0, h0: base.run_loop(
+                            a_loc, w0, h0, solver_cfg, step_fn,
+                            kl_mod.init_aux(a_loc, w0, h0, solver_cfg),
+                            shard_info))(w0s, h0s)
+
+            # restart_chunk composes with the grid mesh exactly as with the
+            # restart mesh (config.py): it bounds the lanes solved
+            # concurrently PER DEVICE — each lane holds an (m_loc × n_loc)
+            # quotient — with chunks running sequentially via lax.map (in
+            # lockstep across the grid group: every chunk's convergence
+            # decisions are global psums/pmaxes)
+            chunk = solver_cfg.restart_chunk
+            c_loc = (max(1, -(-chunk // n_rshards))
+                     if chunk is not None else None)
+            if c_loc is not None and c_loc < r_local:
+                n_full = r_local // c_loc
+                split_at = n_full * c_loc
+                parts = []
+                if n_full:
+                    full = lax.map(
+                        lambda wh: solve_lanes(*wh),
+                        (w0s_loc[:split_at].reshape(
+                            (n_full, c_loc) + w0s_loc.shape[1:]),
+                         h0s_loc[:split_at].reshape(
+                            (n_full, c_loc) + h0s_loc.shape[1:])))
+                    parts.append(jax.tree.map(
+                        lambda x: x.reshape((split_at,) + x.shape[2:]),
+                        full))
+                if split_at < r_local:
+                    parts.append(solve_lanes(w0s_loc[split_at:],
+                                             h0s_loc[split_at:]))
+                res = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0),
+                                   *parts)
+            else:
+                res = solve_lanes(w0s_loc, h0s_loc)
+            hs_loc = res.h  # (r_local, k, n_loc)
+            w_all_loc = res.w  # (r_local, m_loc, k)
+        else:
+            res = mu_packed(a_loc, w0s_loc, h0s_loc, solver_cfg,
+                            varying_axes=vary_axes,
+                            feature_axis=f_ax, m_total=m_true,
+                            sample_axis=s_ax, n_total=n_true)
+            hs_loc = res.hp.reshape(r_local, k, -1)
+            w_all_loc = unpack_w(res.wp, r_local)
         labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hs_loc)
         if has_sample:
             labels = lax.all_gather(labels, SAMPLE_AXIS, tiled=True,
@@ -401,7 +469,7 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
         # full-size factor matrix
         masked_dnorm = jnp.where(valid, res.dnorm, jnp.inf)
         best = jnp.argmin(masked_dnorm)
-        bw_loc = unpack_w(res.wp, r_local)[best]  # (m_loc, k)
+        bw_loc = w_all_loc[best]  # (m_loc, k)
         bh_loc = hs_loc[best]  # (k, n_loc)
         bd = masked_dnorm[best]
         if has_restart:
@@ -426,20 +494,37 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     a_specs = P(FEATURE_AXIS if has_feature else None,
                 SAMPLE_AXIS if has_sample else None)
     key_specs = P(RESTART_AXIS) if has_restart else P()
+    w0_specs = P(FEATURE_AXIS if has_feature else None, None)
+    h0_specs = P(None, SAMPLE_AXIS if has_sample else None)
 
     def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
         a = jnp.asarray(a, dtype)
         m_true, n_true = a.shape
         m_pad = -(-m_true // f_shards) * f_shards
         n_pad = -(-n_true // s_shards) * s_shards
+        if use_nndsvd:
+            # one deterministic init from the full (unpadded) matrix, then
+            # zero-pad to the shard grid — the factors enter shard_map
+            # already row/column-sharded; XLA inserts whatever resharding
+            # of A the SVD needs, outside the solver loop
+            from nmfx.init import nndsvd_init
+
+            w0f, h0f = nndsvd_init(a, k, dtype=dtype,
+                                   svd_method=init_cfg.svd_method,
+                                   ncv=init_cfg.ncv)
+            w0f = jnp.pad(w0f, ((0, m_pad - m_true), (0, 0)))
+            h0f = jnp.pad(h0f, ((0, 0), (0, n_pad - n_true)))
+        else:  # dummies: shard_map wants a fixed arg structure
+            w0f = jnp.zeros((m_pad, k), dtype)
+            h0f = jnp.zeros((k, n_pad), dtype)
         if (m_pad, n_pad) != (m_true, n_true):
             a = jnp.pad(a, ((0, m_pad - m_true), (0, n_pad - n_true)))
         keys = jax.random.split(key, padded)
         sharded = jax.shard_map(
             partial(shard_body, m_true=m_true, n_true=n_true),
-            mesh=mesh, in_specs=(a_specs, key_specs),
+            mesh=mesh, in_specs=(a_specs, key_specs, w0_specs, h0_specs),
             out_specs=P(), check_vma=False)
-        return sharded(a, keys)
+        return sharded(a, keys, w0f, h0f)
 
     return jax.jit(impl)
 

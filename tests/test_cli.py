@@ -65,10 +65,23 @@ def test_cli_shard_flag_validation(gct_path):
         [gct_path, "--feature-shards", "16", "--no-files"],  # > devices
         [gct_path, "--feature-shards", "2", "--algorithm", "als",
          "--no-files"],
-        [gct_path, "--sample-shards", "2", "--init", "nndsvd", "--no-files"],
     ):
         with pytest.raises(SystemExit):
             main(argv)
+
+
+def test_cli_kl_and_nndsvd_on_grid_shards(gct_path, capsys):
+    """kl and NNDSVD compose with grid shards from the CLI (the library
+    paths behind --feature-shards/--sample-shards for both)."""
+    rc = main([gct_path, "--ks", "2", "--restarts", "4", "--maxiter", "100",
+               "--no-files", "--algorithm", "kl", "--feature-shards", "2",
+               "--sample-shards", "2"])
+    assert rc == 0
+    assert "best k = 2" in capsys.readouterr().out
+    rc = main([gct_path, "--ks", "2", "--restarts", "2", "--maxiter", "100",
+               "--no-files", "--init", "nndsvd", "--feature-shards", "2"])
+    assert rc == 0
+    assert "best k = 2" in capsys.readouterr().out
 
 
 def test_grid_mesh_validation():

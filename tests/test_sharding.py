@@ -127,13 +127,14 @@ def test_feature_sharded_uneven_m(low_rank_data):
 def test_feature_sharding_rejects_unsupported_configs(low_rank_data):
     a, _ = low_rank_data
     mesh = feature_mesh(2, 4)
+    # only solvers with a sharded update exist on grid meshes: packed mu
+    # and kl (als' QR half-steps have no collective formulation here)
     with pytest.raises(ValueError, match="packed mu"):
         sweep_one_k(a, jax.random.key(0), k=2, restarts=4,
                     solver_cfg=SolverConfig(algorithm="als"), mesh=mesh)
-    with pytest.raises(ValueError, match="random"):
+    with pytest.raises(ValueError, match="pallas"):
         sweep_one_k(a, jax.random.key(0), k=2, restarts=4,
-                    solver_cfg=SolverConfig(),
-                    init_cfg=InitConfig(method="nndsvd"), mesh=mesh)
+                    solver_cfg=SolverConfig(backend="pallas"), mesh=mesh)
 
 
 # --- full 3-axis grid: restarts (dp) x features (tp) x samples (sp) --------
@@ -169,6 +170,84 @@ def test_grid_sharded_matches_unsharded(low_rank_data, shape):
                                np.asarray(ref.best_w), rtol=5e-3, atol=5e-4)
     np.testing.assert_allclose(np.asarray(got.best_h),
                                np.asarray(ref.best_h), rtol=5e-3, atol=5e-4)
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 2, 4), (2, 1, 4),
+                                   (1, 1, 8)])
+def test_kl_grid_sharded_matches_unsharded(low_rank_data, shape):
+    """kl on grid meshes — the solver that *needs* feature/sample sharding
+    (its per-restart A/(WH) quotient is O(m·n), solvers/kl.py): every mesh
+    shape must reproduce the unsharded sweep (labels and iteration counts
+    exactly; factors to f32 reduction-order tolerance)."""
+    a, _ = low_rank_data
+    a = a[:53, :21]  # both dims uneven across every shard count used here
+    cfg = SolverConfig(algorithm="kl", max_iter=120)
+    key = jax.random.key(5)
+    ref = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg, mesh=None)
+    got = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg,
+                      mesh=grid_mesh(*shape))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.dnorms),
+                               np.asarray(ref.dnorms), rtol=1e-3)
+    assert got.best_w.shape == (53, 3)
+    assert got.best_h.shape == (3, 21)
+    np.testing.assert_allclose(np.asarray(got.best_w),
+                               np.asarray(ref.best_w), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(got.best_h),
+                               np.asarray(ref.best_h), rtol=5e-3, atol=5e-4)
+
+
+def test_kl_restart_chunk_composes_with_grid_mesh(low_rank_data):
+    """restart_chunk on a grid mesh bounds per-device concurrent kl lanes
+    (each lane holds an (m_loc × n_loc) quotient) and must not change
+    results vs the unchunked grid sweep."""
+    a, _ = low_rank_data
+    key = jax.random.key(4)
+    mesh = grid_mesh(2, 2, 2)
+    base_cfg = dict(algorithm="kl", max_iter=100)
+    ref = sweep_one_k(a, key, k=3, restarts=12,
+                      solver_cfg=SolverConfig(**base_cfg), mesh=mesh)
+    got = sweep_one_k(a, key, k=3, restarts=12,
+                      solver_cfg=SolverConfig(**base_cfg, restart_chunk=4),
+                      mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))
+    np.testing.assert_allclose(np.asarray(got.consensus),
+                               np.asarray(ref.consensus), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.dnorms),
+                               np.asarray(ref.dnorms), rtol=1e-4)
+
+
+@pytest.mark.parametrize("algorithm", ["mu", "kl"])
+def test_nndsvd_on_grid_mesh(low_rank_data, algorithm):
+    """NNDSVD init on a grid mesh: one deterministic init computed from the
+    full matrix at the jit level, sliced to the shards (all restarts
+    identical, as in the reference, generatematrix.c:145)."""
+    a, _ = low_rank_data
+    a = a[:53, :21]
+    cfg = SolverConfig(algorithm=algorithm, max_iter=120)
+    icfg = InitConfig(method="nndsvd")
+    key = jax.random.key(5)
+    ref = sweep_one_k(a, key, k=3, restarts=4, solver_cfg=cfg,
+                      init_cfg=icfg, mesh=None)
+    got = sweep_one_k(a, key, k=3, restarts=4, solver_cfg=cfg,
+                      init_cfg=icfg, mesh=grid_mesh(2, 2, 2))
+    np.testing.assert_array_equal(np.asarray(got.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_array_equal(np.asarray(got.iterations),
+                                  np.asarray(ref.iterations))
+    np.testing.assert_allclose(np.asarray(got.best_w),
+                               np.asarray(ref.best_w), rtol=5e-3, atol=5e-4)
+    # deterministic init: every restart converged to the same labeling
+    labels = np.asarray(got.labels)
+    assert (labels == labels[0]).all()
 
 
 def test_restart_chunking_composes_with_mesh(low_rank_data, mesh):
