@@ -8,6 +8,13 @@ a subproblem's tolerance ×0.1 whenever it converges in a single iteration
 below ``tol_pg ×`` its initial value (nmf_alspg.c:193-209), using the
 gradients returned by the previous iteration's subsolvers, as the reference
 does.
+
+Performance shape (profiled, benchmarks/RESULTS.md "pg / alspg profile"):
+latency-bound, not compute- or dispatch-bound — each outer iteration is two
+sequential chains of up to ``sub_max_iter`` dependent tiny-GEMM
+sub-iterations (~0.14 ms per dependent step on TPU), and under vmap every
+restart waits for the worst lane's chain. No batching shortens a dependency
+chain; prefer mu for anything but parity checks and small problems.
 """
 
 from __future__ import annotations

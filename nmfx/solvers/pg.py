@@ -12,6 +12,15 @@ the NNLS subproblem at absolute tolerance 0.001 and seeds the objective
 The reference's inner adaptation loops are unbounded ``while(1)``; here they
 are bounded at 40 trials (α spans 40 decades — beyond float range) so the
 compiled loop provably terminates.
+
+Performance shape (profiled, benchmarks/RESULTS.md "pg / alspg profile"):
+compute-bound at ~25 ms per batched iteration on the north-star config —
+each outer iteration is 4–6 full-matrix GEMM passes (gradients + line-search
+trial objectives), ~100× packed mu's per-iteration cost. Not fixable by
+precision (TPU default is already bf16) or by the Gram-trace objective
+(measured slower); the cost is the algorithm. The projected-gradient stop
+rarely fires at scale (the reference's own tol default 2e-16 never does) —
+``max_iter`` is the honest budget knob.
 """
 
 from __future__ import annotations
