@@ -87,6 +87,80 @@ def test_als_rank_deficient_stays_finite():
     assert float(res.dnorm) < float(residual_norm(a, w0, h0))
 
 
+def _solve_gram_reg_numpy(gram, rhs):
+    """f64 mirror of base.solve_gram_reg: trace-scaled Tikhonov jitter +
+    Cholesky solve (the shape-stable replacement for the reference's lazy
+    QR fallback, nmf_neals.c:206-291)."""
+    import scipy.linalg as sl
+
+    k = gram.shape[0]
+    lam = 10 * np.finfo(gram.dtype).eps * (np.trace(gram) / k)
+    gram = gram + (lam + np.finfo(gram.dtype).tiny) * np.eye(k)
+    return sl.cho_solve(sl.cho_factor(gram), rhs)
+
+
+def _neals_numpy(a, w, h, iters):
+    """Reference normal-equation ALS (libnmf/nmf_neals.c:200-306) with the
+    framework's jittered-Cholesky Gram solve, H then W with the new H."""
+    a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
+    for _ in range(iters):
+        h = np.maximum(_solve_gram_reg_numpy(w.T @ w, w.T @ a), 0.0)
+        w = np.maximum(_solve_gram_reg_numpy(h @ h.T, h @ a.T).T, 0.0)
+    return w, h
+
+
+def test_neals_matches_numpy_reference_math():
+    a, w0, h0 = _problem(seed=17)
+    w_ref, h_ref = _neals_numpy(a, w0, h0, iters=8)
+    res = _run("neals", a, w0, h0, iters=8)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=5e-3,
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=5e-3,
+                               atol=5e-4)
+
+
+def _nndsvd_numpy(a, k):
+    """f64 transliteration of nmfx.init.nndsvd_init (Boutsidis NNDSVD;
+    reference generatematrix.c:145-247). Sign-invariant to the SVD's
+    per-vector sign ambiguity (abs on the leading pair; the ± split swaps
+    sides with the sign, and the dominant side is picked by norm product)."""
+    a = np.asarray(a, np.float64)
+    u, s, vt = np.linalg.svd(a, full_matrices=False)
+    u, s, vt = u[:, :k], s[:k], vt[:k]
+    w0 = np.sqrt(s[0]) * np.abs(u[:, :1])
+    h0 = np.sqrt(s[0]) * np.abs(vt[:1, :])
+    if k > 1:
+        uj, vj = u[:, 1:], vt[1:, :].T
+        up, un = np.maximum(uj, 0), np.maximum(-uj, 0)
+        vp, vn = np.maximum(vj, 0), np.maximum(-vj, 0)
+        nup, nun = np.linalg.norm(up, axis=0), np.linalg.norm(un, axis=0)
+        nvp, nvn = np.linalg.norm(vp, axis=0), np.linalg.norm(vn, axis=0)
+        termp, termn = nup * nvp, nun * nvn
+        use_p = termp >= termn
+        term = np.where(use_p, termp, termn)
+        scale = np.sqrt(s[1:] * term)
+        tiny = np.finfo(np.float64).tiny
+        wcols = scale * np.where(use_p, up / np.maximum(nup, tiny),
+                                 un / np.maximum(nun, tiny))
+        hrows = scale * np.where(use_p, vp / np.maximum(nvp, tiny),
+                                 vn / np.maximum(nvn, tiny))
+        w0 = np.concatenate([w0, wcols], axis=1)
+        h0 = np.concatenate([h0, hrows.T], axis=0)
+    w0[w0 <= 0.0] = 0.0
+    h0[h0 <= 0.0] = 0.0
+    return w0, h0
+
+
+def test_nndsvd_matches_numpy_reference_math():
+    from nmfx.init import nndsvd_init
+
+    a, _, _ = _problem(seed=41)
+    w_ref, h_ref = _nndsvd_numpy(a, 3)
+    w0, h0 = nndsvd_init(jnp.asarray(a, jnp.float32), 3)
+    np.testing.assert_allclose(np.asarray(w0), w_ref, rtol=5e-3, atol=5e-5)
+    np.testing.assert_allclose(np.asarray(h0), h_ref, rtol=5e-3, atol=5e-5)
+
+
 def _kl_numpy(a, w, h, iters, eps=1e-9):
     """Brunet (2004) divergence updates in f64 — the BROAD nmfconsensus.R
     model family the reference replaced with Euclidean mu (see

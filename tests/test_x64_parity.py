@@ -1,50 +1,99 @@
-"""f64 parity mode in a dedicated subprocess (jax_enable_x64 is global and
-must be set before any JAX use, so the in-process suite can only skip it —
-SolverConfig.dtype='float64' is the documented parity path vs the
-reference's f64 BLAS)."""
+"""f64 parity for the FULL solver matrix + NNDSVD, in subprocesses.
 
+``jax_enable_x64`` is global and must be set before any JAX use, so each
+case runs in a dedicated subprocess (the in-process suite pins the f32
+8-device CPU platform). ``SolverConfig.dtype="float64"`` is the documented
+parity path vs the reference's f64 BLAS (``libnmf/*.c`` runs entirely in
+doubles): every solver is driven lockstep against the f64 NumPy
+transliterations of the reference math from tests/test_golden.py and must
+agree at rtol 1e-10 — far beyond anything an f32 run could produce, so this
+also guards the dtype plumbing end to end.
+"""
+
+import os
 import subprocess
 import sys
 import textwrap
 
+import pytest
 
-def test_f64_solver_runs_in_subprocess():
-    code = textwrap.dedent("""
-        import jax
-        jax.config.update("jax_enable_x64", True)
-        jax.config.update("jax_platforms", "cpu")
-        import numpy as np
-        import jax.numpy as jnp
-        from nmfx.config import SolverConfig
-        from nmfx.solvers import solve
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 
-        rng = np.random.default_rng(0)
-        a = rng.uniform(0.1, 1.0, (60, 22))
-        w0 = rng.uniform(0.1, 1.0, (60, 3))
-        h0 = rng.uniform(0.1, 1.0, (3, 22))
-        res = solve(a, w0, h0, SolverConfig(algorithm="mu", max_iter=25,
-                                            dtype="float64",
-                                            use_class_stop=False,
-                                            use_tol_checks=False))
-        assert res.w.dtype == jnp.float64, res.w.dtype
+#: (algorithm, transliteration, iterations). Iteration counts are kept small
+#: enough that the pg family's discrete line-search decisions cannot drift
+#: across the two implementations' reduction orders, but large enough that
+#: f32 execution would visibly diverge from the f64 oracle.
+_CASES = [
+    ("mu", "_mu_numpy", 25),
+    ("als", "_als_numpy", 8),
+    ("neals", "_neals_numpy", 8),
+    ("pg", "_pg_numpy", 6),
+    ("alspg", "_alspg_numpy", 5),
+    ("kl", "_kl_numpy", 25),
+    ("snmf", "_snmf_numpy", 10),
+]
 
-        # lockstep vs the identical update in NumPy f64: agreement must be
-        # at f64 level, far beyond anything f32 could produce
-        w, h = np.asarray(w0, np.float64), np.asarray(h0, np.float64)
-        for _ in range(25):
-            numerh = w.T @ a
-            hn = h * numerh / ((w.T @ w) @ h + 1e-9)
-            hn[(h == 0) | (numerh == 0)] = 0.0
-            h = hn
-            numerw = a @ h.T
-            wn = w * numerw / (w @ (h @ h.T) + 1e-9)
-            wn[(w == 0) | (numerw == 0)] = 0.0
-            w = wn
-        np.testing.assert_allclose(np.asarray(res.w), w, rtol=1e-10)
-        np.testing.assert_allclose(np.asarray(res.h), h, rtol=1e-10)
-        print("OK")
-    """)
-    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                          text=True, timeout=300)
+_PRELUDE = f"""
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_platforms", "cpu")
+    import sys
+    sys.path.insert(0, {_TESTS_DIR!r})
+    import numpy as np
+    import jax.numpy as jnp
+"""
+
+
+def _run_case(code: str) -> None:
+    proc = subprocess.run([sys.executable, "-c", textwrap.dedent(code)],
+                          capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "OK" in proc.stdout
+
+
+@pytest.mark.parametrize("algo,oracle,iters", _CASES,
+                         ids=[c[0] for c in _CASES])
+def test_f64_solver_lockstep_vs_reference_math(algo, oracle, iters):
+    extra = ""
+    call = f"{oracle}(a, w0, h0, iters={iters})"
+    if algo == "snmf":
+        # snmf's transliteration takes its regularizers explicitly; mirror
+        # the solver defaults (beta, eta=max(A)^2)
+        call = (f"{oracle}(a, w0, h0, iters={iters}, beta=0.01, "
+                "eta=float(np.max(a)) ** 2)")
+    if algo in ("pg", "alspg"):
+        extra = ", tol_pg=0.0"
+    _run_case(f"""
+{_PRELUDE}
+    from test_golden import {oracle}, _problem
+    from nmfx.config import SolverConfig
+    from nmfx.solvers import solve
+
+    a, w0, h0 = _problem(seed=12)
+    w_ref, h_ref = {call}
+    cfg = SolverConfig(algorithm={algo!r}, max_iter={iters},
+                       dtype="float64", use_class_stop=False,
+                       use_tol_checks=False{extra})
+    res = solve(a, w0, h0, cfg)
+    assert res.w.dtype == jnp.float64, res.w.dtype
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=1e-10)
+    print("OK")
+    """)
+
+
+def test_f64_nndsvd_lockstep_vs_reference_math():
+    _run_case(f"""
+{_PRELUDE}
+    from test_golden import _nndsvd_numpy, _problem
+    from nmfx.init import nndsvd_init
+
+    a, _, _ = _problem(seed=12)
+    w_ref, h_ref = _nndsvd_numpy(a, 3)
+    w0, h0 = nndsvd_init(jnp.asarray(a, jnp.float64), 3,
+                         dtype=jnp.float64)
+    assert w0.dtype == jnp.float64
+    np.testing.assert_allclose(np.asarray(w0), w_ref, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(h0), h_ref, rtol=1e-10)
+    print("OK")
+    """)
