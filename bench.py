@@ -16,6 +16,27 @@ import argparse
 import json
 import time
 
+#: per-chip dense bf16 matmul peak (FLOP/s) by jax device_kind — the MFU
+#: denominator. bf16 is both the bench default and what "default" matmul
+#: precision runs on TPU, so MFU is reported against the bf16 peak even for
+#: --precision highest (which burns multiple MXU passes per matmul: its
+#: lower MFU is real, not an accounting artifact).
+_BF16_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+}
+
+
+def _mu_model_flops(m: int, n: int, k: int) -> float:
+    """Model FLOPs of ONE mu iteration for ONE restart: the six-GEMM update
+    (reference nmf_mu.c:174-216) — H: WᵀA (2mnk) + WᵀW (2mk²) + (WᵀW)H
+    (2nk²); W: AHᵀ (2mnk) + HHᵀ (2nk²) + W(HHᵀ) (2mk²). Total
+    4mnk + 4k²(m+n); elementwise terms (O(mk + kn)) are omitted —
+    sub-percent at bench shapes."""
+    return 4.0 * m * n * k + 4.0 * k * k * (m + n)
+
 
 def main():
     p = argparse.ArgumentParser()
@@ -74,7 +95,22 @@ def main():
     wall = time.perf_counter() - t0
 
     total_restarts = len(ks) * args.restarts
-    iters = {k: float(np.asarray(raw[k].iterations).mean()) for k in ks}
+    its = {k: np.asarray(raw[k].iterations) for k in ks}  # one transfer per k
+    iters = {k: float(v.mean()) for k, v in its.items()}
+
+    # MFU accounting (mu only — the other families' per-iteration FLOPs
+    # differ per line-search trial / subproblem and are not modeled):
+    # model FLOPs = Σ_k Σ_restart iters · flops_per_iter(k), achieved rate
+    # over the measured wall, utilization vs the devices' bf16 peak
+    model_flops = mfu = achieved = None
+    if args.algorithm == "mu":
+        model_flops = sum(
+            _mu_model_flops(args.genes, args.samples, k)
+            * float(its[k].sum()) for k in ks)
+        achieved = model_flops / wall
+        peak = _BF16_PEAK_FLOPS.get(jax.devices()[0].device_kind)
+        if peak is not None:
+            mfu = achieved / (peak * len(jax.devices()))
     record = {
         "metric": "consensus_sweep_wall_s",
         "value": round(wall, 3),
@@ -87,6 +123,11 @@ def main():
             "restarts_per_s": round(total_restarts / wall, 2),
             "mean_iters_per_k": {str(k): round(v, 1) for k, v in
                                  iters.items()},
+            "model_tflop": (None if model_flops is None
+                            else round(model_flops / 1e12, 3)),
+            "achieved_tflop_per_s": (None if achieved is None
+                                     else round(achieved / 1e12, 3)),
+            "mfu": None if mfu is None else round(mfu, 4),
             "devices": [str(d) for d in jax.devices()],
         },
     }

@@ -331,14 +331,16 @@ def test_pg_matches_numpy_reference_math():
 
 def _snmf_numpy(a, w, h, iters, beta, eta):
     """f64 transliteration of SNMF/R (nmfx/solvers/snmf.py): regularized
-    normal-equation half-steps with clamp."""
+    normal-equation half-steps with clamp, through the same
+    jittered-Cholesky Gram solve as the solver (rtol-1e-10 lockstep needs
+    the jitter too — it is ~1e-14-relative but not zero)."""
     a, w, h = (np.asarray(x, np.float64) for x in (a, w, h))
     k = w.shape[1]
     for _ in range(iters):
-        h = np.maximum(np.linalg.solve(w.T @ w + beta * np.ones((k, k)),
-                                       w.T @ a), 0.0)
-        w = np.maximum(np.linalg.solve(h @ h.T + eta * np.eye(k),
-                                       h @ a.T).T, 0.0)
+        h = np.maximum(_solve_gram_reg_numpy(w.T @ w + beta * np.ones((k, k)),
+                                             w.T @ a), 0.0)
+        w = np.maximum(_solve_gram_reg_numpy(h @ h.T + eta * np.eye(k),
+                                             h @ a.T).T, 0.0)
     return w, h
 
 
