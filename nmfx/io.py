@@ -12,6 +12,7 @@ its own ``read.gct`` — and ours — parses correctly.
 
 from __future__ import annotations
 
+import math
 import os
 from typing import NamedTuple, Sequence
 
@@ -147,6 +148,48 @@ def read_res(path: str) -> Dataset:
     return Dataset(values, row_names, col_names)
 
 
+def _to_chars_double(v: float) -> str:
+    """Byte-exact Python equivalent of ``std::to_chars(double)`` (the native
+    writer's formatter, nmfx/native/gct_io.cpp): shortest-roundtrip digits,
+    presented in fixed or scientific notation — whichever is SHORTER, fixed
+    on ties (C++17 [charconv.to.chars]). Python's ``repr`` produces the same
+    shortest digits but chooses notation by a fixed magnitude window
+    (1e-4 ≤ |x| < 1e16), so e.g. 1e10 reprs as ``10000000000`` where
+    to_chars emits ``1e+10`` — using repr directly would leave written GCTs
+    dependent on whether the C++ library is built. Byte-parity with the
+    real native output is property-tested in tests/test_io.py."""
+    if v != v:
+        # to_chars preserves the NaN sign bit ("-nan"); so must we
+        return "-nan" if math.copysign(1.0, v) < 0 else "nan"
+    if v in (float("inf"), float("-inf")):
+        return "-inf" if v < 0 else "inf"
+    if v == 0.0:
+        return "-0" if str(v)[0] == "-" else "0"
+    from decimal import Decimal
+
+    sign, digits, exp = Decimal(repr(float(v))).as_tuple()
+    ds = "".join(map(str, digits)).rstrip("0") or "0"
+    exp += len(digits) - len(ds)  # fold stripped trailing zeros into exp
+    # value = ds × 10^exp; scientific exponent E places the point after ds[0]
+    e = exp + len(ds) - 1
+    sci = (ds[0] + ("." + ds[1:] if len(ds) > 1 else "")
+           + f"e{'+' if e >= 0 else '-'}{abs(e):02d}")
+    if exp >= 0:
+        # integral value whose shortest digits don't cover the magnitude:
+        # in fixed notation to_chars re-derives the digits, and among the
+        # equal-length candidates (exact integer vs shortest-digits padded
+        # with zeros — same magnitude, same length) proximity breaks the
+        # tie, so the EXACT integer wins (e.g. 70414783084508816.0 prints
+        # exactly, not ...820)
+        fixed = str(abs(int(v)))
+    elif -exp < len(ds):
+        fixed = ds[:exp] + "." + ds[exp:]
+    else:
+        fixed = "0." + "0" * (-exp - len(ds)) + ds
+    body = fixed if len(fixed) <= len(sci) else sci
+    return "-" + body if sign else body
+
+
 def write_gct(
     values: np.ndarray,
     path: str,
@@ -194,8 +237,10 @@ def write_gct(
     else:
         with open(path, "wt") as f:
             f.write(header)
-            # one C-level printf per row ("%.17g" roundtrips float64
-            # exactly and prints integral values without a decimal point)
-            rowfmt = "\t".join(["%.17g"] * n_cols)
+            # per-cell std::to_chars-equivalent formatting (_to_chars_double)
+            # so the file bytes do not depend on whether the native library
+            # is built (an earlier %.17g scheme printed 0.10000000000000001
+            # where the native path wrote 0.1)
             for name, desc, row in zip(row_names, descriptions, vals):
-                f.write(f"{name}\t{desc}\t{rowfmt % tuple(row)}\n")
+                cells = "\t".join(_to_chars_double(v) for v in row)
+                f.write(f"{name}\t{desc}\t{cells}\n")

@@ -52,7 +52,20 @@ def _try_load() -> ctypes.CDLL | None:
             with tempfile.NamedTemporaryFile(
                     suffix=".so", delete=False) as tf:
                 shutil.copyfile(_LIB_PATH, tf.name)
-            return _bind(ctypes.CDLL(tf.name))
+            try:
+                lib = ctypes.CDLL(tf.name)
+            finally:
+                # dlopen holds the mapping (Linux); dropping the directory
+                # entry immediately avoids leaking one temp file per
+                # process that hits the stale-symbol path. Best-effort: an
+                # unlink failure must not discard a successfully loaded
+                # library (it would propagate to the outer except and
+                # silently disable the native path)
+                import contextlib
+
+                with contextlib.suppress(OSError):
+                    os.unlink(tf.name)
+            return _bind(lib)
         except (OSError, AttributeError, subprocess.SubprocessError):
             return None
 

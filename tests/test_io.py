@@ -93,6 +93,37 @@ def test_gct_lenient_parsing(tmp_path, io_backend):
     assert ds.row_names == ["g#1", "g2"]
 
 
+def test_write_gct_backends_byte_identical(tmp_path, monkeypatch):
+    """The numpy fallback writer must produce the same bytes as the native
+    std::to_chars path — a written GCT must not depend on whether the C++
+    library is built. Property-tested across the magnitude range where
+    Python repr and to_chars choose notation differently (repr switches to
+    scientific only outside [1e-4, 1e16); to_chars picks whichever form is
+    shorter), plus boundary values."""
+    from nmfx import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    rand = (rng.uniform(-1, 1, 120)
+            * 10.0 ** rng.integers(-25, 25, size=120))
+    special = np.array([0.1, 1.0, 2.5e-17, 123456.0, -0.0, 7.25,
+                        1e10, -1e10, 0.0001, 1e-4, 9.999e15, 1e16,
+                        123456789.0, 5e-324, 1.7976931348623157e308,
+                        1e100, -3.141592653589793e-100])
+    vals = np.concatenate([rand, special]).reshape(-1, 1)
+    kw = dict(row_names=[f"r{i}" for i in range(len(vals))],
+              col_names=["x"])
+    p_native = str(tmp_path / "n.gct")
+    write_gct(vals, p_native, **kw)
+    monkeypatch.setattr(native, "available", lambda: False)
+    p_numpy = str(tmp_path / "f.gct")
+    write_gct(vals, p_numpy, **kw)
+    with open(p_native, "rt") as f1, open(p_numpy, "rt") as f2:
+        for line1, line2 in zip(f1, f2):
+            assert line1 == line2, (line1, line2)
+
+
 def test_gct_roundtrip_both_backends(tmp_path, io_backend):
     rng = np.random.default_rng(3)
     vals = rng.uniform(0, 10, size=(9, 4))
