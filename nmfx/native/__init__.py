@@ -26,12 +26,18 @@ _load_attempted = False
 def _try_load() -> ctypes.CDLL | None:
     if os.environ.get("NMFX_NATIVE", "1") == "0":
         return None
+    # ALWAYS invoke make (a ~10 ms no-op when the .so is fresh — the
+    # Makefile declares the source dependencies): a stale prebuilt library
+    # with an unchanged symbol set would otherwise be served forever, since
+    # the AttributeError rebuild path below only fires on MISSING symbols.
+    # Best-effort: with no toolchain, fall through to whatever .so exists.
+    try:
+        subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        pass
     if not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(["make", "-C", _DIR, "-s"], check=True,
-                           capture_output=True, timeout=120)
-        except (OSError, subprocess.SubprocessError):
-            return None
+        return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
         return _bind(lib)
