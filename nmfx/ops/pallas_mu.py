@@ -17,13 +17,14 @@ on-chip:
   epilogue in-register. The tiny masked H-Gram is precomputed by the caller
   (one small GEMM — not worth a kernel).
 
-Measured on a single v5e chip (bf16, R=50): wall-time parity with the
-XLA-packed formulation at the north-star 5000×500 shapes (~65 µs/iter
-marginal for both) and ~1.5x slower at 20000×1000 — XLA's GEMM scheduling
-is already excellent for these dense shapes, so ``backend="packed"`` stays
-the default and these kernels are the explicitly-scheduled alternative
-(``backend="pallas"``) for fusion-sensitive regimes and as the template for
-future hand-tuned paths.
+Measured on a single v5e chip (bf16, R=50; see benchmarks/RESULTS.md
+"Pallas backend: regime verdict" for the round-2 protocol and its
+variance caveats): the packed XLA path wins the north-star sweep by
+~15–20%, so ``backend="packed"`` stays the default; these kernels won
+their sessions on isolated long-running large-R·k solves (k=10 at
+5000×500: lower fixed AND marginal cost, ~1.8× end-to-end) and are the
+opt-in ``backend="pallas"`` for that regime, plus the template for future
+hand-tuned paths.
 
 Numerical note (verified on hardware): a single Mosaic iteration matches
 the XLA path to f32 rounding (max rel ~3e-7), but accumulation order
