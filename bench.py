@@ -38,6 +38,17 @@ def _mu_model_flops(m: int, n: int, k: int) -> float:
     return 4.0 * m * n * k + 4.0 * k * k * (m + n)
 
 
+def _kl_model_flops(m: int, n: int, k: int) -> float:
+    """One kl (Brunet) iteration per restart (solvers/kl.py): two quotient
+    reconstructions W@H (2·2mnk), the two quotient contractions WᵀQ and QHᵀ
+    (2·2mnk), the elementwise quotient/update passes (~6mn), and the O(k)
+    sums — 8mnk + 6mn to leading order."""
+    return 8.0 * m * n * k + 6.0 * m * n
+
+
+_MODEL_FLOPS = {"mu": _mu_model_flops, "kl": _kl_model_flops}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--genes", type=int, default=5000)
@@ -99,14 +110,15 @@ def main():
     its = {k: host[k][1] for k in ks}
     iters = {k: float(v.mean()) for k, v in its.items()}
 
-    # MFU accounting (mu only — the other families' per-iteration FLOPs
-    # differ per line-search trial / subproblem and are not modeled):
+    # MFU accounting (mu and kl — the pg/alspg families' per-iteration
+    # FLOPs differ per line-search trial / subproblem and are not modeled):
     # model FLOPs = Σ_k Σ_restart iters · flops_per_iter(k), achieved rate
     # over the measured wall, utilization vs the devices' bf16 peak
     model_flops = mfu = achieved = None
-    if args.algorithm == "mu":
+    flops_fn = _MODEL_FLOPS.get(args.algorithm)
+    if flops_fn is not None:
         model_flops = sum(
-            _mu_model_flops(args.genes, args.samples, k)
+            flops_fn(args.genes, args.samples, k)
             * float(its[k].sum()) for k in ks)
         achieved = model_flops / wall
         peak = _BF16_PEAK_FLOPS.get(jax.devices()[0].device_kind)

@@ -368,9 +368,12 @@ def nmfconsensus(
 
         from nmfx.ops.hclust_jax import rank_selection_jax
 
-        dev_sel = {k: rank_selection_jax(jnp.asarray(out.consensus), k,
-                                         ccfg.linkage)
-                   for k, out in raw.items()}
+        # its own phase so per-k trace/compile cost (synchronous, host-side)
+        # isn't silently charged to device_to_host or to no phase at all
+        with profiler.phase("rank_selection_dispatch"):
+            dev_sel = {k: rank_selection_jax(jnp.asarray(out.consensus), k,
+                                             ccfg.linkage)
+                       for k, out in raw.items()}
     # ONE batched device→host transfer for every rank's outputs (labels are
     # never read here — keep them out of the transfer): a per-field
     # np.asarray pays one round trip per array, ~50–150 ms each through a
