@@ -41,9 +41,10 @@ def _mu_model_flops(m: int, n: int, k: int) -> float:
 def _kl_model_flops(m: int, n: int, k: int) -> float:
     """One kl (Brunet) iteration per restart (solvers/kl.py): two quotient
     reconstructions W@H (2·2mnk), the two quotient contractions WᵀQ and QHᵀ
-    (2·2mnk), the elementwise quotient/update passes (~6mn), and the O(k)
-    sums — 8mnk + 6mn to leading order."""
-    return 8.0 * m * n * k + 6.0 * m * n
+    (2·2mnk), and the two elementwise quotient passes (one add + one divide
+    over m×n each: 4mn); the remaining elementwise work is O(kn + mk) —
+    8mnk + 4mn to leading order."""
+    return 8.0 * m * n * k + 4.0 * m * n
 
 
 _MODEL_FLOPS = {"mu": _mu_model_flops, "kl": _kl_model_flops}

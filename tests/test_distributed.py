@@ -31,6 +31,9 @@ def test_outputs_replicated_and_addressable(two_group_data):
     out = sweep_one_k(two_group_data, jax.random.key(0), k=2, restarts=16,
                       solver_cfg=cfg, mesh=dist.global_mesh())
     for name, x in zip(out._fields, out):
+        if x is None:  # optional factor fields: absent without keep_factors
+            assert name in ("all_w", "all_h")
+            continue
         assert x.sharding.is_fully_replicated, name
         np.asarray(x)  # fully addressable on this (every) host
 
@@ -47,6 +50,18 @@ def test_global_mesh_matches_single_device(two_group_data):
                                   np.asarray(meshed.labels))
 
 
+def _assert_template_matches(real, tmpl):
+    """Field-for-field structural equality between a real sweep output and
+    the broadcast skeleton — including None-ness of the optional factor
+    fields, or the broadcast pytrees disagree between hosts."""
+    for name, r, t in zip(real._fields, real, tmpl):
+        if r is None or t is None:
+            assert r is None and t is None, name
+            continue
+        assert np.asarray(r).shape == t.shape, name
+        assert np.asarray(r).dtype == t.dtype, name
+
+
 def test_template_matches_real_output(two_group_data):
     """The broadcast skeleton must mirror sweep_one_k's structure exactly,
     or multi-host resume would die in broadcast_one_to_all."""
@@ -56,9 +71,19 @@ def test_template_matches_real_output(two_group_data):
     real = sweep_one_k(two_group_data, jax.random.key(0), k=3, restarts=5,
                        solver_cfg=cfg)
     tmpl = _template(two_group_data, k=3, restarts=5, solver_cfg=cfg)
-    for name, r, t in zip(real._fields, real, tmpl):
-        assert np.asarray(r).shape == t.shape, name
-        assert np.asarray(r).dtype == t.dtype, name
+    _assert_template_matches(real, tmpl)
+
+
+def test_template_matches_with_keep_factors(two_group_data):
+    from nmfx.sweep import _template
+
+    cfg = SolverConfig(algorithm="mu", max_iter=20)
+    real = sweep_one_k(two_group_data, jax.random.key(0), k=3, restarts=5,
+                       solver_cfg=cfg, keep_factors=True)
+    tmpl = _template(two_group_data, k=3, restarts=5, solver_cfg=cfg,
+                     keep_factors=True)
+    assert real.all_w is not None and tmpl.all_w is not None
+    _assert_template_matches(real, tmpl)
 
 
 def test_distributed_consensus_end_to_end(two_group_data, tmp_path):
@@ -66,6 +91,17 @@ def test_distributed_consensus_end_to_end(two_group_data, tmp_path):
                          seed=11)
     assert res.best_k == 2  # two planted groups
     assert set(res.per_k) == {2, 3}
+
+
+def test_distributed_consensus_kl_on_grid_mesh(two_group_data):
+    """kl over the distributed grid mesh (the solver the feature/sample
+    axes exist for) end-to-end through dist.consensus."""
+    res = dist.consensus(two_group_data, ks=(2,), restarts=4, max_iter=40,
+                         seed=11, algorithm="kl",
+                         feature_shards=2, sample_shards=2)
+    assert res.best_k == 2
+    assert res.per_k[2].consensus.shape == (
+        two_group_data.shape[1], two_group_data.shape[1])
 
 
 def test_global_mesh_grid_axes():
