@@ -62,6 +62,10 @@ def main():
                    choices=("default", "bfloat16", "highest"),
                    help="solver matmul precision (bfloat16 validated to give "
                         "identical consensus on this workload)")
+    p.add_argument("--backend", default="auto",
+                   choices=("auto", "vmap", "packed", "pallas"),
+                   help="restart-batch execution strategy (SolverConfig."
+                        "backend); pallas/packed are mu-only")
     p.add_argument("--target-s", type=float, default=10.0)
     args = p.parse_args()
 
@@ -75,8 +79,12 @@ def main():
     ks = tuple(range(2, args.kmax + 1))
     if not ks:
         p.error("--kmax must be >= 2")
+    if args.backend in ("packed", "pallas") and args.algorithm != "mu":
+        p.error(f"--backend {args.backend} is only implemented for "
+                "--algorithm mu (use auto to fall back per algorithm)")
     scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter,
-                        matmul_precision=args.precision)
+                        matmul_precision=args.precision,
+                        backend=args.backend)
     ccfg = ConsensusConfig(ks=ks, restarts=args.restarts, seed=123)
     icfg = InitConfig()
     mesh = default_mesh()
@@ -133,7 +141,8 @@ def main():
         "detail": {
             "config": f"k=2..{args.kmax} x {args.restarts} restarts, "
                       f"{args.genes}x{args.samples}, {args.algorithm}, "
-                      f"maxiter={args.maxiter}, precision={args.precision}",
+                      f"maxiter={args.maxiter}, precision={args.precision}, "
+                      f"backend={args.backend}",
             "restarts_per_s": round(total_restarts / wall, 2),
             "mean_iters_per_k": {str(k): round(v, 1) for k, v in
                                  iters.items()},
