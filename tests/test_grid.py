@@ -93,6 +93,24 @@ def test_keep_factors_mesh_invariance(two_group_data, backend):
                                np.asarray(got.all_h), rtol=5e-4, atol=1e-5)
 
 
+def test_keep_factors_composes_with_restart_chunk(two_group_data):
+    """Chunked execution (the bounded-memory path) must retain the same
+    factors as the unchunked sweep — prefix-stable keys make chunking
+    invisible."""
+    key = jax.random.fold_in(jax.random.key(123), 2)
+    cfg = SolverConfig(algorithm="mu", max_iter=200, backend="vmap")
+    ref = sweep_one_k(two_group_data, key, 2, RESTARTS, cfg, InitConfig(),
+                      keep_factors=True)
+    chunked = SolverConfig(algorithm="mu", max_iter=200, backend="vmap",
+                           restart_chunk=2)
+    got = sweep_one_k(two_group_data, key, 2, RESTARTS, chunked,
+                      InitConfig(), keep_factors=True)
+    np.testing.assert_array_equal(np.asarray(ref.all_w),
+                                  np.asarray(got.all_w))
+    np.testing.assert_array_equal(np.asarray(ref.all_h),
+                                  np.asarray(got.all_h))
+
+
 def test_keep_factors_off_returns_none(two_group_data):
     out = _sweep(two_group_data, 2, "packed", keep=False)
     assert out.all_w is None and out.all_h is None
