@@ -30,16 +30,16 @@ def _time_sweep(a, ks, restarts, scfg, warm_seed=999, seed=123):
     def run(seed):
         out = sweep(a, ConsensusConfig(ks=ks, restarts=restarts, seed=seed),
                     scfg, icfg, mesh)
-        for k in ks:
-            np.asarray(out[k].consensus)  # host materialization = sync
-        return out
+        # one batched host materialization = the sync point (per-array
+        # pulls pay a tunnel round trip each; see bench.py / api.py)
+        return out, jax.device_get(
+            {k: (out[k].consensus, out[k].iterations) for k in ks})
 
     run(warm_seed)  # compile
     t0 = time.perf_counter()
-    out = run(seed)
+    _, host = run(seed)
     wall = time.perf_counter() - t0
-    iters = float(np.mean([np.asarray(out[k].iterations).mean()
-                           for k in ks]))
+    iters = float(np.mean([host[k][1].mean() for k in ks]))
     return wall, iters
 
 
