@@ -133,14 +133,15 @@ def main(argv: list[str] | None = None) -> int:
         if args.no_mesh:
             parser.error("--feature-shards/--sample-shards conflict with "
                          "--no-mesh")
+        from nmfx.sweep import GRID_SOLVERS, grid_mesh
+
         grid_ok = (args.algorithm == "mu"
                    and args.backend in ("auto", "packed")) \
-            or args.algorithm == "kl"
+            or args.algorithm in GRID_SOLVERS
         if not grid_ok:
             parser.error("--feature-shards/--sample-shards require "
                          "--algorithm mu with --backend auto or packed, "
-                         "or --algorithm kl")
-        from nmfx.sweep import grid_mesh
+                         f"or one of {'/'.join(GRID_SOLVERS)}")
 
         try:
             mesh = grid_mesh(None, args.feature_shards, args.sample_shards)

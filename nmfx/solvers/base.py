@@ -56,6 +56,23 @@ class ShardInfo(NamedTuple):
     n_total: int | None = None  # unsharded (unpadded) column count
 
 
+def shard_reducers(shard: ShardInfo | None):
+    """The pair of conditional psums every grid-sharded solver update
+    needs: ``(fsum, ssum)`` reduce over the feature / sample mesh axis
+    when present, else pass through. One definition so a future change to
+    the reduction scheme cannot silently desynchronize one solver."""
+    f_ax = shard.feature_axis if shard is not None else None
+    s_ax = shard.sample_axis if shard is not None else None
+
+    def fsum(x):
+        return lax.psum(x, f_ax) if f_ax is not None else x
+
+    def ssum(x):
+        return lax.psum(x, s_ax) if s_ax is not None else x
+
+    return fsum, ssum
+
+
 class StopReason(enum.IntEnum):
     MAX_ITER = 0
     #: per-column argmax of H unchanged for `stable_checks` consecutive checks

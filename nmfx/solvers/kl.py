@@ -32,13 +32,13 @@ fallback memory bound.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 from nmfx.config import SolverConfig
 from nmfx.solvers import base
 
 
-def init_aux(a, w0, h0, cfg: SolverConfig):
+def init_aux(a, w0, h0, cfg: SolverConfig,
+             shard: base.ShardInfo | None = None):
     return ()
 
 
@@ -55,14 +55,7 @@ def step(a, state: base.State, cfg: SolverConfig, check: bool = True,
          shard: base.ShardInfo | None = None) -> base.State:
     w0, h0 = state.w, state.h
     eps = cfg.div_eps
-    f_ax = shard.feature_axis if shard is not None else None
-    s_ax = shard.sample_axis if shard is not None else None
-
-    def fsum(x):
-        return lax.psum(x, f_ax) if f_ax is not None else x
-
-    def ssum(x):
-        return lax.psum(x, s_ax) if s_ax is not None else x
+    fsum, ssum = base.shard_reducers(shard)
 
     # H update: quotient against the current reconstruction. Under shard the
     # quotient block is local (row-shard of W × column-shard of H); the two

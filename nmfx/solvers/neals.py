@@ -15,6 +15,13 @@ indistinguishable from the plain solve for healthy systems (the jitter is
 ~10·eps relative to the Gram's scale).
 
 Convergence: TolX/TolFun checks every 2nd iteration as in als.
+
+Grid sharding: both half-steps are Gram solves, and the Grams contract
+along exactly the axes the mesh tiles — WᵀW and WᵀA over features, HHᵀ
+and HAᵀ over samples — so under ``shard`` each becomes one psum pair and
+the k×k solves run replicated (same placement as mu's packed Grams and
+kl's quotient contractions). Zero-padded rows/columns re-derive as exact
+zeros every iteration (their right-hand-side columns are zero).
 """
 
 from __future__ import annotations
@@ -23,24 +30,22 @@ from nmfx.config import SolverConfig
 from nmfx.solvers import base
 
 
-def init_aux(a, w0, h0, cfg: SolverConfig):
+def init_aux(a, w0, h0, cfg: SolverConfig,
+             shard: base.ShardInfo | None = None):
     return ()
 
 
-def _solve_normal(factor, rhs_gram):
-    """solve(factorᵀfactor + λI, rhs_gram) via the shared jittered Cholesky
-    (base.solve_gram_reg)."""
-    return base.solve_gram_reg(factor.T @ factor, rhs_gram)
-
-
-def step(a, state: base.State, cfg: SolverConfig,
-         check: bool = True) -> base.State:
+def step(a, state: base.State, cfg: SolverConfig, check: bool = True,
+         shard: base.ShardInfo | None = None) -> base.State:
     w0 = state.w
-    h = base.clamp(_solve_normal(w0, w0.T @ a), cfg.zero_threshold)
-    wt = _solve_normal(h.T, h @ a.T)
+    fsum, ssum = base.shard_reducers(shard)
+    h = base.clamp(
+        base.solve_gram_reg(fsum(w0.T @ w0), fsum(w0.T @ a)),
+        cfg.zero_threshold)
+    wt = base.solve_gram_reg(ssum(h @ h.T), ssum(h @ a.T))
     w = base.clamp(wt.T, cfg.zero_threshold)
     state = state._replace(w=w, h=h)
     if not check:
         return state
     return base.check_convergence(state, cfg, a=a, use_tolx=True,
-                                  use_tolfun=True)
+                                  use_tolfun=True, shard=shard)

@@ -22,38 +22,58 @@ same trace-scaled jitter as neals keeps the Cholesky well-posed for
 Convergence: TolX/TolFun every 2nd iteration, plus the class-stability
 stop when enabled — H sparsity makes per-sample argmax labels
 particularly crisp, which is the point of using it for consensus runs.
+
+Grid sharding: like neals, both half-steps are Gram solves whose
+contractions psum along the mesh's feature/sample axes under ``shard``;
+the β/η regularizers and the jitter are added after the psums (global
+terms), and the default η = max(A)² pmaxes over the tiles.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from nmfx.config import SolverConfig
 from nmfx.solvers import base
 
 
-def init_aux(a, w0, h0, cfg: SolverConfig):
+def init_aux(a, w0, h0, cfg: SolverConfig,
+             shard: base.ShardInfo | None = None):
     eta = cfg.ridge_eta
     if eta is None:
-        eta = jnp.max(a) ** 2  # Kim & Park's default
+        amax = jnp.max(a)  # Kim & Park's default eta = max(A)^2
+        if shard is not None:
+            # A is tiled: the default must be the GLOBAL max (zero padding
+            # cannot win — A is non-negative)
+            for ax in (shard.feature_axis, shard.sample_axis):
+                if ax is not None:
+                    amax = lax.pmax(amax, ax)
+        eta = amax ** 2
     return jnp.asarray(eta, w0.dtype)
 
 
-def step(a, state: base.State, cfg: SolverConfig,
-         check: bool = True) -> base.State:
+def step(a, state: base.State, cfg: SolverConfig, check: bool = True,
+         shard: base.ShardInfo | None = None) -> base.State:
     w0 = state.w
     eta = state.aux
     k = w0.shape[1]
+    fsum, ssum = base.shard_reducers(shard)
     beta = jnp.asarray(cfg.sparsity_beta, w0.dtype)
     ones = jnp.ones((k, k), w0.dtype)
-    h = base.clamp(base.solve_gram_reg(w0.T @ w0 + beta * ones, w0.T @ a),
-                   cfg.zero_threshold)
-    wt = base.solve_gram_reg(h @ h.T + eta * jnp.eye(k, dtype=w0.dtype),
-                             h @ a.T)
+    # regularizers are added AFTER the psums: they are global terms, not
+    # per-shard contributions (same placement as neals' jitter)
+    h = base.clamp(
+        base.solve_gram_reg(fsum(w0.T @ w0) + beta * ones,
+                            fsum(w0.T @ a)),
+        cfg.zero_threshold)
+    wt = base.solve_gram_reg(
+        ssum(h @ h.T) + eta * jnp.eye(k, dtype=w0.dtype), ssum(h @ a.T))
     w = base.clamp(wt.T, cfg.zero_threshold)
     state = state._replace(w=w, h=h)
     if not check:
         return state
     return base.check_convergence(state, cfg, a=a,
                                   use_class=cfg.use_class_stop,
-                                  use_tolx=True, use_tolfun=True)
+                                  use_tolx=True, use_tolfun=True,
+                                  shard=shard)

@@ -43,6 +43,14 @@ FEATURE_AXIS = "features"
 #: full 3-D restarts×features×samples mesh (see grid_mesh)
 SAMPLE_AXIS = "samples"
 
+#: solvers whose updates shard over the feature/sample grid axes through
+#: the generic driver: their contracted terms psum along the tiled axes
+#: (kl's quotient contractions; neals'/snmf's normal-equation Grams). mu
+#: grids through its dedicated packed path; als/pg/alspg have lstsq /
+#: line-search structures with no collective formulation and stay
+#: restart-parallel only
+GRID_SOLVERS = ("kl", "neals", "snmf")
+
 
 class KSweepOutput(NamedTuple):
     consensus: jax.Array  # (n, n)
@@ -83,12 +91,13 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     if grid:
         grid_ok = ((_use_packed(solver_cfg)
                     and solver_cfg.backend != "pallas")
-                   or solver_cfg.algorithm == "kl")
+                   or solver_cfg.algorithm in GRID_SOLVERS)
         if not grid_ok:
             raise ValueError(
                 "feature/sample-axis sharding requires the packed mu "
-                "backend (algorithm='mu', backend='packed'/'auto') or "
-                f"algorithm='kl'; got algorithm={solver_cfg.algorithm!r}, "
+                "backend (algorithm='mu', backend='packed'/'auto') or a "
+                f"Gram/quotient-sharded solver {GRID_SOLVERS}; got "
+                f"algorithm={solver_cfg.algorithm!r}, "
                 f"backend={solver_cfg.backend!r}")
         if keep_factors:
             # the point of grid axes is that no device ever holds a full
@@ -311,10 +320,11 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     column-sharded over samples (replicated over features). Per iteration
     the solver psums its m-contracted terms over features and its
     n-contracted terms over samples (SUMMA-style): the packed mu path's
-    Gram pairs (see ``mu_packed``), or kl's quotient contractions — the
+    Gram pairs (see ``mu_packed``), kl's quotient contractions — the
     solver whose O(m·n) per-restart intermediate makes these axes a
     *necessity* at scale (``solvers/kl.py``; its quotient block is purely
-    local under this layout). Labels are computed on local columns with the
+    local under this layout) — or neals'/snmf's normal-equation Grams
+    (``GRID_SOLVERS``). Labels are computed on local columns with the
     class-stability AND reduced by one tiny psum. The consensus reduction
     psums over the restart axis as in the 1-D path.
 
@@ -328,10 +338,10 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     outside the solver loop, never per restart.
     """
     from nmfx.ops.packed_mu import mu_packed, unpack_w
-    from nmfx.solvers import base
-    from nmfx.solvers import kl as kl_mod
+    from nmfx.solvers import SOLVERS, base
 
-    use_kl = solver_cfg.algorithm == "kl"
+    grid_mod = (SOLVERS[solver_cfg.algorithm]
+                if solver_cfg.algorithm in GRID_SOLVERS else None)
     use_nndsvd = init_cfg.method == "nndsvd"
 
     def axis_size(name):
@@ -365,9 +375,12 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
         # full W0/H0 from the canonical per-restart keys (identical draws on
         # every mesh shape), immediately sliced to this shard's row/column
         # blocks so peak transient memory is one restart's m×k + k×n, not
-        # r_local times that; rows/columns past the true dims (padding) are
-        # zeroed so they stay exactly zero under the multiplicative updates
-        # and contribute nothing to the psummed contractions
+        # r_local times that. Rows/columns past the true dims (padding) are
+        # zeroed and stay exactly zero by each grid solver's own argument —
+        # multiplicative short-circuit for mu/kl, zero right-hand-side
+        # columns solving to zero for neals/snmf (their docstrings) — so
+        # they contribute nothing to the psummed contractions; any NEW grid
+        # solver must establish the same invariant
         def init_one(kk):
             w0, h0 = random_init(kk, m_true, n_true, k, init_cfg, dtype)
             w0 = jnp.pad(w0, ((0, m_pad - m_true), (0, 0)))
@@ -387,24 +400,25 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
                                        (r_local,) + h0_init.shape)
         else:
             w0s_loc, h0s_loc = lax.map(init_one, keys)
-        if use_kl:
+        if grid_mod is not None:
             shard_info = base.ShardInfo(f_ax, s_ax, m_true, n_true)
-            step_fn = partial(kl_mod.step, shard=shard_info)
+            step_fn = partial(grid_mod.step, shard=shard_info)
 
             def solve_lanes(w0s, h0s):
                 with base.matmul_precision_ctx(solver_cfg.matmul_precision):
                     return jax.vmap(
                         lambda w0, h0: base.run_loop(
                             a_loc, w0, h0, solver_cfg, step_fn,
-                            kl_mod.init_aux(a_loc, w0, h0, solver_cfg),
+                            grid_mod.init_aux(a_loc, w0, h0, solver_cfg,
+                                              shard=shard_info),
                             shard_info))(w0s, h0s)
 
             # restart_chunk composes with the grid mesh exactly as with the
             # restart mesh (config.py): it bounds the lanes solved
-            # concurrently PER DEVICE — each lane holds an (m_loc × n_loc)
-            # quotient — with chunks running sequentially via lax.map (in
-            # lockstep across the grid group: every chunk's convergence
-            # decisions are global psums/pmaxes)
+            # concurrently PER DEVICE — kl's (m_loc × n_loc) quotient is
+            # the per-lane intermediate that needs it — with chunks running
+            # sequentially via lax.map (in lockstep across the grid group:
+            # every chunk's convergence decisions are global psums/pmaxes)
             chunk = solver_cfg.restart_chunk
             c_loc = (max(1, -(-chunk // n_rshards))
                      if chunk is not None else None)

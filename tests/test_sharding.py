@@ -172,34 +172,77 @@ def test_grid_sharded_matches_unsharded(low_rank_data, shape):
                                np.asarray(ref.best_h), rtol=5e-3, atol=5e-4)
 
 
-@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 2, 4), (2, 1, 4),
-                                   (1, 1, 8)])
-def test_kl_grid_sharded_matches_unsharded(low_rank_data, shape):
-    """kl on grid meshes — the solver that *needs* feature/sample sharding
-    (its per-restart A/(WH) quotient is O(m·n), solvers/kl.py): every mesh
-    shape must reproduce the unsharded sweep (labels and iteration counts
-    exactly; factors to f32 reduction-order tolerance)."""
+@pytest.mark.parametrize("algorithm,shape", [
+    # kl — the solver that *needs* feature/sample sharding (per-restart
+    # O(m·n) quotient, solvers/kl.py) — on every mesh shape
+    ("kl", (2, 2, 2)), ("kl", (1, 2, 4)), ("kl", (2, 1, 4)),
+    ("kl", (1, 1, 8)),
+    # the Gram-based family shards through the same psum placement
+    ("neals", (2, 2, 2)), ("neals", (1, 2, 4)),
+    ("snmf", (2, 2, 2)), ("snmf", (2, 1, 4)),
+])
+def test_grid_solver_sharded_matches_unsharded(low_rank_data, algorithm,
+                                               shape):
+    """Every GRID_SOLVERS algorithm must reproduce the unsharded sweep on
+    grid meshes: labels exactly, factors to f32 reduction-order tolerance.
+    Iteration counts are exact for kl (its class-stability stop is robust
+    over hundreds of iterations) but may drift for the Gram family —
+    neals/snmf stop when a TolX/TolFun threshold crossing lands, and the
+    psummed partial Grams' reduction order moves the ~1e-7-level deltas
+    near the threshold. On a delta plateau the crossing can slip by many
+    checks (measured: up to 18 iterations on one neals restart here), so
+    the stopping iteration is only sanity-bounded — the stable observables
+    (labels, consensus, residual quality) are asserted tightly."""
     a, _ = low_rank_data
     a = a[:53, :21]  # both dims uneven across every shard count used here
-    cfg = SolverConfig(algorithm="kl", max_iter=120)
+    cfg = SolverConfig(algorithm=algorithm, max_iter=120)
     key = jax.random.key(5)
     ref = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg, mesh=None)
     got = sweep_one_k(a, key, k=3, restarts=8, solver_cfg=cfg,
                       mesh=grid_mesh(*shape))
     np.testing.assert_array_equal(np.asarray(got.labels),
                                   np.asarray(ref.labels))
-    np.testing.assert_array_equal(np.asarray(got.iterations),
-                                  np.asarray(ref.iterations))
+    if algorithm == "kl":
+        np.testing.assert_array_equal(np.asarray(got.iterations),
+                                      np.asarray(ref.iterations))
+    else:
+        ref_it = np.asarray(ref.iterations, np.int64)
+        drift = np.abs(np.asarray(got.iterations, np.int64) - ref_it)
+        # pure sanity margin (measured worst case 18; a different XLA
+        # build's reduction order could move a plateau crossing further)
+        bound = np.maximum(25 * cfg.check_every, (ref_it * 0.5).astype(int))
+        assert (drift <= bound).all(), (drift, bound)
     np.testing.assert_allclose(np.asarray(got.consensus),
                                np.asarray(ref.consensus), atol=1e-6)
+    # atol floor: on the exactly-low-rank fixture the Gram family drives
+    # the residual to numerical zero (~1e-4), where relative comparison
+    # of two near-zero residuals stopped a few iterations apart is
+    # meaningless
     np.testing.assert_allclose(np.asarray(got.dnorms),
-                               np.asarray(ref.dnorms), rtol=1e-3)
+                               np.asarray(ref.dnorms), rtol=1e-3,
+                               atol=1e-4)
     assert got.best_w.shape == (53, 3)
     assert got.best_h.shape == (3, 21)
-    np.testing.assert_allclose(np.asarray(got.best_w),
-                               np.asarray(ref.best_w), rtol=5e-3, atol=5e-4)
-    np.testing.assert_allclose(np.asarray(got.best_h),
-                               np.asarray(ref.best_h), rtol=5e-3, atol=5e-4)
+    # kl's factors stop at identical iterations (tight bound); the Gram
+    # family's may stop a few iterations apart (see above), so its factors
+    # differ by the drift of a near-converged trajectory, not by reduction
+    # noise — dnorms already pinned equivalent quality. Compare factors
+    # only when both sweeps crowned the SAME restart: on this fixture all
+    # Gram-family restarts sit at numerically-zero residuals, where
+    # reduction noise may legitimately swap the argmin winner (comparing
+    # two different random inits' factors would be meaningless)
+    ref_best = int(np.argmin(np.asarray(ref.dnorms)))
+    got_best = int(np.argmin(np.asarray(got.dnorms)))
+    if algorithm == "kl":
+        assert ref_best == got_best
+    if ref_best == got_best:
+        f_rtol, f_atol = (5e-3, 5e-4) if algorithm == "kl" else (3e-2, 3e-3)
+        np.testing.assert_allclose(np.asarray(got.best_w),
+                                   np.asarray(ref.best_w), rtol=f_rtol,
+                                   atol=f_atol)
+        np.testing.assert_allclose(np.asarray(got.best_h),
+                                   np.asarray(ref.best_h), rtol=f_rtol,
+                                   atol=f_atol)
 
 
 def test_kl_restart_chunk_composes_with_grid_mesh(low_rank_data):
