@@ -92,6 +92,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None,
                    help="persist per-rank results here and resume an "
                         "interrupted sweep from completed ranks")
+    p.add_argument("--keep-factors", action="store_true",
+                   help="retain every restart's (W, H) in the result "
+                        "(the reference registry's per-job retention); "
+                        "pairs with --save-result for offline "
+                        "restart-level analysis via nmfx.reduce_grid")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory: "
+                        "re-runs of the same (shape, config) skip the "
+                        "20-40 s first-compile (equivalent to setting "
+                        "JAX_COMPILATION_CACHE_DIR)")
     p.add_argument("--profile", action="store_true",
                    help="print a per-phase wall-clock breakdown (replaces "
                         "the reference's rebuild-to-instrument PROFILE_* "
@@ -115,6 +125,12 @@ def main(argv: list[str] | None = None) -> int:
 
         logging.basicConfig(format="%(message)s")
         logging.getLogger("nmfx").setLevel(logging.INFO)
+    if args.compile_cache:
+        # must precede the first compile; config-level set works even if
+        # jax was already imported (unlike the env var)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
     from nmfx.api import nmfconsensus  # deferred: keeps --help fast
 
     output = None
@@ -143,6 +159,11 @@ def main(argv: list[str] | None = None) -> int:
                          "--algorithm mu with --backend auto or packed, "
                          f"or one of {'/'.join(GRID_SOLVERS)}")
 
+        if args.keep_factors:
+            parser.error("--keep-factors is not supported with grid shards "
+                         "(gathering every restart's full factors would "
+                         "defeat the memory bound; use nmfx.restart_factors "
+                         "to recompute single restarts)")
         try:
             mesh = grid_mesh(None, args.feature_shards, args.sample_shards)
         except ValueError as e:
@@ -164,6 +185,7 @@ def main(argv: list[str] | None = None) -> int:
             mesh=mesh,
             use_mesh=not args.no_mesh,
             rank_selection=args.rank_selection,
+            keep_factors=args.keep_factors,
             output=output,
             checkpoint_dir=args.checkpoint_dir,
             profiler=profiler,
