@@ -36,5 +36,13 @@ if n_dev >= 4:
                                 seed=7, mesh=mesh)
     print("\n2x2 grid-sharded sweep (identical by construction):")
     print(result2.summary())
+
+    # 3) grid sharding is not mu-only: kl (whose per-restart m x n quotient
+    #    makes the tiling a memory necessity at scale) and the Gram-based
+    #    neals/snmf shard through the same psum placement
+    result3 = nmfx.nmfconsensus(a, ks=(2,), restarts=2 * n_dev, seed=7,
+                                algorithm="kl", max_iter=2000, mesh=mesh)
+    print("\nkl on the same grid mesh:")
+    print(result3.summary())
 else:
     print("\n(grid-sharding demo needs >= 4 devices; skipped)")
