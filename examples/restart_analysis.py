@@ -18,7 +18,6 @@ import numpy as np
 
 import nmfx
 from nmfx.datasets import two_group_matrix
-from nmfx.sweep import sweep
 
 KS = (2, 3)
 RESTARTS = 8
@@ -42,24 +41,21 @@ def main():
     print("recomputed restart 3 matches retained:",
           np.allclose(solo.w, r2.all_w[3], rtol=1e-5, atol=1e-6))
 
-    # 3. generic grid reductions over the raw sweep output
-    raw = sweep(a, nmfx.ConsensusConfig(ks=KS, restarts=RESTARTS, seed=SEED,
-                                        keep_factors=True),
-                nmfx.SolverConfig(max_iter=2000))
-    # the reference's own reduction (consensus per k) is the default fun
-    cons = nmfx.reduce_grid(raw)
+    # 3. generic grid reductions — directly on the result from step 1
+    # (reduce_grid also accepts raw nmfx.sweep.sweep output)
+    cons = nmfx.reduce_grid(result)  # default fun = reference's reduction
     print("reduce_grid consensus matches on-device:",
-          {k: bool(np.allclose(cons[k], np.asarray(raw[k].consensus),
+          {k: bool(np.allclose(cons[k], result.per_k[k].consensus,
                                atol=1e-6)) for k in KS})
     # a reduction the fixed pipeline can't express: per-k residual spread
     spread = nmfx.reduce_grid(
-        raw, lambda cells: (min(c.dnorm for c in cells),
-                            max(c.dnorm for c in cells)))
+        result, lambda cells: (min(c.dnorm for c in cells),
+                               max(c.dnorm for c in cells)))
     for k, (lo, hi) in spread.items():
         print(f"k={k}: residual range over restarts [{lo:.5f}, {hi:.5f}]")
     # transpose grouping: every rank's result for restart 0
     per_restart = nmfx.reduce_grid(
-        raw, lambda cells: [(c.k, c.iterations) for c in cells],
+        result, lambda cells: [(c.k, c.iterations) for c in cells],
         by="restart")
     print("restart 0 across ranks (k, iters):", per_restart[0])
 

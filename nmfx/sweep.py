@@ -13,7 +13,12 @@ from __future__ import annotations
 import logging
 import time
 from functools import lru_cache, partial
-from typing import Mapping, NamedTuple, Sequence
+from typing import TYPE_CHECKING, Mapping, NamedTuple, Sequence, Union
+
+if TYPE_CHECKING:  # api imports sweep; runtime import here would be a cycle
+    from nmfx.api import ConsensusResult
+
+    GridResults = Union[Mapping[int, "KSweepOutput"], "ConsensusResult"]
 
 import jax
 import jax.numpy as jnp
@@ -749,9 +754,14 @@ class RestartResult(NamedTuple):
     stop_reason: int
 
 
-def grid_cells(results: Mapping[int, KSweepOutput]) -> list[RestartResult]:
-    """Flatten a ``sweep(..., keep_factors=True)`` output into the (k ×
-    restart) grid of per-job results the reference's registry holds."""
+def grid_cells(results: "GridResults") -> list[RestartResult]:
+    """Flatten a ``keep_factors=True`` sweep into the (k × restart) grid of
+    per-job results the reference's registry holds. Accepts either the raw
+    ``sweep`` output (``{k: KSweepOutput}``) or a ``ConsensusResult`` from
+    ``nmfconsensus`` (its per-k records carry the same per-restart
+    fields)."""
+    if hasattr(results, "per_k"):  # ConsensusResult
+        results = results.per_k
     cells: list[RestartResult] = []
     for k in sorted(results):
         out = results[k]
@@ -772,7 +782,7 @@ def grid_cells(results: Mapping[int, KSweepOutput]) -> list[RestartResult]:
     return cells
 
 
-def reduce_grid(results: Mapping[int, KSweepOutput], fun=None,
+def reduce_grid(results: "GridResults", fun=None,
                 by: str = "k") -> dict[int, object]:
     """Generic axis-grouped reduction over the (k × restart) job grid — the
     reference's ``reduceGridBy`` (nmf.r:72-98), which groups job results by
@@ -784,10 +794,12 @@ def reduce_grid(results: Mapping[int, KSweepOutput], fun=None,
     ``by="k"``: ``fun`` receives all restarts at one rank (the reference's
     only actual use, ``by="k"`` with the consensus reduction, nmf.r:117);
     ``by="restart"``: the transpose grouping — one restart index across all
-    ranks (the reference's ``num.clusterings`` axis). Returns
-    ``{axis_value: fun(cells)}`` sorted by axis value. Host-side by design:
-    this is the flexibility hook for custom analyses; the performance path
-    is the on-device consensus reduction inside ``sweep_one_k``.
+    ranks (the reference's ``num.clusterings`` axis). ``results`` is the
+    raw ``sweep`` output or a ``ConsensusResult`` (see ``grid_cells``).
+    Returns ``{axis_value: fun(cells)}`` sorted by axis value. Host-side by
+    design: this is the flexibility hook for custom analyses; the
+    performance path is the on-device consensus reduction inside
+    ``sweep_one_k``.
     """
     if fun is None:
         fun = consensus_from_cells

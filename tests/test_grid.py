@@ -200,6 +200,22 @@ def test_restart_factors_bounds():
         restart_factors(np.ones((4, 4)), 2, 5, restarts=5)
 
 
+def test_reduce_grid_accepts_consensus_result(two_group_data):
+    """reduce_grid works directly on the high-level nmfconsensus result —
+    the object a keep_factors user actually holds."""
+    res = nmfconsensus(two_group_data, ks=KS, restarts=RESTARTS,
+                       solver_cfg=_cfg("packed"), keep_factors=True)
+    host = reduce_grid(res)  # default fun = reference consensus reduction
+    for k in KS:
+        np.testing.assert_allclose(host[k], res.per_k[k].consensus,
+                                   atol=1e-6)
+    # without retention the same call explains what to do
+    res2 = nmfconsensus(two_group_data, ks=(2,), restarts=2,
+                        solver_cfg=_cfg("packed"))
+    with pytest.raises(ValueError, match="keep_factors=True"):
+        reduce_grid(res2)
+
+
 def test_nmfconsensus_keep_factors_and_save_roundtrip(two_group_data,
                                                       tmp_path):
     res = nmfconsensus(two_group_data, ks=KS, restarts=RESTARTS,
