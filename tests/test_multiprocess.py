@@ -1,8 +1,8 @@
 """True multi-process distributed execution: two OS processes form one
-jax.distributed job (4 virtual CPU devices each -> 8 global), run the same
-SPMD consensus sweep, and must return identical replicated results with
-coordinator-only file writes — the cross-host contract documented in
-nmfx/distributed.py, which single-process mesh tests cannot exercise."""
+jax.distributed job, run the same SPMD consensus sweep, and must return
+identical replicated results with coordinator-only file writes — the
+cross-host contract documented in nmfx/distributed.py, which
+single-process mesh tests cannot exercise."""
 
 import json
 import os
@@ -42,6 +42,34 @@ _WORKER = textwrap.dedent("""
         json.dump(payload, f)
 """)
 
+_GRID_WORKER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    # 2 devices per process -> 4 global: a (1, 2, 2) grid mesh then puts
+    # the FEATURE axis across the two processes (jax.devices() is
+    # process-major), so the per-iteration feature psums genuinely cross
+    # the process boundary — the DCN analogue. (With 4 devices per
+    # process and a restart axis of 2, each factorization's grid would
+    # sit wholly inside one process and test nothing new.)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    coord, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    import nmfx.distributed as dist
+    dist.initialize(coordinator_address=coord, num_processes=2,
+                    process_id=pid)
+    assert len(jax.devices()) == 4
+    import numpy as np
+    from nmfx.datasets import two_group_matrix
+    a = two_group_matrix(n_genes=80, n_per_group=8, seed=1)
+    result = dist.consensus(
+        a, ks=(2,), restarts=4, seed=5, algorithm="kl", max_iter=150,
+        feature_shards=2, sample_shards=2)
+    payload = {"summary": result.summary(),
+               "consensus2": np.asarray(result.per_k[2].consensus).tolist()}
+    with open(os.path.join(outdir, f"grid{pid}.json"), "w") as f:
+        json.dump(payload, f)
+""")
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -49,9 +77,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_consensus(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+def _run_workers(worker_src: str, tmp_path, out_prefix: str):
+    """Launch two worker processes forming one jax.distributed job; return
+    their per-process JSON payloads."""
+    worker = tmp_path / f"{out_prefix}_worker.py"
+    worker.write_text(worker_src)
     coord = f"localhost:{_free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
                + os.environ.get("PYTHONPATH", ""))
@@ -69,8 +99,12 @@ def test_two_process_distributed_consensus(tmp_path):
         if p.returncode != 0:
             errs.append(e[-3000:])
     assert not errs, errs
-    r0 = json.loads((tmp_path / "proc0.json").read_text())
-    r1 = json.loads((tmp_path / "proc1.json").read_text())
+    return [json.loads((tmp_path / f"{out_prefix}{i}.json").read_text())
+            for i in range(2)]
+
+
+def test_two_process_distributed_consensus(tmp_path):
+    r0, r1 = _run_workers(_WORKER, tmp_path, "proc")
     # replicated-output contract: every host computes the identical result
     assert r0["summary"] == r1["summary"]
     assert r0["consensus2"] == r1["consensus2"]
@@ -80,3 +114,13 @@ def test_two_process_distributed_consensus(tmp_path):
     files = os.listdir(tmp_path / "files0")
     assert "cophenetic.txt" in files
     assert not (tmp_path / "files1").exists()
+
+
+def test_two_process_grid_axes(tmp_path):
+    """Feature-axis collectives spanning the process boundary: a (1, 2, 2)
+    grid mesh over two OS processes running the kl grid driver — every
+    iteration's feature psums cross processes."""
+    r0, r1 = _run_workers(_GRID_WORKER, tmp_path, "grid")
+    assert r0["summary"] == r1["summary"]
+    assert r0["consensus2"] == r1["consensus2"]
+    assert "best k = 2" in r0["summary"]
