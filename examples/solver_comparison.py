@@ -1,4 +1,4 @@
-"""Compare all seven solver families on one factorization problem.
+"""Compare all eight solver families on one factorization problem.
 
 Runs each algorithm on the same matrix/seed and reports the final RMS
 residual, iterations, and stop reason — the single-factorization API

@@ -3,7 +3,7 @@
 The public surface a user of the reference lands on:
 
 * ``nmf(...)``          ≈ one ``doNMF`` call (reference ``nmf.r:23-51``),
-  with all seven solvers wired instead of only mu (the reference's
+  with all eight solvers wired instead of only mu (the reference's
   five plus the BROAD original's Brunet ``kl`` rule and Kim & Park
   ``snmf``).
 * ``nmfconsensus(...)`` ≈ ``runNMFinJobs`` + ``computeConsensusAndSaveFiles``

@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence
 
-ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf")
+ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf", "hals")
 INIT_METHODS = ("random", "nndsvd")
 LINKAGE_METHODS = ("average", "complete", "single")
 

@@ -1,15 +1,15 @@
-"""NMF solver family — seven update rules sharing one while_loop driver.
+"""NMF solver family — eight update rules sharing one while_loop driver.
 
 TPU-native re-designs of the reference's five C solvers
 (reference ``libnmf/nmf_{mu,als,neals,pg,alspg}.c``) plus the BROAD
-original's Brunet divergence rule (``kl``) and Kim & Park sparse NMF
-(``snmf``): seven in all, each a pure ``step``
-function over arrays, jit-compiled into a ``lax.while_loop`` and vmappable
-over the restart axis.
+original's Brunet divergence rule (``kl``), Kim & Park sparse NMF
+(``snmf``), and Cichocki & Phan HALS (``hals``): eight in all, each a pure
+``step`` function over arrays, jit-compiled into a ``lax.while_loop`` and
+vmappable over the restart axis.
 """
 
 from nmfx.solvers.base import SolverResult, StopReason, solve
-from nmfx.solvers import als, alspg, kl, mu, neals, pg, snmf
+from nmfx.solvers import als, alspg, hals, kl, mu, neals, pg, snmf
 
 SOLVERS = {
     "mu": mu,
@@ -22,7 +22,9 @@ SOLVERS = {
     "kl": kl,
     # beyond the reference: Kim & Park sparse NMF (solvers/snmf.py)
     "snmf": snmf,
+    # beyond the reference: Cichocki & Phan HALS (solvers/hals.py)
+    "hals": hals,
 }
 
 __all__ = ["SOLVERS", "SolverResult", "StopReason", "solve", "mu", "als",
-           "neals", "pg", "alspg", "kl", "snmf"]
+           "neals", "pg", "alspg", "kl", "snmf", "hals"]

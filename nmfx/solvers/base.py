@@ -1,4 +1,4 @@
-"""Shared solver driver: one ``lax.while_loop`` for all seven update rules.
+"""Shared solver driver: one ``lax.while_loop`` for all eight update rules.
 
 The reference implements convergence control separately (and inconsistently)
 in each C solver; here every solver exposes
@@ -346,7 +346,7 @@ def solve(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
     Jittable and vmappable; the single-restart analogue of the reference's
     ``doNMF`` R→C bridge (reference ``nmf.r:23-51``), minus the process
-    boundary and with all seven solvers wired (the reference only wires mu —
+    boundary and with all eight solvers wired (the reference only wires mu —
     "calls to add: nmf_als, mu, neals, alspg, pg", nmf.r:40).
     """
     from nmfx.solvers import SOLVERS  # local import to avoid cycle

@@ -50,11 +50,11 @@ SAMPLE_AXIS = "samples"
 
 #: solvers whose updates shard over the feature/sample grid axes through
 #: the generic driver: their contracted terms psum along the tiled axes
-#: (kl's quotient contractions; neals'/snmf's normal-equation Grams). mu
-#: grids through its dedicated packed path; als/pg/alspg have lstsq /
-#: line-search structures with no collective formulation and stay
-#: restart-parallel only
-GRID_SOLVERS = ("kl", "neals", "snmf")
+#: (kl's quotient contractions; neals'/snmf's normal-equation Grams;
+#: hals' shared GEMM precomputations). mu grids through its dedicated
+#: packed path; als/pg/alspg have lstsq / line-search structures with no
+#: collective formulation and stay restart-parallel only
+GRID_SOLVERS = ("kl", "neals", "snmf", "hals")
 
 
 class KSweepOutput(NamedTuple):
@@ -383,8 +383,9 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
         # r_local times that. Rows/columns past the true dims (padding) are
         # zeroed and stay exactly zero by each grid solver's own argument —
         # multiplicative short-circuit for mu/kl, zero right-hand-side
-        # columns solving to zero for neals/snmf (their docstrings) — so
-        # they contribute nothing to the psummed contractions; any NEW grid
+        # columns solving to zero for neals/snmf, zero numerators and zero
+        # AXPY contributions for hals (their docstrings) — so they
+        # contribute nothing to the psummed contractions; any NEW grid
         # solver must establish the same invariant
         def init_one(kk):
             w0, h0 = random_init(kk, m_true, n_true, k, init_cfg, dtype)

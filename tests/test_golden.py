@@ -161,6 +161,53 @@ def test_nndsvd_matches_numpy_reference_math():
     np.testing.assert_allclose(np.asarray(h0), h_ref, rtol=5e-3, atol=5e-5)
 
 
+def _hals_numpy(a, w, h, iters, eps=1e-9):
+    """f64 transliteration of HALS (nmfx/solvers/hals.py; Cichocki & Phan
+    2009): coordinate-wise exact minimizations against fresh values, H
+    pass then W pass with the new H. Copies its factor inputs — unlike the
+    other oracles it updates rows/columns IN PLACE, and np.asarray aliases
+    f64 inputs (mutating the caller's w0/h0 would corrupt the
+    comparison)."""
+    a = np.asarray(a, np.float64)
+    w = np.array(w, np.float64, copy=True)
+    h = np.array(h, np.float64, copy=True)
+    k = w.shape[1]
+    for _ in range(iters):
+        wta, wtw = w.T @ a, w.T @ w
+        for j in range(k):
+            h[j] = np.maximum(
+                h[j] + (wta[j] - wtw[j] @ h) / (wtw[j, j] + eps), 0.0)
+        aht, hht = a @ h.T, h @ h.T
+        for j in range(k):
+            w[:, j] = np.maximum(
+                w[:, j] + (aht[:, j] - w @ hht[:, j]) / (hht[j, j] + eps),
+                0.0)
+    return w, h
+
+
+def test_hals_matches_numpy_reference_math():
+    a, w0, h0 = _problem(seed=23)
+    w_ref, h_ref = _hals_numpy(a, w0, h0, iters=10)
+    res = _run("hals", a, w0, h0, iters=10)
+    np.testing.assert_allclose(np.asarray(res.w), w_ref, rtol=5e-3,
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(res.h), h_ref, rtol=5e-3,
+                               atol=5e-4)
+
+
+def test_hals_monotone_loss():
+    """HALS' coordinate-wise exact minimizations never increase the
+    Frobenius objective."""
+    a, w0, h0 = _problem(seed=29)
+    prev = np.inf
+    for it in (2, 4, 6, 10, 16):
+        res = _run("hals", a, w0, h0, iters=it)
+        d = float(residual_norm(jnp.asarray(a, jnp.float32),
+                                res.w, res.h))
+        assert d <= prev + 1e-6, (it, d, prev)
+        prev = d
+
+
 def _kl_numpy(a, w, h, iters, eps=1e-9):
     """Brunet (2004) divergence updates in f64 — the BROAD nmfconsensus.R
     model family the reference replaced with Euclidean mu (see

@@ -180,6 +180,7 @@ def test_grid_sharded_matches_unsharded(low_rank_data, shape):
     # the Gram-based family shards through the same psum placement
     ("neals", (2, 2, 2)), ("neals", (1, 2, 4)),
     ("snmf", (2, 2, 2)), ("snmf", (2, 1, 4)),
+    ("hals", (2, 2, 2)), ("hals", (1, 2, 4)),
 ])
 def test_grid_solver_sharded_matches_unsharded(low_rank_data, algorithm,
                                                shape):

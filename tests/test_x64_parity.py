@@ -31,6 +31,7 @@ _CASES = [
     ("alspg", "_alspg_numpy", 5),
     ("kl", "_kl_numpy", 25),
     ("snmf", "_snmf_numpy", 10),
+    ("hals", "_hals_numpy", 12),
 ]
 
 _PRELUDE = f"""
