@@ -47,7 +47,11 @@ def _kl_model_flops(m: int, n: int, k: int) -> float:
     return 8.0 * m * n * k + 4.0 * m * n
 
 
-_MODEL_FLOPS = {"mu": _mu_model_flops, "kl": _kl_model_flops}
+#: hals' per-iteration FLOPs match mu's to leading order: the same two big
+#: GEMMs + two Grams, with the coordinate passes summing to the same
+#: 2k²(m+n) as mu's Gram-product terms (solvers/hals.py)
+_MODEL_FLOPS = {"mu": _mu_model_flops, "kl": _kl_model_flops,
+                "hals": _mu_model_flops}
 
 
 def main():
@@ -119,8 +123,9 @@ def main():
     its = {k: host[k][1] for k in ks}
     iters = {k: float(v.mean()) for k, v in its.items()}
 
-    # MFU accounting (mu and kl — the pg/alspg families' per-iteration
-    # FLOPs differ per line-search trial / subproblem and are not modeled):
+    # MFU accounting for the algorithms in _MODEL_FLOPS (the pg/alspg
+    # families' per-iteration FLOPs differ per line-search trial /
+    # subproblem and are not modeled):
     # model FLOPs = Σ_k Σ_restart iters · flops_per_iter(k), achieved rate
     # over the measured wall, utilization vs the devices' bf16 peak
     model_flops = mfu = achieved = None

@@ -328,8 +328,8 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     Gram pairs (see ``mu_packed``), kl's quotient contractions — the
     solver whose O(m·n) per-restart intermediate makes these axes a
     *necessity* at scale (``solvers/kl.py``; its quotient block is purely
-    local under this layout) — or neals'/snmf's normal-equation Grams
-    (``GRID_SOLVERS``). Labels are computed on local columns with the
+    local under this layout) — or the neals/snmf/hals Gram-family
+    contractions (``GRID_SOLVERS``). Labels are computed on local columns with the
     class-stability AND reduced by one tiny psum. The consensus reduction
     psums over the restart axis as in the 1-D path.
 
