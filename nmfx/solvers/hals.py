@@ -34,8 +34,6 @@ stop when enabled, like the other Gram-family solvers.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from nmfx.config import SolverConfig
 from nmfx.solvers import base
 
