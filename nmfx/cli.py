@@ -100,8 +100,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache directory: "
                         "re-runs of the same (shape, config) skip the "
-                        "20-40 s first-compile (equivalent to setting "
-                        "JAX_COMPILATION_CACHE_DIR)")
+                        "~10 s-per-rank first-compile (equivalent to "
+                        "setting JAX_COMPILATION_CACHE_DIR)")
     p.add_argument("--profile", action="store_true",
                    help="print a per-phase wall-clock breakdown (replaces "
                         "the reference's rebuild-to-instrument PROFILE_* "
