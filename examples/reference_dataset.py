@@ -6,11 +6,20 @@ The reference ships ``20+20x1000.gct`` (1000 genes × 40 samples, two
     python examples/reference_dataset.py path/to/data.gct
 """
 
+import os
 import sys
 
 import nmfx
 
-path = sys.argv[1] if len(sys.argv) > 1 else "20+20x1000.gct"
+_DEFAULTS = ("20+20x1000.gct", "/root/reference/20+20x1000.gct")
+if len(sys.argv) > 1:
+    path = sys.argv[1]
+else:
+    path = next((p for p in _DEFAULTS if os.path.exists(p)), None)
+    if path is None:
+        sys.exit("no GCT given and none of the default locations exist "
+                 f"({', '.join(_DEFAULTS)}); pass a path: "
+                 "python examples/reference_dataset.py data.gct")
 ds = nmfx.read_gct(path)
 print(f"{path}: {ds.values.shape[0]} genes x {ds.values.shape[1]} samples")
 
