@@ -240,7 +240,9 @@ def write_gct(
             # per-cell std::to_chars-equivalent formatting (_to_chars_double)
             # so the file bytes do not depend on whether the native library
             # is built (an earlier %.17g scheme printed 0.10000000000000001
-            # where the native path wrote 0.1)
+            # where the native path wrote 0.1). Orders of magnitude slower
+            # per value than the C codec — large writes want the native
+            # library (auto-built on import when a toolchain is present)
             for name, desc, row in zip(row_names, descriptions, vals):
                 cells = "\t".join(_to_chars_double(v) for v in row)
                 f.write(f"{name}\t{desc}\t{cells}\n")
