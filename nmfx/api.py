@@ -294,6 +294,7 @@ def nmfconsensus(
     use_mesh: bool = True,
     rank_selection: str = "host",
     keep_factors: bool = False,
+    grid_exec: str = "auto",
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
@@ -319,6 +320,13 @@ def nmfconsensus(
     (``all_w``/``all_h``) — the reference registry's per-job retention
     (nmf.r:50). Off by default; any single restart is also recomputable
     exactly via :func:`restart_factors`.
+
+    ``grid_exec``: how the (k × restart) grid executes —
+    ``ConsensusConfig.grid_exec``. The default "auto" solves ALL ranks in
+    one dense-batched compile when eligible (the reference's whole-grid
+    job-array concurrency, nmf.r:64-68); "per_k" forces the sequential
+    per-rank path; "grid" demands the whole-grid path (error when the
+    config can't run it).
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
@@ -340,7 +348,7 @@ def nmfconsensus(
             f"k={max(ks)} exceeds the number of samples ({n_samples})")
     ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
                            label_rule=label_rule, linkage=linkage,
-                           keep_factors=keep_factors)
+                           keep_factors=keep_factors, grid_exec=grid_exec)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
     if mesh is None and use_mesh:
         mesh = default_mesh()

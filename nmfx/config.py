@@ -216,6 +216,24 @@ class ConsensusConfig:
     #: the recompute-by-key route (``nmfx.restart_factors``) reconstructs
     #: any single restart exactly without retention
     keep_factors: bool = False
+    #: how the (k × restart) grid executes — the analogue of the
+    #: reference's whole-grid job array (every |k|·R job concurrent,
+    #: nmf.r:64-68). "grid" packs ALL ranks into one dense-batched solve
+    #: (nmfx.ops.grid_mu): ONE jit compile for the sweep and the chip
+    #: contracts over every grid cell at once; "per_k" runs ranks
+    #: sequentially, each through its own backend (one compile per rank).
+    #: "auto" picks "grid" when eligible — algorithm="mu" with the
+    #: packed-family backend, >1 rank to solve, no feature/sample mesh
+    #: axes — else "per_k". Results agree with per_k to float tolerance
+    #: (GEMM reduction orders differ between the layouts).
+    grid_exec: str = "auto"
+    #: slot-pool width of the whole-grid scheduler (nmfx.ops.sched_mu):
+    #: how many grid cells iterate concurrently per device; freed slots
+    #: reload queued jobs. Wall ≈ max(longest job, total-iters/slots) ×
+    #: per-iteration cost(slots) — 48 measured best at the north-star
+    #: sweep (450 jobs on one v5e chip); larger pools help only when the
+    #: grid is iteration-rich relative to its stragglers
+    grid_slots: int = 48
 
     def __post_init__(self):
         # dedupe preserving order: a duplicated rank would be solved twice
@@ -229,6 +247,12 @@ class ConsensusConfig:
             raise ValueError("restarts must be >= 1")
         if self.label_rule not in ("argmax", "argmin"):
             raise ValueError("label_rule must be 'argmax' or 'argmin'")
+        if self.grid_exec not in ("auto", "grid", "per_k"):
+            raise ValueError(
+                f"grid_exec must be 'auto', 'grid' or 'per_k', got "
+                f"{self.grid_exec!r}")
+        if self.grid_slots < 1:
+            raise ValueError("grid_slots must be >= 1")
         if self.linkage not in LINKAGE_METHODS:
             raise ValueError(
                 f"linkage must be one of {LINKAGE_METHODS}, got "

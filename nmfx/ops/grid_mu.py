@@ -1,0 +1,240 @@
+"""Whole-grid MU: every (k × restart) cell of the sweep in ONE solve.
+
+The reference expands the FULL (k × restart) grid into one job array and
+runs all |k|·R jobs concurrently with shuffled chunking (reference
+``nmf.r:64-68``, ``nmf.r:111``). The per-rank packed path
+(``nmfx.ops.packed_mu``) restored within-rank concurrency but still looped
+ranks sequentially: each rank was its own jit compile (~10 s × |k| ranks of
+cold start against a ~2 s execute) and at small k the chip ran 100-column
+GEMMs while the grid as a whole holds Σ R·k columns. This module lays the
+ENTIRE grid out as one dense zero-padded lane batch
+
+    W = (B, m, k_max)      B = |ks|·R lanes, rank-major
+    H = (B, k_max, n)
+
+so each iteration's two data contractions run over every grid cell at once:
+
+    numerh = einsum("bmk,mn->bkn", W, A)    — ONE (B·k_max, m)@(m, n) GEMM
+    numerw = einsum("mn,bkn->bmk", A, H)    — ONE (m, n)@(n, B·k_max) GEMM
+
+(A carries no batch dimension, so XLA folds the lane axis into the GEMM's
+free dimension — B·k_max MXU-dense columns where the sequential path had
+R·k.) The k×k Grams and their products stay exact batched (B, k, k) ops.
+
+Why dense padding instead of generalizing ``packed_mu``'s block-diagonal
+mask to variable-k blocks: the masked-Gram trick costs two (P, P) products
+per iteration, affordable while P = R·k stays small against m and n — but
+the whole grid's P = R·Σk (2700 at the north-star sweep) EXCEEDS n = 500,
+so the masked products would dominate the useful work ~5×. Dense batching
+computes only the true per-lane Grams and pays instead a
+|ks|·k_max/Σk FLOP overhead (≈1.67× at k=2..10) on the two data GEMMs —
+strictly cheaper at grid scale, and the padding is exactly invariant: a
+zero column of W / zero row of H has zero numerator, and the MU epilogue's
+exact-zero short-circuit (``solvers/mu.py``) keeps it zero forever, the
+same invariant the feature/sample grid sharding already relies on
+(``sweep.py``). Labels, Grams, residuals, and maxchange all ignore padding
+by construction (argmax never picks an all-zero row over a positive one;
+zero entries contribute nothing to products, sums, or |diffs|).
+
+Convergence bookkeeping is per-lane with freeze masks, shared with the
+packed path (``packed_mu.batch_convergence``) — identical semantics to the
+reference's class-stability rule with the documented TolX addition. The
+whole sweep is ONE jit compile and ONE ``lax.while_loop``; a converged
+lane's factors freeze while the batch runs on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nmfx.config import SolverConfig
+from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
+from nmfx.solvers import base
+from nmfx.solvers.mu import _mu_update
+
+
+class GridState(NamedTuple):
+    w: jax.Array  # (B, m, k_max)
+    h: jax.Array  # (B, k_max, n)
+    w_prev: jax.Array
+    h_prev: jax.Array
+    iteration: jax.Array  # () i32 — shared batch clock
+    classes: jax.Array  # (B, n) i32
+    stable: jax.Array  # (B,) i32
+    done: jax.Array  # (B,) bool
+    done_iter: jax.Array  # (B,) i32
+    stop_reason: jax.Array  # (B,) i32
+
+
+class GridMUResult(NamedTuple):
+    w: jax.Array  # (B, m, k_max) final factors, zero-padded past each k
+    h: jax.Array  # (B, k_max, n)
+    iterations: jax.Array  # (B,) i32
+    dnorm: jax.Array  # (B,) final RMS residual per lane (direct form)
+    stop_reason: jax.Array  # (B,) i32 StopReason
+
+
+def _labels(h: jax.Array) -> jax.Array:
+    """(B, k_max, n) → per-lane argmax labels (B, n). Padded rows are exact
+    zeros and loadings are non-negative, so they never beat a positive true
+    loading; an all-zero column labels 0 under any k."""
+    return jnp.argmax(h, axis=1).astype(jnp.int32)
+
+
+def mu_block(a, wp, hp, done_mask, cfg: SolverConfig):
+    """ONE dense-batched MU iteration: the six reference dgemms
+    (nmf_mu.c:174-216) as batched einsums whose lane axis folds into the
+    data GEMMs' free dimension; lanes under ``done_mask`` freeze (the
+    vmapped while_loop masks implicitly; here the lane axis lives inside
+    shared GEMMs, so explicitly). Shared by the fixed-batch (mu_grid) and
+    slot-scheduled (sched_mu) whole-grid drivers."""
+    if a.dtype == jnp.bfloat16:
+        # bandwidth-lean bf16 operand path (A pre-truncated by the caller):
+        # bit-identical to the f32-operand GEMMs under
+        # matmul_precision="bfloat16" (the MXU rounds operands to bf16
+        # either way) while halving the HBM bytes of the big reads — see
+        # packed_mu._step's identical branch for the measurement
+        f32 = hp.dtype
+        wb = wp.astype(jnp.bfloat16)
+        numerh = jnp.einsum("bmk,mn->bkn", wb, a,
+                            preferred_element_type=f32)
+        gw = jnp.einsum("bmk,bml->bkl", wb, wb,
+                        preferred_element_type=f32)
+        denomh = jnp.einsum("bkl,bln->bkn", gw, hp)
+        h = _mu_update(hp, numerh, denomh, cfg)
+
+        hb = h.astype(jnp.bfloat16)
+        gh = jnp.einsum("bkn,bln->bkl", hb, hb,
+                        preferred_element_type=f32)
+        numerw = jnp.einsum("mn,bkn->bmk", a, hb,
+                            preferred_element_type=f32)
+        denomw = jnp.einsum("bmk,bkl->bml", wp, gh)
+        w = _mu_update(wp, numerw, denomw, cfg)
+    else:
+        # H update (reference nmf_mu.c:174-191, batched over the whole grid)
+        numerh = jnp.einsum("bmk,mn->bkn", wp, a)
+        gw = jnp.einsum("bmk,bml->bkl", wp, wp)
+        denomh = jnp.einsum("bkl,bln->bkn", gw, hp)
+        h = _mu_update(hp, numerh, denomh, cfg)
+
+        # W update with the fresh H (reference order, nmf_mu.c:198-216)
+        gh = jnp.einsum("bkn,bln->bkl", h, h)
+        numerw = jnp.einsum("mn,bkn->bmk", a, h)
+        denomw = jnp.einsum("bmk,bkl->bml", wp, gh)
+        w = _mu_update(wp, numerw, denomw, cfg)
+
+    frozen = done_mask[:, None, None]
+    return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
+
+
+def _step(a, state: GridState, cfg: SolverConfig, check: bool) -> GridState:
+    w0, h0 = state.w, state.h
+    it = state.iteration + 1
+    w, h = mu_block(a, state.w, state.h, state.done, cfg)
+    state = state._replace(w=w, h=h, w_prev=w0, h_prev=h0, iteration=it)
+    if not check:
+        return state
+    return _check(state, cfg)
+
+
+def _check(state: GridState, cfg: SolverConfig) -> GridState:
+    """Per-lane convergence tests on the dense layout; the bookkeeping
+    semantics live in packed_mu.batch_convergence (shared with the packed
+    per-rank path)."""
+    delta = None
+    if cfg.use_tol_checks:
+        sqrteps = jnp.sqrt(jnp.finfo(state.w.dtype).eps)
+
+        def _delta(cur, prev):
+            diff = jnp.max(jnp.abs(cur - prev), axis=(1, 2))
+            ref = jnp.max(jnp.abs(prev), axis=(1, 2))
+            return diff / (sqrteps + ref)
+
+        delta = jnp.maximum(_delta(state.w, state.w_prev),
+                            _delta(state.h, state.h_prev))  # (B,)
+
+    classes, stable, done, done_iter, reason = batch_convergence(
+        cfg, state.iteration, new_classes=_labels(state.h), delta=delta,
+        n_glob=state.h.shape[2], classes=state.classes, stable=state.stable,
+        done=state.done, done_iter=state.done_iter,
+        stop_reason=state.stop_reason)
+    return state._replace(classes=classes, stable=stable, done=done,
+                          done_iter=done_iter, stop_reason=reason)
+
+
+@partial(jax.jit, static_argnames=("cfg", "varying_axes"))
+def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
+            cfg: SolverConfig = SolverConfig(),
+            varying_axes: tuple[str, ...] = ()) -> GridMUResult:
+    """Solve a dense zero-padded lane batch (every grid cell, any mix of
+    ranks) with shared-GEMM iterations.
+
+    Semantically equivalent to running ``mu_packed`` per rank on the same
+    initial factors (same update rule, same convergence tests, same
+    freeze-on-convergence), restructured so the whole (k × restart) grid is
+    one compile and one while_loop. ``varying_axes`` as in ``mu_packed``:
+    inside ``shard_map`` over those mesh axes the constant-initialized
+    carry components must be lifted to device-varying.
+    """
+    if cfg.algorithm != "mu":
+        raise ValueError("mu_grid only implements the mu algorithm")
+    dtype = jnp.dtype(cfg.dtype)
+    a = jnp.asarray(a, dtype)
+    w0 = jnp.asarray(w0, dtype)
+    h0 = jnp.asarray(h0, dtype)
+    b, _, n = h0.shape
+    a_true = a  # full precision, for the final residuals
+    with base.matmul_precision_ctx(cfg.matmul_precision):
+
+        def vary(x):
+            for ax in varying_axes:
+                x = lax.pcast(x, ax, to="varying")
+            return x
+
+        state0 = GridState(
+            w=w0, h=h0, w_prev=w0, h_prev=h0,
+            iteration=jnp.zeros((), jnp.int32),
+            classes=vary(jnp.full((b, n), -1, jnp.int32)),
+            stable=vary(jnp.zeros((b,), jnp.int32)),
+            done=vary(jnp.zeros((b,), bool)),
+            done_iter=vary(jnp.zeros((b,), jnp.int32)),
+            stop_reason=vary(jnp.full((b,), base.StopReason.MAX_ITER,
+                                      jnp.int32)),
+        )
+        a_loop = a
+        if (cfg.matmul_precision == "bfloat16" and dtype == jnp.float32
+                and jax.default_backend() == "tpu"):
+            # one-time truncation: every loop GEMM reads A in the exact
+            # bf16 form the MXU would round it to anyway (TPU-only: other
+            # backends ignore the precision hint and run full-f32 GEMMs,
+            # so truncating there would change results)
+            a_loop = a.astype(jnp.bfloat16)
+        step = partial(_step, a_loop)
+
+        def cond(s: GridState):
+            return jnp.any(~s.done) & (s.iteration + cfg.check_every
+                                       <= cfg.max_iter)
+
+        def body(s: GridState):
+            for i in range(cfg.check_every):
+                s = step(s, cfg, check=(i == cfg.check_every - 1))
+            return s
+
+        final = lax.while_loop(cond, body, state0)
+
+        def tail_cond(s: GridState):
+            return jnp.any(~s.done) & (s.iteration < cfg.max_iter)
+
+        final = lax.while_loop(tail_cond, lambda s: step(s, cfg, True),
+                               final)
+
+        iterations = jnp.where(final.done, final.done_iter, final.iteration)
+        dnorm = residual_norms_direct(a_true, final.w, final.h)
+    return GridMUResult(w=final.w, h=final.h,
+                        iterations=iterations.astype(jnp.int32),
+                        dnorm=dnorm, stop_reason=final.stop_reason)

@@ -149,10 +149,107 @@ def residual_norms(a: jax.Array, wp: jax.Array, hp: jax.Array, r: int,
     return jnp.sqrt(sq / (m * n))
 
 
+def residual_norms_direct(a: jax.Array, w: jax.Array, h: jax.Array,
+                          chunk: int = 8,
+                          feature_axis: str | None = None,
+                          m_total: int | None = None,
+                          sample_axis: str | None = None,
+                          n_total: int | None = None) -> jax.Array:
+    """Per-lane RMS residual ‖A − WᵦHᵦ‖_F/√(mn) from dense (B, m, k) /
+    (B, k, n) factor stacks, computed the DIRECT way — fused subtract-square
+    reduction over chunks of lanes, never more than ``chunk`` m×n
+    reconstructions live at once.
+
+    This is the end-of-solve form: the in-loop Gram-trace identity
+    (:func:`residual_norms`) subtracts numbers ~‖A‖²/‖A−WH‖² larger than the
+    result, so its relative error grows without bound as convergence
+    tightens (at dnorm/‖A‖ ~ √eps the identity returns pure cancellation
+    noise, hidden by its clamp). The direct form costs one reconstruction
+    per lane — half a mu iteration — and runs once per solve, as the
+    reference does in f64 (``libnmf/calculatenorm.c:44-78``). Zero-padded
+    trailing k-columns/rows contribute exact zeros. Under
+    ``feature_axis``/``sample_axis`` the local square-sums psum over the
+    grid axes and the RMS normalizer uses the unsharded dims."""
+    b, m, _ = w.shape
+    n = h.shape[2]
+    nb = -(-b // chunk)
+    pad = nb * chunk - b
+    if pad:
+        w = jnp.pad(w, ((0, pad), (0, 0), (0, 0)))
+        h = jnp.pad(h, ((0, pad), (0, 0), (0, 0)))
+
+    def body(_, wh):
+        wc, hc = wh
+        d = a[None] - jnp.einsum("cmk,ckn->cmn", wc, hc)
+        return _, jnp.sum(d * d, axis=(1, 2))
+
+    _, sq = lax.scan(body, None,
+                     (w.reshape(nb, chunk, *w.shape[1:]),
+                      h.reshape(nb, chunk, *h.shape[1:])))
+    sq = sq.reshape(-1)[:b]
+    if feature_axis is not None:
+        if m_total is None:
+            raise ValueError("residual_norms_direct with feature_axis "
+                             "needs m_total (the unsharded row count)")
+        sq = lax.psum(sq, feature_axis)
+        m = m_total
+    if sample_axis is not None:
+        if n_total is None:
+            raise ValueError("residual_norms_direct with sample_axis "
+                             "needs n_total (the unsharded column count)")
+        sq = lax.psum(sq, sample_axis)
+        n = n_total
+    return jnp.sqrt(jnp.maximum(sq, 0.0) / (m * n))
+
+
 def _labels(hp: jax.Array, r: int) -> jax.Array:
     """(R·k, n) → per-restart argmax labels (R, n)."""
     n = hp.shape[1]
     return jnp.argmax(hp.reshape(r, -1, n), axis=1).astype(jnp.int32)
+
+
+def batch_convergence(cfg: SolverConfig, it, *, new_classes, delta, n_glob,
+                      classes, stable, done, done_iter, stop_reason,
+                      mism_reduce=None):
+    """(B,)-batched convergence bookkeeping shared by the packed and
+    whole-grid formulations: the noise-tolerant class-stability snapshot
+    rule plus the TolX test, with per-lane freeze flags — mirroring
+    ``base.check_convergence``'s scalar semantics exactly (see
+    ``SolverConfig.class_flip_tol``; reference rule ``nmf_mu.c:253-282``).
+
+    ``new_classes`` (B, n_local) are this check's labels; ``delta`` the
+    caller's per-lane maxchange ratio, precomputed because its reductions
+    are layout- and sharding-specific (or None when ``use_tol_checks`` is
+    off); ``mism_reduce`` psums label mismatches when labels are
+    column-sharded. Returns the five updated bookkeeping arrays."""
+    is_check = (it > 1) & (it % cfg.check_every == 0)
+    active = is_check & (~done)
+    done_in = done
+    reason = stop_reason
+
+    if cfg.use_class_stop:
+        # +eps before flooring: 0.3 * 10 is 2.999... in binary float and
+        # int() would land one flip below the documented floor(tol * n)
+        flip_tol = int(cfg.class_flip_tol * n_glob + 1e-9)
+        mism = jnp.sum((new_classes != classes).astype(jnp.int32), axis=1)
+        if mism_reduce is not None:
+            mism = mism_reduce(mism)
+        same = mism <= flip_tol
+        stable = jnp.where(active, jnp.where(same, stable + 1, 0), stable)
+        reset = active & ~same
+        classes = jnp.where(reset[:, None], new_classes, classes)
+        hit = active & (stable >= cfg.stable_checks)
+        done = done | hit
+        reason = jnp.where(hit, base.StopReason.CLASS_STABLE, reason)
+
+    if cfg.use_tol_checks:
+        hit = active & (delta < cfg.tol_x) & ~done
+        done = done | hit
+        reason = jnp.where(hit, base.StopReason.TOL_X, reason)
+
+    newly = done & ~done_in
+    done_iter = jnp.where(newly, it, done_iter)
+    return classes, stable, done, done_iter, reason
 
 
 def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
@@ -249,47 +346,30 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
     bookkeeping instead of vmapped scalars."""
     it = state.iteration
     k = state.hp.shape[0] // r
-    is_check = (it > 1) & (it % cfg.check_every == 0)
-    active = is_check & (~state.done)
 
-    done = state.done
-    reason = state.stop_reason
-    classes, stable = state.classes, state.stable
+    # noise-tolerant snapshot rule (see base.check_convergence and
+    # SolverConfig.class_flip_tol): mismatches are counted against a held
+    # reference labeling that only updates on reset, so bounded label
+    # oscillation passes while genuine drift accumulates and resets.
+    # flip_tol=0 is bit-identical to the reference's consecutive-check
+    # rule (nmf_mu.c:253-282). Bookkeeping shared with the whole-grid
+    # formulation via batch_convergence; only the labels and the maxchange
+    # reductions are packed-layout-specific.
+    new_classes = _labels(state.hp, r)
+    if sample_axis is not None:
+        if n_total is None:
+            raise ValueError(
+                "class-stability check with sample_axis needs n_total "
+                "(the unsharded column count); the local shard width "
+                "would make the flip tolerance ~#shards too strict")
+        n_glob = n_total
+        # labels are column shards: the mismatch count is a global sum
+        mism_reduce = partial(lax.psum, axis_name=sample_axis)
+    else:
+        n_glob = state.hp.shape[1]
+        mism_reduce = None
 
-    if cfg.use_class_stop:
-        # noise-tolerant snapshot rule (see base.check_convergence and
-        # SolverConfig.class_flip_tol): mismatches are counted against a held
-        # reference labeling that only updates on reset, so bounded label
-        # oscillation passes while genuine drift accumulates and resets.
-        # flip_tol=0 is bit-identical to the reference's consecutive-check
-        # rule (nmf_mu.c:253-282).
-        new_classes = _labels(state.hp, r)
-        if sample_axis is not None:
-            if n_total is None:
-                raise ValueError(
-                    "class-stability check with sample_axis needs n_total "
-                    "(the unsharded column count); the local shard width "
-                    "would make the flip tolerance ~#shards too strict")
-            n_glob = n_total
-        else:
-            n_glob = state.hp.shape[1]
-        # +eps before flooring: 0.3 * 10 is 2.999... in binary float and
-        # int() would land one flip below the documented floor(tol * n)
-        flip_tol = int(cfg.class_flip_tol * n_glob + 1e-9)
-        mism = jnp.sum((new_classes != state.classes).astype(jnp.int32),
-                       axis=1)  # (R,)
-        if sample_axis is not None:
-            # labels are column shards: the mismatch count is a global sum
-            mism = lax.psum(mism, sample_axis)
-        same = mism <= flip_tol
-        stable = jnp.where(active, jnp.where(same, state.stable + 1, 0),
-                           state.stable)
-        reset = active & ~same
-        classes = jnp.where(reset[:, None], new_classes, state.classes)
-        hit = active & (stable >= cfg.stable_checks)
-        done = done | hit
-        reason = jnp.where(hit, base.StopReason.CLASS_STABLE, reason)
-
+    delta = None
     if cfg.use_tol_checks:
         sqrteps = jnp.sqrt(jnp.finfo(state.wp.dtype).eps)
 
@@ -297,9 +377,6 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
             diff = jnp.max(jnp.abs(cur - prev).reshape(shape), axis=axes)
             ref = jnp.max(jnp.abs(prev).reshape(shape), axis=axes)
             return diff / (sqrteps + ref)
-
-        m = state.wp.shape[0]
-        n = state.hp.shape[1]
 
         def _delta_sharded(cur, prev, axes, shape, mesh_axis):
             # sharded maxchange is a ratio of *global* maxima: pmax the
@@ -310,6 +387,8 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
                            mesh_axis)
             return diff / (sqrteps + ref)
 
+        m = state.wp.shape[0]
+        n = state.hp.shape[1]
         if feature_axis is None:
             dw = _delta(state.wp, state.wp_prev, (0, 2), (m, r, k))
         else:
@@ -321,12 +400,12 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
             dh = _delta_sharded(state.hp, state.hp_prev, (1, 2), (r, k, n),
                                 sample_axis)
         delta = jnp.maximum(dw, dh)  # (R,)
-        hit = active & (delta < cfg.tol_x) & ~done
-        done = done | hit
-        reason = jnp.where(hit, base.StopReason.TOL_X, reason)
 
-    newly = done & ~state.done
-    done_iter = jnp.where(newly, it, state.done_iter)
+    classes, stable, done, done_iter, reason = batch_convergence(
+        cfg, it, new_classes=new_classes, delta=delta, n_glob=n_glob,
+        classes=state.classes, stable=state.stable, done=state.done,
+        done_iter=state.done_iter, stop_reason=state.stop_reason,
+        mism_reduce=mism_reduce)
     return state._replace(classes=classes, stable=stable, done=done,
                           done_iter=done_iter, stop_reason=reason)
 
@@ -450,9 +529,16 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
 
         iterations = jnp.where(final.done, final.done_iter, final.iteration)
         wp_final = final.wp[:m]  # drop pallas m-padding rows, if any
-        dnorm = residual_norms(a_true, wp_final, final.hp, r,
-                               feature_axis=feature_axis, m_total=m_total,
-                               sample_axis=sample_axis, n_total=n_total)
+        # final residuals the DIRECT way (reference f64 calculateNorm,
+        # libnmf/calculatenorm.c:44-78): the Gram-trace identity loses all
+        # precision to cancellation at tight convergence, and this number
+        # picks the best restart and lands in rank_metrics.txt. One
+        # reconstruction per restart, chunked — half an iteration's FLOPs,
+        # once per solve.
+        dnorm = residual_norms_direct(
+            a_true, unpack_w(wp_final, r), final.hp.reshape(r, k, n),
+            feature_axis=feature_axis, m_total=m_total,
+            sample_axis=sample_axis, n_total=n_total)
     return PackedMUResult(wp=wp_final, hp=final.hp,
                           iterations=iterations.astype(jnp.int32),
                           dnorm=dnorm, stop_reason=final.stop_reason)

@@ -1,0 +1,208 @@
+"""Slot-scheduled whole-grid MU: a work-conserving job scheduler inside one
+``lax.while_loop``.
+
+The reference's execution model is a job array: all |k|·R (k, restart) jobs
+queued at once, chunk-shuffled over a fixed pool of workers that pick up new
+jobs as they finish (reference ``nmf.r:64-68``, ``nmf.r:111-113``). This
+module is that model made TPU-native, with the worker pool as a *static
+batch dimension*:
+
+* S **slots** (default 48 — ``ConsensusConfig.grid_slots`` is the
+  authoritative knob; the sweep always passes it) form a dense zero-padded
+  factor batch
+  ``(S, m, k_max)`` / ``(S, k_max, n)`` — each slot hosts ONE job's
+  factorization, iterated with the shared-GEMM step of ``grid_mu``.
+* When a slot's job converges (the reference class-stability rule + TolX,
+  via ``packed_mu.batch_convergence``), its factors scatter into per-job
+  result buffers and the slot **reloads the next queued job's** W0/H0 in
+  place — all static-shape gathers/scatters inside the loop carry.
+* Jobs are fed **longest-expected-first** (rank-descending — iteration
+  counts grow with k), the classic LPT schedule: stragglers start early and
+  overlap the bulk, short jobs drain the tail.
+
+Why this shape: a plain whole-grid batch (``grid_mu``) holds every lane
+until the LAST lane converges, so the measured wall is
+``global_max_iters × c(full width)`` — at the north-star sweep ~7200
+straggler iterations × the 450-lane iteration cost, ~4× worse than the
+sequential per-rank path. The slot pool keeps the running width at S
+always-busy lanes instead: total wall ≈
+``max(longest job, total lane-iters / S) × c(S)``, minimized near S = 48
+at the north-star sweep (measured 1.41 s vs 1.63 s at 64, 8.35 s for the
+fixed 450-lane batch) — while still being ONE compile
+for the entire sweep (the per-k path pays one ~10 s compile per rank) and
+keeping every GEMM at MXU-dense width. Per-job trajectories are
+bit-identical to the fixed-batch path (each slot's updates read only its
+own lane of the batched GEMMs); only scheduling changes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nmfx.config import SolverConfig
+from nmfx.ops.grid_mu import mu_block
+from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
+from nmfx.solvers import base
+
+
+class SchedState(NamedTuple):
+    # slot-resident solver state (no cross-block w_prev/h_prev: the TolX
+    # delta is between the block's last two steps, both inside `body`)
+    wp: jax.Array  # (S, m, k_max)
+    hp: jax.Array  # (S, k_max, n)
+    slot_iter: jax.Array  # (S,) i32 — iterations completed by the slot's job
+    classes: jax.Array  # (S, n) i32
+    stable: jax.Array  # (S,) i32
+    # scheduler state
+    slot_job: jax.Array  # (S,) i32 — job index resident in each slot
+    active: jax.Array  # (S,) bool — slot holds a live job
+    queue: jax.Array  # () i32 — next job index to load
+    # per-job result buffers (scatter-once at eviction)
+    out_w: jax.Array  # (J+1, m, k_max) — row J is the drop target
+    out_h: jax.Array  # (J+1, k_max, n)
+    out_iters: jax.Array  # (J+1,) i32
+    out_stop: jax.Array  # (J+1,) i32
+
+
+class SchedMUResult(NamedTuple):
+    w: jax.Array  # (J, m, k_max) final factors per job, zero-padded
+    h: jax.Array  # (J, k_max, n)
+    iterations: jax.Array  # (J,) i32
+    dnorm: jax.Array  # (J,) final RMS residual (direct form)
+    stop_reason: jax.Array  # (J,) i32 StopReason
+
+
+@partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes"))
+def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
+             cfg: SolverConfig = SolverConfig(),
+             slots: int = 48,
+             varying_axes: tuple[str, ...] = ()) -> SchedMUResult:
+    """Solve J dense zero-padded jobs through an S-slot scheduler.
+
+    ``w0``/``h0``: (J, m, k_max) / (J, k_max, n) initial factors, in the
+    order jobs should be DISPATCHED (callers pass rank-descending for LPT;
+    results come back indexed by the same job order). Semantically
+    equivalent to solving each job independently (the per-k paths); only
+    the schedule differs. ``cfg.max_iter`` should be a multiple of
+    ``cfg.check_every`` (the CLI default 10000/2 is): a non-multiple cap
+    lands on the next check boundary, where the cap is enforced by
+    freezing, so at most check_every-1 trailing iterations are skipped
+    relative to the generic driver's tail loop.
+
+    ``varying_axes`` as in ``mu_packed``: inside ``shard_map`` over those
+    mesh axes the constant-initialized carry components must be lifted to
+    device-varying. The loop body has NO collectives, so each device runs
+    its own queue at its own pace and exits independently — per-device
+    work-conserving schedules over the device's job shard.
+    """
+    if cfg.algorithm != "mu":
+        raise ValueError("mu_sched only implements the mu algorithm")
+    dtype = jnp.dtype(cfg.dtype)
+    a = jnp.asarray(a, dtype)
+    w0 = jnp.asarray(w0, dtype)
+    h0 = jnp.asarray(h0, dtype)
+    j, _, k_max = w0.shape
+    n = h0.shape[2]
+    s = min(slots, j)
+    ce = cfg.check_every
+
+    with base.matmul_precision_ctx(cfg.matmul_precision):
+        a_loop = a
+        if (cfg.matmul_precision == "bfloat16" and dtype == jnp.float32
+                and jax.default_backend() == "tpu"):
+            # same one-time operand truncation as grid_mu/packed_mu
+            a_loop = a.astype(jnp.bfloat16)
+
+        def vary(x):
+            for ax in varying_axes:
+                x = lax.pcast(x, ax, to="varying")
+            return x
+
+        state0 = SchedState(
+            wp=w0[:s], hp=h0[:s],
+            slot_iter=vary(jnp.zeros((s,), jnp.int32)),
+            classes=vary(jnp.full((s, n), -1, jnp.int32)),
+            stable=vary(jnp.zeros((s,), jnp.int32)),
+            slot_job=vary(jnp.arange(s, dtype=jnp.int32)),
+            active=vary(jnp.ones((s,), bool)),
+            queue=vary(jnp.asarray(s, jnp.int32)),
+            out_w=vary(jnp.zeros((j + 1, w0.shape[1], k_max), dtype)),
+            out_h=vary(jnp.zeros((j + 1, k_max, n), dtype)),
+            out_iters=vary(jnp.zeros((j + 1,), jnp.int32)),
+            out_stop=vary(jnp.full((j + 1,), base.StopReason.MAX_ITER,
+                                   jnp.int32)),
+        )
+
+        def body(st: SchedState) -> SchedState:
+            # --- check_every MU iterations, per-slot max_iter fencing ---
+            wp, hp = st.wp, st.hp
+            for i in range(ce):
+                frozen = ~st.active | (st.slot_iter + i >= cfg.max_iter)
+                if i == ce - 1:
+                    wprev, hprev = wp, hp  # for TolX at the block's check
+                wp, hp = mu_block(a_loop, wp, hp, frozen, cfg)
+            it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
+
+            # --- convergence check (shared bookkeeping; vector `it`) ---
+            delta = None
+            if cfg.use_tol_checks:
+                sqrteps = jnp.sqrt(jnp.finfo(wp.dtype).eps)
+
+                def _d(cur, prev):
+                    diff = jnp.max(jnp.abs(cur - prev), axis=(1, 2))
+                    ref = jnp.max(jnp.abs(prev), axis=(1, 2))
+                    return diff / (sqrteps + ref)
+
+                delta = jnp.maximum(_d(wp, wprev), _d(hp, hprev))
+            new_classes = jnp.argmax(hp, axis=1).astype(jnp.int32)
+            classes, stable, conv, _, reason = batch_convergence(
+                cfg, it_new, new_classes=new_classes, delta=delta,
+                n_glob=n, classes=st.classes, stable=st.stable,
+                done=~st.active, done_iter=jnp.zeros_like(st.slot_iter),
+                stop_reason=jnp.full((s,), base.StopReason.MAX_ITER,
+                                     jnp.int32))
+            # conv folds in ~active (passed as `done`); isolate fresh stops
+            finished = st.active & (conv | (it_new >= cfg.max_iter))
+
+            # --- evict finished jobs into the result buffers ---
+            idx = jnp.where(finished, st.slot_job, j)  # j = drop row
+            out_w = st.out_w.at[idx].set(wp)
+            out_h = st.out_h.at[idx].set(hp)
+            out_iters = st.out_iters.at[idx].set(it_new)
+            out_stop = st.out_stop.at[idx].set(reason)
+
+            # --- reload freed slots from the queue (prefix-sum claim) ---
+            claim = jnp.cumsum(finished.astype(jnp.int32))
+            new_job = st.queue + claim - 1
+            load = finished & (new_job < j)
+            gather = jnp.where(load, new_job, st.slot_job)
+            ld = load[:, None, None]
+            wp = jnp.where(ld, w0[gather], wp)
+            hp = jnp.where(ld, h0[gather], hp)
+            fresh_or_done = finished
+            return SchedState(
+                wp=wp, hp=hp,
+                slot_iter=jnp.where(fresh_or_done, 0, it_new),
+                classes=jnp.where(fresh_or_done[:, None], -1, classes),
+                stable=jnp.where(fresh_or_done, 0, stable),
+                slot_job=jnp.where(load, new_job,
+                                   jnp.where(finished, j, st.slot_job)),
+                active=jnp.where(finished, load, st.active),
+                queue=st.queue + jnp.sum(load.astype(jnp.int32)),
+                out_w=out_w, out_h=out_h, out_iters=out_iters,
+                out_stop=out_stop,
+            )
+
+        final = lax.while_loop(lambda st: jnp.any(st.active), body, state0)
+        out_w = final.out_w[:j]
+        out_h = final.out_h[:j]
+        # exact final residuals, once, from the retained per-job factors
+        dnorm = residual_norms_direct(a, out_w, out_h)
+    return SchedMUResult(w=out_w, h=out_h,
+                         iterations=final.out_iters[:j],
+                         dnorm=dnorm, stop_reason=final.out_stop[:j])

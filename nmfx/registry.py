@@ -58,6 +58,10 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     ``restart_chunk`` is excluded entirely: chunked and unchunked sweeps
     are bit-identical by construction (prefix-stable PRNG keys; see
     tests/test_solvers.py::test_restart_chunking_matches_unchunked).
+    ``ConsensusConfig.grid_exec`` and the mesh shape are likewise excluded:
+    whole-grid vs per-k execution (and different device meshes) reorder
+    GEMM reductions but solve the same factorizations from the same keys —
+    equivalent within float tolerance, like resuming on different hardware.
     """
     from nmfx.sweep import _use_packed
 

@@ -200,6 +200,44 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     return jax.jit(impl)
 
 
+def _sharded_rank_output(k: int, labels, iters, dnorm, stops, wk, hk,
+                         valid, restarts: int,
+                         keep_factors: bool) -> KSweepOutput:
+    """Replicated KSweepOutput for ONE rank from restart-sharded per-lane
+    results (inside ``shard_map`` over RESTART_AXIS) — shared epilogue of
+    the packed per-k and whole-grid builders. ``valid`` masks this shard's
+    padding lanes. Masked one-hot consensus reduction: invalid lanes
+    contribute 0 and one psum over ICI yields the replicated n×n mean
+    connectivity; per-restart stats gather the padded axis (pad sliced off
+    after); best restart = local argmin candidate per shard, then a tiny
+    gathered argmin across shards."""
+    onehot = (jax.nn.one_hot(labels, k, dtype=jnp.float32)
+              * valid[:, None, None])
+    cons = lax.psum(jnp.einsum("rik,rjk->ij", onehot, onehot),
+                    RESTART_AXIS) / restarts
+    iters_g = lax.all_gather(iters, RESTART_AXIS, tiled=True)
+    dnorm_g = lax.all_gather(dnorm, RESTART_AXIS, tiled=True)
+    stop_g = lax.all_gather(stops, RESTART_AXIS, tiled=True)
+    labels_g = lax.all_gather(labels, RESTART_AXIS, tiled=True)
+    masked = jnp.where(valid, dnorm, jnp.inf)
+    best = jnp.argmin(masked)
+    bws = lax.all_gather(wk[best], RESTART_AXIS)
+    bhs = lax.all_gather(hk[best], RESTART_AXIS)
+    bds = lax.all_gather(masked[best], RESTART_AXIS)
+    gbest = jnp.argmin(bds)
+    extra = (None, None)
+    if keep_factors:
+        # every restart's factors, replicated on each device — fine at
+        # restart-mesh scale (factors are small); grid meshes refuse
+        # keep_factors upstream precisely because this gather would
+        # defeat their memory bound
+        extra = (lax.all_gather(wk, RESTART_AXIS, tiled=True)[:restarts],
+                 lax.all_gather(hk, RESTART_AXIS, tiled=True)[:restarts])
+    return KSweepOutput(cons, iters_g[:restarts], dnorm_g[:restarts],
+                        stop_g[:restarts], labels_g[:restarts],
+                        bws[gbest], bhs[gbest], *extra)
+
+
 def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                            init_cfg: InitConfig, label_rule: str,
                            mesh: Mesh | None, keep_factors: bool = False):
@@ -263,37 +301,10 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         gidx = (lax.axis_index(RESTART_AXIS) * r_local
                 + jnp.arange(r_local))
         valid = gidx < restarts
-        # masked consensus reduction: invalid (padding) lanes contribute 0,
-        # one psum over ICI yields the replicated n×n mean connectivity
-        onehot = (jax.nn.one_hot(labels, k, dtype=jnp.float32)
-                  * valid[:, None, None])
-        cons = lax.psum(jnp.einsum("rik,rjk->ij", onehot, onehot),
-                        RESTART_AXIS) / restarts
-        # per-restart stats: gather the padded axis, slice the pad off later
-        iters_g = lax.all_gather(res.iterations, RESTART_AXIS, tiled=True)
-        dnorm_g = lax.all_gather(res.dnorm, RESTART_AXIS, tiled=True)
-        stop_g = lax.all_gather(res.stop_reason, RESTART_AXIS, tiled=True)
-        labels_g = lax.all_gather(labels, RESTART_AXIS, tiled=True)
-        # best restart: local candidate per shard, then a tiny gathered argmin
-        bw, bh, bd = _best(res, hs, jnp.where(valid, res.dnorm, jnp.inf),
-                           r_local)
-        bws = lax.all_gather(bw, RESTART_AXIS)
-        bhs = lax.all_gather(bh, RESTART_AXIS)
-        bds = lax.all_gather(bd, RESTART_AXIS)
-        gbest = jnp.argmin(bds)
-        extra = (None, None)
-        if keep_factors:
-            # every restart's factors, replicated on each device — fine at
-            # restart-mesh scale (factors are small); grid meshes refuse
-            # keep_factors upstream precisely because this gather would
-            # defeat their memory bound
-            extra = (
-                lax.all_gather(unpack_w(res.wp, r_local), RESTART_AXIS,
-                               tiled=True)[:restarts],
-                lax.all_gather(hs, RESTART_AXIS, tiled=True)[:restarts])
-        return KSweepOutput(cons, iters_g[:restarts], dnorm_g[:restarts],
-                            stop_g[:restarts], labels_g[:restarts],
-                            bws[gbest], bhs[gbest], *extra)
+        return _sharded_rank_output(k, labels, res.iterations, res.dnorm,
+                                    res.stop_reason,
+                                    unpack_w(res.wp, r_local), hs, valid,
+                                    restarts, keep_factors)
 
     # check_vma=False: every output IS replicated (psum for the consensus,
     # all_gather + identical replicated epilogues for the rest), but the
@@ -549,6 +560,131 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     return jax.jit(impl)
 
 
+def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
+    """Whether the whole-grid dense-batched solve (``nmfx.ops.grid_mu``)
+    can run this configuration: the mu algorithm under the packed-family
+    backend, with no feature/sample mesh axes (those shard single ranks;
+    the grid layout composes with the restart axis only). The pallas
+    backend's fused kernels assume the per-rank packed layout, so it keeps
+    the per-k path."""
+    if solver_cfg.algorithm != "mu" or solver_cfg.backend not in ("auto",
+                                                                  "packed"):
+        return False
+    return not (mesh is not None
+                and any(ax in mesh.axis_names and mesh.shape[ax] > 1
+                        for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
+
+
+@lru_cache(maxsize=64)
+def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
+                              solver_cfg: SolverConfig,
+                              init_cfg: InitConfig, label_rule: str,
+                              mesh: Mesh | None,
+                              keep_factors: bool = False,
+                              slots: int = 48):
+    """Sweep builder for the whole-grid path (``nmfx.ops.sched_mu``):
+    EVERY (k, restart) cell solves through one jit'd slot-scheduled
+    while_loop — the reference's whole-grid-concurrent job array with
+    workers picking up queued jobs (nmf.r:64-68, nmf.r:111-113) — instead
+    of one compile + dispatch per rank.
+
+    Jobs dispatch rank-DESCENDING (longest-expected-first, the LPT rule;
+    iteration counts grow with k). Per-rank consensus/stats come from
+    static lane slices of the per-job results (rank-major). With a restart
+    mesh each device schedules its own restart shard of every rank
+    independently (no collectives inside the loop); per rank, one psum
+    reduces the consensus and small all_gathers replicate the stats — the
+    same replicated-output contract as the per-k builders.
+    """
+    from nmfx.ops.sched_mu import mu_sched
+
+    ks = tuple(sorted(ks, reverse=True))  # LPT dispatch order
+    k_max = max(ks)
+    padded = _pad_count(restarts, mesh)
+    dtype = jnp.dtype(solver_cfg.dtype)
+
+    def _init_lanes(a, rank_keys):
+        """[(k, (r,) keys)] → zero-padded dense (B, m, k_max), (B, k_max, n)
+        lane batch, rank-major. Padding is exactly invariant under the MU
+        epilogue (see grid_mu module docstring)."""
+        w0l, h0l = [], []
+        for k, keys in rank_keys:
+            w0s, h0s = jax.vmap(
+                lambda kk, k=k: initialize(kk, a, k, init_cfg, dtype))(keys)
+            w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+            h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+        return jnp.concatenate(w0l), jnp.concatenate(h0l)
+
+    if (mesh is None or RESTART_AXIS not in mesh.axis_names
+            or mesh.shape[RESTART_AXIS] == 1):
+
+        def impl(a: jax.Array, root_key: jax.Array) -> dict[int,
+                                                            KSweepOutput]:
+            a = jnp.asarray(a, dtype)
+            # the canonical per-(k, restart) keys of the per-k path
+            # (sweep: fold_in(root, k), then split) — a given (seed, k,
+            # restart) yields the same initial factors on either execution
+            rank_keys = [(k, jax.random.split(jax.random.fold_in(root_key,
+                                                                 k), padded))
+                         for k in ks]
+            w0, h0 = _init_lanes(a, rank_keys)
+            res = mu_sched(a, w0, h0, solver_cfg, slots=slots)
+            out: dict[int, KSweepOutput] = {}
+            for g, k in enumerate(ks):
+                sl = slice(g * padded, g * padded + restarts)
+                hk = res.h[sl, :k, :]  # true rows only: correct under
+                wk = res.w[sl, :, :k]  # both label rules
+                labels = jax.vmap(partial(labels_from_h,
+                                          rule=label_rule))(hk)
+                cons = consensus_matrix(labels, k)
+                best = jnp.argmin(res.dnorm[sl])
+                extra = (wk, hk) if keep_factors else (None, None)
+                out[k] = KSweepOutput(cons, res.iterations[sl],
+                                      res.dnorm[sl], res.stop_reason[sl],
+                                      labels, wk[best], hk[best], *extra)
+            return out
+
+        return jax.jit(impl)
+
+    n_shards = mesh.shape[RESTART_AXIS]
+    r_local = padded // n_shards
+
+    def shard_body(a: jax.Array, keys: jax.Array) -> dict[int, KSweepOutput]:
+        rank_keys = [(k, keys[g]) for g, k in enumerate(ks)]
+        w0, h0 = _init_lanes(a, rank_keys)
+        res = mu_sched(a, w0, h0, solver_cfg, slots=slots,
+                       varying_axes=(RESTART_AXIS,))
+        gidx = (lax.axis_index(RESTART_AXIS) * r_local
+                + jnp.arange(r_local))
+        valid = gidx < restarts
+        out: dict[int, KSweepOutput] = {}
+        for g, k in enumerate(ks):
+            sl = slice(g * r_local, (g + 1) * r_local)
+            hk = res.h[sl, :k, :]
+            wk = res.w[sl, :, :k]
+            labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hk)
+            out[k] = _sharded_rank_output(k, labels, res.iterations[sl],
+                                          res.dnorm[sl],
+                                          res.stop_reason[sl], wk, hk,
+                                          valid, restarts, keep_factors)
+        return out
+
+    # check_vma=False for the same reason as the per-k packed builder: the
+    # outputs ARE replicated but the checker can't see it through the
+    # argmin-over-gathered-candidates pattern
+    sharded = jax.shard_map(shard_body, mesh=mesh,
+                            in_specs=(P(), P(None, RESTART_AXIS)),
+                            out_specs=P(), check_vma=False)
+
+    def impl(a: jax.Array, root_key: jax.Array) -> dict[int, KSweepOutput]:
+        a = jnp.asarray(a, dtype)
+        keys = jnp.stack([jax.random.split(jax.random.fold_in(root_key, k),
+                                           padded) for k in ks])
+        return sharded(a, keys)
+
+    return jax.jit(impl)
+
+
 def grid_mesh(restart_shards: int | None = None,
               feature_shards: int = 1,
               sample_shards: int = 1,
@@ -622,13 +758,21 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
           init_cfg: InitConfig = InitConfig(),
           mesh: Mesh | None = None,
           registry=None, profiler=None) -> dict[int, KSweepOutput]:
-    """Full (k × restart) grid. k values run sequentially (their shapes
-    differ); each k uses every device via the sharded restart batch —
-    the TPU analogue of the reference's shuffled job chunks (nmf.r:111).
+    """Full (k × restart) grid — by default as ONE whole-grid solve.
+
+    Under ``cfg.grid_exec`` "grid"/"auto" (and an eligible config, see
+    :func:`grid_exec_ok`) every remaining (k, restart) cell runs in one
+    dense-batched jit'd solve: the TPU analogue of the reference's
+    whole-grid-concurrent job array (nmf.r:64-68, shuffled chunks
+    nmf.r:111) — one compile for the sweep instead of one per rank, and
+    the chip contracts over every grid cell at once. Otherwise k values
+    run sequentially, each using every device via the sharded restart
+    batch.
 
     With a ``registry`` (nmfx.registry.SweepRegistry), each finished rank is
     checkpointed and a re-run resumes from the completed ranks instead of
-    recomputing them (SURVEY.md §5 checkpoint/resume)."""
+    recomputing them (SURVEY.md §5 checkpoint/resume); under grid
+    execution the still-missing ranks form one (smaller) grid solve."""
     if profiler is None:
         from nmfx.profiling import NullProfiler
 
@@ -640,8 +784,8 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     # and broadcasts; loaded results are broadcast to the other hosts.
     multi = jax.process_count() > 1
     root = jax.random.key(cfg.seed)
-    placed = False  # transfer A lazily: a fully-checkpointed re-run never pays
     out: dict[int, KSweepOutput] = {}
+    needed: list[int] = []
     for k in cfg.ks:
         loaded = registry.try_load(k) if registry is not None else None
         have = loaded is not None
@@ -659,25 +803,58 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
                     None if x is None else np.asarray(x) for x in
                     multihost_utils.broadcast_one_to_all(tuple(loaded))))
             out[k] = loaded
-            continue
-        if not placed:
-            # place A on device once, replicated over the mesh —
-            # re-transferring the matrix for every rank costs more than a
-            # rank's whole solve at small sizes (~0.14 s/call through the
-            # TPU tunnel for a 10 MB matrix)
-            with profiler.phase("host_to_device") as sync:
-                a = sync(place_input(a, solver_cfg, mesh))
-            placed = True
+        else:
+            needed.append(k)
+    if not needed:  # fully-checkpointed re-run: A never transfers
+        return out
+    # place A on device once, replicated over the mesh — re-transferring
+    # the matrix for every rank costs more than a rank's whole solve at
+    # small sizes (~0.14 s/call through the TPU tunnel for a 10 MB matrix)
+    with profiler.phase("host_to_device") as sync:
+        a_dev = sync(place_input(a, solver_cfg, mesh))
+
+    eligible = grid_exec_ok(solver_cfg, mesh)
+    if cfg.grid_exec == "grid" and not eligible:
+        raise ValueError(
+            "grid_exec='grid' needs algorithm='mu' with backend "
+            "'auto'/'packed' and no feature/sample mesh axes; got "
+            f"algorithm={solver_cfg.algorithm!r}, "
+            f"backend={solver_cfg.backend!r} (use grid_exec='auto' to "
+            "fall back per configuration)")
+    use_grid = eligible and (cfg.grid_exec == "grid"
+                             or (cfg.grid_exec == "auto" and len(needed) > 1))
+    coord = not multi or jax.process_index() == 0
+    if use_grid:
+        fn = _build_grid_exec_sweep_fn(tuple(needed), cfg.restarts,
+                                       solver_cfg, init_cfg, cfg.label_rule,
+                                       mesh, cfg.keep_factors,
+                                       cfg.grid_slots)
+        t0 = time.perf_counter()
+        with profiler.phase("solve.grid") as sync:
+            solved = sync(fn(a_dev, root))
+        out.update(solved)
+        if 0 < _log.level <= logging.INFO and coord:
+            iters = {k: float(np.asarray(v.iterations).mean())
+                     for k, v in solved.items()}
+            _log.info("grid: %d ranks x %d restarts in one solve, %.2fs "
+                      "(mean iters %s)", len(needed), cfg.restarts,
+                      time.perf_counter() - t0,
+                      {k: round(v) for k, v in iters.items()})
+        if registry is not None and coord:
+            with profiler.phase("checkpoint"):
+                for k in needed:
+                    registry.save(k, out[k])
+        return {k: out[k] for k in cfg.ks}
+    for k in needed:
         # fold in k itself (not its position) so a given (seed, k) always
         # yields the same factorizations regardless of sweep composition
         key = jax.random.fold_in(root, k)
         t0 = time.perf_counter()
         with profiler.phase(f"solve.k={k}") as sync:
-            out[k] = sync(sweep_one_k(a, key, k, cfg.restarts, solver_cfg,
-                                      init_cfg, cfg.label_rule, mesh,
-                                      cfg.keep_factors))
-        if (0 < _log.level <= logging.INFO
-                and (not multi or jax.process_index() == 0)):
+            out[k] = sync(sweep_one_k(a_dev, key, k, cfg.restarts,
+                                      solver_cfg, init_cfg, cfg.label_rule,
+                                      mesh, cfg.keep_factors))
+        if 0 < _log.level <= logging.INFO and coord:
             # reading the stats forces a device sync, trading the k-grid's
             # async dispatch pipelining for live progress. Gated on a level
             # set explicitly on the "nmfx" logger (CLI --verbose does this)
@@ -687,10 +864,10 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             _log.info("k=%d: %d restarts in %.2fs (mean %.0f iters)",
                       k, cfg.restarts, time.perf_counter() - t0,
                       float(iters.mean()))
-        if registry is not None and (not multi or jax.process_index() == 0):
+        if registry is not None and coord:
             with profiler.phase("checkpoint"):
                 registry.save(k, out[k])
-    return out
+    return {k: out[k] for k in cfg.ks}
 
 
 def place_input(a, solver_cfg: SolverConfig, mesh: Mesh | None) -> jax.Array:

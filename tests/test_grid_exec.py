@@ -1,0 +1,180 @@
+"""Whole-grid execution (nmfx.ops.grid_mu + sweep grid_exec).
+
+The grid path must be a drop-in for the sequential per-k path: same
+per-(seed, k, restart) factorizations (bit-equal decisions, float-tolerance
+factors — the dense-batched and packed layouts order GEMM reductions
+differently), same consensus matrices, same best-restart selection — while
+solving every rank in ONE compile, the reference's whole-grid-concurrent
+job-array model (reference nmf.r:64-68, shuffled chunks nmf.r:111).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.grid_mu import mu_grid
+from nmfx.ops.packed_mu import mu_packed, unpack_w
+from nmfx.sweep import RESTART_AXIS, default_mesh, grid_exec_ok, sweep
+
+KS = (2, 3, 4)
+R = 5
+
+
+@pytest.fixture(scope="module")
+def data():
+    return grouped_matrix(200, (10, 10, 10), effect=2.0, seed=0)
+
+
+def _dense_init(a, root, ks, restarts, k_max, icfg=InitConfig()):
+    w0l, h0l = [], []
+    for k in ks:
+        keys = jax.random.split(jax.random.fold_in(root, k), restarts)
+        w0s, h0s = jax.vmap(
+            lambda kk, k=k: initialize(kk, a, k, icfg, jnp.float32))(keys)
+        w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+        h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+    return jnp.concatenate(w0l), jnp.concatenate(h0l)
+
+
+def test_mu_grid_matches_per_rank_packed(data):
+    """Every lane of the grid solve reproduces the per-rank packed solve:
+    identical stopping decisions, float-tolerance factors, and exactly-zero
+    padding (the dense layout's correctness invariant)."""
+    a = jnp.asarray(data, jnp.float32)
+    cfg = SolverConfig(max_iter=600)
+    root = jax.random.key(123)
+    k_max = max(KS)
+    w0, h0 = _dense_init(a, root, KS, R, k_max)
+    res = mu_grid(a, w0, h0, cfg)
+    for g, k in enumerate(KS):
+        keys = jax.random.split(jax.random.fold_in(root, k), R)
+        w0s, h0s = jax.vmap(
+            lambda kk, k=k: initialize(kk, a, k, InitConfig(),
+                                       jnp.float32))(keys)
+        ref = mu_packed(a, w0s, h0s, cfg)
+        sl = slice(g * R, (g + 1) * R)
+        np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                      np.asarray(res.iterations[sl]))
+        np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                      np.asarray(res.stop_reason[sl]))
+        np.testing.assert_allclose(np.asarray(ref.dnorm),
+                                   np.asarray(res.dnorm[sl]), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(unpack_w(ref.wp, R)),
+                                   np.asarray(res.w[sl, :, :k]),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(ref.hp).reshape(R, k, -1),
+            np.asarray(res.h[sl, :k, :]), rtol=2e-4, atol=2e-5)
+        # padding must be EXACT zeros — the invariance the whole layout
+        # rests on (a nonzero leak would bleed into Grams and labels)
+        assert np.all(np.asarray(res.w[sl, :, k:]) == 0)
+        assert np.all(np.asarray(res.h[sl, k:, :]) == 0)
+
+
+def _assert_outputs_match(g, p, ks, keep_factors=False):
+    for k in ks:
+        np.testing.assert_array_equal(np.asarray(g[k].iterations),
+                                      np.asarray(p[k].iterations))
+        np.testing.assert_array_equal(np.asarray(g[k].stop_reasons),
+                                      np.asarray(p[k].stop_reasons))
+        np.testing.assert_array_equal(np.asarray(g[k].labels),
+                                      np.asarray(p[k].labels))
+        np.testing.assert_allclose(np.asarray(g[k].consensus),
+                                   np.asarray(p[k].consensus), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g[k].dnorms),
+                                   np.asarray(p[k].dnorms), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(g[k].best_w),
+                                   np.asarray(p[k].best_w),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(g[k].best_h),
+                                   np.asarray(p[k].best_h),
+                                   rtol=2e-4, atol=2e-5)
+        if keep_factors:
+            np.testing.assert_allclose(np.asarray(g[k].all_w),
+                                       np.asarray(p[k].all_w),
+                                       rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_sweep_grid_matches_per_k(data, use_mesh):
+    """sweep(grid_exec='grid') ≡ sweep(grid_exec='per_k') on one device and
+    on the restart mesh (restarts=5 on 8 devices exercises the padding
+    lanes)."""
+    mesh = default_mesh() if use_mesh else None
+    if use_mesh:
+        assert mesh is not None and RESTART_AXIS in mesh.axis_names
+    scfg = SolverConfig(max_iter=600)
+    g = sweep(data, ConsensusConfig(ks=KS, restarts=R, grid_exec="grid"),
+              scfg, InitConfig(), mesh)
+    p = sweep(data, ConsensusConfig(ks=KS, restarts=R, grid_exec="per_k"),
+              scfg, InitConfig(), mesh)
+    _assert_outputs_match(g, p, KS)
+
+
+def test_sweep_grid_keep_factors_and_argmin(data):
+    """keep_factors retention and the argmin label rule both flow through
+    the grid path; argmin labels must come from the true rows only (the
+    zero-padded rows would otherwise always win the argmin)."""
+    scfg = SolverConfig(max_iter=400)
+    cc = dict(ks=KS, restarts=3, label_rule="argmin", keep_factors=True)
+    g = sweep(data, ConsensusConfig(grid_exec="grid", **cc), scfg,
+              InitConfig())
+    p = sweep(data, ConsensusConfig(grid_exec="per_k", **cc), scfg,
+              InitConfig())
+    _assert_outputs_match(g, p, KS, keep_factors=True)
+    for k in KS:
+        assert np.asarray(g[k].labels).max() < k
+        assert np.asarray(g[k].all_w).shape == (3, data.shape[0], k)
+
+
+def test_grid_exec_auto_and_validation(data):
+    """auto → grid only for eligible configs; grid_exec='grid' on an
+    ineligible config is a clear error, and auto falls back silently."""
+    assert grid_exec_ok(SolverConfig(), None)
+    assert not grid_exec_ok(SolverConfig(algorithm="hals"), None)
+    assert not grid_exec_ok(SolverConfig(backend="vmap"), None)
+    with pytest.raises(ValueError, match="grid_exec='grid'"):
+        sweep(data, ConsensusConfig(ks=KS, restarts=2, grid_exec="grid"),
+              SolverConfig(algorithm="kl", max_iter=50), InitConfig())
+    # auto + ineligible solver: per-k fallback, no error
+    out = sweep(data, ConsensusConfig(ks=(2, 3), restarts=2),
+                SolverConfig(algorithm="hals", max_iter=50), InitConfig())
+    assert set(out) == {2, 3}
+    with pytest.raises(ValueError, match="grid_exec"):
+        ConsensusConfig(grid_exec="bogus")
+
+
+def test_grid_resume_solves_only_missing_ranks(data, tmp_path):
+    """Registry resume under grid execution: checkpointed ranks load, the
+    missing ranks form one smaller grid solve, and the merged result
+    matches a fresh full sweep."""
+    from nmfx.registry import SweepRegistry
+
+    scfg = SolverConfig(max_iter=400)
+    icfg = InitConfig()
+    full_cfg = ConsensusConfig(ks=KS, restarts=3, grid_exec="grid")
+    part_cfg = ConsensusConfig(ks=KS[:2], restarts=3, grid_exec="grid")
+
+    reg = SweepRegistry.open(str(tmp_path), np.asarray(data, np.float32),
+                             scfg, icfg, 3, part_cfg.seed,
+                             part_cfg.label_rule)
+    first = sweep(data, part_cfg, scfg, icfg, registry=reg)
+    reg2 = SweepRegistry.open(str(tmp_path), np.asarray(data, np.float32),
+                              scfg, icfg, 3, full_cfg.seed,
+                              full_cfg.label_rule)
+    resumed = sweep(data, full_cfg, scfg, icfg, registry=reg2)
+    fresh = sweep(data, full_cfg, scfg, icfg)
+    for k in KS[:2]:  # loaded from checkpoint: bit-equal to the first run
+        np.testing.assert_array_equal(np.asarray(resumed[k].consensus),
+                                      np.asarray(first[k].consensus))
+    # the remaining rank was solved (alone → per-k path is fine too) and
+    # matches the fresh run's decisions
+    np.testing.assert_array_equal(np.asarray(resumed[KS[2]].iterations),
+                                  np.asarray(fresh[KS[2]].iterations))
+    np.testing.assert_allclose(np.asarray(resumed[KS[2]].consensus),
+                               np.asarray(fresh[KS[2]].consensus),
+                               atol=1e-6)

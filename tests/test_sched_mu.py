@@ -1,0 +1,78 @@
+"""Slot-scheduled whole-grid solver (nmfx.ops.sched_mu).
+
+The scheduler must be pure execution policy: for every job, the trajectory
+(stopping iteration, stop reason, factors) is identical to the fixed-batch
+whole-grid solve no matter the slot count, dispatch order, or how jobs
+share slots over time — only wall-clock changes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.grid_mu import mu_grid
+from nmfx.ops.sched_mu import mu_sched
+
+KS = (4, 3, 2)  # rank-descending, as the sweep dispatches
+R = 5
+
+
+@pytest.fixture(scope="module")
+def jobs():
+    a = jnp.asarray(grouped_matrix(200, (10, 10, 10), effect=2.0, seed=0),
+                    jnp.float32)
+    k_max = max(KS)
+    root = jax.random.key(123)
+    w0l, h0l = [], []
+    for k in KS:
+        keys = jax.random.split(jax.random.fold_in(root, k), R)
+        w0s, h0s = jax.vmap(
+            lambda kk, k=k: initialize(kk, a, k, InitConfig(),
+                                       jnp.float32))(keys)
+        w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+        h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+    return a, jnp.concatenate(w0l), jnp.concatenate(h0l)
+
+
+@pytest.mark.parametrize("slots", [1, 3, 7, 15, 64])
+def test_schedule_free_results(jobs, slots):
+    """Identical decisions and factors at ANY slot count — including one
+    slot (fully sequential), a pool larger than the job count (degenerates
+    to the fixed batch), and pools forcing multi-generation slot reuse."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(max_iter=600)
+    ref = mu_grid(a, w0, h0, cfg)
+    got = mu_sched(a, w0, h0, cfg, slots=slots)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    np.testing.assert_allclose(np.asarray(ref.dnorm),
+                               np.asarray(got.dnorm), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref.w), np.asarray(got.w),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ref.h), np.asarray(got.h),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_max_iter_budget(jobs):
+    """A cap below convergence evicts every job at exactly max_iter with
+    MAX_ITER recorded — the queue still drains (no livelock on jobs that
+    never converge)."""
+    from nmfx.solvers.base import StopReason
+
+    a, w0, h0 = jobs
+    cfg = SolverConfig(max_iter=20)
+    got = mu_sched(a, w0, h0, cfg, slots=4)
+    assert np.all(np.asarray(got.iterations) == 20)
+    assert np.all(np.asarray(got.stop_reason) == StopReason.MAX_ITER)
+
+
+def test_non_mu_rejected(jobs):
+    a, w0, h0 = jobs
+    with pytest.raises(ValueError, match="mu"):
+        mu_sched(a, w0, h0, SolverConfig(algorithm="als"))
