@@ -112,11 +112,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grid-exec", default="auto",
                    choices=("auto", "grid", "per_k"),
                    help="(k x restart) grid execution: 'auto' solves every "
-                        "rank in ONE compiled whole-grid batch when "
-                        "eligible (mu + packed backend, no grid shards) — "
-                        "the reference's whole-grid job-array concurrency; "
-                        "'per_k' forces sequential ranks (one compile "
-                        "each); 'grid' demands the whole-grid path")
+                        "rank in ONE compiled whole-grid slot-scheduled "
+                        "batch when eligible (mu or hals with the packed "
+                        "backend family, no grid shards) — the reference's "
+                        "whole-grid job-array concurrency; 'per_k' forces "
+                        "sequential ranks (one compile each); 'grid' "
+                        "demands the whole-grid path")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "

@@ -129,10 +129,15 @@ class SolverConfig:
             raise ValueError(
                 f"backend must be 'auto', 'vmap', 'packed' or 'pallas', "
                 f"got {self.backend!r}")
-        if self.backend in ("packed", "pallas") and self.algorithm != "mu":
+        if self.backend == "pallas" and self.algorithm != "mu":
             raise ValueError(
-                f"backend={self.backend!r} is only implemented for "
-                "algorithm='mu'; use 'auto' to fall back per algorithm")
+                "backend='pallas' is only implemented for algorithm='mu'; "
+                "use 'auto' to fall back per algorithm")
+        if self.backend == "packed" and self.algorithm not in ("mu",
+                                                               "hals"):
+            raise ValueError(
+                "backend='packed' is only implemented for algorithm='mu' "
+                "and 'hals'; use 'auto' to fall back per algorithm")
         if self.algorithm not in ALGORITHMS:
             raise ValueError(
                 f"algorithm must be one of {ALGORITHMS}, got {self.algorithm!r}"

@@ -69,6 +69,7 @@ class GridState(NamedTuple):
     done: jax.Array  # (B,) bool
     done_iter: jax.Array  # (B,) i32
     stop_reason: jax.Array  # (B,) i32
+    dnorm: jax.Array  # (B,) residual at last check (TolFun family only)
 
 
 class GridMUResult(NamedTuple):
@@ -132,20 +133,91 @@ def mu_block(a, wp, hp, done_mask, cfg: SolverConfig):
     return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
 
 
-def _step(a, state: GridState, cfg: SolverConfig, check: bool) -> GridState:
+def hals_block(a, wp, hp, done_mask, cfg: SolverConfig):
+    """ONE dense-batched HALS iteration (Cichocki & Phan 2009 — see
+    solvers/hals.py for the per-restart form and reference relationship):
+    the two shared GEMMs batch over every lane exactly like mu_block; the
+    k coordinate minimizations unroll at trace time as (B, n)/(B, m) VPU
+    AXPYs. Zero-padded components are invariant: their numerators are zero
+    (zero W column / H row), the eps-guarded diagonal keeps the division
+    finite, and real components never see them (their Gram cross-terms are
+    zero)."""
+    eps = cfg.div_eps
+    k_max = wp.shape[2]
+    if a.dtype == jnp.bfloat16:
+        f32 = hp.dtype
+        wb = wp.astype(jnp.bfloat16)
+        wta = jnp.einsum("bmk,mn->bkn", wb, a, preferred_element_type=f32)
+        wtw = jnp.einsum("bmk,bml->bkl", wb, wb, preferred_element_type=f32)
+    else:
+        wta = jnp.einsum("bmk,mn->bkn", wp, a)
+        wtw = jnp.einsum("bmk,bml->bkl", wp, wp)
+    h = hp
+    for jj in range(k_max):
+        num = wta[:, jj, :] - jnp.einsum("bl,bln->bn", wtw[:, jj, :], h)
+        hj = h[:, jj, :] + num / (wtw[:, jj, jj, None] + eps)
+        h = h.at[:, jj, :].set(base.clamp(hj, cfg.zero_threshold))
+    if a.dtype == jnp.bfloat16:
+        hb = h.astype(jnp.bfloat16)
+        aht = jnp.einsum("mn,bkn->bmk", a, hb, preferred_element_type=f32)
+        hht = jnp.einsum("bkn,bln->bkl", hb, hb, preferred_element_type=f32)
+    else:
+        aht = jnp.einsum("mn,bkn->bmk", a, h)
+        hht = jnp.einsum("bkn,bln->bkl", h, h)
+    w = wp
+    for jj in range(k_max):
+        num = aht[:, :, jj] - jnp.einsum("bmk,bk->bm", w, hht[:, :, jj])
+        wj = w[:, :, jj] + num / (hht[:, jj, jj, None] + eps)
+        w = w.at[:, :, jj].set(base.clamp(wj, cfg.zero_threshold))
+    frozen = done_mask[:, None, None]
+    return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
+
+
+#: dense-batched iteration blocks by algorithm, and whether the algorithm's
+#: convergence uses the TolFun residual-decrease test (matching each
+#: solver's per-restart check_convergence flags: mu = class+TolX only,
+#: hals = class+TolX+TolFun, solvers/{mu,hals}.py)
+BLOCKS = {"mu": mu_block, "hals": hals_block}
+USES_TOLFUN = {"mu": False, "hals": True}
+
+
+def tolfun_update(a, state_w, state_h, it, cfg: SolverConfig, *,
+                  dnorm, done, done_in, stop_reason):
+    """The TolFun test for the batched drivers, mirroring
+    ``base.check_convergence``'s rule (relative residual decrease vs the
+    previous check, after the class/TolX tests of the same check): the
+    residual is the DIRECT chunked form — the Gram-trace identity's
+    cancellation noise would fire the decrease test spuriously near
+    convergence. Returns (dnorm, done, stop_reason)."""
+    is_check = (it > 1) & (it % cfg.check_every == 0)
+    active = is_check & (~done_in)
+    new_dnorm = residual_norms_direct(a, state_w, state_h)
+    hit = (active & jnp.isfinite(dnorm)
+           & (dnorm - new_dnorm <= cfg.tol_fun * dnorm) & ~done)
+    dnorm = jnp.where(is_check & ~done_in, new_dnorm, dnorm)
+    done = done | hit
+    stop_reason = jnp.where(hit, base.StopReason.TOL_FUN, stop_reason)
+    return dnorm, done, stop_reason
+
+
+def _step(a, a_res, state: GridState, cfg: SolverConfig,
+          check: bool) -> GridState:
+    """``a`` feeds the iteration (possibly bf16-truncated); ``a_res`` the
+    TolFun residual (full precision, matching the generic driver)."""
     w0, h0 = state.w, state.h
     it = state.iteration + 1
-    w, h = mu_block(a, state.w, state.h, state.done, cfg)
+    w, h = BLOCKS[cfg.algorithm](a, state.w, state.h, state.done, cfg)
     state = state._replace(w=w, h=h, w_prev=w0, h_prev=h0, iteration=it)
     if not check:
         return state
-    return _check(state, cfg)
+    return _check(a_res, state, cfg)
 
 
-def _check(state: GridState, cfg: SolverConfig) -> GridState:
+def _check(a_res, state: GridState, cfg: SolverConfig) -> GridState:
     """Per-lane convergence tests on the dense layout; the bookkeeping
     semantics live in packed_mu.batch_convergence (shared with the packed
-    per-rank path)."""
+    per-rank path), plus the TolFun residual test for the algorithms whose
+    per-restart form uses it."""
     delta = None
     if cfg.use_tol_checks:
         sqrteps = jnp.sqrt(jnp.finfo(state.w.dtype).eps)
@@ -158,13 +230,22 @@ def _check(state: GridState, cfg: SolverConfig) -> GridState:
         delta = jnp.maximum(_delta(state.w, state.w_prev),
                             _delta(state.h, state.h_prev))  # (B,)
 
+    done_in = state.done
     classes, stable, done, done_iter, reason = batch_convergence(
         cfg, state.iteration, new_classes=_labels(state.h), delta=delta,
         n_glob=state.h.shape[2], classes=state.classes, stable=state.stable,
         done=state.done, done_iter=state.done_iter,
         stop_reason=state.stop_reason)
+    dnorm = state.dnorm
+    if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
+        dnorm, done, reason = tolfun_update(
+            a_res, state.w, state.h, state.iteration, cfg, dnorm=dnorm,
+            done=done, done_in=done_in, stop_reason=reason)
+        newly = done & ~done_in
+        done_iter = jnp.where(newly, state.iteration, done_iter)
     return state._replace(classes=classes, stable=stable, done=done,
-                          done_iter=done_iter, stop_reason=reason)
+                          done_iter=done_iter, stop_reason=reason,
+                          dnorm=dnorm)
 
 
 @partial(jax.jit, static_argnames=("cfg", "varying_axes"))
@@ -181,8 +262,10 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
     inside ``shard_map`` over those mesh axes the constant-initialized
     carry components must be lifted to device-varying.
     """
-    if cfg.algorithm != "mu":
-        raise ValueError("mu_grid only implements the mu algorithm")
+    if cfg.algorithm not in BLOCKS:
+        raise ValueError(
+            f"the dense-batched grid drivers implement {tuple(BLOCKS)}, "
+            f"got algorithm={cfg.algorithm!r}")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0 = jnp.asarray(w0, dtype)
@@ -205,6 +288,7 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
             done_iter=vary(jnp.zeros((b,), jnp.int32)),
             stop_reason=vary(jnp.full((b,), base.StopReason.MAX_ITER,
                                       jnp.int32)),
+            dnorm=vary(jnp.full((b,), jnp.inf, dtype)),
         )
         a_loop = a
         if (cfg.matmul_precision == "bfloat16" and dtype == jnp.float32
@@ -214,7 +298,7 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
             # backends ignore the precision hint and run full-f32 GEMMs,
             # so truncating there would change results)
             a_loop = a.astype(jnp.bfloat16)
-        step = partial(_step, a_loop)
+        step = partial(_step, a_loop, a_true)
 
         def cond(s: GridState):
             return jnp.any(~s.done) & (s.iteration + cfg.check_every

@@ -45,7 +45,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from nmfx.config import SolverConfig
-from nmfx.ops.grid_mu import mu_block
+from nmfx.ops.grid_mu import BLOCKS, USES_TOLFUN, tolfun_update
 from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
 
@@ -58,6 +58,7 @@ class SchedState(NamedTuple):
     slot_iter: jax.Array  # (S,) i32 — iterations completed by the slot's job
     classes: jax.Array  # (S, n) i32
     stable: jax.Array  # (S,) i32
+    dnorm: jax.Array  # (S,) residual at last check (TolFun family only)
     # scheduler state
     slot_job: jax.Array  # (S,) i32 — job index resident in each slot
     active: jax.Array  # (S,) bool — slot holds a live job
@@ -100,8 +101,10 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     its own queue at its own pace and exits independently — per-device
     work-conserving schedules over the device's job shard.
     """
-    if cfg.algorithm != "mu":
-        raise ValueError("mu_sched only implements the mu algorithm")
+    if cfg.algorithm not in BLOCKS:
+        raise ValueError(
+            f"the slot scheduler implements {tuple(BLOCKS)}, got "
+            f"algorithm={cfg.algorithm!r}")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0 = jnp.asarray(w0, dtype)
@@ -128,6 +131,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             slot_iter=vary(jnp.zeros((s,), jnp.int32)),
             classes=vary(jnp.full((s, n), -1, jnp.int32)),
             stable=vary(jnp.zeros((s,), jnp.int32)),
+            dnorm=vary(jnp.full((s,), jnp.inf, dtype)),
             slot_job=vary(jnp.arange(s, dtype=jnp.int32)),
             active=vary(jnp.ones((s,), bool)),
             queue=vary(jnp.asarray(s, jnp.int32)),
@@ -138,14 +142,16 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                                    jnp.int32)),
         )
 
+        block = BLOCKS[cfg.algorithm]
+
         def body(st: SchedState) -> SchedState:
-            # --- check_every MU iterations, per-slot max_iter fencing ---
+            # --- check_every solver iterations, per-slot max_iter fence ---
             wp, hp = st.wp, st.hp
             for i in range(ce):
                 frozen = ~st.active | (st.slot_iter + i >= cfg.max_iter)
                 if i == ce - 1:
                     wprev, hprev = wp, hp  # for TolX at the block's check
-                wp, hp = mu_block(a_loop, wp, hp, frozen, cfg)
+                wp, hp = block(a_loop, wp, hp, frozen, cfg)
             it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
 
             # --- convergence check (shared bookkeeping; vector `it`) ---
@@ -166,6 +172,11 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 done=~st.active, done_iter=jnp.zeros_like(st.slot_iter),
                 stop_reason=jnp.full((s,), base.StopReason.MAX_ITER,
                                      jnp.int32))
+            dnorm = st.dnorm
+            if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
+                dnorm, conv, reason = tolfun_update(
+                    a, wp, hp, it_new, cfg, dnorm=dnorm, done=conv,
+                    done_in=~st.active, stop_reason=reason)
             # conv folds in ~active (passed as `done`); isolate fresh stops
             finished = st.active & (conv | (it_new >= cfg.max_iter))
 
@@ -190,6 +201,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 slot_iter=jnp.where(fresh_or_done, 0, it_new),
                 classes=jnp.where(fresh_or_done[:, None], -1, classes),
                 stable=jnp.where(fresh_or_done, 0, stable),
+                dnorm=jnp.where(fresh_or_done, jnp.inf, dnorm),
                 slot_job=jnp.where(load, new_job,
                                    jnp.where(finished, j, st.slot_job)),
                 active=jnp.where(finished, load, st.active),

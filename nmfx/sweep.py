@@ -89,7 +89,7 @@ def _use_packed(solver_cfg: SolverConfig) -> bool:
 @lru_cache(maxsize=64)
 def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                     init_cfg: InitConfig, label_rule: str, mesh: Mesh | None,
-                    keep_factors: bool = False):
+                    keep_factors: bool = False, grid_slots: int = 48):
     grid = (mesh is not None
             and any(ax in mesh.axis_names and mesh.shape[ax] > 1
                     for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
@@ -118,6 +118,18 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     if _use_packed(solver_cfg):
         return _build_packed_sweep_fn(k, restarts, solver_cfg, init_cfg,
                                       label_rule, mesh, keep_factors)
+    if solver_cfg.algorithm == "hals" and solver_cfg.backend == "packed":
+        # hals' batched backend IS the dense grid machinery at one rank:
+        # shared-GEMM lanes through the slot scheduler (its two big GEMMs
+        # are mu-shaped — ref libnmf/nmf_mu.c:174-216 for the shapes)
+        grid_fn = _build_grid_exec_sweep_fn(
+            (k,), restarts, solver_cfg, init_cfg, label_rule, mesh,
+            keep_factors, grid_slots, fold_keys=False)
+
+        def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
+            return grid_fn(a, key)[k]
+
+        return impl
     padded = _pad_count(restarts, mesh)
     dtype = jnp.dtype(solver_cfg.dtype)
     mesh_size = (mesh.shape[RESTART_AXIS]
@@ -561,14 +573,14 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
 
 
 def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
-    """Whether the whole-grid dense-batched solve (``nmfx.ops.grid_mu``)
-    can run this configuration: the mu algorithm under the packed-family
-    backend, with no feature/sample mesh axes (those shard single ranks;
-    the grid layout composes with the restart axis only). The pallas
-    backend's fused kernels assume the per-rank packed layout, so it keeps
-    the per-k path."""
-    if solver_cfg.algorithm != "mu" or solver_cfg.backend not in ("auto",
-                                                                  "packed"):
+    """Whether the whole-grid slot-scheduled solve (``nmfx.ops.sched_mu``)
+    can run this configuration: an algorithm with a dense-batched block
+    (mu, hals) under the packed-family backend, with no feature/sample
+    mesh axes (those shard single ranks; the grid layout composes with the
+    restart axis only). The pallas backend's fused kernels assume the
+    per-rank packed layout, so it keeps the per-k path."""
+    if (solver_cfg.algorithm not in ("mu", "hals")
+            or solver_cfg.backend not in ("auto", "packed")):
         return False
     return not (mesh is not None
                 and any(ax in mesh.axis_names and mesh.shape[ax] > 1
@@ -581,7 +593,8 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
                               init_cfg: InitConfig, label_rule: str,
                               mesh: Mesh | None,
                               keep_factors: bool = False,
-                              slots: int = 48):
+                              slots: int = 48,
+                              fold_keys: bool = True):
     """Sweep builder for the whole-grid path (``nmfx.ops.sched_mu``):
     EVERY (k, restart) cell solves through one jit'd slot-scheduled
     while_loop — the reference's whole-grid-concurrent job array with
@@ -598,6 +611,9 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
     """
     from nmfx.ops.sched_mu import mu_sched
 
+    if not fold_keys and len(ks) != 1:
+        raise ValueError("fold_keys=False is the single-rank (pre-folded "
+                         "key) mode; got multiple ks")
     ks = tuple(sorted(ks, reverse=True))  # LPT dispatch order
     k_max = max(ks)
     padded = _pad_count(restarts, mesh)
@@ -624,9 +640,11 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
             # the canonical per-(k, restart) keys of the per-k path
             # (sweep: fold_in(root, k), then split) — a given (seed, k,
             # restart) yields the same initial factors on either execution
-            rank_keys = [(k, jax.random.split(jax.random.fold_in(root_key,
-                                                                 k), padded))
-                         for k in ks]
+            rank_keys = [
+                (k, jax.random.split(
+                    jax.random.fold_in(root_key, k) if fold_keys
+                    else root_key, padded))
+                for k in ks]
             w0, h0 = _init_lanes(a, rank_keys)
             res = mu_sched(a, w0, h0, solver_cfg, slots=slots)
             out: dict[int, KSweepOutput] = {}
@@ -678,8 +696,9 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
 
     def impl(a: jax.Array, root_key: jax.Array) -> dict[int, KSweepOutput]:
         a = jnp.asarray(a, dtype)
-        keys = jnp.stack([jax.random.split(jax.random.fold_in(root_key, k),
-                                           padded) for k in ks])
+        keys = jnp.stack([
+            jax.random.split(jax.random.fold_in(root_key, k) if fold_keys
+                             else root_key, padded) for k in ks])
         return sharded(a, keys)
 
     return jax.jit(impl)
@@ -740,16 +759,19 @@ def sweep_one_k(a, key, k: int, restarts: int,
                 init_cfg: InitConfig = InitConfig(),
                 label_rule: str = "argmax",
                 mesh: Mesh | None = None,
-                keep_factors: bool = False) -> KSweepOutput:
+                keep_factors: bool = False,
+                grid_slots: int = 48) -> KSweepOutput:
     """Run `restarts` independent factorizations at rank k and reduce them to
     one consensus matrix, entirely on-device.
 
     ``keep_factors=True`` additionally returns every restart's (W, H) in
     ``all_w``/``all_h`` — the reference registry's per-job retention
     (nmf.r:50) — enabling restart-level analyses and custom ``reduce_grid``
-    reductions without re-solving."""
+    reductions without re-solving. ``grid_slots`` bounds the concurrent
+    lanes of the slot-scheduled backends (hals backend='packed';
+    ConsensusConfig.grid_slots at the sweep level)."""
     fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh,
-                         keep_factors)
+                         keep_factors, grid_slots)
     return fn(jnp.asarray(a), key)
 
 
@@ -816,7 +838,7 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     eligible = grid_exec_ok(solver_cfg, mesh)
     if cfg.grid_exec == "grid" and not eligible:
         raise ValueError(
-            "grid_exec='grid' needs algorithm='mu' with backend "
+            "grid_exec='grid' needs algorithm 'mu' or 'hals' with backend "
             "'auto'/'packed' and no feature/sample mesh axes; got "
             f"algorithm={solver_cfg.algorithm!r}, "
             f"backend={solver_cfg.backend!r} (use grid_exec='auto' to "
@@ -853,7 +875,8 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
         with profiler.phase(f"solve.k={k}") as sync:
             out[k] = sync(sweep_one_k(a_dev, key, k, cfg.restarts,
                                       solver_cfg, init_cfg, cfg.label_rule,
-                                      mesh, cfg.keep_factors))
+                                      mesh, cfg.keep_factors,
+                                      cfg.grid_slots))
         if 0 < _log.level <= logging.INFO and coord:
             # reading the stats forces a device sync, trading the k-grid's
             # async dispatch pipelining for live progress. Gated on a level

@@ -135,17 +135,40 @@ def test_grid_exec_auto_and_validation(data):
     """auto → grid only for eligible configs; grid_exec='grid' on an
     ineligible config is a clear error, and auto falls back silently."""
     assert grid_exec_ok(SolverConfig(), None)
-    assert not grid_exec_ok(SolverConfig(algorithm="hals"), None)
+    assert grid_exec_ok(SolverConfig(algorithm="hals"), None)
+    assert not grid_exec_ok(SolverConfig(algorithm="kl"), None)
     assert not grid_exec_ok(SolverConfig(backend="vmap"), None)
     with pytest.raises(ValueError, match="grid_exec='grid'"):
         sweep(data, ConsensusConfig(ks=KS, restarts=2, grid_exec="grid"),
               SolverConfig(algorithm="kl", max_iter=50), InitConfig())
     # auto + ineligible solver: per-k fallback, no error
     out = sweep(data, ConsensusConfig(ks=(2, 3), restarts=2),
-                SolverConfig(algorithm="hals", max_iter=50), InitConfig())
+                SolverConfig(algorithm="neals", max_iter=50), InitConfig())
     assert set(out) == {2, 3}
     with pytest.raises(ValueError, match="grid_exec"):
         ConsensusConfig(grid_exec="bogus")
+
+
+def test_hals_grid_matches_per_k_vmap(data):
+    """hals through the whole-grid scheduler (and the per-k packed backend)
+    reproduces the vmapped generic driver: same stop decisions, factors to
+    float tolerance — the VERDICT r2 #3 'packed backend for hals'."""
+    scfg_v = SolverConfig(algorithm="hals", backend="vmap", max_iter=400)
+    scfg_g = SolverConfig(algorithm="hals", backend="packed", max_iter=400)
+    cc = dict(ks=KS, restarts=3)
+    p = sweep(data, ConsensusConfig(grid_exec="per_k", **cc), scfg_v,
+              InitConfig())
+    g = sweep(data, ConsensusConfig(grid_exec="grid", **cc), scfg_g,
+              InitConfig())
+    _assert_outputs_match(g, p, KS)
+    # per-k packed backend (single-rank route through the scheduler)
+    solo_v = sweep(data, ConsensusConfig(ks=(3,), restarts=3,
+                                         grid_exec="per_k"), scfg_v,
+                   InitConfig())
+    solo_p = sweep(data, ConsensusConfig(ks=(3,), restarts=3,
+                                         grid_exec="per_k"), scfg_g,
+                   InitConfig())
+    _assert_outputs_match(solo_p, solo_v, (3,))
 
 
 def test_grid_resume_solves_only_missing_ranks(data, tmp_path):
