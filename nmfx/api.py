@@ -295,6 +295,7 @@ def nmfconsensus(
     rank_selection: str = "host",
     keep_factors: bool = False,
     grid_exec: str = "auto",
+    grid_slots: int = 48,
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
@@ -326,7 +327,8 @@ def nmfconsensus(
     one dense-batched compile when eligible (the reference's whole-grid
     job-array concurrency, nmf.r:64-68); "per_k" forces the sequential
     per-rank path; "grid" demands the whole-grid path (error when the
-    config can't run it).
+    config can't run it). ``grid_slots`` is the scheduler's per-device
+    slot-pool width (``ConsensusConfig.grid_slots``).
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
@@ -348,7 +350,8 @@ def nmfconsensus(
             f"k={max(ks)} exceeds the number of samples ({n_samples})")
     ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
                            label_rule=label_rule, linkage=linkage,
-                           keep_factors=keep_factors, grid_exec=grid_exec)
+                           keep_factors=keep_factors, grid_exec=grid_exec,
+                           grid_slots=grid_slots)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
     if mesh is None and use_mesh:
         mesh = default_mesh()

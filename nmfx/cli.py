@@ -118,6 +118,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "whole-grid job-array concurrency; 'per_k' forces "
                         "sequential ranks (one compile each); 'grid' "
                         "demands the whole-grid path")
+    p.add_argument("--grid-slots", type=int, default=48,
+                   help="slot-pool width of the whole-grid scheduler: how "
+                        "many grid cells iterate concurrently per device "
+                        "(freed slots reload queued jobs); 48 measured "
+                        "best at the north-star sweep")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "
@@ -226,6 +231,7 @@ def main(argv: list[str] | None = None) -> int:
             rank_selection=args.rank_selection,
             keep_factors=args.keep_factors,
             grid_exec=args.grid_exec,
+            grid_slots=args.grid_slots,
             output=output,
             checkpoint_dir=args.checkpoint_dir,
             profiler=profiler,

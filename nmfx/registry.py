@@ -73,7 +73,12 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     solver = _all_fields(solver_cfg)
     solver.pop("restart_chunk", None)
     resolved = ("pallas" if solver_cfg.backend == "pallas"
-                else "packed" if _use_packed(solver_cfg) else "vmap")
+                else "packed" if _use_packed(solver_cfg)
+                # hals' explicit packed backend (the dense-batched
+                # scheduler) is likewise not bit-identical to its vmap path
+                else "packed" if (solver_cfg.algorithm == "hals"
+                                  and solver_cfg.backend == "packed")
+                else "vmap")
     solver["backend"] = resolved
     payload = {
         "solver": solver,

@@ -770,6 +770,11 @@ def sweep_one_k(a, key, k: int, restarts: int,
     reductions without re-solving. ``grid_slots`` bounds the concurrent
     lanes of the slot-scheduled backends (hals backend='packed';
     ConsensusConfig.grid_slots at the sweep level)."""
+    if not (solver_cfg.algorithm == "hals"
+            and solver_cfg.backend == "packed"):
+        # only the slot-scheduled branch consumes grid_slots; normalize so
+        # a different value cannot force a re-trace of unrelated builders
+        grid_slots = 48
     fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh,
                          keep_factors, grid_slots)
     return fn(jnp.asarray(a), key)

@@ -1,0 +1,130 @@
+"""Generate the reference-BINARY golden fixture (reference_mu_fixture.npz).
+
+The reference's own (dormant) validation idea is comparison against a real
+reference run (``/root/reference/test_nmf.r:29``). No R interpreter exists
+in this image, so — as for BASELINE.md — the reference's C solver is
+compiled as-is and driven through ctypes replicating the R ``.C("nmf_mu",
+DUP=F)`` protocol exactly (column-major f64 buffers mutated in place,
+initial W0/H0 supplied by the caller as the R layer does with ``runif``,
+reference ``nmf.r:37-45``). The resulting factors/labels/consensus/rho are
+the committed oracle that ``tests/test_reference_binary.py`` asserts nmfx
+reproduces — parity against the reference BINARY, not a transliteration.
+
+Protocol notes:
+
+* ``maxiter=300`` (even, fixed): the reference's only live stop needs 200
+  stable every-2nd-iteration checks (>= 400 iterations,
+  ``nmf_mu.c:253-282``), so neither side can stop early and the
+  garbage-driven out-of-bounds stability scan (SURVEY.md Q1) cannot
+  influence the run. The pointer-swap double buffering lands results in
+  the caller's buffers after an even iteration count (``nmf_mu.c:241-242``).
+* W0/H0 ~ numpy ``default_rng(1000*k + r)`` uniform [0,1) f64 — the exact
+  protocol the test re-derives.
+* Labels use the R layer's observed argmin rule (``nmf.r:128``, quirk Q3);
+  consensus is the mean connectivity over restarts (``nmf.r:140-143``);
+  rho is computed with SCIPY (average linkage + cophenetic + Pearson — an
+  oracle independent of nmfx), unrounded (the reference rounds to 4
+  significant digits only when printing, ``nmf.r:172``).
+
+Regenerate (needs /root/reference and a C toolchain; system BLAS/LAPACK/
+ARPACK — the exact BLAS only perturbs f64 rounding, the test tolerance
+absorbs it):
+
+    cp -r /root/reference/libnmf /tmp/refbuild3
+    cd /tmp/refbuild3
+    gcc -Wall -Iinclude/ -g -fPIC -shared -o libnmf.so *.c \
+        /lib/x86_64-linux-gnu/liblapack.so.3 \
+        /lib/x86_64-linux-gnu/libarpack.so.2 \
+        /lib/x86_64-linux-gnu/libblas.so.3
+    python tests/golden_ref/generate_reference_fixture.py \
+        --libnmf /tmp/refbuild3/libnmf.so
+"""
+
+import argparse
+import ctypes
+import os
+
+import numpy as np
+
+KS = (2, 3, 4, 5)
+RESTARTS = 10
+MAXITER = 300
+GCT = "/root/reference/20+20x1000.gct"
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "reference_mu_fixture.npz")
+
+
+def read_gct(path: str) -> np.ndarray:
+    """Minimal GCT v1.2 reader (independent of nmfx.io): skip the 2 header
+    lines + the dims line, drop Name/Description columns
+    (reference nmf.r:371-377)."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    n_rows, n_cols = (int(x) for x in lines[1].split("\t")[:2])
+    data = [line.split("\t")[2:] for line in lines[3:3 + n_rows]]
+    a = np.asarray(data, dtype=np.float64)
+    assert a.shape == (n_rows, n_cols), a.shape
+    return a
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--libnmf", required=True,
+                   help="path to the compiled reference libnmf.so")
+    args = p.parse_args()
+
+    from scipy.cluster.hierarchy import average, cophenet
+    from scipy.spatial.distance import squareform
+
+    lib = ctypes.CDLL(args.libnmf)
+    pd = ctypes.POINTER(ctypes.c_double)
+    pi = ctypes.POINTER(ctypes.c_int)
+    lib.nmf_mu.restype = ctypes.c_double
+    lib.nmf_mu.argtypes = [pd, pd, pd, pi, pi, pi, pi, pd, pd]
+
+    a = read_gct(GCT)
+    m, n = a.shape
+    out: dict[str, np.ndarray] = {
+        "ks": np.asarray(KS), "restarts": np.asarray(RESTARTS),
+        "maxiter": np.asarray(MAXITER), "shape": np.asarray([m, n]),
+    }
+    for k in KS:
+        labels_all = []
+        for r in range(RESTARTS):
+            rng = np.random.default_rng(1000 * k + r)
+            w0 = rng.random((m, k))
+            h0 = rng.random((k, n))
+            af = np.asfortranarray(a)  # fresh per call; `a` is an in-param
+            wf = np.asfortranarray(w0)
+            hf = np.asfortranarray(h0)
+            mi = ctypes.c_int(MAXITER)
+            tolx = ctypes.c_double(1e-4)  # dead in nmf_mu (checks
+            tolfun = ctypes.c_double(1e-4)  # commented out) but part of
+            rc = lib.nmf_mu(  # the .C signature
+                af.ctypes.data_as(pd), wf.ctypes.data_as(pd),
+                hf.ctypes.data_as(pd),
+                ctypes.byref(ctypes.c_int(m)), ctypes.byref(ctypes.c_int(n)),
+                ctypes.byref(ctypes.c_int(k)), ctypes.byref(mi),
+                ctypes.byref(tolx), ctypes.byref(tolfun))
+            assert np.isfinite(rc)
+            assert mi.value == MAXITER, (
+                f"reference stopped early at {mi.value} — the fixed-budget "
+                "protocol is broken")
+            labels_all.append(np.argmin(hf, axis=0))  # R rule (Q3)
+            out[f"h_k{k}_r{r}"] = np.ascontiguousarray(hf)
+            if r == 0:
+                out[f"w_k{k}_r0"] = np.ascontiguousarray(wf)
+        labels_all = np.stack(labels_all)  # (R, n)
+        cons = (labels_all[:, :, None] == labels_all[:, None, :]).mean(0)
+        out[f"labels_k{k}"] = labels_all
+        out[f"consensus_k{k}"] = cons
+        d = squareform(1.0 - cons, checks=False)
+        coph = cophenet(average(d))
+        out[f"rho_k{k}"] = np.asarray(np.corrcoef(d, coph)[0, 1])
+        print(f"k={k}: rho={float(out[f'rho_k{k}']):.6f}")
+    np.savez_compressed(OUT, **out)
+    print(f"wrote {OUT} ({os.path.getsize(OUT) / 1024:.0f} KiB)")
+
+
+if __name__ == "__main__":
+    main()

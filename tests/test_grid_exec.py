@@ -149,6 +149,20 @@ def test_grid_exec_auto_and_validation(data):
         ConsensusConfig(grid_exec="bogus")
 
 
+def test_hals_backend_fingerprints_differ(data):
+    """hals' vmap and packed executions are not bit-identical, so they
+    must not share a checkpoint fingerprint (the registry's resolved-
+    backend contract)."""
+    from nmfx.registry import _fingerprint
+
+    a = np.asarray(data, np.float32)
+    fp = {b: _fingerprint(a, SolverConfig(algorithm="hals", backend=b),
+                          InitConfig(), 3, 123, "argmax")
+          for b in ("vmap", "packed", "auto")}
+    assert fp["vmap"] != fp["packed"]
+    assert fp["auto"] == fp["vmap"]  # auto resolves hals per-k to vmap
+
+
 def test_hals_grid_matches_per_k_vmap(data):
     """hals through the whole-grid scheduler (and the per-k packed backend)
     reproduces the vmapped generic driver: same stop decisions, factors to
