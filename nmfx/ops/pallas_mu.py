@@ -24,7 +24,12 @@ variance caveats): the packed XLA path wins the north-star sweep by
 their sessions on isolated long-running large-R·k solves (k=10 at
 5000×500: lower fixed AND marginal cost, ~1.8× end-to-end) and are the
 opt-in ``backend="pallas"`` for that regime, plus the template for future
-hand-tuned paths.
+hand-tuned paths. Round 3: the whole-grid slot scheduler
+(``nmfx.ops.sched_mu``) also runs on these kernels under
+``backend="pallas"`` (packed-column slot state, two launches per
+iteration vs ~12 XLA kernels) — measured ahead on same-session minima
+(1.98 vs 2.22 s north star, min of 6 interleaved) but within tunnel
+noise, so the default is unchanged (RESULTS.md round-3 section).
 
 Numerical note (verified on hardware): a single Mosaic iteration matches
 the XLA path to f32 rounding (max rel ~3e-7), but accumulation order

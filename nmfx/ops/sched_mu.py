@@ -105,11 +105,14 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
         raise ValueError(
             f"the slot scheduler implements {tuple(BLOCKS)}, got "
             f"algorithm={cfg.algorithm!r}")
+    use_pallas = cfg.backend == "pallas"
+    if use_pallas and cfg.algorithm != "mu":
+        raise ValueError("the pallas slot scheduler is mu-only")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0 = jnp.asarray(w0, dtype)
     h0 = jnp.asarray(h0, dtype)
-    j, _, k_max = w0.shape
+    j, m, k_max = w0.shape
     n = h0.shape[2]
     s = min(slots, j)
     ce = cfg.check_every
@@ -117,8 +120,9 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     with base.matmul_precision_ctx(cfg.matmul_precision):
         a_loop = a
         if (cfg.matmul_precision == "bfloat16" and dtype == jnp.float32
-                and jax.default_backend() == "tpu"):
-            # same one-time operand truncation as grid_mu/packed_mu
+                and jax.default_backend() == "tpu" and not use_pallas):
+            # same one-time operand truncation as grid_mu/packed_mu (the
+            # pallas kernels cast operands in-kernel instead)
             a_loop = a.astype(jnp.bfloat16)
 
         def vary(x):
@@ -126,8 +130,102 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 x = lax.pcast(x, ax, to="varying")
             return x
 
+        # --- layout hooks: dense (S, m, k) lanes under XLA, or packed
+        # (m, S·k) columns feeding the fused pallas kernels --------------
+        if use_pallas:
+            from nmfx.ops.packed_mu import block_diag_mask
+            from nmfx.ops.pallas_mu import fused_h_update, fused_w_update
+
+            # m padded to the kernels' tile grid (zero rows are invariant
+            # under the MU epilogue — same scheme as mu_packed)
+            ceil_div = lambda x, d: -(-x // d)
+            tiles = ceil_div(m, 512)
+            block_m = ceil_div(ceil_div(m, tiles), 8) * 8
+            m_pad = tiles * block_m
+            if m_pad != m:
+                a_loop = jnp.pad(a_loop, ((0, m_pad - m), (0, 0)))
+                w0 = jnp.pad(w0, ((0, 0), (0, m_pad - m), (0, 0)))
+            interp = jax.default_backend() != "tpu"
+            bd = block_diag_mask(s, k_max, dtype)
+
+            def init_slots():
+                # (s, m_pad, k) → packed (m_pad, s·k)
+                return (jnp.transpose(w0[:s], (1, 0, 2)).reshape(m_pad, -1),
+                        h0[:s].reshape(s * k_max, n))
+
+            def do_step(wp, hp, frozen):
+                frozen_col = jnp.repeat(frozen, k_max)
+                hn = fused_h_update(
+                    a_loop, wp, hp, k=k_max, block_m=block_m,
+                    eps=cfg.div_eps, zero_threshold=cfg.zero_threshold,
+                    matmul_precision=cfg.matmul_precision, interpret=interp)
+                hn = jnp.where(frozen_col[:, None], hp, hn)
+                gh = (hn @ hn.T) * bd  # tiny; stays in XLA
+                wn = fused_w_update(
+                    a_loop, wp, hn, gh, block_m=block_m, eps=cfg.div_eps,
+                    zero_threshold=cfg.zero_threshold,
+                    matmul_precision=cfg.matmul_precision, interpret=interp)
+                wn = jnp.where(frozen_col[None, :], wp, wn)
+                return wn, hn
+
+            def slot_deltas(wp, hp, wprev, hprev, sqrteps):
+                def _d(cur, prev, shape, axes):
+                    diff = jnp.max(jnp.abs(cur - prev).reshape(shape),
+                                   axis=axes)
+                    ref = jnp.max(jnp.abs(prev).reshape(shape), axis=axes)
+                    return diff / (sqrteps + ref)
+
+                return jnp.maximum(
+                    _d(wp, wprev, (m_pad, s, k_max), (0, 2)),
+                    _d(hp, hprev, (s, k_max, n), (1, 2)))
+
+            def slot_labels(hp):
+                return jnp.argmax(hp.reshape(s, k_max, n),
+                                  axis=1).astype(jnp.int32)
+
+            def dense_views(wp, hp):
+                wd = jnp.transpose(wp.reshape(m_pad, s, k_max),
+                                   (1, 0, 2))[:, :m, :]
+                return wd, hp.reshape(s, k_max, n)
+
+            def reload(wp, hp, load, gather):
+                w3 = wp.reshape(m_pad, s, k_max)
+                wg = jnp.transpose(w0[gather], (1, 0, 2))  # (m_pad, s, k)
+                w3 = jnp.where(load[None, :, None], wg, w3)
+                h3 = jnp.where(load[:, None, None], h0[gather],
+                               hp.reshape(s, k_max, n))
+                return w3.reshape(m_pad, s * k_max), h3.reshape(-1, n)
+        else:
+            block = BLOCKS[cfg.algorithm]
+
+            def init_slots():
+                return w0[:s], h0[:s]
+
+            def do_step(wp, hp, frozen):
+                return block(a_loop, wp, hp, frozen, cfg)
+
+            def slot_deltas(wp, hp, wprev, hprev, sqrteps):
+                def _d(cur, prev):
+                    diff = jnp.max(jnp.abs(cur - prev), axis=(1, 2))
+                    ref = jnp.max(jnp.abs(prev), axis=(1, 2))
+                    return diff / (sqrteps + ref)
+
+                return jnp.maximum(_d(wp, wprev), _d(hp, hprev))
+
+            def slot_labels(hp):
+                return jnp.argmax(hp, axis=1).astype(jnp.int32)
+
+            def dense_views(wp, hp):
+                return wp, hp
+
+            def reload(wp, hp, load, gather):
+                ld = load[:, None, None]
+                return (jnp.where(ld, w0[gather], wp),
+                        jnp.where(ld, h0[gather], hp))
+
+        wp0, hp0 = init_slots()
         state0 = SchedState(
-            wp=w0[:s], hp=h0[:s],
+            wp=wp0, hp=hp0,
             slot_iter=vary(jnp.zeros((s,), jnp.int32)),
             classes=vary(jnp.full((s, n), -1, jnp.int32)),
             stable=vary(jnp.zeros((s,), jnp.int32)),
@@ -135,14 +233,12 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             slot_job=vary(jnp.arange(s, dtype=jnp.int32)),
             active=vary(jnp.ones((s,), bool)),
             queue=vary(jnp.asarray(s, jnp.int32)),
-            out_w=vary(jnp.zeros((j + 1, w0.shape[1], k_max), dtype)),
+            out_w=vary(jnp.zeros((j + 1, m, k_max), dtype)),
             out_h=vary(jnp.zeros((j + 1, k_max, n), dtype)),
             out_iters=vary(jnp.zeros((j + 1,), jnp.int32)),
             out_stop=vary(jnp.full((j + 1,), base.StopReason.MAX_ITER,
                                    jnp.int32)),
         )
-
-        block = BLOCKS[cfg.algorithm]
 
         def body(st: SchedState) -> SchedState:
             # --- check_every solver iterations, per-slot max_iter fence ---
@@ -151,39 +247,33 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 frozen = ~st.active | (st.slot_iter + i >= cfg.max_iter)
                 if i == ce - 1:
                     wprev, hprev = wp, hp  # for TolX at the block's check
-                wp, hp = block(a_loop, wp, hp, frozen, cfg)
+                wp, hp = do_step(wp, hp, frozen)
             it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
 
             # --- convergence check (shared bookkeeping; vector `it`) ---
             delta = None
             if cfg.use_tol_checks:
-                sqrteps = jnp.sqrt(jnp.finfo(wp.dtype).eps)
-
-                def _d(cur, prev):
-                    diff = jnp.max(jnp.abs(cur - prev), axis=(1, 2))
-                    ref = jnp.max(jnp.abs(prev), axis=(1, 2))
-                    return diff / (sqrteps + ref)
-
-                delta = jnp.maximum(_d(wp, wprev), _d(hp, hprev))
-            new_classes = jnp.argmax(hp, axis=1).astype(jnp.int32)
+                sqrteps = jnp.sqrt(jnp.finfo(jnp.dtype(dtype)).eps)
+                delta = slot_deltas(wp, hp, wprev, hprev, sqrteps)
             classes, stable, conv, _, reason = batch_convergence(
-                cfg, it_new, new_classes=new_classes, delta=delta,
+                cfg, it_new, new_classes=slot_labels(hp), delta=delta,
                 n_glob=n, classes=st.classes, stable=st.stable,
                 done=~st.active, done_iter=jnp.zeros_like(st.slot_iter),
                 stop_reason=jnp.full((s,), base.StopReason.MAX_ITER,
                                      jnp.int32))
+            wd, hd = dense_views(wp, hp)
             dnorm = st.dnorm
             if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
                 dnorm, conv, reason = tolfun_update(
-                    a, wp, hp, it_new, cfg, dnorm=dnorm, done=conv,
+                    a, wd, hd, it_new, cfg, dnorm=dnorm, done=conv,
                     done_in=~st.active, stop_reason=reason)
             # conv folds in ~active (passed as `done`); isolate fresh stops
             finished = st.active & (conv | (it_new >= cfg.max_iter))
 
             # --- evict finished jobs into the result buffers ---
             idx = jnp.where(finished, st.slot_job, j)  # j = drop row
-            out_w = st.out_w.at[idx].set(wp)
-            out_h = st.out_h.at[idx].set(hp)
+            out_w = st.out_w.at[idx].set(wd)
+            out_h = st.out_h.at[idx].set(hd)
             out_iters = st.out_iters.at[idx].set(it_new)
             out_stop = st.out_stop.at[idx].set(reason)
 
@@ -192,9 +282,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             new_job = st.queue + claim - 1
             load = finished & (new_job < j)
             gather = jnp.where(load, new_job, st.slot_job)
-            ld = load[:, None, None]
-            wp = jnp.where(ld, w0[gather], wp)
-            hp = jnp.where(ld, h0[gather], hp)
+            wp, hp = reload(wp, hp, load, gather)
             fresh_or_done = finished
             return SchedState(
                 wp=wp, hp=hp,

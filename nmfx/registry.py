@@ -51,17 +51,19 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
                  keep_factors: bool = False) -> str:
     """Hash of every input that affects sweep numerics.
 
-    The execution-strategy knob ``backend`` is hashed by its *resolved*
-    value ("auto" picks a concrete path per algorithm), since packed and
-    vmapped execution group matmul reductions differently and are therefore
-    not bit-identical — but "auto" vs an explicit equivalent choice is.
-    ``restart_chunk`` is excluded entirely: chunked and unchunked sweeps
-    are bit-identical by construction (prefix-stable PRNG keys; see
-    tests/test_solvers.py::test_restart_chunking_matches_unchunked).
-    ``ConsensusConfig.grid_exec`` and the mesh shape are likewise excluded:
-    whole-grid vs per-k execution (and different device meshes) reorder
-    GEMM reductions but solve the same factorizations from the same keys —
-    equivalent within float tolerance, like resuming on different hardware.
+    The execution-strategy knob ``backend`` is hashed by its *resolved
+    engine family* ("auto" picks a concrete engine per algorithm: the
+    packed/scheduled GEMM family for mu and hals, the vmapped generic
+    driver otherwise), since different engines group matmul reductions
+    differently and are not bit-identical — but "auto" vs an explicit
+    equivalent choice is. ``restart_chunk`` is excluded entirely: chunked
+    and unchunked sweeps are bit-identical by construction (prefix-stable
+    PRNG keys; see tests/test_solvers.py).
+    ``ConsensusConfig.grid_exec``/``grid_slots`` and the mesh shape are
+    likewise excluded: within one engine family, whole-grid vs per-k
+    execution (and different device meshes) reorder GEMM reductions but
+    solve the same factorizations from the same keys — equivalent within
+    float tolerance, like resuming on different hardware.
     """
     from nmfx.sweep import _use_packed
 
@@ -74,10 +76,11 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     solver.pop("restart_chunk", None)
     resolved = ("pallas" if solver_cfg.backend == "pallas"
                 else "packed" if _use_packed(solver_cfg)
-                # hals' explicit packed backend (the dense-batched
-                # scheduler) is likewise not bit-identical to its vmap path
+                # hals' packed/scheduled family ("auto" resolves there on
+                # every sweep path) is not bit-identical to its vmap path
                 else "packed" if (solver_cfg.algorithm == "hals"
-                                  and solver_cfg.backend == "packed")
+                                  and solver_cfg.backend in ("auto",
+                                                             "packed"))
                 else "vmap")
     solver["backend"] = resolved
     payload = {

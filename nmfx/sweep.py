@@ -118,10 +118,14 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     if _use_packed(solver_cfg):
         return _build_packed_sweep_fn(k, restarts, solver_cfg, init_cfg,
                                       label_rule, mesh, keep_factors)
-    if solver_cfg.algorithm == "hals" and solver_cfg.backend == "packed":
+    if (solver_cfg.algorithm == "hals"
+            and solver_cfg.backend in ("auto", "packed")):
         # hals' batched backend IS the dense grid machinery at one rank:
         # shared-GEMM lanes through the slot scheduler (its two big GEMMs
-        # are mu-shaped — ref libnmf/nmf_mu.c:174-216 for the shapes)
+        # are mu-shaped — ref libnmf/nmf_mu.c:174-216 for the shapes).
+        # "auto" resolves here too so hals' execution family is the same
+        # on every sweep path (the checkpoint fingerprint hashes that
+        # family; vmap is the explicit backend="vmap" choice)
         grid_fn = _build_grid_exec_sweep_fn(
             (k,), restarts, solver_cfg, init_cfg, label_rule, mesh,
             keep_factors, grid_slots, fold_keys=False)
@@ -575,12 +579,15 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
 def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
     """Whether the whole-grid slot-scheduled solve (``nmfx.ops.sched_mu``)
     can run this configuration: an algorithm with a dense-batched block
-    (mu, hals) under the packed-family backend, with no feature/sample
+    (mu, hals) under the packed-family backend — including the fused
+    pallas kernels for mu (the scheduler keeps its slot state in the
+    packed column layout those kernels consume) — with no feature/sample
     mesh axes (those shard single ranks; the grid layout composes with the
-    restart axis only). The pallas backend's fused kernels assume the
-    per-rank packed layout, so it keeps the per-k path."""
+    restart axis only)."""
+    backends = (("auto", "packed", "pallas")
+                if solver_cfg.algorithm == "mu" else ("auto", "packed"))
     if (solver_cfg.algorithm not in ("mu", "hals")
-            or solver_cfg.backend not in ("auto", "packed")):
+            or solver_cfg.backend not in backends):
         return False
     return not (mesh is not None
                 and any(ax in mesh.axis_names and mesh.shape[ax] > 1
@@ -771,7 +778,7 @@ def sweep_one_k(a, key, k: int, restarts: int,
     lanes of the slot-scheduled backends (hals backend='packed';
     ConsensusConfig.grid_slots at the sweep level)."""
     if not (solver_cfg.algorithm == "hals"
-            and solver_cfg.backend == "packed"):
+            and solver_cfg.backend in ("auto", "packed")):
         # only the slot-scheduled branch consumes grid_slots; normalize so
         # a different value cannot force a re-trace of unrelated builders
         grid_slots = 48
@@ -843,8 +850,9 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     eligible = grid_exec_ok(solver_cfg, mesh)
     if cfg.grid_exec == "grid" and not eligible:
         raise ValueError(
-            "grid_exec='grid' needs algorithm 'mu' or 'hals' with backend "
-            "'auto'/'packed' and no feature/sample mesh axes; got "
+            "grid_exec='grid' needs algorithm 'mu' (backend "
+            "'auto'/'packed'/'pallas') or 'hals' (backend "
+            "'auto'/'packed'), and no feature/sample mesh axes; got "
             f"algorithm={solver_cfg.algorithm!r}, "
             f"backend={solver_cfg.backend!r} (use grid_exec='auto' to "
             "fall back per configuration)")

@@ -19,11 +19,18 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 _TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 FIXTURE = os.path.join(_TESTS_DIR, "golden_ref", "reference_mu_fixture.npz")
 
 
 def test_reproduces_reference_binary_run():
+    gct = os.environ.get("NMFX_REFERENCE_GCT",
+                         "/root/reference/20+20x1000.gct")
+    if not os.path.exists(gct):
+        pytest.skip(f"reference fixture not found at {gct} "
+                    "(set NMFX_REFERENCE_GCT)")
     code = f"""
     import jax
     jax.config.update("jax_enable_x64", True)
@@ -39,7 +46,7 @@ def test_reproduces_reference_binary_run():
     ks = tuple(int(k) for k in fx["ks"])
     restarts = int(fx["restarts"])
     maxiter = int(fx["maxiter"])
-    ds = read_gct("/root/reference/20+20x1000.gct")
+    ds = read_gct({gct!r})
     a = np.asarray(ds.values, np.float64)
     assert list(a.shape) == list(fx["shape"])
 

@@ -59,6 +59,27 @@ def test_schedule_free_results(jobs, slots):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("slots", [3, 15])
+def test_pallas_scheduler_matches_dense(jobs, slots):
+    """backend='pallas' runs the same scheduler with packed-column slot
+    state through the fused kernels (interpret mode on CPU executes XLA's
+    own arithmetic, so decisions and factors match the dense path
+    tightly)."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(max_iter=600)
+    ref = mu_sched(a, w0, h0, cfg, slots=slots)
+    got = mu_sched(a, w0, h0, SolverConfig(max_iter=600,
+                                           backend="pallas"), slots=slots)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    np.testing.assert_allclose(np.asarray(ref.w), np.asarray(got.w),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ref.dnorm),
+                               np.asarray(got.dnorm), rtol=1e-5)
+
+
 def test_max_iter_budget(jobs):
     """A cap below convergence evicts every job at exactly max_iter with
     MAX_ITER recorded — the queue still drains (no livelock on jobs that
