@@ -171,6 +171,177 @@ def fused_h_update(a: jax.Array, wp: jax.Array, hp: jax.Array, *, k: int,
     )(a, wp, hp)
 
 
+def _block_kernel(a_ref, frozen_ref, frozenr_ref, w_in_ref, h_in_ref,
+                  w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref, numer_acc,
+                  gram_acc, *, block_m: int, k: int, eps: float,
+                  zero_threshold: float, matmul_dtype):
+    """One grid step of the resident-W block kernel (see
+    fused_block_iterations). Grid = (iters, 2 phases, nt m-tiles); w_ref /
+    h_ref are input/output-aliased FULL blocks that stay VMEM-resident
+    across every step (constant index maps), so the factors never touch
+    HBM inside a block; only A's tiles stream. Phase 0 accumulates the
+    H-half numerator/Gram per tile and applies the H update at the last
+    tile (also pre-masking HHᵀ into gram_acc for phase 1); phase 1 updates
+    W tile-locally. The final iteration also accumulates per-column
+    max|Δ| / max|prev| into the four small stat outputs — the TolX
+    ingredients — so convergence checks need no extra factor snapshot."""
+    del w_in_ref, h_in_ref  # aliased onto w_ref/h_ref (same VMEM window)
+    it = pl.program_id(0)
+    ph = pl.program_id(1)
+    t = pl.program_id(2)
+    last_it = it == pl.num_programs(0) - 1
+    rk = gram_acc.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 0) // k
+    cols = jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 1) // k
+    bd = rows == cols
+    # Mosaic note: masks and stats stay strictly 2-D (keepdims reductions,
+    # pre-shaped (1, rk)/(rk, 1) frozen inputs) — inserting a minor dim on
+    # a non-32-bit value (bool masks) is unsupported on TPU
+    frozen_c = frozen_ref[:] > 0.0  # (1, rk) — W-phase column mask
+    frozen_r = frozenr_ref[:] > 0.0  # (rk, 1) — H-phase row mask
+
+    @pl.when((ph == 0) & (t == 0))
+    def _():
+        numer_acc[:] = jnp.zeros_like(numer_acc)
+        gram_acc[:] = jnp.zeros_like(gram_acc)
+
+    @pl.when(ph == 0)
+    def _():
+        wt = _maybe_cast(w_ref[pl.dslice(t * block_m, block_m), :],
+                         matmul_dtype)
+        at = _maybe_cast(a_ref[:], matmul_dtype)
+        numer_acc[:] += jax.lax.dot_general(
+            wt, at, _CONTRACT_ROWS, preferred_element_type=jnp.float32)
+        gram_acc[:] += jax.lax.dot_general(
+            wt, wt, _CONTRACT_ROWS, preferred_element_type=jnp.float32)
+
+        @pl.when(t == pl.num_programs(2) - 1)
+        def _():
+            gram = jnp.where(bd, gram_acc[:], 0.0)
+            h0 = h_ref[:].astype(jnp.float32)
+            denom = jax.lax.dot_general(
+                _maybe_cast(gram, matmul_dtype),
+                _maybe_cast(h0, matmul_dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            hn = _epilogue(h0, numer_acc[:], denom, eps, zero_threshold,
+                           jnp.float32)
+            hn = jnp.where(frozen_r, h0, hn)
+            h_ref[:] = hn.astype(h_ref.dtype)
+
+            @pl.when(last_it)
+            def _():
+                hd_ref[:] = jnp.max(jnp.abs(hn - h0), axis=1,
+                                    keepdims=True)
+                hm_ref[:] = jnp.max(jnp.abs(h0), axis=1, keepdims=True)
+            # pre-mask HHᵀ for phase 1 (gram_acc is free now)
+            hc = _maybe_cast(hn, matmul_dtype)
+            gram_acc[:] = jnp.where(bd, jax.lax.dot_general(
+                hc, hc, _CONTRACT_COLS,
+                preferred_element_type=jnp.float32), 0.0)
+
+    @pl.when(ph == 1)
+    def _():
+        at = _maybe_cast(a_ref[:], matmul_dtype)
+        h = h_ref[:].astype(jnp.float32)
+        numer = jax.lax.dot_general(
+            at, _maybe_cast(h, matmul_dtype), _CONTRACT_COLS,
+            preferred_element_type=jnp.float32)
+        wt0 = w_ref[pl.dslice(t * block_m, block_m), :].astype(jnp.float32)
+        denom = jax.lax.dot_general(
+            _maybe_cast(wt0, matmul_dtype),
+            _maybe_cast(gram_acc[:], matmul_dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        wn = _epilogue(wt0, numer, denom, eps, zero_threshold, jnp.float32)
+        wn = jnp.where(frozen_c, wt0, wn)
+        w_ref[pl.dslice(t * block_m, block_m), :] = wn.astype(w_ref.dtype)
+
+        @pl.when(last_it)
+        def _():
+            wd_t = jnp.max(jnp.abs(wn - wt0), axis=0, keepdims=True)
+            wm_t = jnp.max(jnp.abs(wt0), axis=0, keepdims=True)
+
+            @pl.when(t == 0)
+            def _():
+                wd_ref[:] = wd_t
+                wm_ref[:] = wm_t
+
+            @pl.when(t > 0)
+            def _():
+                wd_ref[:] = jnp.maximum(wd_ref[:], wd_t)
+                wm_ref[:] = jnp.maximum(wm_ref[:], wm_t)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "iters", "block_m", "eps", "zero_threshold", "matmul_precision",
+    "interpret"))
+def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
+                           frozen_cols: jax.Array, *, k: int,
+                           iters: int = 2, block_m: int = 512,
+                           eps: float = 1e-9, zero_threshold: float = 0.0,
+                           matmul_precision: str = "default",
+                           interpret: bool = False):
+    """``iters`` full MU iterations (both half-updates) in ONE pallas_call
+    with the packed factors VMEM-resident throughout — the whole-solve
+    launch count drops from ~4 kernels per iteration-pair to 1.
+
+    ``frozen_cols``: (1, R·k) f32, >0 marks a frozen (converged/inactive)
+    lane whose columns must not change — callers must keep it constant
+    within the block (the slot scheduler's check/reload boundaries are
+    block-aligned, so it is). Returns ``(wp, hp, wdiff, wmax, hdiff,
+    hmax)`` — the last four are per-column TolX ingredients, (1, R·k) for
+    the W pair and (R·k, 1) for the H pair, measured across the LAST
+    iteration of the block (max|Δ| and max|prev| over the column/row,
+    reduced per lane by the caller).
+
+    VMEM budget: W full-resident dominates — (m·rk + rk·n + 2·block_m·rk
+    + rk² + rk·n)·4B ≈ 13 MB at (m=5120, rk=512, n=512); larger rk
+    overflows ~16 MB VMEM and Mosaic rejects at compile time (use the
+    per-iteration kernels there).
+    """
+    m, n = a.shape
+    rk = wp.shape[1]
+    if m % block_m:
+        raise ValueError(f"m={m} must be a multiple of block_m={block_m}")
+    nt = m // block_m
+    kernel = functools.partial(
+        _block_kernel, block_m=block_m, k=k, eps=eps,
+        zero_threshold=zero_threshold,
+        matmul_dtype=_matmul_dtype(matmul_precision))
+    frozen_rows = frozen_cols.reshape(rk, 1)
+
+    def const(shape):
+        return pl.BlockSpec(shape, lambda i, p, t: (0, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(iters, 2, nt),
+        in_specs=[
+            pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            const((1, rk)), const((rk, 1)), const((m, rk)),
+            const((rk, n)),
+        ],
+        out_specs=[const((m, rk)), const((rk, n)), const((1, rk)),
+                   const((1, rk)), const((rk, 1)), const((rk, 1))],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, rk), wp.dtype),
+            jax.ShapeDtypeStruct((rk, n), hp.dtype),
+            jax.ShapeDtypeStruct((1, rk), jnp.float32),
+            jax.ShapeDtypeStruct((1, rk), jnp.float32),
+            jax.ShapeDtypeStruct((rk, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rk, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rk, n), jnp.float32),
+            pltpu.VMEM((rk, rk), jnp.float32),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )(a, frozen_cols, frozen_rows, wp, hp)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "block_m", "eps", "zero_threshold", "matmul_precision", "interpret"))
 def fused_w_update(a: jax.Array, wp: jax.Array, hp: jax.Array,

@@ -120,9 +120,12 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     with base.matmul_precision_ctx(cfg.matmul_precision):
         a_loop = a
         if (cfg.matmul_precision == "bfloat16" and dtype == jnp.float32
-                and jax.default_backend() == "tpu" and not use_pallas):
-            # same one-time operand truncation as grid_mu/packed_mu (the
-            # pallas kernels cast operands in-kernel instead)
+                and jax.default_backend() == "tpu"):
+            # one-time operand truncation as in grid_mu/packed_mu. For the
+            # pallas path this also halves A's per-block HBM stream — the
+            # kernels' in-kernel cast becomes a no-op on already-bf16
+            # tiles, and the MXU would round the operands to bf16 either
+            # way, so results are unchanged
             a_loop = a.astype(jnp.bfloat16)
 
         def vary(x):
@@ -132,52 +135,100 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
         # --- layout hooks: dense (S, m, k) lanes under XLA, or packed
         # (m, S·k) columns feeding the fused pallas kernels --------------
+        sqrteps = jnp.sqrt(jnp.finfo(jnp.dtype(dtype)).eps)
+
+        def stepped_block(step_fn, delta_fn):
+            """The generic check block: check_every single iterations with
+            the per-step max_iter fence, prev snapshot before the last
+            step, and the layout-specific TolX delta — shared by the dense
+            path and the pallas per-iteration fallback so the fence/delta
+            semantics cannot diverge."""
+            def do_block(wp, hp, active, slot_iter):
+                for i in range(ce):
+                    frozen = ~active | (slot_iter + i >= cfg.max_iter)
+                    if i == ce - 1:
+                        wprev, hprev = wp, hp
+                    wp, hp = step_fn(wp, hp, frozen)
+                return wp, hp, delta_fn(wp, hp, wprev, hprev)
+
+            return do_block
+
+        def ratio(diff, ref):
+            return diff / (sqrteps + ref)
+
         if use_pallas:
             from nmfx.ops.packed_mu import block_diag_mask
-            from nmfx.ops.pallas_mu import fused_h_update, fused_w_update
+            from nmfx.ops.pallas_mu import (fused_block_iterations,
+                                            fused_h_update, fused_w_update)
 
             # m padded to the kernels' tile grid (zero rows are invariant
-            # under the MU epilogue — same scheme as mu_packed)
+            # under the MU epilogue — same scheme as mu_packed, but
+            # 16-row-aligned: A streams in bf16 under that precision, and
+            # bf16's native sublane tiling is 16
             ceil_div = lambda x, d: -(-x // d)
             tiles = ceil_div(m, 512)
-            block_m = ceil_div(ceil_div(m, tiles), 8) * 8
+            block_m = ceil_div(ceil_div(m, tiles), 16) * 16
             m_pad = tiles * block_m
             if m_pad != m:
                 a_loop = jnp.pad(a_loop, ((0, m_pad - m), (0, 0)))
                 w0 = jnp.pad(w0, ((0, 0), (0, m_pad - m), (0, 0)))
             interp = jax.default_backend() != "tpu"
             bd = block_diag_mask(s, k_max, dtype)
+            kern_kw = dict(block_m=block_m, eps=cfg.div_eps,
+                           zero_threshold=cfg.zero_threshold,
+                           matmul_precision=cfg.matmul_precision,
+                           interpret=interp)
 
             def init_slots():
                 # (s, m_pad, k) → packed (m_pad, s·k)
                 return (jnp.transpose(w0[:s], (1, 0, 2)).reshape(m_pad, -1),
                         h0[:s].reshape(s * k_max, n))
 
-            def do_step(wp, hp, frozen):
+            def _one_step(wp, hp, frozen):
                 frozen_col = jnp.repeat(frozen, k_max)
-                hn = fused_h_update(
-                    a_loop, wp, hp, k=k_max, block_m=block_m,
-                    eps=cfg.div_eps, zero_threshold=cfg.zero_threshold,
-                    matmul_precision=cfg.matmul_precision, interpret=interp)
+                hn = fused_h_update(a_loop, wp, hp, k=k_max, **kern_kw)
                 hn = jnp.where(frozen_col[:, None], hp, hn)
                 gh = (hn @ hn.T) * bd  # tiny; stays in XLA
-                wn = fused_w_update(
-                    a_loop, wp, hn, gh, block_m=block_m, eps=cfg.div_eps,
-                    zero_threshold=cfg.zero_threshold,
-                    matmul_precision=cfg.matmul_precision, interpret=interp)
+                wn = fused_w_update(a_loop, wp, hn, gh, **kern_kw)
                 wn = jnp.where(frozen_col[None, :], wp, wn)
                 return wn, hn
 
-            def slot_deltas(wp, hp, wprev, hprev, sqrteps):
-                def _d(cur, prev, shape, axes):
-                    diff = jnp.max(jnp.abs(cur - prev).reshape(shape),
-                                   axis=axes)
-                    ref = jnp.max(jnp.abs(prev).reshape(shape), axis=axes)
-                    return diff / (sqrteps + ref)
+            if cfg.max_iter % ce == 0:
+                # the whole check block is ONE pallas_call: factors stay
+                # VMEM-resident across both half-updates of all
+                # check_every iterations, and the TolX ingredients come
+                # back as per-column stats (fused_block_iterations). The
+                # max_iter fence needs no per-step mask here: slot_iter is
+                # always a multiple of check_every, so a slot crosses the
+                # cap only at a block boundary.
+                def do_block(wp, hp, active, slot_iter):
+                    frozen = ~active | (slot_iter >= cfg.max_iter)
+                    fcol = jnp.repeat(frozen, k_max).astype(
+                        jnp.float32)[None, :]
+                    wp, hp, wd, wm, hd, hm = fused_block_iterations(
+                        a_loop, wp, hp, fcol, k=k_max, iters=ce, **kern_kw)
 
-                return jnp.maximum(
-                    _d(wp, wprev, (m_pad, s, k_max), (0, 2)),
-                    _d(hp, hprev, (s, k_max, n), (1, 2)))
+                    def lane_max(x):  # (1, rk) or (rk, 1) → per-slot max
+                        return jnp.max(x.reshape(s, k_max), axis=1)
+
+                    delta = jnp.maximum(
+                        ratio(lane_max(wd), lane_max(wm)),
+                        ratio(lane_max(hd), lane_max(hm)))
+                    return wp, hp, delta
+            else:
+                def packed_deltas(wp, hp, wprev, hprev):
+                    def _d(cur, prev, shape, axes):
+                        return ratio(
+                            jnp.max(jnp.abs(cur - prev).reshape(shape),
+                                    axis=axes),
+                            jnp.max(jnp.abs(prev).reshape(shape),
+                                    axis=axes))
+
+                    return jnp.maximum(
+                        _d(wp, wprev, (m_pad, s, k_max), (0, 2)),
+                        _d(hp, hprev, (s, k_max, n), (1, 2)))
+
+                do_block = stepped_block(_one_step, packed_deltas)
 
             def slot_labels(hp):
                 return jnp.argmax(hp.reshape(s, k_max, n),
@@ -201,16 +252,16 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             def init_slots():
                 return w0[:s], h0[:s]
 
-            def do_step(wp, hp, frozen):
-                return block(a_loop, wp, hp, frozen, cfg)
-
-            def slot_deltas(wp, hp, wprev, hprev, sqrteps):
+            def dense_deltas(wp, hp, wprev, hprev):
                 def _d(cur, prev):
-                    diff = jnp.max(jnp.abs(cur - prev), axis=(1, 2))
-                    ref = jnp.max(jnp.abs(prev), axis=(1, 2))
-                    return diff / (sqrteps + ref)
+                    return ratio(jnp.max(jnp.abs(cur - prev), axis=(1, 2)),
+                                 jnp.max(jnp.abs(prev), axis=(1, 2)))
 
                 return jnp.maximum(_d(wp, wprev), _d(hp, hprev))
+
+            do_block = stepped_block(
+                lambda wp, hp, frozen: block(a_loop, wp, hp, frozen, cfg),
+                dense_deltas)
 
             def slot_labels(hp):
                 return jnp.argmax(hp, axis=1).astype(jnp.int32)
@@ -241,20 +292,12 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
         )
 
         def body(st: SchedState) -> SchedState:
-            # --- check_every solver iterations, per-slot max_iter fence ---
-            wp, hp = st.wp, st.hp
-            for i in range(ce):
-                frozen = ~st.active | (st.slot_iter + i >= cfg.max_iter)
-                if i == ce - 1:
-                    wprev, hprev = wp, hp  # for TolX at the block's check
-                wp, hp = do_step(wp, hp, frozen)
+            # --- one check block: check_every solver iterations with the
+            # per-slot max_iter fence, returning the TolX delta ----------
+            wp, hp, delta = do_block(st.wp, st.hp, st.active, st.slot_iter)
             it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
-
-            # --- convergence check (shared bookkeeping; vector `it`) ---
-            delta = None
-            if cfg.use_tol_checks:
-                sqrteps = jnp.sqrt(jnp.finfo(jnp.dtype(dtype)).eps)
-                delta = slot_deltas(wp, hp, wprev, hprev, sqrteps)
+            if not cfg.use_tol_checks:
+                delta = None
             classes, stable, conv, _, reason = batch_convergence(
                 cfg, it_new, new_classes=slot_labels(hp), delta=delta,
                 n_glob=n, classes=st.classes, stable=st.stable,

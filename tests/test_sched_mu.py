@@ -59,16 +59,17 @@ def test_schedule_free_results(jobs, slots):
                                rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("slots", [3, 15])
-def test_pallas_scheduler_matches_dense(jobs, slots):
+@pytest.mark.parametrize("slots,max_iter", [(3, 600), (15, 600), (5, 601)])
+def test_pallas_scheduler_matches_dense(jobs, slots, max_iter):
     """backend='pallas' runs the same scheduler with packed-column slot
     state through the fused kernels (interpret mode on CPU executes XLA's
     own arithmetic, so decisions and factors match the dense path
-    tightly)."""
+    tightly). max_iter=601 covers the per-iteration fallback (the
+    block kernel needs max_iter % check_every == 0)."""
     a, w0, h0 = jobs
-    cfg = SolverConfig(max_iter=600)
+    cfg = SolverConfig(max_iter=max_iter)
     ref = mu_sched(a, w0, h0, cfg, slots=slots)
-    got = mu_sched(a, w0, h0, SolverConfig(max_iter=600,
+    got = mu_sched(a, w0, h0, SolverConfig(max_iter=max_iter,
                                            backend="pallas"), slots=slots)
     np.testing.assert_array_equal(np.asarray(ref.iterations),
                                   np.asarray(got.iterations))
