@@ -304,28 +304,49 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 done=~st.active, done_iter=jnp.zeros_like(st.slot_iter),
                 stop_reason=jnp.full((s,), base.StopReason.MAX_ITER,
                                      jnp.int32))
-            wd, hd = dense_views(wp, hp)
             dnorm = st.dnorm
             if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
+                wd, hd = dense_views(wp, hp)
                 dnorm, conv, reason = tolfun_update(
                     a, wd, hd, it_new, cfg, dnorm=dnorm, done=conv,
                     done_in=~st.active, stop_reason=reason)
             # conv folds in ~active (passed as `done`); isolate fresh stops
             finished = st.active & (conv | (it_new >= cfg.max_iter))
 
-            # --- evict finished jobs into the result buffers ---
-            idx = jnp.where(finished, st.slot_job, j)  # j = drop row
-            out_w = st.out_w.at[idx].set(wd)
-            out_h = st.out_h.at[idx].set(hd)
-            out_iters = st.out_iters.at[idx].set(it_new)
-            out_stop = st.out_stop.at[idx].set(reason)
+            # --- evict + reload, under lax.cond: the vast majority of
+            # check blocks finish NO job, and inside a (non-vmapped)
+            # while_loop body the cond is a real branch — the result-
+            # buffer scatters, W0/H0 gathers, factor rewrites (and, on
+            # the packed layout, the dense-view transpose) are skipped
+            # entirely on no-evict blocks instead of running as masked
+            # no-ops every 2 iterations
+            def evict_reload(ops):
+                wp, hp, out_w, out_h, out_iters, out_stop, slot_job, \
+                    active, queue = ops
+                wdv, hdv = dense_views(wp, hp)
+                idx = jnp.where(finished, slot_job, j)  # j = drop row
+                out_w = out_w.at[idx].set(wdv)
+                out_h = out_h.at[idx].set(hdv)
+                out_iters = out_iters.at[idx].set(it_new)
+                out_stop = out_stop.at[idx].set(reason)
+                # prefix-sum claim of the next queued jobs
+                claim = jnp.cumsum(finished.astype(jnp.int32))
+                new_job = queue + claim - 1
+                load = finished & (new_job < j)
+                gather = jnp.where(load, new_job, slot_job)
+                wp, hp = reload(wp, hp, load, gather)
+                slot_job = jnp.where(load, new_job,
+                                     jnp.where(finished, j, slot_job))
+                active = jnp.where(finished, load, active)
+                queue = queue + jnp.sum(load.astype(jnp.int32))
+                return (wp, hp, out_w, out_h, out_iters, out_stop,
+                        slot_job, active, queue)
 
-            # --- reload freed slots from the queue (prefix-sum claim) ---
-            claim = jnp.cumsum(finished.astype(jnp.int32))
-            new_job = st.queue + claim - 1
-            load = finished & (new_job < j)
-            gather = jnp.where(load, new_job, st.slot_job)
-            wp, hp = reload(wp, hp, load, gather)
+            ops = (wp, hp, st.out_w, st.out_h, st.out_iters, st.out_stop,
+                   st.slot_job, st.active, st.queue)
+            (wp, hp, out_w, out_h, out_iters, out_stop, slot_job, active,
+             queue) = lax.cond(jnp.any(finished), evict_reload,
+                               lambda ops: ops, ops)
             fresh_or_done = finished
             return SchedState(
                 wp=wp, hp=hp,
@@ -333,10 +354,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 classes=jnp.where(fresh_or_done[:, None], -1, classes),
                 stable=jnp.where(fresh_or_done, 0, stable),
                 dnorm=jnp.where(fresh_or_done, jnp.inf, dnorm),
-                slot_job=jnp.where(load, new_job,
-                                   jnp.where(finished, j, st.slot_job)),
-                active=jnp.where(finished, load, st.active),
-                queue=st.queue + jnp.sum(load.astype(jnp.int32)),
+                slot_job=slot_job, active=active, queue=queue,
                 out_w=out_w, out_h=out_h, out_iters=out_iters,
                 out_stop=out_stop,
             )
