@@ -115,6 +115,18 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     j, m, k_max = w0.shape
     n = h0.shape[2]
     s = min(slots, j)
+    if use_pallas:
+        # hard VMEM envelope of the resident-W block kernel: W
+        # full-resident means s·k_max packed columns must stay ≲512
+        # (≈13 MB at m≈5000) or Mosaic rejects at compile time — shrink
+        # the pool instead of crashing; the queue semantics are
+        # slot-count-free (test_sched_mu.py::test_schedule_free_results)
+        if k_max > 512:
+            raise ValueError(
+                f"k_max={k_max} exceeds the pallas scheduler's resident-W "
+                "VMEM envelope (512 packed columns) even at one slot; use "
+                "backend='packed'")
+        s = max(1, min(s, 512 // k_max))
     ce = cfg.check_every
 
     with base.matmul_precision_ctx(cfg.matmul_precision):

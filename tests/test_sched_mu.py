@@ -81,6 +81,24 @@ def test_pallas_scheduler_matches_dense(jobs, slots, max_iter):
                                np.asarray(got.dnorm), rtol=1e-5)
 
 
+def test_pallas_pool_clamps_to_vmem_envelope(jobs):
+    """k_max beyond the resident-W envelope (slots·k_max > 512) shrinks
+    the pallas pool instead of hitting a Mosaic VMEM rejection; results
+    stay schedule-free."""
+    a, w0, h0 = jobs
+    k_big = 52  # 512 // 52 = 9 slots < the requested 48
+    w0b = jnp.pad(w0, ((0, 0), (0, 0), (0, k_big - w0.shape[2])))
+    h0b = jnp.pad(h0, ((0, 0), (0, k_big - h0.shape[1]), (0, 0)))
+    cfg = SolverConfig(max_iter=100)
+    ref = mu_sched(a, w0b, h0b, cfg, slots=48)
+    got = mu_sched(a, w0b, h0b, SolverConfig(max_iter=100,
+                                             backend="pallas"), slots=48)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_allclose(np.asarray(ref.w), np.asarray(got.w),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_max_iter_budget(jobs):
     """A cap below convergence evicts every job at exactly max_iter with
     MAX_ITER recorded — the queue still drains (no livelock on jobs that
