@@ -99,19 +99,22 @@ def _assert_outputs_match(g, p, ks, keep_factors=False):
                                        rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("use_mesh", [False, True])
-def test_sweep_grid_matches_per_k(data, use_mesh):
+@pytest.mark.parametrize("use_mesh,backend", [(False, "auto"),
+                                              (True, "auto"),
+                                              (True, "pallas")])
+def test_sweep_grid_matches_per_k(data, use_mesh, backend):
     """sweep(grid_exec='grid') ≡ sweep(grid_exec='per_k') on one device and
     on the restart mesh (restarts=5 on 8 devices exercises the padding
-    lanes)."""
+    lanes); the pallas scheduler composes with the mesh (per-device pools
+    inside shard_map, interpret mode on CPU)."""
     mesh = default_mesh() if use_mesh else None
     if use_mesh:
         assert mesh is not None and RESTART_AXIS in mesh.axis_names
-    scfg = SolverConfig(max_iter=600)
+    scfg = SolverConfig(max_iter=600, backend=backend)
     g = sweep(data, ConsensusConfig(ks=KS, restarts=R, grid_exec="grid"),
               scfg, InitConfig(), mesh)
     p = sweep(data, ConsensusConfig(ks=KS, restarts=R, grid_exec="per_k"),
-              scfg, InitConfig(), mesh)
+              SolverConfig(max_iter=600), InitConfig(), mesh)
     _assert_outputs_match(g, p, KS)
 
 
