@@ -112,6 +112,28 @@ def test_max_iter_budget(jobs):
     assert np.all(np.asarray(got.stop_reason) == StopReason.MAX_ITER)
 
 
+def test_single_job_matches_solve(jobs):
+    """The degenerate 1-job grid equals the plain single-restart solver
+    (same update math, no scheduling to do)."""
+    from nmfx.solvers.base import solve
+
+    a, w0, h0 = jobs
+    k = KS[0]
+    cfg = SolverConfig(max_iter=300)
+    ref = solve(a, w0[0, :, :k], h0[0, :k, :], cfg)
+    got = mu_sched(a, w0[:1], h0[:1], cfg, slots=8)
+    np.testing.assert_array_equal(int(ref.iterations),
+                                  int(got.iterations[0]))
+    np.testing.assert_array_equal(int(ref.stop_reason),
+                                  int(got.stop_reason[0]))
+    np.testing.assert_allclose(np.asarray(ref.w),
+                               np.asarray(got.w[0, :, :k]),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ref.h),
+                               np.asarray(got.h[0, :k, :]),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_non_mu_rejected(jobs):
     a, w0, h0 = jobs
     with pytest.raises(ValueError, match="mu"):
