@@ -21,10 +21,15 @@ n_dev = len(jax.devices())
 print(f"{n_dev} device(s): {jax.devices()}")
 a = two_group_matrix(n_genes=400, n_per_group=12, seed=0)
 
-# 1) restart axis over all devices (what use_mesh=True does automatically)
+# 1) restart axis over all devices (what use_mesh=True does automatically).
+#    Multi-rank mu/hals sweeps also default to whole-grid execution: every
+#    (k, restart) cell solves in ONE compiled slot-scheduled batch, each
+#    device running its own job queue over its restart shard
+#    (grid_exec="auto"; pass grid_exec="per_k" for sequential ranks, or
+#    solver_cfg backend="pallas" for the fused-kernel pool on TPU)
 result = nmfx.nmfconsensus(a, ks=(2, 3), restarts=2 * max(n_dev, 1),
                            seed=7)
-print("\nrestart-sharded sweep:")
+print("\nrestart-sharded sweep (whole-grid scheduler):")
 print(result.summary())
 
 # 2) grid sharding: tile each factorization's rows/columns across devices.
