@@ -148,6 +148,11 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if not os.path.isfile(args.dataset):
+        # a clean, instant usage error instead of a FileNotFoundError /
+        # IsADirectoryError traceback from deep inside the reader — and
+        # before the jax import and cache-dir creation below
+        parser.error(f"dataset not found: {args.dataset}")
     if args.trace_dir and not args.profile:
         parser.error("--trace-dir requires --profile")
     if args.backend in ("packed", "pallas") and args.algorithm != "mu":
