@@ -1,0 +1,76 @@
+"""Same-session interleaved A/B: dense vs pallas scheduler, north star.
+
+The repo's measurement protocol for backend comparisons (BASELINE.md):
+the tunneled chip swings ±50% between sessions and single runs flip 2×,
+so both configurations compile once in ONE process and then alternate
+timed reps; only same-session minima (and medians) are compared.
+
+Usage: python benchmarks/probe_ab_northstar.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.sweep import default_mesh, sweep
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--genes", type=int, default=5000)
+    ap.add_argument("--samples", type=int, default=500)
+    ap.add_argument("--kmax", type=int, default=10)
+    ap.add_argument("--restarts", type=int, default=50)
+    ap.add_argument("--backends", nargs="+", default=["auto", "pallas"])
+    args = ap.parse_args()
+
+    ks = tuple(range(2, args.kmax + 1))
+    sizes = [args.samples // 4] * 4
+    sizes[0] += args.samples % 4
+    a = grouped_matrix(args.genes, tuple(sizes), effect=2.0, seed=0)
+    icfg = InitConfig()
+    mesh = default_mesh()
+    ccfg = ConsensusConfig(ks=ks, restarts=args.restarts, seed=123,
+                           grid_exec="grid")
+
+    def run(backend):
+        scfg = SolverConfig(algorithm="mu", max_iter=10000,
+                            matmul_precision="bfloat16", backend=backend)
+        t0 = time.perf_counter()
+        raw = sweep(a, ccfg, scfg, icfg, mesh)
+        host = jax.device_get({k: (raw[k].consensus, raw[k].iterations)
+                               for k in ks})
+        wall = time.perf_counter() - t0
+        mean_iters = {k: float(host[k][1].mean()) for k in ks}
+        return wall, mean_iters
+
+    # warm both (compile) before any timing
+    for b in args.backends:
+        t0 = time.perf_counter()
+        _, its = run(b)
+        print(f"warm {b}: {time.perf_counter() - t0:.1f}s "
+              f"mean_iters={ {k: round(v, 1) for k, v in its.items()} }",
+              flush=True)
+
+    walls = {b: [] for b in args.backends}
+    for rep in range(args.reps):
+        for b in args.backends:
+            w, _ = run(b)
+            walls[b].append(w)
+            print(f"rep {rep} {b}: {w:.3f}s", flush=True)
+
+    for b in args.backends:
+        v = np.array(walls[b])
+        print(f"{b}: min={v.min():.3f}s median={np.median(v):.3f}s "
+              f"all={[round(x, 3) for x in v.tolist()]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Marginal per-iteration cost of the slot scheduler: dense vs pallas.
+
+The repo's marginal-cost protocol (round 3, kept for round 4 re-runs on
+the FIXED block kernel): class/TolX stops OFF so every job runs exactly
+max_iter iterations with the pool permanently full (48 jobs in 48
+slots, no reloads), then the per-whole-pool-iteration cost is the
+min-of-N delta between a long and a short run divided by the iteration
+difference — short-delta timing on the tunneled chip fabricates fixed
+costs, so the delta must span hundreds of iterations.
+
+Usage: python benchmarks/probe_sched_marginal.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nmfx.config import SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.ops.sched_mu import mu_sched
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--genes", type=int, default=5000)
+    ap.add_argument("--samples", type=int, default=500)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--jobs", type=int, default=48)
+    ap.add_argument("--iters", type=int, nargs=2, default=[200, 800])
+    args = ap.parse_args()
+
+    m, n, k, j = args.genes, args.samples, args.k, args.jobs
+    lo, hi = args.iters
+    a = grouped_matrix(m, (n // 4,) * 4, effect=2.0, seed=0)
+    key = jax.random.PRNGKey(3)
+    kw, kh = jax.random.split(key)
+    w0 = jax.random.uniform(kw, (j, m, k), jnp.float32)
+    h0 = jax.random.uniform(kh, (j, k, n), jnp.float32)
+
+    def run(backend, max_iter):
+        cfg = SolverConfig(algorithm="mu", max_iter=max_iter,
+                           use_class_stop=False, use_tol_checks=False,
+                           matmul_precision="bfloat16", backend=backend)
+        t0 = time.perf_counter()
+        r = mu_sched(a, w0, h0, cfg, slots=j)
+        np.asarray(r.iterations)  # host materialization
+        np.asarray(r.w[0])
+        return time.perf_counter() - t0
+
+    cells = [(b, it) for b in ("auto", "pallas") for it in (lo, hi)]
+    for b, it in cells:  # compile
+        t0 = time.perf_counter()
+        run(b, it)
+        print(f"warm {b}@{it}: {time.perf_counter() - t0:.1f}s", flush=True)
+
+    walls = {c: [] for c in cells}
+    for rep in range(args.reps):
+        for c in cells:
+            w = run(*c)
+            walls[c].append(w)
+            print(f"rep {rep} {c}: {w:.3f}s", flush=True)
+
+    for b in ("auto", "pallas"):
+        wlo = min(walls[(b, lo)])
+        whi = min(walls[(b, hi)])
+        per_iter = (whi - wlo) / (hi - lo)
+        print(f"{b}: min {lo}-iter={wlo:.3f}s min {hi}-iter={whi:.3f}s "
+              f"marginal={per_iter * 1e3:.4f} ms/pool-iteration")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Hardware probe: mu_sched dense vs pallas — per-job stop parity.
+
+Second bisect stage for the round-3 corruption: probe_block_kernel.py
+shows the block kernel is bit-exact standalone, so this drives the FULL
+scheduler (while_loop + lax.cond evict/reload) on the real chip at a
+scaled shape and compares per-job iteration counts and stop reasons
+between backend='pallas' (block-kernel path) and the XLA-dense scheduler.
+
+Usage: python benchmarks/probe_sched_pallas.py [--max-iter 10000]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nmfx.config import SolverConfig
+from nmfx.ops.sched_mu import mu_sched
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--m", type=int, default=1000)
+    ap.add_argument("--n", type=int, default=200)
+    ap.add_argument("--jobs", type=int, default=16)
+    ap.add_argument("--k", type=int, default=5)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-iter", type=int, default=10000)
+    ap.add_argument("--stable-checks", type=int, default=50)
+    ap.add_argument("--precision", default="bfloat16",
+                    choices=["default", "bfloat16"])
+    args = ap.parse_args()
+    j, m, n, k = args.jobs, args.m, args.n, args.k
+    print(f"platform={jax.default_backend()} J={j} m={m} n={n} k={k} "
+          f"slots={args.slots} stable_checks={args.stable_checks}")
+
+    key = jax.random.PRNGKey(7)
+    ka, k0 = jax.random.split(key)
+    # planted 3-group structure so class labels genuinely stabilize
+    groups = jnp.repeat(jnp.arange(3), n // 3 + 1)[:n]
+    base_sig = jax.random.uniform(ka, (m, 3)) * 2.0
+    a = base_sig[:, groups] + 0.1 * jax.random.uniform(k0, (m, n))
+    keys = jax.random.split(jax.random.PRNGKey(11), 2 * j)
+    w0 = jnp.stack([jax.random.uniform(keys[i], (m, k)) for i in range(j)])
+    h0 = jnp.stack([jax.random.uniform(keys[j + i], (k, n))
+                    for i in range(j)])
+
+    results = {}
+    for backend in ("auto", "pallas"):
+        cfg = SolverConfig(algorithm="mu", backend=backend,
+                           max_iter=args.max_iter,
+                           stable_checks=args.stable_checks,
+                           matmul_precision=args.precision)
+        r = mu_sched(a, w0, h0, cfg, slots=args.slots)
+        iters = np.asarray(r.iterations)
+        stops = np.asarray(r.stop_reason)
+        results[backend] = (iters, stops)
+        print(f"backend={backend:7s} iters={iters.tolist()}")
+        print(f"                 stops={stops.tolist()}")
+
+    di, ds = results["auto"]
+    pi, ps = results["pallas"]
+    floor = 2 * args.stable_checks  # min credible class-stable iteration
+    bad = pi < floor
+    print(f"\nmin-credible-stop floor = {floor}")
+    print(f"pallas jobs below floor: {int(bad.sum())}/{j}")
+    print(f"iter agreement (exact): {int((di == pi).sum())}/{j}; "
+          f"max |diff| = {int(np.max(np.abs(di - pi)))}")
+    print(f"stop-reason agreement: {int((ds == ps).sum())}/{j}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Compile-feasibility sweep for fused_block_iterations VMEM envelope."""
+import jax, jax.numpy as jnp
+from nmfx.ops.pallas_mu import fused_block_iterations
+
+def try_cfg(m, n, rk, k, block_m, a_dtype, precision):
+    a = jnp.ones((m, n), a_dtype)
+    wp = jnp.ones((m, rk), jnp.float32)
+    hp = jnp.ones((rk, n), jnp.float32)
+    fc = jnp.zeros((1, rk), jnp.float32)
+    try:
+        r = fused_block_iterations(a, wp, hp, fc, k=k, iters=2,
+                                   block_m=block_m,
+                                   matmul_precision=precision)
+        jax.block_until_ready(r)
+        return "OK"
+    except Exception as e:
+        msg = str(e)
+        if "vmem" in msg.lower() or "memory" in msg.lower():
+            import re
+            mm = re.search(r"size ([0-9.]+)M", msg)
+            return f"VMEM OOM ({mm.group(1)}M)" if mm else "VMEM OOM"
+        return "ERR: " + msg.splitlines()[0][:100]
+
+for a_dtype, prec in ((jnp.float32, "default"), (jnp.bfloat16, "bfloat16")):
+    for rk in (512, 448, 384):
+        for bm in (512, 256, 128):
+            res = try_cfg(5120, 512, rk, 8, bm, a_dtype, prec)
+            print(f"a={a_dtype.__name__} rk={rk} block_m={bm}: {res}", flush=True)

@@ -1,0 +1,38 @@
+"""Second VMEM-envelope sweep: vary m and n to calibrate the slot-clamp
+byte model (`sched_mu._pallas_slot_clamp`); see probe_vmem_envelope.py
+for the rk/block_m sweep at the north-star shape."""
+import jax, jax.numpy as jnp
+from nmfx.ops.pallas_mu import fused_block_iterations
+
+def try_cfg(m, n, rk, k, block_m, a_dtype, precision):
+    a = jnp.ones((m, n), a_dtype)
+    wp = jnp.ones((m, rk), jnp.float32)
+    hp = jnp.ones((rk, n), jnp.float32)
+    fc = jnp.zeros((1, rk), jnp.float32)
+    try:
+        r = fused_block_iterations(a, wp, hp, fc, k=k, iters=2,
+                                   block_m=block_m, matmul_precision=precision)
+        jax.block_until_ready(r)
+        return "OK"
+    except Exception as e:
+        msg = str(e)
+        if "vmem" in msg.lower():
+            import re
+            mm = re.search(r"size ([0-9.]+)M", msg)
+            return f"OOM({mm.group(1)}M)" if mm else "OOM"
+        return "ERR: " + msg.splitlines()[0][:80]
+
+cases = [
+    # vary m at n=512
+    (10240, 512, 256, 8, 512), (10240, 512, 224, 8, 512),
+    (20480, 512, 128, 8, 512), (20480, 512, 112, 8, 512),
+    # vary n at m=5120
+    (5120, 1024, 384, 8, 512), (5120, 1024, 320, 8, 512),
+    (5120, 1024, 256, 8, 512),
+    (5120, 2048, 192, 8, 256), (5120, 2048, 160, 8, 256),
+    # small-k north-star-ish: k=10 -> rk=440 (44 slots)
+    (5120, 512, 440, 10, 512), (5120, 512, 480, 10, 512),
+]
+for m, n, rk, k, bm in cases:
+    res = try_cfg(m, n, rk, k, bm, jnp.bfloat16, "bfloat16")
+    print(f"m={m} n={n} rk={rk} block_m={bm}: {res}", flush=True)

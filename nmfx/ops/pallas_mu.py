@@ -24,21 +24,27 @@ variance caveats): the packed XLA path wins the north-star sweep by
 their sessions on isolated long-running large-R·k solves (k=10 at
 5000×500: lower fixed AND marginal cost, ~1.8× end-to-end) and are the
 opt-in ``backend="pallas"`` for that regime, plus the template for future
-hand-tuned paths. Round 3: the whole-grid slot scheduler
-(``nmfx.ops.sched_mu``) also runs on these kernels under
-``backend="pallas"`` (packed-column slot state, two launches per
-iteration vs ~12 XLA kernels) — measured ahead on same-session minima
-(1.98 vs 2.22 s north star, min of 6 interleaved) but within tunnel
-noise, so the default is unchanged (RESULTS.md round-3 section).
+hand-tuned paths. The whole-grid slot scheduler (``nmfx.ops.sched_mu``)
+also runs on these kernels under ``backend="pallas"`` (packed-column
+slot state; one ``fused_block_iterations`` launch per check block).
+History: round 3's block kernel used input/output-aliased VMEM windows
+and was corrupted inside the scheduler's while_loop on real hardware
+(BENCH_r03's headline was retracted — VERDICT.md round 3); round 4
+replaced the aliasing with an explicit one-shot DMA and re-verified
+on-chip (see below). For current performance numbers see
+benchmarks/RESULTS.md round-4 section.
 
-Numerical note (verified on hardware): a single Mosaic iteration matches
-the XLA path to f32 rounding (max rel ~3e-7), but accumulation order
-differs, so *factor trajectories* drift apart multiplicatively over
-hundreds of iterations (~1e-2 relative after 60). The converged
-consensus pipeline is invariant to this: labels, consensus matrices, and
-per-restart iteration counts come out identical to the packed backend on
-the real chip (and the CPU interpret-mode tests match tightly because
-interpret executes XLA's own arithmetic).
+Numerical note (verified on hardware, round 4 —
+``benchmarks/probe_block_kernel.py`` / ``probe_sched_pallas.py`` on a
+real v5e): ``fused_block_iterations`` is bit-exact against the
+per-iteration kernel pair over 60 iterations, including frozen-lane
+invariance and the TolX stats, and the pallas slot scheduler's per-job
+iteration counts are bit-identical between the block-kernel path and the
+per-iteration fallback. Against the XLA dense path, Mosaic accumulation
+order differs, so *factor trajectories* drift apart multiplicatively
+over hundreds of iterations (~1e-2 relative after 60) and individual
+stop iterations can drift with them; stop *reasons* and the converged
+consensus pipeline agree (hardware gate: ``bench.py --verify``).
 
 VMEM budget: the H kernel holds the (R·k, n) numerator and (R·k, R·k)
 Gram accumulators plus three streamed blocks resident, ≈
@@ -177,18 +183,42 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, w_in_ref, h_in_ref,
                   zero_threshold: float, matmul_dtype):
     """One grid step of the resident-W block kernel (see
     fused_block_iterations). Grid = (iters, 2 phases, nt m-tiles); w_ref /
-    h_ref are input/output-aliased FULL blocks that stay VMEM-resident
-    across every step (constant index maps), so the factors never touch
-    HBM inside a block; only A's tiles stream. Phase 0 accumulates the
+    h_ref are FULL output blocks that stay VMEM-resident across every
+    step (constant index maps) and are seeded from w_in/h_in by a
+    one-shot DMA at the first step, so the factors never touch HBM
+    inside a block; only A's tiles stream. Phase 0 accumulates the
     H-half numerator/Gram per tile and applies the H update at the last
     tile (also pre-masking HHᵀ into gram_acc for phase 1); phase 1 updates
     W tile-locally. The final iteration also accumulates per-column
     max|Δ| / max|prev| into the four small stat outputs — the TolX
     ingredients — so convergence checks need no extra factor snapshot."""
-    del w_in_ref, h_in_ref  # aliased onto w_ref/h_ref (same VMEM window)
     it = pl.program_id(0)
     ph = pl.program_id(1)
     t = pl.program_id(2)
+
+    # One-shot manual DMA of the initial factors (HBM, memory_space=ANY)
+    # into the VMEM-resident output windows at the very first grid step.
+    # Deliberately NOT input_output_aliases: round 3 shipped this kernel
+    # with the inputs aliased onto the output windows, and on real
+    # hardware, inside the scheduler's `lax.while_loop`/`lax.cond` body,
+    # the aliased windows went stale — slot reloads written to the HBM
+    # buffer between calls never reached VMEM, so reloaded jobs iterated
+    # on the PREVIOUS job's converged factors (the BENCH_r03
+    # mean_iters_per_k=2.0 corruption; VERDICT.md round 3, Weak #1).
+    # Bisected on-chip in round 4: the kernel is bit-exact standalone
+    # either way, and bit-exact in-scheduler only with the aliasing
+    # removed (benchmarks/probe_block_kernel.py, probe_sched_pallas.py).
+    @pl.when((it == 0) & (ph == 0) & (t == 0))
+    def _():
+        def init(sems):
+            dma_w = pltpu.make_async_copy(w_in_ref, w_ref, sems.at[0])
+            dma_h = pltpu.make_async_copy(h_in_ref, h_ref, sems.at[1])
+            dma_w.start()
+            dma_h.start()
+            dma_w.wait()
+            dma_h.wait()
+
+        pl.run_scoped(init, pltpu.SemaphoreType.DMA((2,)))
     last_it = it == pl.num_programs(0) - 1
     rk = gram_acc.shape[0]
     rows = jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 0) // k
@@ -294,10 +324,22 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
     iteration of the block (max|Δ| and max|prev| over the column/row,
     reduced per lane by the caller).
 
-    VMEM budget: W full-resident dominates — (m·rk + rk·n + 2·block_m·rk
-    + rk² + rk·n)·4B ≈ 13 MB at (m=5120, rk=512, n=512); larger rk
-    overflows ~16 MB VMEM and Mosaic rejects at compile time (use the
-    per-iteration kernels there).
+    The initial factors are NOT aliased onto the outputs: they arrive in
+    HBM (``memory_space=ANY``) and the kernel DMAs them into the resident
+    windows once at the first grid step. Round 3's
+    ``input_output_aliases`` formulation was bit-exact standalone but
+    silently read stale VMEM inside a ``lax.while_loop``/``lax.cond``
+    body on real hardware (see ``_block_kernel``'s comment and VERDICT.md
+    round 3); do not reintroduce it.
+
+    VMEM budget (measured on v5e, round 4 —
+    ``benchmarks/probe_vmem_envelope*.py``): W full-resident dominates;
+    the empirical fit accepted by the scheduler
+    (``sched_mu._pallas_slot_clamp``, the single source of truth for the
+    formula) is ``4·rk·(m_pad + 3·n_pad + rk) + 2·block_m·n_pad·a_bytes
+    ≤ 14.9 MiB`` with n_pad = n rounded up to 128 lanes (e.g. rk ≤ 480
+    at m=5120, n=512, bf16 A; rk ≤ 352 at n=1024). Beyond it Mosaic
+    rejects at compile time — use the per-iteration kernels there.
     """
     m, n = a.shape
     rk = wp.shape[1]
@@ -314,14 +356,18 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
         return pl.BlockSpec(shape, lambda i, p, t: (0, 0),
                             memory_space=pltpu.VMEM)
 
+    # w0/h0 stay in HBM (ANY); the kernel DMAs them into the resident
+    # output windows exactly once — same total traffic as the round-3
+    # aliased design, without relying on custom-call aliasing semantics
     return pl.pallas_call(
         kernel,
         grid=(iters, 2, nt),
         in_specs=[
             pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
                          memory_space=pltpu.VMEM),
-            const((1, rk)), const((rk, 1)), const((m, rk)),
-            const((rk, n)),
+            const((1, rk)), const((rk, 1)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[const((m, rk)), const((rk, n)), const((1, rk)),
                    const((1, rk)), const((rk, 1)), const((rk, 1))],
@@ -337,7 +383,6 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
             pltpu.VMEM((rk, n), jnp.float32),
             pltpu.VMEM((rk, rk), jnp.float32),
         ],
-        input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
     )(a, frozen_cols, frozen_rows, wp, hp)
 

@@ -50,6 +50,72 @@ from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
 
 
+def _pallas_block_geometry(m: int):
+    """Tile geometry shared by the clamp and the solver: ~512-row tiles,
+    16-row-aligned so bf16 A streams on its native sublane tiling."""
+    ceil_div = lambda x, d: -(-x // d)
+    tiles = ceil_div(m, 512)
+    block_m = ceil_div(ceil_div(m, tiles), 16) * 16
+    return tiles, block_m, tiles * block_m
+
+
+def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
+                       cfg: SolverConfig) -> int:
+    """Clamp the slot pool to the resident-W block kernel's VMEM envelope.
+
+    Empirical v5e model (round 4, benchmarks/probe_vmem_envelope*.py —
+    measured OK/OOM boundaries at m∈{5120,10240,20480}, n∈{512,1024,2048},
+    both A dtypes): with rk = s·k_max packed columns,
+
+        4·rk·(m_pad + 3·n_pad + rk) + 2·block_m·n_pad·a_bytes
+
+    must stay ≤ 14.9 MiB (≈ the 16 MiB scoped-VMEM limit minus ~1.1 MiB
+    fixed overhead; the 3·n_pad term — one slot beyond the h/numer
+    windows — matches an extra n-proportional allocation visible in the
+    measured OOM sizes). The fit separates every measured point: accepts
+    rk=480 (m=5120, n=512, bf16 — the north-star 48-slot pool at
+    k_max=10), rejects rk=512 there (measured OOM 17.08 MiB), accepts
+    rk=320 and rejects rk=384 at n=1024 (OOM 17.33 MiB), accepts rk=448
+    f32 (boundary OK). Shrinks the pool to the largest fitting slot count
+    instead of letting Mosaic reject at compile time (the model is
+    best-effort: if it ever admits an unfittable shape, Mosaic still
+    fails loudly at compile time); the queue semantics are
+    slot-count-free (test_sched_mu.py::test_schedule_free_results). The
+    clamp is a real performance cliff (fewer resident lanes → narrower
+    GEMMs), so any reduction below the requested pool is logged at
+    WARNING.
+    """
+    _, block_m, m_pad = _pallas_block_geometry(m)
+    n_pad = -(-n // 128) * 128
+    a_bytes = 2 if (cfg.matmul_precision == "bfloat16"
+                    and jnp.dtype(cfg.dtype) == jnp.float32
+                    and jax.default_backend() == "tpu") else \
+        jnp.dtype(cfg.dtype).itemsize
+    budget = int(14.9 * 2**20) - 2 * block_m * n_pad * a_bytes
+
+    def fits(slots: int) -> bool:
+        rk = slots * k_max
+        return 4 * rk * (m_pad + 3 * n_pad + rk) <= budget
+
+    if not fits(1):
+        raise ValueError(
+            f"one k={k_max} job at m={m}, n={n} already exceeds the pallas "
+            "scheduler's resident-W VMEM envelope (see "
+            "nmfx/ops/pallas_mu.py VMEM budget); use backend='packed'")
+    clamped = s
+    while not fits(clamped):
+        clamped -= 1
+    if clamped < s:
+        import logging
+        logging.getLogger("nmfx").warning(
+            "pallas scheduler: slot pool clamped %d -> %d (VMEM envelope: "
+            "k_max=%d, m=%d, n=%d, %d packed columns resident); fewer "
+            "slots narrows the batched GEMMs — backend='packed' may be "
+            "faster at this shape", s, clamped, k_max, m, n,
+            clamped * k_max)
+    return clamped
+
+
 class SchedState(NamedTuple):
     # slot-resident solver state (no cross-block w_prev/h_prev: the TolX
     # delta is between the block's last two steps, both inside `body`)
@@ -116,17 +182,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     n = h0.shape[2]
     s = min(slots, j)
     if use_pallas:
-        # hard VMEM envelope of the resident-W block kernel: W
-        # full-resident means s·k_max packed columns must stay ≲512
-        # (≈13 MB at m≈5000) or Mosaic rejects at compile time — shrink
-        # the pool instead of crashing; the queue semantics are
-        # slot-count-free (test_sched_mu.py::test_schedule_free_results)
-        if k_max > 512:
-            raise ValueError(
-                f"k_max={k_max} exceeds the pallas scheduler's resident-W "
-                "VMEM envelope (512 packed columns) even at one slot; use "
-                "backend='packed'")
-        s = max(1, min(s, 512 // k_max))
+        s = _pallas_slot_clamp(s, k_max, m, n, cfg)
     ce = cfg.check_every
 
     with base.matmul_precision_ctx(cfg.matmul_precision):
@@ -177,10 +233,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             # under the MU epilogue — same scheme as mu_packed, but
             # 16-row-aligned: A streams in bf16 under that precision, and
             # bf16's native sublane tiling is 16
-            ceil_div = lambda x, d: -(-x // d)
-            tiles = ceil_div(m, 512)
-            block_m = ceil_div(ceil_div(m, tiles), 16) * 16
-            m_pad = tiles * block_m
+            _, block_m, m_pad = _pallas_block_geometry(m)
             if m_pad != m:
                 a_loop = jnp.pad(a_loop, ((0, m_pad - m), (0, 0)))
                 w0 = jnp.pad(w0, ((0, 0), (0, m_pad - m), (0, 0)))

@@ -82,11 +82,12 @@ def test_pallas_scheduler_matches_dense(jobs, slots, max_iter):
 
 
 def test_pallas_pool_clamps_to_vmem_envelope(jobs):
-    """k_max beyond the resident-W envelope (slots·k_max > 512) shrinks
-    the pallas pool instead of hitting a Mosaic VMEM rejection; results
-    stay schedule-free."""
+    """k_max beyond the resident-W VMEM envelope shrinks the pallas pool
+    (``_pallas_slot_clamp``'s measured byte model of m, n, k_max and the
+    A dtype — far fewer than the requested 48 slots at k=52) instead of
+    hitting a Mosaic VMEM rejection; results stay schedule-free."""
     a, w0, h0 = jobs
-    k_big = 52  # 512 // 52 = 9 slots < the requested 48
+    k_big = 52  # the clamp model admits only a handful of 52-wide slots
     w0b = jnp.pad(w0, ((0, 0), (0, 0), (0, k_big - w0.shape[2])))
     h0b = jnp.pad(h0, ((0, 0), (0, k_big - h0.shape[1]), (0, 0)))
     cfg = SolverConfig(max_iter=100)
