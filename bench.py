@@ -291,6 +291,12 @@ def main():
                         f"fixed scaled shape; --{name.replace('_', '-')} "
                         "does not apply (only --maxiter/--precision are "
                         "honored)")
+        # the gate asserts no MAX_ITER burns, which presumes the budget
+        # lets every job converge (class-stability floor 402 + headroom)
+        if args.maxiter < 2000:
+            p.error("--verify needs --maxiter >= 2000 so every job can "
+                    "converge; a lower cap would fail the gate's "
+                    "no-MAX_ITER assertion on a healthy solver")
         raise SystemExit(run_verify(args))
     scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter,
                         matmul_precision=args.precision,

@@ -1,0 +1,103 @@
+"""North-star cross-engine consensus equivalence — the recorded artifact.
+
+Runs the FULL north-star sweep (k=2..10 × 50 restarts, 5000×500) through
+the three mu execution engines on the real device — per-k packed,
+grid-dense (slot scheduler on XLA blocks), grid-pallas (slot scheduler
+on the fused kernels) — and records the user-visible deltas: per-k
+max |ΔC| between consensus matrices, Δrho, the rank table each engine
+selects, and mean iterations. `bench.py --verify` is the fast scaled
+gate; this is the full-scale evidence artifact (VERDICT r3 #6), written
+to benchmarks/CROSSCHECK_r04.json + a markdown summary on stdout.
+
+Usage: python benchmarks/crosscheck_consensus.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _run_sweep_engine  # noqa: E402
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig  # noqa: E402
+from nmfx.datasets import grouped_matrix  # noqa: E402
+from nmfx.sweep import default_mesh  # noqa: E402
+
+
+def main():
+    m, n, restarts = 5000, 500, 50
+    ks = tuple(range(2, 11))
+    a = grouped_matrix(m, (n // 4,) * 4, effect=2.0, seed=0)
+    scfg = SolverConfig(algorithm="mu", max_iter=10000,
+                        matmul_precision="bfloat16")
+    icfg = InitConfig()
+    mesh = default_mesh()
+    engines = {
+        "per-k": (dataclasses.replace(scfg, backend="packed"), "per_k"),
+        "grid-dense": (dataclasses.replace(scfg, backend="auto"), "grid"),
+        "grid-pallas": (dataclasses.replace(scfg, backend="pallas"),
+                        "grid"),
+    }
+    results = {}
+    for name, (cfg_e, grid_exec) in engines.items():
+        ccfg = ConsensusConfig(ks=ks, restarts=restarts, seed=123,
+                               grid_exec=grid_exec)
+        t0 = time.perf_counter()
+        results[name] = _run_sweep_engine(a, ks, cfg_e, ccfg, icfg, mesh)
+        print(f"# {name}: {time.perf_counter() - t0:.1f}s "
+              "(incl. compile on first run)", file=sys.stderr)
+
+    record = {"shape": f"{m}x{n}", "ks": list(ks), "restarts": restarts,
+              "config": "maxiter=10000, bf16, seed=123", "engines": {}}
+    for name, (its, _, cons, rho) in results.items():
+        record["engines"][name] = {
+            "rho": {str(k): round(float(rho[k]), 4) for k in ks},
+            "best_k": int(max(ks, key=lambda k: rho[k])),
+            "mean_iters": {str(k): round(float(its[k].mean()), 1)
+                           for k in ks},
+        }
+    ref_name = "grid-dense"
+    _, _, ref_cons, ref_rho = results[ref_name]
+    record["deltas_vs_grid_dense"] = {}
+    for name in engines:
+        if name == ref_name:
+            continue
+        _, _, cons, rho = results[name]
+        record["deltas_vs_grid_dense"][name] = {
+            str(k): {"max_dC": round(float(np.max(np.abs(
+                cons[k] - ref_cons[k]))), 4),
+                "mean_dC": round(float(np.mean(np.abs(
+                    cons[k] - ref_cons[k]))), 5),
+                "d_rho": round(abs(float(rho[k]) - float(ref_rho[k])), 4)}
+            for k in ks}
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "CROSSCHECK_r04.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"# wrote {out}", file=sys.stderr)
+
+    # markdown summary
+    print("| engine | best k | rho(k=2..10) |")
+    print("|---|---|---|")
+    for name, e in record["engines"].items():
+        rhos = " ".join(e["rho"][str(k)] if isinstance(e["rho"][str(k)], str)
+                        else f"{e['rho'][str(k)]:.3f}" for k in ks)
+        print(f"| {name} | {e['best_k']} | {rhos} |")
+    print()
+    print("| engine vs grid-dense | worst max|dC| | worst d_rho |")
+    print("|---|---|---|")
+    for name, d in record["deltas_vs_grid_dense"].items():
+        worst_dc = max(v["max_dC"] for v in d.values())
+        worst_dr = max(v["d_rho"] for v in d.values())
+        print(f"| {name} | {worst_dc} | {worst_dr} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -63,7 +63,10 @@ def main():
 
     di, ds = results["auto"]
     pi, ps = results["pallas"]
-    floor = 2 * args.stable_checks  # min credible class-stable iteration
+    # min credible class-stable stop: first counted check at iteration
+    # 2·check_every, then stable_checks consecutive stable checks
+    # (same formula as bench._integrity_problems)
+    floor = 2 * (args.stable_checks + 1)
     bad = pi < floor
     print(f"\nmin-credible-stop floor = {floor}")
     print(f"pallas jobs below floor: {int(bad.sum())}/{j}")

@@ -21,8 +21,11 @@ def try_cfg(m, n, rk, k, block_m, a_dtype, precision):
             return f"VMEM OOM ({mm.group(1)}M)" if mm else "VMEM OOM"
         return "ERR: " + msg.splitlines()[0][:100]
 
-for a_dtype, prec in ((jnp.float32, "default"), (jnp.bfloat16, "bfloat16")):
-    for rk in (512, 448, 384):
-        for bm in (512, 256, 128):
-            res = try_cfg(5120, 512, rk, 8, bm, a_dtype, prec)
-            print(f"a={a_dtype.__name__} rk={rk} block_m={bm}: {res}", flush=True)
+if __name__ == "__main__":
+    for a_dtype, prec in ((jnp.float32, "default"),
+                          (jnp.bfloat16, "bfloat16")):
+        for rk in (512, 448, 384):
+            for bm in (512, 256, 128):
+                res = try_cfg(5120, 512, rk, 8, bm, a_dtype, prec)
+                print(f"a={a_dtype.__name__} rk={rk} block_m={bm}: {res}",
+                      flush=True)

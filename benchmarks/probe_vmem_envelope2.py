@@ -1,26 +1,9 @@
 """Second VMEM-envelope sweep: vary m and n to calibrate the slot-clamp
 byte model (`sched_mu._pallas_slot_clamp`); see probe_vmem_envelope.py
-for the rk/block_m sweep at the north-star shape."""
-import jax, jax.numpy as jnp
-from nmfx.ops.pallas_mu import fused_block_iterations
+for the rk/block_m sweep at the north-star shape (and for try_cfg)."""
+import jax.numpy as jnp
 
-def try_cfg(m, n, rk, k, block_m, a_dtype, precision):
-    a = jnp.ones((m, n), a_dtype)
-    wp = jnp.ones((m, rk), jnp.float32)
-    hp = jnp.ones((rk, n), jnp.float32)
-    fc = jnp.zeros((1, rk), jnp.float32)
-    try:
-        r = fused_block_iterations(a, wp, hp, fc, k=k, iters=2,
-                                   block_m=block_m, matmul_precision=precision)
-        jax.block_until_ready(r)
-        return "OK"
-    except Exception as e:
-        msg = str(e)
-        if "vmem" in msg.lower():
-            import re
-            mm = re.search(r"size ([0-9.]+)M", msg)
-            return f"OOM({mm.group(1)}M)" if mm else "OOM"
-        return "ERR: " + msg.splitlines()[0][:80]
+from probe_vmem_envelope import try_cfg
 
 cases = [
     # vary m at n=512

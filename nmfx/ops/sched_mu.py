@@ -50,6 +50,18 @@ from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
 
 
+def _streams_bf16_a(cfg: SolverConfig) -> bool:
+    """Whether the loop streams A as one-time-truncated bf16 (the MXU
+    would round the operands to bf16 either way under this precision, so
+    results are unchanged and A's HBM traffic halves). Single source of
+    truth for both the cast site in ``mu_sched`` and the VMEM slot
+    clamp's a_bytes — the two must never disagree or the byte model is
+    off by 2x on the A-tile term."""
+    return (cfg.matmul_precision == "bfloat16"
+            and jnp.dtype(cfg.dtype) == jnp.float32
+            and jax.default_backend() == "tpu")
+
+
 def _pallas_block_geometry(m: int):
     """Tile geometry shared by the clamp and the solver: ~512-row tiles,
     16-row-aligned so bf16 A streams on its native sublane tiling."""
@@ -87,10 +99,7 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
     """
     _, block_m, m_pad = _pallas_block_geometry(m)
     n_pad = -(-n // 128) * 128
-    a_bytes = 2 if (cfg.matmul_precision == "bfloat16"
-                    and jnp.dtype(cfg.dtype) == jnp.float32
-                    and jax.default_backend() == "tpu") else \
-        jnp.dtype(cfg.dtype).itemsize
+    a_bytes = 2 if _streams_bf16_a(cfg) else jnp.dtype(cfg.dtype).itemsize
     budget = int(14.9 * 2**20) - 2 * block_m * n_pad * a_bytes
 
     def fits(slots: int) -> bool:
@@ -187,13 +196,10 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
     with base.matmul_precision_ctx(cfg.matmul_precision):
         a_loop = a
-        if (cfg.matmul_precision == "bfloat16" and dtype == jnp.float32
-                and jax.default_backend() == "tpu"):
-            # one-time operand truncation as in grid_mu/packed_mu. For the
-            # pallas path this also halves A's per-block HBM stream — the
-            # kernels' in-kernel cast becomes a no-op on already-bf16
-            # tiles, and the MXU would round the operands to bf16 either
-            # way, so results are unchanged
+        if _streams_bf16_a(cfg):
+            # one-time operand truncation as in grid_mu/packed_mu (see
+            # _streams_bf16_a for why results are unchanged and why the
+            # predicate is shared with the VMEM slot clamp)
             a_loop = a.astype(jnp.bfloat16)
 
         def vary(x):
@@ -394,8 +400,11 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 out_h = out_h.at[idx].set(hdv)
                 out_iters = out_iters.at[idx].set(it_new)
                 out_stop = out_stop.at[idx].set(reason)
-                # prefix-sum claim of the next queued jobs
-                claim = jnp.cumsum(finished.astype(jnp.int32))
+                # prefix-sum claim of the next queued jobs (dtypes pinned
+                # to int32: under jax_enable_x64 jnp.sum/cumsum would
+                # otherwise promote to int64 and break the lax.cond's
+                # equal-output-types contract with the no-evict branch)
+                claim = jnp.cumsum(finished, dtype=jnp.int32)
                 new_job = queue + claim - 1
                 load = finished & (new_job < j)
                 gather = jnp.where(load, new_job, slot_job)
@@ -403,7 +412,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 slot_job = jnp.where(load, new_job,
                                      jnp.where(finished, j, slot_job))
                 active = jnp.where(finished, load, active)
-                queue = queue + jnp.sum(load.astype(jnp.int32))
+                queue = queue + jnp.sum(load, dtype=jnp.int32)
                 return (wp, hp, out_w, out_h, out_iters, out_stop,
                         slot_job, active, queue)
 

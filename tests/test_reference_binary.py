@@ -100,3 +100,93 @@ def test_reproduces_reference_binary_run():
                           cwd="/root/repo")
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "OK" in proc.stdout
+
+
+@pytest.mark.parametrize("engine", ["grid", "sched", "sched_pallas"])
+def test_reference_binary_through_grid_engines(engine):
+    """The same reference-binary fixture driven through the execution
+    engines users actually get (VERDICT r3 #5): the whole mixed-rank
+    (k=2..5 × 10 restarts) grid as ONE zero-padded job batch through
+    ``mu_grid`` and ``mu_sched`` — the scheduler with a deliberately tiny
+    slot pool (7 slots for 40 jobs) so every job beyond the first seven
+    rides the evict/reload path that round 3's pallas kernel corrupted.
+
+    The f64 engines (grid, sched-dense) must match the reference binary's
+    factors to the same tight tolerance as the vmap path plus labels and
+    consensus EXACTLY; the pallas engine accumulates in f32 inside its
+    kernels (interpret mode on CPU), so its factors drift at f32 scale —
+    for it the binary-parity claim is the user-visible one: labels and
+    consensus exact, rho in the tie-ambiguity band.
+    """
+    gct = os.environ.get("NMFX_REFERENCE_GCT",
+                         "/root/reference/20+20x1000.gct")
+    if not os.path.exists(gct):
+        pytest.skip(f"reference fixture not found at {gct} "
+                    "(set NMFX_REFERENCE_GCT)")
+    code = f"""
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from nmfx.config import SolverConfig
+    from nmfx.io import read_gct
+
+    engine = {engine!r}
+    fx = np.load({FIXTURE!r})
+    ks = tuple(int(k) for k in fx["ks"])
+    restarts = int(fx["restarts"])
+    maxiter = int(fx["maxiter"])
+    a = np.asarray(read_gct({gct!r}).values, np.float64)
+    m, n = a.shape
+    k_max = max(ks)
+
+    # one dense zero-padded job batch, rank-major — the grid engines'
+    # production layout (sweep._build_grid_exec_sweep_fn)
+    jobs = [(k, r) for k in ks for r in range(restarts)]
+    w0 = np.zeros((len(jobs), m, k_max))
+    h0 = np.zeros((len(jobs), k_max, n))
+    for j, (k, r) in enumerate(jobs):
+        rng = np.random.default_rng(1000 * k + r)
+        w0[j, :, :k] = rng.random((m, k))
+        h0[j, :k, :] = rng.random((k, n))
+
+    backend = "pallas" if engine == "sched_pallas" else "auto"
+    cfg = SolverConfig(algorithm="mu", max_iter=maxiter, dtype="float64",
+                       use_tol_checks=False, class_flip_tol=0.0,
+                       backend=backend)
+    if engine == "grid":
+        from nmfx.ops.grid_mu import mu_grid
+        res = mu_grid(a, jnp.asarray(w0), jnp.asarray(h0), cfg)
+    else:
+        from nmfx.ops.sched_mu import mu_sched
+        res = mu_sched(a, jnp.asarray(w0), jnp.asarray(h0), cfg, slots=7)
+    assert np.all(np.asarray(res.iterations) == maxiter)
+
+    h = np.asarray(res.h)
+    w = np.asarray(res.w)
+    for k in ks:
+        base_j = jobs.index((k, 0))
+        labels = np.stack([np.argmin(h[base_j + r, :k, :], axis=0)
+                           for r in range(restarts)])
+        np.testing.assert_array_equal(labels, fx[f"labels_k{{k}}"])
+        cons = (labels[:, :, None] == labels[:, None, :]).mean(0)
+        np.testing.assert_array_equal(cons, fx[f"consensus_k{{k}}"])
+        if engine != "sched_pallas":
+            for r in range(restarts):
+                np.testing.assert_allclose(
+                    h[base_j + r, :k, :], fx[f"h_k{{k}}_r{{r}}"],
+                    rtol=1e-7, atol=1e-9)
+            np.testing.assert_allclose(w[base_j, :, :k], fx[f"w_k{{k}}_r0"],
+                                       rtol=1e-7, atol=1e-9)
+        from nmfx.cophenetic import rank_selection
+        rho, _, _ = rank_selection(cons, k)
+        np.testing.assert_allclose(rho, float(fx[f"rho_k{{k}}"]), atol=1e-3)
+        print(f"k={{k}} OK")
+    print("OK")
+    """
+    proc = subprocess.run([sys.executable, "-c", textwrap.dedent(code)],
+                          capture_output=True, text=True, timeout=600,
+                          cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "OK" in proc.stdout
