@@ -362,7 +362,7 @@ def nmfconsensus(
 
         registry = SweepRegistry.open(checkpoint_dir, arr, scfg, icfg,
                                       restarts, seed, label_rule,
-                                      keep_factors)
+                                      keep_factors, mesh)
     if profiler is None:
         from nmfx.profiling import NullProfiler
 

@@ -155,9 +155,20 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"dataset not found: {args.dataset}")
     if args.trace_dir and not args.profile:
         parser.error("--trace-dir requires --profile")
-    if args.backend in ("packed", "pallas") and args.algorithm != "mu":
-        parser.error(f"--backend {args.backend} is only implemented for "
+    if not args.ks:
+        # e.g. a descending range '5-3' parses to no ranks at all
+        parser.error("--ks selects no ranks (use e.g. '2-5', '2,3,4' "
+                     "or '3')")
+    if min(args.ks) < 2:
+        # instant usage error instead of the ValueError traceback the API
+        # raises for the same input (reference guard: nmf.r:107-108)
+        parser.error(f"--ks must all be >= 2, got {min(args.ks)}")
+    if args.backend == "pallas" and args.algorithm != "mu":
+        parser.error("--backend pallas is only implemented for "
                      "--algorithm mu (use auto)")
+    if args.backend == "packed" and args.algorithm not in ("mu", "hals"):
+        parser.error("--backend packed is only implemented for "
+                     "--algorithm mu/hals (use auto)")
     if args.verbose:
         import logging
 

@@ -150,7 +150,7 @@ def residual_norms(a: jax.Array, wp: jax.Array, hp: jax.Array, r: int,
 
 
 def residual_norms_direct(a: jax.Array, w: jax.Array, h: jax.Array,
-                          chunk: int = 8,
+                          chunk: int | None = None,
                           feature_axis: str | None = None,
                           m_total: int | None = None,
                           sample_axis: str | None = None,
@@ -169,9 +169,19 @@ def residual_norms_direct(a: jax.Array, w: jax.Array, h: jax.Array,
     reference does in f64 (``libnmf/calculatenorm.c:44-78``). Zero-padded
     trailing k-columns/rows contribute exact zeros. Under
     ``feature_axis``/``sample_axis`` the local square-sums psum over the
-    grid axes and the RMS normalizer uses the unsharded dims."""
+    grid axes and the RMS normalizer uses the unsharded dims.
+
+    ``chunk=None`` (the default used by every solver entry point) caps
+    the transient at ~80 MB of reconstructions: chunk = 8 at the
+    north-star 5000×500 (measured optimal there: 8/16/32/64 →
+    73/112/113/112 ms) and proportionally fewer as m·n grows — at
+    20000×1000 a fixed chunk of 8 would materialize a ~640 MB (8, m, n)
+    scratch per scan step."""
     b, m, _ = w.shape
     n = h.shape[2]
+    if chunk is None:
+        budget = 80 * 2**20  # bytes of live (chunk, m, n) reconstruction
+        chunk = max(1, min(8, budget // (m * n * a.dtype.itemsize)))
     nb = -(-b // chunk)
     pad = nb * chunk - b
     if pad:

@@ -48,7 +48,7 @@ def _all_fields(cfg) -> dict:
 
 def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
                  seed: int, label_rule: str,
-                 keep_factors: bool = False) -> str:
+                 keep_factors: bool = False, mesh=None) -> str:
     """Hash of every input that affects sweep numerics.
 
     The execution-strategy knob ``backend`` is hashed by its *resolved
@@ -56,7 +56,12 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     packed/scheduled GEMM family for mu and hals, the vmapped generic
     driver otherwise), since different engines group matmul reductions
     differently and are not bit-identical — but "auto" vs an explicit
-    equivalent choice is. ``restart_chunk`` is excluded entirely: chunked
+    equivalent choice is. The ``mesh`` participates ONLY in that
+    resolution (mirroring ``sweep._build_sweep_fn``'s routing): on a
+    feature/sample-sharded mesh hals executes the grid-sharded generic
+    driver, not the packed family, so its family resolves to "vmap"
+    there — the mesh shape itself stays out of the hash (see below).
+    ``restart_chunk`` is excluded entirely: chunked
     and unchunked sweeps are bit-identical by construction (prefix-stable
     PRNG keys; see tests/test_solvers.py).
     ``ConsensusConfig.grid_exec``/``grid_slots`` and the mesh shape are
@@ -65,7 +70,7 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     solve the same factorizations from the same keys — equivalent within
     float tolerance, like resuming on different hardware.
     """
-    from nmfx.sweep import _use_packed
+    from nmfx.sweep import resolve_engine_family
 
     h = hashlib.sha256()
     arr = np.ascontiguousarray(np.asarray(a))
@@ -74,15 +79,7 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     h.update(arr.tobytes())
     solver = _all_fields(solver_cfg)
     solver.pop("restart_chunk", None)
-    resolved = ("pallas" if solver_cfg.backend == "pallas"
-                else "packed" if _use_packed(solver_cfg)
-                # hals' packed/scheduled family ("auto" resolves there on
-                # every sweep path) is not bit-identical to its vmap path
-                else "packed" if (solver_cfg.algorithm == "hals"
-                                  and solver_cfg.backend in ("auto",
-                                                             "packed"))
-                else "vmap")
-    solver["backend"] = resolved
+    solver["backend"] = resolve_engine_family(solver_cfg, mesh)
     payload = {
         "solver": solver,
         "init": _all_fields(init_cfg),
@@ -130,10 +127,10 @@ class SweepRegistry:
     @classmethod
     def open(cls, directory: str, a, solver_cfg, init_cfg,
              restarts: int, seed: int, label_rule: str,
-             keep_factors: bool = False) -> "SweepRegistry":
+             keep_factors: bool = False, mesh=None) -> "SweepRegistry":
         return cls(directory, _fingerprint(a, solver_cfg, init_cfg,
                                            restarts, seed, label_rule,
-                                           keep_factors))
+                                           keep_factors, mesh))
 
     def _path(self, k: int) -> str:
         return os.path.join(self.directory, f"k{k}.npz")

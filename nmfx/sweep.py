@@ -86,13 +86,44 @@ def _use_packed(solver_cfg: SolverConfig) -> bool:
             and solver_cfg.backend in ("auto", "packed", "pallas"))
 
 
+def grid_axes_active(mesh: Mesh | None) -> bool:
+    """Whether the mesh shards single factorizations over feature/sample
+    axes (vs a restart-only or absent mesh)."""
+    return (mesh is not None
+            and any(ax in mesh.axis_names and mesh.shape[ax] > 1
+                    for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
+
+
+def resolve_engine_family(solver_cfg: SolverConfig,
+                          mesh: Mesh | None = None) -> str:
+    """The engine family a configuration actually executes — "pallas",
+    "packed" (the batched/scheduled GEMM family), or "vmap" (the generic
+    driver, including its grid-sharded form).
+
+    Single source of truth shared by the sweep dispatch below and the
+    registry fingerprint (nmfx/registry.py): families group matmul
+    reductions differently and are not bit-identical, so checkpoints must
+    never cross them — any routing change here invalidates exactly the
+    right registries. hals auto/packed resolves to the packed family on
+    restart-only meshes but to the grid-sharded generic driver when
+    feature/sample axes are active (the GRID_SOLVERS branch of
+    ``_build_sweep_fn``)."""
+    if solver_cfg.backend == "pallas":
+        return "pallas"
+    if _use_packed(solver_cfg):
+        return "packed"
+    if (solver_cfg.algorithm == "hals"
+            and solver_cfg.backend in ("auto", "packed")
+            and not grid_axes_active(mesh)):
+        return "packed"
+    return "vmap"
+
+
 @lru_cache(maxsize=64)
 def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                     init_cfg: InitConfig, label_rule: str, mesh: Mesh | None,
                     keep_factors: bool = False, grid_slots: int = 48):
-    grid = (mesh is not None
-            and any(ax in mesh.axis_names and mesh.shape[ax] > 1
-                    for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
+    grid = grid_axes_active(mesh)
     if grid:
         grid_ok = ((_use_packed(solver_cfg)
                     and solver_cfg.backend != "pallas")
@@ -589,9 +620,7 @@ def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
     if (solver_cfg.algorithm not in ("mu", "hals")
             or solver_cfg.backend not in backends):
         return False
-    return not (mesh is not None
-                and any(ax in mesh.axis_names and mesh.shape[ax] > 1
-                        for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
+    return not grid_axes_active(mesh)
 
 
 @lru_cache(maxsize=64)

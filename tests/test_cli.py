@@ -47,6 +47,13 @@ def test_cli_rejects_bad_combos(gct_path):
               "--no-files"])
     with pytest.raises(SystemExit):
         main([gct_path, "--trace-dir", "/tmp/x", "--no-files"])
+    with pytest.raises(SystemExit):
+        # clean usage error, not a ValueError traceback (reference guard
+        # nmf.r:107-108)
+        main([gct_path, "--ks", "1-3", "--no-files"])
+    with pytest.raises(SystemExit):
+        main([gct_path, "--backend", "pallas", "--algorithm", "hals",
+              "--no-files"])
 
 
 def test_cli_writes_outputs(gct_path, tmp_path, capsys):

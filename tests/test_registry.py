@@ -100,6 +100,35 @@ def test_fingerprint_forward_compatible_with_default_fields():
     assert _fingerprint(a, other, InitConfig(), 4, 1, "argmax") != fp
 
 
+def test_fingerprint_resolves_hals_engine_with_mesh():
+    """hals backend='auto' executes the packed/scheduled family on
+    restart-only meshes but the grid-sharded generic (vmap-family) driver
+    on a feature/sample-sharded mesh (sweep GRID_SOLVERS routing) — the
+    fingerprint must distinguish the two so checkpoints never cross
+    engine families, while 'auto' and the explicit equivalent backend
+    still hash identically within each family."""
+    import dataclasses
+
+    import numpy as np
+
+    from nmfx.config import InitConfig, SolverConfig
+    from nmfx.registry import _fingerprint
+    from nmfx.sweep import grid_mesh
+
+    a = np.ones((4, 3))
+    cfg = SolverConfig(algorithm="hals", max_iter=50)
+    mesh = grid_mesh(None, feature_shards=2, sample_shards=1)
+    fp_flat = _fingerprint(a, cfg, InitConfig(), 4, 1, "argmax")
+    fp_grid = _fingerprint(a, cfg, InitConfig(), 4, 1, "argmax", mesh=mesh)
+    assert fp_flat != fp_grid
+    # auto == the explicit engine it resolves to, in both regimes
+    packed = dataclasses.replace(cfg, backend="packed")
+    vmap = dataclasses.replace(cfg, backend="vmap")
+    assert _fingerprint(a, packed, InitConfig(), 4, 1, "argmax") == fp_flat
+    assert _fingerprint(a, vmap, InitConfig(), 4, 1, "argmax",
+                        mesh=mesh) == fp_grid
+
+
 def test_corrupt_checkpoint_self_heals(low_rank_data, tmp_path, caplog):
     """A truncated/garbage rank file must not crash resume: the sweep logs
     a warning, recomputes the rank, and overwrites a good checkpoint."""
