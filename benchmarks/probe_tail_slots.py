@@ -1,0 +1,65 @@
+"""Tail-pool width sweep: tune mu_sched's straggler tail compaction.
+
+Interleaved same-session reps of the full north-star sweep across tail
+widths for both scheduler engines; the winner sets
+``sched_mu._AUTO_TAIL_SLOTS``. Protocol as in probe_ab_northstar.py
+(same-session minima only).
+
+Usage: python benchmarks/probe_tail_slots.py [--reps 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.sweep import default_mesh, sweep
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=4)
+    ap.add_argument("--tails", nargs="+", default=["0", "4", "8", "16"])
+    ap.add_argument("--backends", nargs="+", default=["auto", "pallas"])
+    args = ap.parse_args()
+    tails = [int(t) for t in args.tails]
+
+    ks = tuple(range(2, 11))
+    a = grouped_matrix(5000, (125,) * 4, effect=2.0, seed=0)
+    icfg = InitConfig()
+    mesh = default_mesh()
+
+    def run(backend, tail):
+        scfg = SolverConfig(algorithm="mu", max_iter=10000,
+                            matmul_precision="bfloat16", backend=backend)
+        ccfg = ConsensusConfig(ks=ks, restarts=50, seed=123,
+                               grid_exec="grid", grid_tail_slots=tail)
+        t0 = time.perf_counter()
+        raw = sweep(a, ccfg, scfg, icfg, mesh)
+        jax.device_get({k: raw[k].consensus for k in ks})
+        return time.perf_counter() - t0
+
+    cells = [(b, t) for b in args.backends for t in tails]
+    for c in cells:
+        t0 = time.perf_counter()
+        run(*c)
+        print(f"warm {c}: {time.perf_counter() - t0:.1f}s", flush=True)
+    walls = {c: [] for c in cells}
+    for rep in range(args.reps):
+        for c in cells:
+            w = run(*c)
+            walls[c].append(w)
+            print(f"rep {rep} {c}: {w:.3f}s", flush=True)
+    for c in cells:
+        v = np.array(walls[c])
+        print(f"{c}: min={v.min():.3f} median={np.median(v):.3f} "
+              f"all={[round(x, 3) for x in v.tolist()]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -296,6 +296,7 @@ def nmfconsensus(
     keep_factors: bool = False,
     grid_exec: str = "auto",
     grid_slots: int = 48,
+    grid_tail_slots: "int | None | str" = "auto",
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
@@ -328,7 +329,9 @@ def nmfconsensus(
     job-array concurrency, nmf.r:64-68); "per_k" forces the sequential
     per-rank path; "grid" demands the whole-grid path (error when the
     config can't run it). ``grid_slots`` is the scheduler's per-device
-    slot-pool width (``ConsensusConfig.grid_slots``).
+    slot-pool width (``ConsensusConfig.grid_slots``); ``grid_tail_slots``
+    its straggler tail-pool width (``ConsensusConfig.grid_tail_slots`` —
+    "auto"/0-to-disable; per-job stop decisions identical either way).
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
@@ -351,7 +354,8 @@ def nmfconsensus(
     ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
                            label_rule=label_rule, linkage=linkage,
                            keep_factors=keep_factors, grid_exec=grid_exec,
-                           grid_slots=grid_slots)
+                           grid_slots=grid_slots,
+                           grid_tail_slots=grid_tail_slots)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
     if mesh is None and use_mesh:
         mesh = default_mesh()

@@ -41,6 +41,22 @@ def parse_ks(spec: str) -> tuple[int, ...]:
     return tuple(ks)
 
 
+def _tail_slots_arg(value: str):
+    """'auto' or a non-negative int — validated at parse time so a bad
+    value is a usage error, not a late ValueError traceback."""
+    if value == "auto":
+        return value
+    try:
+        v = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or a non-negative integer, got {value!r}")
+    if v < 0:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or a non-negative integer, got {value!r}")
+    return v
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="nmfx",
@@ -123,6 +139,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "many grid cells iterate concurrently per device "
                         "(freed slots reload queued jobs); 48 measured "
                         "best at the north-star sweep")
+    p.add_argument("--grid-tail-slots", default="auto",
+                   type=_tail_slots_arg,
+                   help="tail-pool width of the whole-grid scheduler: once "
+                        "the queue drains, surviving stragglers compact "
+                        "into a pool this wide and finish at its cheaper "
+                        "per-iteration cost. 'auto' (default) = measured "
+                        "default; 0 disables the tail phase. Per-job "
+                        "stop decisions are identical either way")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "
@@ -248,6 +272,7 @@ def main(argv: list[str] | None = None) -> int:
             keep_factors=args.keep_factors,
             grid_exec=args.grid_exec,
             grid_slots=args.grid_slots,
+            grid_tail_slots=args.grid_tail_slots,
             output=output,
             checkpoint_dir=args.checkpoint_dir,
             profiler=profiler,

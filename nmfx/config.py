@@ -239,6 +239,15 @@ class ConsensusConfig:
     #: sweep (450 jobs on one v5e chip); larger pools help only when the
     #: grid is iteration-rich relative to its stragglers
     grid_slots: int = 48
+    #: tail-pool width of the whole-grid scheduler: once the job queue
+    #: drains and at most this many jobs are still live, the survivors
+    #: compact into a pool this wide and finish at the narrow width's
+    #: per-iteration cost (the straggler tail dominates the sweep wall —
+    #: see nmfx/ops/sched_mu.py). "auto" = measured default; 0/None
+    #: disables the tail phase. Per-job stop decisions are identical
+    #: either way, factors within float tolerance (as for any slot-count
+    #: change); costs one extra compiled loop.
+    grid_tail_slots: int | None | str = "auto"
 
     def __post_init__(self):
         # dedupe preserving order: a duplicated rank would be solved twice
@@ -258,6 +267,12 @@ class ConsensusConfig:
                 f"{self.grid_exec!r}")
         if self.grid_slots < 1:
             raise ValueError("grid_slots must be >= 1")
+        ts = self.grid_tail_slots
+        if not (ts is None or ts == "auto"
+                or (isinstance(ts, int) and ts >= 0)):
+            raise ValueError(
+                f"grid_tail_slots must be 'auto', None, or an int >= 0, "
+                f"got {ts!r}")
         if self.linkage not in LINKAGE_METHODS:
             raise ValueError(
                 f"linkage must be one of {LINKAGE_METHODS}, got "

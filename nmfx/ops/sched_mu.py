@@ -153,11 +153,36 @@ class SchedMUResult(NamedTuple):
     stop_reason: jax.Array  # (J,) i32 StopReason
 
 
-@partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes"))
+def _resolve_tail(tail_slots, s: int):
+    """Resolve the tail-pool width: None/0 disables, "auto" picks the
+    measured default, and any width >= the main pool is a no-op (there is
+    nothing to compact into)."""
+    if tail_slots in (None, 0):
+        return None
+    if tail_slots == "auto":
+        tail_slots = _AUTO_TAIL_SLOTS
+    t = int(tail_slots)
+    if t < 1:
+        raise ValueError(f"tail_slots must be >= 1, got {t}")
+    return t if t < s else None
+
+
+#: measured on the real chip (benchmarks/probe_tail_slots.py, round 4,
+#: same-session interleaved min-of-3 over tail widths {off, 4, 8, 16} at
+#: the full north star): 8 won for BOTH engines — XLA-dense 3.52 s (off)
+#: → 3.12 s, pallas 3.31 s → 3.02 s in its (slow-tunnel) session, ~9–11%
+#: off the sweep wall; 4 throttles live jobs slightly too early, 16
+#: leaves too much width under the stragglers
+_AUTO_TAIL_SLOTS = 8
+
+
+@partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes",
+                                  "tail_slots"))
 def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              cfg: SolverConfig = SolverConfig(),
              slots: int = 48,
-             varying_axes: tuple[str, ...] = ()) -> SchedMUResult:
+             varying_axes: tuple[str, ...] = (),
+             tail_slots: int | None | str = "auto") -> SchedMUResult:
     """Solve J dense zero-padded jobs through an S-slot scheduler.
 
     ``w0``/``h0``: (J, m, k_max) / (J, k_max, n) initial factors, in the
@@ -175,6 +200,16 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     device-varying. The loop body has NO collectives, so each device runs
     its own queue at its own pace and exits independently — per-device
     work-conserving schedules over the device's job shard.
+
+    ``tail_slots``: once the queue drains and at most this many jobs are
+    still live, the survivors compact into a ``tail_slots``-wide pool
+    and finish there — straggler iterations then cost the narrow width's
+    per-iteration price instead of the full pool's (see the phase-2
+    comment in the body). "auto" (default) uses the measured default;
+    None/0 disables the tail phase (single full-width loop). Per-job
+    stop decisions are identical either way (factors drift only at the
+    float-tolerance level any width change produces); the knob affects
+    wall-clock.
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
@@ -244,7 +279,6 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 a_loop = jnp.pad(a_loop, ((0, m_pad - m), (0, 0)))
                 w0 = jnp.pad(w0, ((0, 0), (0, m_pad - m), (0, 0)))
             interp = jax.default_backend() != "tpu"
-            bd = block_diag_mask(s, k_max, dtype)
             kern_kw = dict(block_m=block_m, eps=cfg.div_eps,
                            zero_threshold=cfg.zero_threshold,
                            matmul_precision=cfg.matmul_precision,
@@ -255,38 +289,48 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 return (jnp.transpose(w0[:s], (1, 0, 2)).reshape(m_pad, -1),
                         h0[:s].reshape(s * k_max, n))
 
-            def _one_step(wp, hp, frozen):
-                frozen_col = jnp.repeat(frozen, k_max)
-                hn = fused_h_update(a_loop, wp, hp, k=k_max, **kern_kw)
-                hn = jnp.where(frozen_col[:, None], hp, hn)
-                gh = (hn @ hn.T) * bd  # tiny; stays in XLA
-                wn = fused_w_update(a_loop, wp, hn, gh, **kern_kw)
-                wn = jnp.where(frozen_col[None, :], wp, wn)
-                return wn, hn
+            def make_do_block(width):
+                """Width-specific check block (the tail pool re-derives it
+                at its own packed width; the fused kernels themselves
+                infer width from the operand shapes)."""
+                if cfg.max_iter % ce == 0:
+                    # the whole check block is ONE pallas_call: factors
+                    # stay VMEM-resident across both half-updates of all
+                    # check_every iterations, and the TolX ingredients
+                    # come back as per-column stats
+                    # (fused_block_iterations). The max_iter fence needs
+                    # no per-step mask here: slot_iter is always a
+                    # multiple of check_every, so a slot crosses the cap
+                    # only at a block boundary.
+                    def do_block(wp, hp, active, slot_iter):
+                        frozen = ~active | (slot_iter >= cfg.max_iter)
+                        fcol = jnp.repeat(frozen, k_max).astype(
+                            jnp.float32)[None, :]
+                        wp, hp, wd, wm, hd, hm = fused_block_iterations(
+                            a_loop, wp, hp, fcol, k=k_max, iters=ce,
+                            **kern_kw)
 
-            if cfg.max_iter % ce == 0:
-                # the whole check block is ONE pallas_call: factors stay
-                # VMEM-resident across both half-updates of all
-                # check_every iterations, and the TolX ingredients come
-                # back as per-column stats (fused_block_iterations). The
-                # max_iter fence needs no per-step mask here: slot_iter is
-                # always a multiple of check_every, so a slot crosses the
-                # cap only at a block boundary.
-                def do_block(wp, hp, active, slot_iter):
-                    frozen = ~active | (slot_iter >= cfg.max_iter)
-                    fcol = jnp.repeat(frozen, k_max).astype(
-                        jnp.float32)[None, :]
-                    wp, hp, wd, wm, hd, hm = fused_block_iterations(
-                        a_loop, wp, hp, fcol, k=k_max, iters=ce, **kern_kw)
+                        def lane_max(x):  # (1, rk)/(rk, 1) → per-slot max
+                            return jnp.max(x.reshape(-1, k_max), axis=1)
 
-                    def lane_max(x):  # (1, rk) or (rk, 1) → per-slot max
-                        return jnp.max(x.reshape(s, k_max), axis=1)
+                        delta = jnp.maximum(
+                            ratio(lane_max(wd), lane_max(wm)),
+                            ratio(lane_max(hd), lane_max(hm)))
+                        return wp, hp, delta
 
-                    delta = jnp.maximum(
-                        ratio(lane_max(wd), lane_max(wm)),
-                        ratio(lane_max(hd), lane_max(hm)))
-                    return wp, hp, delta
-            else:
+                    return do_block
+
+                bd = block_diag_mask(width, k_max, dtype)
+
+                def _one_step(wp, hp, frozen):
+                    frozen_col = jnp.repeat(frozen, k_max)
+                    hn = fused_h_update(a_loop, wp, hp, k=k_max, **kern_kw)
+                    hn = jnp.where(frozen_col[:, None], hp, hn)
+                    gh = (hn @ hn.T) * bd  # tiny; stays in XLA
+                    wn = fused_w_update(a_loop, wp, hn, gh, **kern_kw)
+                    wn = jnp.where(frozen_col[None, :], wp, wn)
+                    return wn, hn
+
                 def packed_deltas(wp, hp, wprev, hprev):
                     def _d(cur, prev, shape, axes):
                         return ratio(
@@ -296,27 +340,33 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                                     axis=axes))
 
                     return jnp.maximum(
-                        _d(wp, wprev, (m_pad, s, k_max), (0, 2)),
-                        _d(hp, hprev, (s, k_max, n), (1, 2)))
+                        _d(wp, wprev, (m_pad, width, k_max), (0, 2)),
+                        _d(hp, hprev, (width, k_max, n), (1, 2)))
 
-                do_block = stepped_block(_one_step, packed_deltas)
+                return stepped_block(_one_step, packed_deltas)
 
             def slot_labels(hp):
-                return jnp.argmax(hp.reshape(s, k_max, n),
+                return jnp.argmax(hp.reshape(-1, k_max, n),
                                   axis=1).astype(jnp.int32)
 
             def dense_views(wp, hp):
-                wd = jnp.transpose(wp.reshape(m_pad, s, k_max),
+                wd = jnp.transpose(wp.reshape(m_pad, -1, k_max),
                                    (1, 0, 2))[:, :m, :]
-                return wd, hp.reshape(s, k_max, n)
+                return wd, hp.reshape(-1, k_max, n)
 
             def reload(wp, hp, load, gather):
-                w3 = wp.reshape(m_pad, s, k_max)
+                w3 = wp.reshape(m_pad, -1, k_max)
                 wg = jnp.transpose(w0[gather], (1, 0, 2))  # (m_pad, s, k)
                 w3 = jnp.where(load[None, :, None], wg, w3)
                 h3 = jnp.where(load[:, None, None], h0[gather],
-                               hp.reshape(s, k_max, n))
-                return w3.reshape(m_pad, s * k_max), h3.reshape(-1, n)
+                               hp.reshape(-1, k_max, n))
+                return w3.reshape(m_pad, -1), h3.reshape(-1, n)
+
+            def gather_slots(wp, hp, order):
+                """Packed-layout lane gather for the tail compaction."""
+                w3 = wp.reshape(m_pad, -1, k_max)[:, order, :]
+                h3 = hp.reshape(-1, k_max, n)[order]
+                return w3.reshape(m_pad, -1), h3.reshape(-1, n)
         else:
             block = BLOCKS[cfg.algorithm]
 
@@ -330,9 +380,12 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
                 return jnp.maximum(_d(wp, wprev), _d(hp, hprev))
 
-            do_block = stepped_block(
-                lambda wp, hp, frozen: block(a_loop, wp, hp, frozen, cfg),
-                dense_deltas)
+            def make_do_block(width):
+                del width  # the dense blocks are batch-width-free
+                return stepped_block(
+                    lambda wp, hp, frozen: block(a_loop, wp, hp, frozen,
+                                                 cfg),
+                    dense_deltas)
 
             def slot_labels(hp):
                 return jnp.argmax(hp, axis=1).astype(jnp.int32)
@@ -344,6 +397,9 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 ld = load[:, None, None]
                 return (jnp.where(ld, w0[gather], wp),
                         jnp.where(ld, h0[gather], hp))
+
+            def gather_slots(wp, hp, order):
+                return wp[order], hp[order]
 
         wp0, hp0 = init_slots()
         state0 = SchedState(
@@ -362,78 +418,128 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                                    jnp.int32)),
         )
 
-        def body(st: SchedState) -> SchedState:
-            # --- one check block: check_every solver iterations with the
-            # per-slot max_iter fence, returning the TolX delta ----------
-            wp, hp, delta = do_block(st.wp, st.hp, st.active, st.slot_iter)
-            it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
-            if not cfg.use_tol_checks:
-                delta = None
-            classes, stable, conv, _, reason = batch_convergence(
-                cfg, it_new, new_classes=slot_labels(hp), delta=delta,
-                n_glob=n, classes=st.classes, stable=st.stable,
-                done=~st.active, done_iter=jnp.zeros_like(st.slot_iter),
-                stop_reason=jnp.full((s,), base.StopReason.MAX_ITER,
-                                     jnp.int32))
-            dnorm = st.dnorm
-            if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
-                wd, hd = dense_views(wp, hp)
-                dnorm, conv, reason = tolfun_update(
-                    a, wd, hd, it_new, cfg, dnorm=dnorm, done=conv,
-                    done_in=~st.active, stop_reason=reason)
-            # conv folds in ~active (passed as `done`); isolate fresh stops
-            finished = st.active & (conv | (it_new >= cfg.max_iter))
+        def make_body(do_block):
+            def body(st: SchedState) -> SchedState:
+                # --- one check block: check_every solver iterations with
+                # the per-slot max_iter fence, returning the TolX delta --
+                wp, hp, delta = do_block(st.wp, st.hp, st.active,
+                                         st.slot_iter)
+                it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
+                if not cfg.use_tol_checks:
+                    delta = None
+                classes, stable, conv, _, reason = batch_convergence(
+                    cfg, it_new, new_classes=slot_labels(hp), delta=delta,
+                    n_glob=n, classes=st.classes, stable=st.stable,
+                    done=~st.active,
+                    done_iter=jnp.zeros_like(st.slot_iter),
+                    stop_reason=jnp.full_like(st.slot_iter,
+                                              base.StopReason.MAX_ITER))
+                dnorm = st.dnorm
+                if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
+                    wd, hd = dense_views(wp, hp)
+                    dnorm, conv, reason = tolfun_update(
+                        a, wd, hd, it_new, cfg, dnorm=dnorm, done=conv,
+                        done_in=~st.active, stop_reason=reason)
+                # conv folds in ~active (passed as `done`); isolate fresh
+                # stops
+                finished = st.active & (conv | (it_new >= cfg.max_iter))
 
-            # --- evict + reload, under lax.cond: the vast majority of
-            # check blocks finish NO job, and inside a (non-vmapped)
-            # while_loop body the cond is a real branch — the result-
-            # buffer scatters, W0/H0 gathers, factor rewrites (and, on
-            # the packed layout, the dense-view transpose) are skipped
-            # entirely on no-evict blocks instead of running as masked
-            # no-ops every 2 iterations
-            def evict_reload(ops):
-                wp, hp, out_w, out_h, out_iters, out_stop, slot_job, \
-                    active, queue = ops
-                wdv, hdv = dense_views(wp, hp)
-                idx = jnp.where(finished, slot_job, j)  # j = drop row
-                out_w = out_w.at[idx].set(wdv)
-                out_h = out_h.at[idx].set(hdv)
-                out_iters = out_iters.at[idx].set(it_new)
-                out_stop = out_stop.at[idx].set(reason)
-                # prefix-sum claim of the next queued jobs (dtypes pinned
-                # to int32: under jax_enable_x64 jnp.sum/cumsum would
-                # otherwise promote to int64 and break the lax.cond's
-                # equal-output-types contract with the no-evict branch)
-                claim = jnp.cumsum(finished, dtype=jnp.int32)
-                new_job = queue + claim - 1
-                load = finished & (new_job < j)
-                gather = jnp.where(load, new_job, slot_job)
-                wp, hp = reload(wp, hp, load, gather)
-                slot_job = jnp.where(load, new_job,
-                                     jnp.where(finished, j, slot_job))
-                active = jnp.where(finished, load, active)
-                queue = queue + jnp.sum(load, dtype=jnp.int32)
-                return (wp, hp, out_w, out_h, out_iters, out_stop,
-                        slot_job, active, queue)
+                # --- evict + reload, under lax.cond: the vast majority
+                # of check blocks finish NO job, and inside a
+                # (non-vmapped) while_loop body the cond is a real branch
+                # — the result-buffer scatters, W0/H0 gathers, factor
+                # rewrites (and, on the packed layout, the dense-view
+                # transpose) are skipped entirely on no-evict blocks
+                # instead of running as masked no-ops every 2 iterations
+                def evict_reload(ops):
+                    wp, hp, out_w, out_h, out_iters, out_stop, slot_job, \
+                        active, queue = ops
+                    wdv, hdv = dense_views(wp, hp)
+                    idx = jnp.where(finished, slot_job, j)  # j = drop row
+                    out_w = out_w.at[idx].set(wdv)
+                    out_h = out_h.at[idx].set(hdv)
+                    out_iters = out_iters.at[idx].set(it_new)
+                    out_stop = out_stop.at[idx].set(reason)
+                    # prefix-sum claim of the next queued jobs (dtypes
+                    # pinned to int32: under jax_enable_x64
+                    # jnp.sum/cumsum would otherwise promote to int64 and
+                    # break the lax.cond's equal-output-types contract
+                    # with the no-evict branch)
+                    claim = jnp.cumsum(finished, dtype=jnp.int32)
+                    new_job = queue + claim - 1
+                    load = finished & (new_job < j)
+                    gather = jnp.where(load, new_job, slot_job)
+                    wp, hp = reload(wp, hp, load, gather)
+                    slot_job = jnp.where(load, new_job,
+                                         jnp.where(finished, j, slot_job))
+                    active = jnp.where(finished, load, active)
+                    queue = queue + jnp.sum(load, dtype=jnp.int32)
+                    return (wp, hp, out_w, out_h, out_iters, out_stop,
+                            slot_job, active, queue)
 
-            ops = (wp, hp, st.out_w, st.out_h, st.out_iters, st.out_stop,
-                   st.slot_job, st.active, st.queue)
-            (wp, hp, out_w, out_h, out_iters, out_stop, slot_job, active,
-             queue) = lax.cond(jnp.any(finished), evict_reload,
-                               lambda ops: ops, ops)
-            fresh_or_done = finished
-            return SchedState(
-                wp=wp, hp=hp,
-                slot_iter=jnp.where(fresh_or_done, 0, it_new),
-                classes=jnp.where(fresh_or_done[:, None], -1, classes),
-                stable=jnp.where(fresh_or_done, 0, stable),
-                dnorm=jnp.where(fresh_or_done, jnp.inf, dnorm),
-                slot_job=slot_job, active=active, queue=queue,
-                out_w=out_w, out_h=out_h, out_iters=out_iters,
-                out_stop=out_stop,
+                ops = (wp, hp, st.out_w, st.out_h, st.out_iters,
+                       st.out_stop, st.slot_job, st.active, st.queue)
+                (wp, hp, out_w, out_h, out_iters, out_stop, slot_job,
+                 active, queue) = lax.cond(jnp.any(finished), evict_reload,
+                                           lambda ops: ops, ops)
+                fresh_or_done = finished
+                return SchedState(
+                    wp=wp, hp=hp,
+                    slot_iter=jnp.where(fresh_or_done, 0, it_new),
+                    classes=jnp.where(fresh_or_done[:, None], -1, classes),
+                    stable=jnp.where(fresh_or_done, 0, stable),
+                    dnorm=jnp.where(fresh_or_done, jnp.inf, dnorm),
+                    slot_job=slot_job, active=active, queue=queue,
+                    out_w=out_w, out_h=out_h, out_iters=out_iters,
+                    out_stop=out_stop,
+                )
+
+            return body
+
+        body = make_body(make_do_block(s))
+        tail_s = _resolve_tail(tail_slots, s)
+        if tail_s is None:
+            final = lax.while_loop(lambda st: jnp.any(st.active), body,
+                                   state0)
+        else:
+            # --- two-phase tail compaction -------------------------------
+            # The sweep's wall is dominated by its stragglers: once the
+            # queue drains, a handful of long jobs keep iterating inside a
+            # mostly-empty full-width pool, paying c(S) per iteration for
+            # ≤ tail_s lanes of real work (measured: the north-star k=10
+            # stragglers run thousands of iterations after the pool
+            # drains). Phase 1 runs the full pool while the queue has
+            # jobs OR more than tail_s slots are live; then the surviving
+            # jobs compact (a stable lane gather) into a tail_s-wide
+            # pool that finishes them at the narrow width's per-iteration
+            # cost. Same bookkeeping, same result buffers; per-job stop
+            # decisions are identical to the single-phase schedule and
+            # factors agree to float tolerance (XLA/Mosaic tile GEMMs
+            # differently per batch width — measured ~1e-6 relative,
+            # the same drift any slot-count change produces).
+            def phase1_cond(st):
+                live = jnp.sum(st.active, dtype=jnp.int32)
+                return jnp.any(st.active) & (
+                    (st.queue < j) | (live > tail_s))
+
+            st1 = lax.while_loop(phase1_cond, body, state0)
+            order = jnp.argsort(~st1.active, stable=True)[:tail_s]
+            wp_t, hp_t = gather_slots(st1.wp, st1.hp, order)
+            state_t = SchedState(
+                wp=wp_t, hp=hp_t,
+                slot_iter=st1.slot_iter[order],
+                classes=st1.classes[order],
+                stable=st1.stable[order],
+                dnorm=st1.dnorm[order],
+                slot_job=st1.slot_job[order],
+                active=st1.active[order],
+                queue=st1.queue,
+                out_w=st1.out_w, out_h=st1.out_h,
+                out_iters=st1.out_iters, out_stop=st1.out_stop,
             )
-
-        final = lax.while_loop(lambda st: jnp.any(st.active), body, state0)
+            tail_body = make_body(make_do_block(tail_s))
+            final = lax.while_loop(lambda st: jnp.any(st.active),
+                                   tail_body, state_t)
         out_w = final.out_w[:j]
         out_h = final.out_h[:j]
         # exact final residuals, once, from the retained per-job factors

@@ -122,7 +122,8 @@ def resolve_engine_family(solver_cfg: SolverConfig,
 @lru_cache(maxsize=64)
 def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                     init_cfg: InitConfig, label_rule: str, mesh: Mesh | None,
-                    keep_factors: bool = False, grid_slots: int = 48):
+                    keep_factors: bool = False, grid_slots: int = 48,
+                    grid_tail_slots="auto"):
     grid = grid_axes_active(mesh)
     if grid:
         grid_ok = ((_use_packed(solver_cfg)
@@ -159,7 +160,7 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         # family; vmap is the explicit backend="vmap" choice)
         grid_fn = _build_grid_exec_sweep_fn(
             (k,), restarts, solver_cfg, init_cfg, label_rule, mesh,
-            keep_factors, grid_slots, fold_keys=False)
+            keep_factors, grid_slots, grid_tail_slots, fold_keys=False)
 
         def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
             return grid_fn(a, key)[k]
@@ -630,6 +631,7 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
                               mesh: Mesh | None,
                               keep_factors: bool = False,
                               slots: int = 48,
+                              tail_slots="auto",
                               fold_keys: bool = True):
     """Sweep builder for the whole-grid path (``nmfx.ops.sched_mu``):
     EVERY (k, restart) cell solves through one jit'd slot-scheduled
@@ -682,7 +684,8 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
                     else root_key, padded))
                 for k in ks]
             w0, h0 = _init_lanes(a, rank_keys)
-            res = mu_sched(a, w0, h0, solver_cfg, slots=slots)
+            res = mu_sched(a, w0, h0, solver_cfg, slots=slots,
+                           tail_slots=tail_slots)
             out: dict[int, KSweepOutput] = {}
             for g, k in enumerate(ks):
                 sl = slice(g * padded, g * padded + restarts)
@@ -707,7 +710,7 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
         rank_keys = [(k, keys[g]) for g, k in enumerate(ks)]
         w0, h0 = _init_lanes(a, rank_keys)
         res = mu_sched(a, w0, h0, solver_cfg, slots=slots,
-                       varying_axes=(RESTART_AXIS,))
+                       varying_axes=(RESTART_AXIS,), tail_slots=tail_slots)
         gidx = (lax.axis_index(RESTART_AXIS) * r_local
                 + jnp.arange(r_local))
         valid = gidx < restarts
@@ -796,7 +799,8 @@ def sweep_one_k(a, key, k: int, restarts: int,
                 label_rule: str = "argmax",
                 mesh: Mesh | None = None,
                 keep_factors: bool = False,
-                grid_slots: int = 48) -> KSweepOutput:
+                grid_slots: int = 48,
+                grid_tail_slots="auto") -> KSweepOutput:
     """Run `restarts` independent factorizations at rank k and reduce them to
     one consensus matrix, entirely on-device.
 
@@ -808,11 +812,13 @@ def sweep_one_k(a, key, k: int, restarts: int,
     ConsensusConfig.grid_slots at the sweep level)."""
     if not (solver_cfg.algorithm == "hals"
             and solver_cfg.backend in ("auto", "packed")):
-        # only the slot-scheduled branch consumes grid_slots; normalize so
-        # a different value cannot force a re-trace of unrelated builders
+        # only the slot-scheduled branch consumes the grid knobs;
+        # normalize so a different value cannot force a re-trace of
+        # unrelated builders
         grid_slots = 48
+        grid_tail_slots = "auto"
     fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh,
-                         keep_factors, grid_slots)
+                         keep_factors, grid_slots, grid_tail_slots)
     return fn(jnp.asarray(a), key)
 
 
@@ -892,7 +898,7 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
         fn = _build_grid_exec_sweep_fn(tuple(needed), cfg.restarts,
                                        solver_cfg, init_cfg, cfg.label_rule,
                                        mesh, cfg.keep_factors,
-                                       cfg.grid_slots)
+                                       cfg.grid_slots, cfg.grid_tail_slots)
         t0 = time.perf_counter()
         with profiler.phase("solve.grid") as sync:
             solved = sync(fn(a_dev, root))
@@ -918,7 +924,7 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             out[k] = sync(sweep_one_k(a_dev, key, k, cfg.restarts,
                                       solver_cfg, init_cfg, cfg.label_rule,
                                       mesh, cfg.keep_factors,
-                                      cfg.grid_slots))
+                                      cfg.grid_slots, cfg.grid_tail_slots))
         if 0 < _log.level <= logging.INFO and coord:
             # reading the stats forces a device sync, trading the k-grid's
             # async dispatch pipelining for live progress. Gated on a level

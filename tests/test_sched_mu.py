@@ -81,6 +81,47 @@ def test_pallas_scheduler_matches_dense(jobs, slots, max_iter):
                                np.asarray(got.dnorm), rtol=1e-5)
 
 
+@pytest.mark.parametrize("backend", ["auto", "pallas"])
+@pytest.mark.parametrize("tail", [1, 2, 5])
+def test_tail_compaction_schedule_free(jobs, backend, tail):
+    """The straggler tail phase (compact survivors into a narrow pool once
+    the queue drains) is pure execution policy: per-job iterations and
+    stop reasons are IDENTICAL with the tail enabled at any width or
+    disabled; factors agree to the same float tolerance as any other
+    width change (GEMM tiling differs per batch width — measured ~1e-6
+    relative). Exercises compaction mid-flight: 6 slots over 15 jobs with
+    tail widths below, at, and above the live-job count at drain."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(max_iter=600, backend=backend)
+    ref = mu_sched(a, w0, h0, cfg, slots=6, tail_slots=None)
+    got = mu_sched(a, w0, h0, cfg, slots=6, tail_slots=tail)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    np.testing.assert_allclose(np.asarray(ref.w), np.asarray(got.w),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ref.h), np.asarray(got.h),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_tail_auto_default(jobs):
+    """tail_slots='auto' (the default) makes the same per-job decisions as
+    the disabled path and is a no-op when the pool is already narrower
+    than the auto width."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(max_iter=600)
+    ref = mu_sched(a, w0, h0, cfg, slots=15, tail_slots=None)
+    got = mu_sched(a, w0, h0, cfg, slots=15)  # auto
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_allclose(np.asarray(ref.w), np.asarray(got.w),
+                               rtol=2e-4, atol=2e-5)
+    narrow = mu_sched(a, w0, h0, cfg, slots=2)  # auto >= s -> single phase
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(narrow.iterations))
+
+
 def test_pallas_pool_clamps_to_vmem_envelope(jobs):
     """k_max beyond the resident-W VMEM envelope shrinks the pallas pool
     (``_pallas_slot_clamp``'s measured byte model of m, n, k_max and the
