@@ -1,0 +1,95 @@
+"""The bench hardware-truth gate's plausibility rules (bench.py).
+
+These run on synthetic stop records — no solver execution — and lock the
+exact failure modes of the round-3 incident (BENCH_r03 recorded
+mean_iters_per_k=2.0 from a broken kernel and nothing noticed): a
+physically-impossible record must produce problems, and every legitimate
+record class (TolX solvers, low-maxiter smoke runs, healthy mu) must
+not.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _integrity_problems  # noqa: E402
+from nmfx.config import SolverConfig  # noqa: E402
+from nmfx.solvers.base import StopReason  # noqa: E402
+
+CS = int(StopReason.CLASS_STABLE)
+TX = int(StopReason.TOL_X)
+MI = int(StopReason.MAX_ITER)
+#: check_every * (stable_checks + 1) at SolverConfig defaults — the gate's
+#: minimum credible class-stable stop; boundary assertions reference it so
+#: a default change moves the tests with it
+FLOOR = (SolverConfig().check_every
+         * (SolverConfig().stable_checks + 1))
+
+
+def rec(iters, stops):
+    return ({2: np.asarray(iters)}, {2: np.asarray(stops)})
+
+
+def test_healthy_mu_record_passes():
+    its, stops = rec([FLOOR + 48, FLOOR + 118, FLOOR + 298, 8000],
+                     [CS, CS, CS, MI])
+    assert _integrity_problems(SolverConfig(), its, stops) == []
+
+
+def test_class_stable_below_floor_is_impossible():
+    its, stops = rec([FLOOR - 300, FLOOR + 118], [CS, CS])
+    problems = _integrity_problems(SolverConfig(), its, stops)
+    assert any("CLASS_STABLE below" in p for p in problems)
+
+
+def test_bench_r03_corruption_signature_trips():
+    """~89% of jobs at ~2 iterations with TolX stop reasons — the exact
+    BENCH_r03 record shape — must fail the dominance check."""
+    its, stops = rec([2] * 45 + [8000] * 5, [TX] * 45 + [MI] * 5)
+    problems = _integrity_problems(SolverConfig(), its, stops)
+    assert any("implausible from random init" in p for p in problems)
+
+
+def test_tolx_solvers_exempt_from_dominance():
+    """als legitimately TolX-stops in ~14 iterations; the floor must not
+    apply to non-class-stop algorithms."""
+    its, stops = rec([14, 15, 13], [TX, TX, TX])
+    cfg = SolverConfig(algorithm="als")
+    assert _integrity_problems(cfg, its, stops) == []
+
+
+def test_hals_exempt_from_dominance():
+    its, stops = rec([22, 20, 24], [TX, TX, TX])
+    cfg = SolverConfig(algorithm="hals")
+    assert _integrity_problems(cfg, its, stops) == []
+    # but an impossible CLASS_STABLE still trips even for hals
+    its, stops = rec([22, 20, 24], [CS, TX, TX])
+    assert _integrity_problems(cfg, its, stops)
+
+
+def test_low_maxiter_smoke_run_passes():
+    """maxiter below the floor: every job burns to MAX_ITER — legitimate
+    for smoke runs, not a corruption signature."""
+    its, stops = rec([100, 100, 100], [MI, MI, MI])
+    cfg = SolverConfig(max_iter=100)
+    assert _integrity_problems(cfg, its, stops) == []
+
+
+def test_class_stop_disabled_skips_dominance():
+    its, stops = rec([40, 44, 38], [TX, TX, TX])
+    cfg = SolverConfig(use_class_stop=False)
+    assert _integrity_problems(cfg, its, stops) == []
+
+
+@pytest.mark.parametrize("frac_early,trips", [(0.1, False), (0.5, True)])
+def test_dominance_threshold(frac_early, trips):
+    n = 20
+    ne = int(n * frac_early)
+    its, stops = rec([10] * ne + [FLOOR + 98] * (n - ne),
+                     [TX] * ne + [CS] * (n - ne))
+    problems = _integrity_problems(SolverConfig(), its, stops)
+    assert bool(problems) == trips
