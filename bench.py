@@ -335,12 +335,23 @@ def main():
     # per-array pull pays a tunnel round trip each (~50–150 ms depending on
     # session; batching the 18 north-star pulls measured 0.4–1.4 s faster;
     # the API pipeline batches identically)
+    from nmfx.profiling import Profiler
+
+    prof = Profiler()
     t0 = time.perf_counter()
-    raw = sweep(a, ccfg, scfg, icfg, mesh)
-    host = jax.device_get(
-        {k: (raw[k].consensus, raw[k].iterations, raw[k].stop_reasons)
-         for k in ks})
+    with prof:
+        raw = sweep(a, ccfg, scfg, icfg, mesh, profiler=prof)
+        with prof.phase("device_to_host"):
+            host = jax.device_get(
+                {k: (raw[k].consensus, raw[k].iterations,
+                     raw[k].stop_reasons) for k in ks})
     wall = time.perf_counter() - t0
+    # the tunneled dev chip inflates transfers far beyond real PCIe/ICI
+    # (measured: ~0.7 s for A's 10 MB in slow sessions); the headline
+    # stays the honest full wall, but the phase split lets readers
+    # separate solve throughput from environment transfer artifacts
+    phase_s = {name: round(rec.seconds, 3)
+               for name, rec in prof.phases.items()}
 
     total_restarts = len(ks) * args.restarts
     its = {k: host[k][1] for k in ks}
@@ -364,7 +375,9 @@ def main():
     # subproblem and are not modeled):
     # model FLOPs = Σ_k Σ_restart iters · flops_per_iter(k), achieved rate
     # over the measured wall, utilization vs the devices' bf16 peak
-    model_flops = mfu = achieved = None
+    model_flops = mfu = achieved = mfu_solve = None
+    solve_s = sum(rec.seconds for name, rec in prof.phases.items()
+                  if name.startswith("solve"))
     flops_fn = _MODEL_FLOPS.get(args.algorithm)
     if flops_fn is not None:
         model_flops = sum(
@@ -374,6 +387,12 @@ def main():
         peak = _BF16_PEAK_FLOPS.get(jax.devices()[0].device_kind)
         if peak is not None:
             mfu = achieved / (peak * len(jax.devices()))
+            if solve_s > 0:
+                # utilization of the solve phase alone — what the
+                # device actually sustains, excluding the (tunnel-
+                # inflated) host transfers counted in the honest wall
+                mfu_solve = model_flops / solve_s / (
+                    peak * len(jax.devices()))
     record = {
         "metric": "consensus_sweep_wall_s",
         "value": round(wall, 3),
@@ -387,6 +406,7 @@ def main():
             "restarts_per_s": round(total_restarts / wall, 2),
             "cold_wall_s": round(cold_wall, 3),
             "compile_wall_s": round(max(cold_wall - wall, 0.0), 3),
+            "phase_s": phase_s,
             "integrity": "ok",
             "mean_iters_per_k": {str(k): round(v, 1) for k, v in
                                  iters.items()},
@@ -395,6 +415,8 @@ def main():
             "achieved_tflop_per_s": (None if achieved is None
                                      else round(achieved / 1e12, 3)),
             "mfu": None if mfu is None else round(mfu, 4),
+            "mfu_solve": (None if mfu_solve is None
+                          else round(mfu_solve, 4)),
             "devices": [str(d) for d in jax.devices()],
         },
     }
