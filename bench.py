@@ -277,9 +277,10 @@ def main():
     if args.backend == "pallas" and args.algorithm != "mu":
         p.error("--backend pallas is only implemented for --algorithm mu "
                 "(use auto to fall back per algorithm)")
-    if args.backend == "packed" and args.algorithm not in ("mu", "hals"):
+    if args.backend == "packed" and args.algorithm not in (
+            "mu", "hals", "neals", "snmf"):
         p.error("--backend packed is only implemented for --algorithm "
-                "mu/hals (use auto to fall back per algorithm)")
+                "mu/hals/neals/snmf (use auto to fall back per algorithm)")
     if args.verify:
         # the gate runs the three MU engines at its own fixed scaled
         # shape — reject, rather than silently ignore, arguments that

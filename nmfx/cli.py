@@ -190,9 +190,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.backend == "pallas" and args.algorithm != "mu":
         parser.error("--backend pallas is only implemented for "
                      "--algorithm mu (use auto)")
-    if args.backend == "packed" and args.algorithm not in ("mu", "hals"):
+    if args.backend == "packed" and args.algorithm not in (
+            "mu", "hals", "neals", "snmf"):
         parser.error("--backend packed is only implemented for "
-                     "--algorithm mu/hals (use auto)")
+                     "--algorithm mu/hals/neals/snmf (use auto)")
     if args.verbose:
         import logging
 

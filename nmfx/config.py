@@ -133,11 +133,12 @@ class SolverConfig:
             raise ValueError(
                 "backend='pallas' is only implemented for algorithm='mu'; "
                 "use 'auto' to fall back per algorithm")
-        if self.backend == "packed" and self.algorithm not in ("mu",
-                                                               "hals"):
+        if self.backend == "packed" and self.algorithm not in (
+                "mu", "hals", "neals", "snmf"):
             raise ValueError(
-                "backend='packed' is only implemented for algorithm='mu' "
-                "and 'hals'; use 'auto' to fall back per algorithm")
+                "backend='packed' is only implemented for algorithms with "
+                "a dense-batched block (mu, hals, neals, snmf); use "
+                "'auto' to fall back per algorithm")
         if self.algorithm not in ALGORITHMS:
             raise ValueError(
                 f"algorithm must be one of {ALGORITHMS}, got {self.algorithm!r}"

@@ -173,12 +173,130 @@ def hals_block(a, wp, hp, done_mask, cfg: SolverConfig):
     return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
 
 
-#: dense-batched iteration blocks by algorithm, and whether the algorithm's
-#: convergence uses the TolFun residual-decrease test (matching each
-#: solver's per-restart check_convergence flags: mu = class+TolX only,
-#: hals = class+TolX+TolFun, solvers/{mu,hals}.py)
-BLOCKS = {"mu": mu_block, "hals": hals_block}
-USES_TOLFUN = {"mu": False, "hals": True}
+def _batched_gram_solve(gram, rhs):
+    """(B, k, k) @ x = (B, k, rhs_cols) via the same trace-scaled
+    Tikhonov Cholesky as the per-restart form (base.solve_gram_reg),
+    vmapped. Zero-padded components solve to exact zeros: their Gram
+    rows/cols are zero, the jitter puts λ on their diagonal, and their
+    right-hand-side rows are zero — x_pad = 0/λ = 0. (λ's trace/k uses
+    k_max here vs the lane's true k per-restart: a ~10·eps-scale
+    difference, within the float tolerance any engine change carries.)"""
+    return jax.vmap(base.solve_gram_reg)(gram, rhs)
+
+
+def neals_block(a, wp, hp, done_mask, cfg: SolverConfig):
+    """ONE dense-batched normal-equation ALS iteration (see solvers/
+    neals.py for the per-restart form; reference nmf_neals.c:200-306):
+    H = max(G_w \\ WᵀA, 0), W = max((G_h \\ HAᵀ)ᵀ, 0) with the shared
+    jittered-Cholesky Gram solve (hp feeds only the frozen-lane
+    passthrough: ALS re-derives H from W alone). Both Grams batch over
+    lanes; the k×k solves are tiny and vmap cleanly. Zero padding is
+    invariant (see _batched_gram_solve)."""
+    f32 = wp.dtype
+    if a.dtype == jnp.bfloat16:
+        wb = wp.astype(jnp.bfloat16)
+        gw = jnp.einsum("bmk,bml->bkl", wb, wb, preferred_element_type=f32)
+        wta = jnp.einsum("bmk,mn->bkn", wb, a, preferred_element_type=f32)
+    else:
+        gw = jnp.einsum("bmk,bml->bkl", wp, wp)
+        wta = jnp.einsum("bmk,mn->bkn", wp, a)
+    h = base.clamp(_batched_gram_solve(gw, wta), cfg.zero_threshold)
+    if a.dtype == jnp.bfloat16:
+        hb = h.astype(jnp.bfloat16)
+        gh = jnp.einsum("bkn,bln->bkl", hb, hb, preferred_element_type=f32)
+        hat = jnp.einsum("bkn,mn->bkm", hb, a, preferred_element_type=f32)
+    else:
+        gh = jnp.einsum("bkn,bln->bkl", h, h)
+        hat = jnp.einsum("bkn,mn->bkm", h, a)
+    w = base.clamp(jnp.transpose(_batched_gram_solve(gh, hat), (0, 2, 1)),
+                   cfg.zero_threshold)
+    frozen = done_mask[:, None, None]
+    return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
+
+
+def snmf_block(a, wp, hp, done_mask, cfg: SolverConfig, eta=None):
+    """ONE dense-batched sparse-NMF iteration (Kim & Park 2007; see
+    solvers/snmf.py): the H-solve's L1 surrogate ``beta·ones`` couples
+    components, so it is masked to each lane's LIVE components (nonzero W
+    columns) — zero-padded lanes of the mixed-rank grid would otherwise
+    leak the coupling into real components. A component whose W column
+    genuinely dies mid-solve drops out of the coupling (the per-restart
+    form keeps its zero row in the system); that degenerate case aside,
+    the engines agree to float tolerance. The W-solve's ridge is
+    diagonal and needs no mask. ``eta``: the Kim & Park ``max(A)²``
+    ridge, precomputed ONCE by the drivers from the FULL-PRECISION A
+    (``make_block``) — computing it here from ``a`` would use the
+    bf16-truncated loop matrix under that precision and re-reduce O(mn)
+    every iteration."""
+    f32 = wp.dtype
+    if eta is None:
+        eta = (jnp.max(a).astype(f32) ** 2 if cfg.ridge_eta is None
+               else jnp.asarray(cfg.ridge_eta, f32))
+    beta = jnp.asarray(cfg.sparsity_beta, f32)
+    k_max = wp.shape[2]
+    live = jnp.any(wp != 0, axis=1)  # (B, k_max) — padded cols are zero
+    ones_mask = (live[:, :, None] & live[:, None, :]).astype(f32)
+    if a.dtype == jnp.bfloat16:
+        wb = wp.astype(jnp.bfloat16)
+        gw = jnp.einsum("bmk,bml->bkl", wb, wb, preferred_element_type=f32)
+        wta = jnp.einsum("bmk,mn->bkn", wb, a, preferred_element_type=f32)
+    else:
+        gw = jnp.einsum("bmk,bml->bkl", wp, wp)
+        wta = jnp.einsum("bmk,mn->bkn", wp, a)
+    h = base.clamp(_batched_gram_solve(gw + beta * ones_mask, wta),
+                   cfg.zero_threshold)
+    if a.dtype == jnp.bfloat16:
+        hb = h.astype(jnp.bfloat16)
+        gh = jnp.einsum("bkn,bln->bkl", hb, hb, preferred_element_type=f32)
+        hat = jnp.einsum("bkn,mn->bkm", hb, a, preferred_element_type=f32)
+    else:
+        gh = jnp.einsum("bkn,bln->bkl", h, h)
+        hat = jnp.einsum("bkn,mn->bkm", h, a)
+    eye = jnp.eye(k_max, dtype=f32)
+    w = base.clamp(
+        jnp.transpose(_batched_gram_solve(gh + eta * eye, hat), (0, 2, 1)),
+        cfg.zero_threshold)
+    frozen = done_mask[:, None, None]
+    return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
+
+
+#: dense-batched iteration blocks by algorithm; whether the algorithm's
+#: convergence uses the TolFun residual-decrease test; and whether it
+#: uses the class-stability stop — matching each solver's per-restart
+#: check_convergence flags (mu = class+TolX; hals/snmf =
+#: class+TolX+TolFun; neals = TolX+TolFun only, solvers/*.py)
+BLOCKS = {"mu": mu_block, "hals": hals_block, "neals": neals_block,
+          "snmf": snmf_block}
+USES_TOLFUN = {"mu": False, "hals": True, "neals": True, "snmf": True}
+USES_CLASS = {"mu": True, "hals": True, "neals": False, "snmf": True}
+
+
+def conv_cfg(cfg: SolverConfig) -> SolverConfig:
+    """Normalize the config for the batched drivers' convergence path:
+    algorithms whose per-restart form never uses the class-stability stop
+    (neals) must not gain it from the shared batch_convergence, which
+    keys only on cfg.use_class_stop."""
+    if cfg.use_class_stop and not USES_CLASS[cfg.algorithm]:
+        import dataclasses
+        return dataclasses.replace(cfg, use_class_stop=False)
+    return cfg
+
+
+def make_block(cfg: SolverConfig, a_full):
+    """The per-iteration block for ``cfg.algorithm``, with any
+    data-dependent auxiliaries resolved ONCE from the FULL-PRECISION A
+    (snmf's default ``eta = max(A)²`` — matching the per-restart
+    ``snmf.init_aux``, which also sees the untruncated matrix; under
+    bf16 streaming the loop operand is truncated and must not feed
+    eta). Shared by both batched drivers (mu_grid, mu_sched)."""
+    block = BLOCKS[cfg.algorithm]
+    if cfg.algorithm == "snmf":
+        dtype = jnp.dtype(cfg.dtype)
+        eta = (jnp.max(jnp.asarray(a_full, dtype)) ** 2
+               if cfg.ridge_eta is None
+               else jnp.asarray(cfg.ridge_eta, dtype))
+        return partial(snmf_block, eta=eta)
+    return block
 
 
 def tolfun_update(a, state_w, state_h, it, cfg: SolverConfig, *,
@@ -200,13 +318,14 @@ def tolfun_update(a, state_w, state_h, it, cfg: SolverConfig, *,
     return dnorm, done, stop_reason
 
 
-def _step(a, a_res, state: GridState, cfg: SolverConfig,
+def _step(block, a, a_res, state: GridState, cfg: SolverConfig,
           check: bool) -> GridState:
-    """``a`` feeds the iteration (possibly bf16-truncated); ``a_res`` the
-    TolFun residual (full precision, matching the generic driver)."""
+    """``block`` from make_block; ``a`` feeds the iteration (possibly
+    bf16-truncated); ``a_res`` the TolFun residual (full precision,
+    matching the generic driver)."""
     w0, h0 = state.w, state.h
     it = state.iteration + 1
-    w, h = BLOCKS[cfg.algorithm](a, state.w, state.h, state.done, cfg)
+    w, h = block(a, state.w, state.h, state.done, cfg)
     state = state._replace(w=w, h=h, w_prev=w0, h_prev=h0, iteration=it)
     if not check:
         return state
@@ -266,6 +385,7 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
         raise ValueError(
             f"the dense-batched grid drivers implement {tuple(BLOCKS)}, "
             f"got algorithm={cfg.algorithm!r}")
+    cfg = conv_cfg(cfg)
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0 = jnp.asarray(w0, dtype)
@@ -298,7 +418,7 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
             # backends ignore the precision hint and run full-f32 GEMMs,
             # so truncating there would change results)
             a_loop = a.astype(jnp.bfloat16)
-        step = partial(_step, a_loop, a_true)
+        step = partial(_step, make_block(cfg, a_true), a_loop, a_true)
 
         def cond(s: GridState):
             return jnp.any(~s.done) & (s.iteration + cfg.check_every

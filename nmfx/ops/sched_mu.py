@@ -45,7 +45,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from nmfx.config import SolverConfig
-from nmfx.ops.grid_mu import BLOCKS, USES_TOLFUN, tolfun_update
+from nmfx.ops.grid_mu import (BLOCKS, USES_TOLFUN, conv_cfg,
+                              make_block, tolfun_update)
 from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
 
@@ -215,6 +216,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
         raise ValueError(
             f"the slot scheduler implements {tuple(BLOCKS)}, got "
             f"algorithm={cfg.algorithm!r}")
+    cfg = conv_cfg(cfg)
     use_pallas = cfg.backend == "pallas"
     if use_pallas and cfg.algorithm != "mu":
         raise ValueError("the pallas slot scheduler is mu-only")
@@ -368,7 +370,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 h3 = hp.reshape(-1, k_max, n)[order]
                 return w3.reshape(m_pad, -1), h3.reshape(-1, n)
         else:
-            block = BLOCKS[cfg.algorithm]
+            block = make_block(cfg, a)
 
             def init_slots():
                 return w0[:s], h0[:s]

@@ -116,6 +116,12 @@ def resolve_engine_family(solver_cfg: SolverConfig,
             and solver_cfg.backend in ("auto", "packed")
             and not grid_axes_active(mesh)):
         return "packed"
+    if (solver_cfg.algorithm in ("neals", "snmf")
+            and solver_cfg.backend == "packed"
+            and not grid_axes_active(mesh)):
+        # the round-4 explicit whole-grid opt-in for the Gram families;
+        # their "auto" stays the vmap family (_GRID_EXEC_BACKENDS)
+        return "packed"
     return "vmap"
 
 
@@ -151,13 +157,18 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         return _build_packed_sweep_fn(k, restarts, solver_cfg, init_cfg,
                                       label_rule, mesh, keep_factors)
     if (solver_cfg.algorithm == "hals"
-            and solver_cfg.backend in ("auto", "packed")):
-        # hals' batched backend IS the dense grid machinery at one rank:
-        # shared-GEMM lanes through the slot scheduler (its two big GEMMs
-        # are mu-shaped — ref libnmf/nmf_mu.c:174-216 for the shapes).
-        # "auto" resolves here too so hals' execution family is the same
+            and solver_cfg.backend in ("auto", "packed")) or (
+            solver_cfg.algorithm in ("neals", "snmf")
+            and solver_cfg.backend == "packed"):
+        # the batched backend IS the dense grid machinery at one rank:
+        # shared-GEMM lanes through the slot scheduler (hals' two big
+        # GEMMs are mu-shaped — ref libnmf/nmf_mu.c:174-216; neals/snmf
+        # batch their Gram solves, ref nmf_neals.c:200-306). For hals,
+        # "auto" resolves here too so its execution family is the same
         # on every sweep path (the checkpoint fingerprint hashes that
-        # family; vmap is the explicit backend="vmap" choice)
+        # family; vmap is the explicit backend="vmap" choice); for
+        # neals/snmf the grid engine is the explicit "packed" opt-in
+        # (_GRID_EXEC_BACKENDS)
         grid_fn = _build_grid_exec_sweep_fn(
             (k,), restarts, solver_cfg, init_cfg, label_rule, mesh,
             keep_factors, grid_slots, grid_tail_slots, fold_keys=False)
@@ -608,18 +619,31 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     return jax.jit(impl)
 
 
+#: backends that route each algorithm into the slot-scheduled dense-grid
+#: machinery. mu/hals: the packed family IS their default engine ("auto"
+#: resolves there). neals/snmf (round 4): the dense-batched blocks exist
+#: (grid_mu.BLOCKS) but "auto" deliberately stays on the vmapped generic
+#: driver — their defaults' engine family (and checkpoint fingerprints)
+#: are stable, and the whole-grid solve is an explicit backend="packed"
+#: opt-in whose win is compile time (one jit vs one per rank), not
+#: iteration throughput (they converge in ~14–21 iterations).
+_GRID_EXEC_BACKENDS = {"mu": ("auto", "packed", "pallas"),
+                       "hals": ("auto", "packed"),
+                       "neals": ("packed",),
+                       "snmf": ("packed",)}
+
+
 def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
     """Whether the whole-grid slot-scheduled solve (``nmfx.ops.sched_mu``)
     can run this configuration: an algorithm with a dense-batched block
-    (mu, hals) under the packed-family backend — including the fused
-    pallas kernels for mu (the scheduler keeps its slot state in the
-    packed column layout those kernels consume) — with no feature/sample
-    mesh axes (those shard single ranks; the grid layout composes with the
+    (grid_mu.BLOCKS: mu, hals, neals, snmf) under the backend that routes
+    it there (``_GRID_EXEC_BACKENDS`` — including the fused pallas
+    kernels for mu; the scheduler keeps its slot state in the packed
+    column layout those kernels consume) — with no feature/sample mesh
+    axes (those shard single ranks; the grid layout composes with the
     restart axis only)."""
-    backends = (("auto", "packed", "pallas")
-                if solver_cfg.algorithm == "mu" else ("auto", "packed"))
-    if (solver_cfg.algorithm not in ("mu", "hals")
-            or solver_cfg.backend not in backends):
+    backends = _GRID_EXEC_BACKENDS.get(solver_cfg.algorithm, ())
+    if solver_cfg.backend not in backends:
         return False
     return not grid_axes_active(mesh)
 
@@ -885,10 +909,11 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     eligible = grid_exec_ok(solver_cfg, mesh)
     if cfg.grid_exec == "grid" and not eligible:
         raise ValueError(
-            "grid_exec='grid' needs algorithm 'mu' (backend "
-            "'auto'/'packed'/'pallas') or 'hals' (backend "
-            "'auto'/'packed'), and no feature/sample mesh axes; got "
-            f"algorithm={solver_cfg.algorithm!r}, "
+            "grid_exec='grid' needs an algorithm/backend pair that routes "
+            "into the slot scheduler — mu (backend "
+            "'auto'/'packed'/'pallas'), hals ('auto'/'packed'), or "
+            "neals/snmf (explicit 'packed') — and no feature/sample mesh "
+            f"axes; got algorithm={solver_cfg.algorithm!r}, "
             f"backend={solver_cfg.backend!r} (use grid_exec='auto' to "
             "fall back per configuration)")
     use_grid = eligible and (cfg.grid_exec == "grid"

@@ -190,6 +190,62 @@ def test_hals_grid_matches_per_k_vmap(data):
     _assert_outputs_match(solo_p, solo_v, (3,))
 
 
+@pytest.mark.parametrize("algorithm", ["neals", "snmf"])
+def test_gram_family_grid_matches_per_k_vmap(data, algorithm):
+    """neals/snmf through the whole-grid scheduler (explicit
+    backend='packed' opt-in, round 4) reproduce the vmapped generic
+    driver: same stop decisions and labels, factors to float tolerance.
+    Their 'auto' default stays the vmap family — the grid engine exists
+    for its compile-time win (one jit for the whole sweep vs one per
+    rank), so this parity is what makes the opt-in safe."""
+    scfg_v = SolverConfig(algorithm=algorithm, backend="vmap", max_iter=400)
+    scfg_g = SolverConfig(algorithm=algorithm, backend="packed",
+                          max_iter=400)
+    cc = dict(ks=KS, restarts=3)
+    p = sweep(data, ConsensusConfig(grid_exec="per_k", **cc), scfg_v,
+              InitConfig())
+    g = sweep(data, ConsensusConfig(grid_exec="grid", **cc), scfg_g,
+              InitConfig())
+    for k in KS:
+        np.testing.assert_array_equal(np.asarray(g[k].iterations),
+                                      np.asarray(p[k].iterations))
+        np.testing.assert_array_equal(np.asarray(g[k].stop_reasons),
+                                      np.asarray(p[k].stop_reasons))
+        np.testing.assert_array_equal(np.asarray(g[k].labels),
+                                      np.asarray(p[k].labels))
+        np.testing.assert_allclose(np.asarray(g[k].consensus),
+                                   np.asarray(p[k].consensus), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g[k].dnorms),
+                                   np.asarray(p[k].dnorms), rtol=1e-4)
+        # factor tolerance is slightly wider than _assert_outputs_match's:
+        # the batched Gram solve's Tikhonov jitter uses trace/k_max vs the
+        # per-restart trace/k (see grid_mu._batched_gram_solve), a
+        # ~10·eps-scale perturbation the iteration amplifies into ~3e-5
+        # absolute drift on near-zero factor entries
+        np.testing.assert_allclose(np.asarray(g[k].best_w),
+                                   np.asarray(p[k].best_w),
+                                   rtol=2e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(g[k].best_h),
+                                   np.asarray(p[k].best_h),
+                                   rtol=2e-4, atol=1e-4)
+    # the per-k route (single-rank wrapper around the grid engine) —
+    # reachable via backend='packed' with grid_exec='per_k' or a
+    # single-k sweep
+    solo_v = sweep(data, ConsensusConfig(ks=(3,), restarts=3,
+                                         grid_exec="per_k"), scfg_v,
+                   InitConfig())
+    solo_p = sweep(data, ConsensusConfig(ks=(3,), restarts=3,
+                                         grid_exec="per_k"), scfg_g,
+                   InitConfig())
+    np.testing.assert_array_equal(np.asarray(solo_p[3].iterations),
+                                  np.asarray(solo_v[3].iterations))
+    np.testing.assert_array_equal(np.asarray(solo_p[3].labels),
+                                  np.asarray(solo_v[3].labels))
+    np.testing.assert_allclose(np.asarray(solo_p[3].best_h),
+                               np.asarray(solo_v[3].best_h),
+                               rtol=2e-4, atol=1e-4)
+
+
 def test_grid_resume_solves_only_missing_ranks(data, tmp_path):
     """Registry resume under grid execution: checkpointed ranks load, the
     missing ranks form one smaller grid solve, and the merged result
