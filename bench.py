@@ -278,9 +278,10 @@ def main():
         p.error("--backend pallas is only implemented for --algorithm mu "
                 "(use auto to fall back per algorithm)")
     if args.backend == "packed" and args.algorithm not in (
-            "mu", "hals", "neals", "snmf"):
+            "mu", "hals", "neals", "snmf", "kl"):
         p.error("--backend packed is only implemented for --algorithm "
-                "mu/hals/neals/snmf (use auto to fall back per algorithm)")
+                "mu/hals/neals/snmf/kl (use auto to fall back per "
+                "algorithm)")
     if args.verify:
         # the gate runs the three MU engines at its own fixed scaled
         # shape — reject, rather than silently ignore, arguments that

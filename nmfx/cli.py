@@ -191,9 +191,9 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--backend pallas is only implemented for "
                      "--algorithm mu (use auto)")
     if args.backend == "packed" and args.algorithm not in (
-            "mu", "hals", "neals", "snmf"):
+            "mu", "hals", "neals", "snmf", "kl"):
         parser.error("--backend packed is only implemented for "
-                     "--algorithm mu/hals/neals/snmf (use auto)")
+                     "--algorithm mu/hals/neals/snmf/kl (use auto)")
     if args.verbose:
         import logging
 

@@ -134,10 +134,10 @@ class SolverConfig:
                 "backend='pallas' is only implemented for algorithm='mu'; "
                 "use 'auto' to fall back per algorithm")
         if self.backend == "packed" and self.algorithm not in (
-                "mu", "hals", "neals", "snmf"):
+                "mu", "hals", "neals", "snmf", "kl"):
             raise ValueError(
                 "backend='packed' is only implemented for algorithms with "
-                "a dense-batched block (mu, hals, neals, snmf); use "
+                "a dense-batched block (mu, hals, neals, snmf, kl); use "
                 "'auto' to fall back per algorithm")
         if self.algorithm not in ALGORITHMS:
             raise ValueError(

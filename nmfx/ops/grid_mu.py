@@ -230,8 +230,13 @@ def snmf_block(a, wp, hp, done_mask, cfg: SolverConfig, eta=None):
     every iteration."""
     f32 = wp.dtype
     if eta is None:
-        eta = (jnp.max(a).astype(f32) ** 2 if cfg.ridge_eta is None
-               else jnp.asarray(cfg.ridge_eta, f32))
+        # a direct BLOCKS["snmf"] call would be tempted to derive eta
+        # from `a` here — which under bf16 streaming is the TRUNCATED
+        # loop operand, the exact hazard the docstring describes. Fail
+        # fast instead of silently drifting from the per-restart form.
+        raise ValueError("snmf_block requires eta resolved by "
+                         "make_block(cfg, a_full) from the "
+                         "full-precision matrix")
     beta = jnp.asarray(cfg.sparsity_beta, f32)
     k_max = wp.shape[2]
     live = jnp.any(wp != 0, axis=1)  # (B, k_max) — padded cols are zero
@@ -260,15 +265,57 @@ def snmf_block(a, wp, hp, done_mask, cfg: SolverConfig, eta=None):
     return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
 
 
+def kl_block(a, wp, hp, done_mask, cfg: SolverConfig):
+    """ONE dense-batched KL-divergence iteration (Brunet rule; see
+    solvers/kl.py): each lane materializes its m×n quotient
+    A ⊘ (WH) — the whole block holds a (B, m, n) intermediate, so under
+    the slot scheduler ``grid_slots`` directly bounds kl's working set
+    (B = slots), playing the role ``restart_chunk`` plays for the
+    vmapped driver. Zero padding is invariant: a padded component's
+    numerator contraction and column/row sum are both zero, so its
+    update is 0·x/(0+eps) = 0."""
+    eps = cfg.div_eps
+    f32 = hp.dtype
+    if a.dtype == jnp.bfloat16:
+        wb = wp.astype(jnp.bfloat16)
+        wh = jnp.einsum("bmk,bkn->bmn", wb, hp.astype(jnp.bfloat16),
+                        preferred_element_type=f32)
+        q = a.astype(f32)[None] / (wh + eps)
+        numer = jnp.einsum("bmk,bmn->bkn", wb, q.astype(jnp.bfloat16),
+                           preferred_element_type=f32)
+    else:
+        wh = jnp.einsum("bmk,bkn->bmn", wp, hp)
+        q = a[None] / (wh + eps)
+        numer = jnp.einsum("bmk,bmn->bkn", wp, q)
+    h = hp * numer / (jnp.sum(wp, axis=1)[:, :, None] + eps)
+    h = base.clamp(h, cfg.zero_threshold)
+    if a.dtype == jnp.bfloat16:
+        hb = h.astype(jnp.bfloat16)
+        wh = jnp.einsum("bmk,bkn->bmn", wb, hb, preferred_element_type=f32)
+        q = a.astype(f32)[None] / (wh + eps)
+        numer = jnp.einsum("bmn,bkn->bmk", q.astype(jnp.bfloat16), hb,
+                           preferred_element_type=f32)
+    else:
+        wh = jnp.einsum("bmk,bkn->bmn", wp, h)
+        q = a[None] / (wh + eps)
+        numer = jnp.einsum("bmn,bkn->bmk", q, h)
+    w = wp * numer / (jnp.sum(h, axis=2)[:, None, :] + eps)
+    w = base.clamp(w, cfg.zero_threshold)
+    frozen = done_mask[:, None, None]
+    return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
+
+
 #: dense-batched iteration blocks by algorithm; whether the algorithm's
 #: convergence uses the TolFun residual-decrease test; and whether it
 #: uses the class-stability stop — matching each solver's per-restart
-#: check_convergence flags (mu = class+TolX; hals/snmf =
+#: check_convergence flags (mu/kl = class+TolX; hals/snmf =
 #: class+TolX+TolFun; neals = TolX+TolFun only, solvers/*.py)
 BLOCKS = {"mu": mu_block, "hals": hals_block, "neals": neals_block,
-          "snmf": snmf_block}
-USES_TOLFUN = {"mu": False, "hals": True, "neals": True, "snmf": True}
-USES_CLASS = {"mu": True, "hals": True, "neals": False, "snmf": True}
+          "snmf": snmf_block, "kl": kl_block}
+USES_TOLFUN = {"mu": False, "hals": True, "neals": True, "snmf": True,
+               "kl": False}
+USES_CLASS = {"mu": True, "hals": True, "neals": False, "snmf": True,
+              "kl": True}
 
 
 def conv_cfg(cfg: SolverConfig) -> SolverConfig:

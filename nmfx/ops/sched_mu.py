@@ -126,6 +126,25 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
     return clamped
 
 
+def _kl_slot_clamp(s: int, m: int, n: int, dtype) -> int:
+    """Bound kl's quotient working set: each live lane materializes m×n
+    intermediates (reconstruction, quotient, and the contraction operand
+    — budgeted as 3 concurrently-live (B, m, n) buffers, conservative
+    against XLA fusion), so the slot pool is the memory knob on this path
+    (the role ``restart_chunk`` plays for the vmapped driver). Capped at
+    ~4 GB of quotient traffic — no clamp at the north-star 5000×500
+    (133-slot ceiling), 16 slots at 20000×1000 f32. Logged at WARNING
+    when it shrinks the requested pool, like the pallas VMEM clamp."""
+    bytes_per_lane = 3 * m * n * jnp.dtype(dtype).itemsize
+    clamped = max(1, min(s, int(4e9 // bytes_per_lane)))
+    if clamped < s:
+        import logging
+        logging.getLogger("nmfx").warning(
+            "kl scheduler: slot pool clamped %d -> %d (each lane holds "
+            "~3 m*n quotient intermediates; m=%d, n=%d)", s, clamped, m, n)
+    return clamped
+
+
 class SchedState(NamedTuple):
     # slot-resident solver state (no cross-block w_prev/h_prev: the TolX
     # delta is between the block's last two steps, both inside `body`)
@@ -229,6 +248,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     s = min(slots, j)
     if use_pallas:
         s = _pallas_slot_clamp(s, k_max, m, n, cfg)
+    if cfg.algorithm == "kl":
+        s = _kl_slot_clamp(s, m, n, dtype)
     ce = cfg.check_every
 
     with base.matmul_precision_ctx(cfg.matmul_precision):

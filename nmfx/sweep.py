@@ -94,6 +94,24 @@ def grid_axes_active(mesh: Mesh | None) -> bool:
                     for ax in (FEATURE_AXIS, SAMPLE_AXIS)))
 
 
+#: backends that route each algorithm into the slot-scheduled dense-grid
+#: machinery. mu/hals: the packed family IS their default engine ("auto"
+#: resolves there). neals/snmf (round 4): the dense-batched blocks exist
+#: (grid_mu.BLOCKS) but "auto" deliberately stays on the vmapped generic
+#: driver — their defaults' engine family (and checkpoint fingerprints)
+#: are stable, and the whole-grid solve is an explicit backend="packed"
+#: opt-in whose win is compile time (one jit vs one per rank), not
+#: iteration throughput (they converge in ~14–21 iterations).
+_GRID_EXEC_BACKENDS = {"mu": ("auto", "packed", "pallas"),
+                       "hals": ("auto", "packed"),
+                       "neals": ("packed",),
+                       "snmf": ("packed",),
+                       # kl: the slot count bounds its (B, m, n) quotient
+                       # working set — grid_slots plays restart_chunk's
+                       # memory-bounding role on this path
+                       "kl": ("packed",)}
+
+
 def resolve_engine_family(solver_cfg: SolverConfig,
                           mesh: Mesh | None = None) -> str:
     """The engine family a configuration actually executes — "pallas",
@@ -112,15 +130,14 @@ def resolve_engine_family(solver_cfg: SolverConfig,
         return "pallas"
     if _use_packed(solver_cfg):
         return "packed"
-    if (solver_cfg.algorithm == "hals"
-            and solver_cfg.backend in ("auto", "packed")
+    # non-mu algorithms route into the batched/scheduled machinery
+    # exactly when _GRID_EXEC_BACKENDS says so and no grid axes shard
+    # single ranks — ONE table shared with grid_exec_ok and
+    # _build_sweep_fn, so the fingerprint cannot desynchronize from the
+    # execution routing
+    if (solver_cfg.backend in _GRID_EXEC_BACKENDS.get(
+            solver_cfg.algorithm, ())
             and not grid_axes_active(mesh)):
-        return "packed"
-    if (solver_cfg.algorithm in ("neals", "snmf")
-            and solver_cfg.backend == "packed"
-            and not grid_axes_active(mesh)):
-        # the round-4 explicit whole-grid opt-in for the Gram families;
-        # their "auto" stays the vmap family (_GRID_EXEC_BACKENDS)
         return "packed"
     return "vmap"
 
@@ -156,10 +173,9 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     if _use_packed(solver_cfg):
         return _build_packed_sweep_fn(k, restarts, solver_cfg, init_cfg,
                                       label_rule, mesh, keep_factors)
-    if (solver_cfg.algorithm == "hals"
-            and solver_cfg.backend in ("auto", "packed")) or (
-            solver_cfg.algorithm in ("neals", "snmf")
-            and solver_cfg.backend == "packed"):
+    if (solver_cfg.algorithm != "mu"
+            and solver_cfg.backend in _GRID_EXEC_BACKENDS.get(
+                solver_cfg.algorithm, ())):
         # the batched backend IS the dense grid machinery at one rank:
         # shared-GEMM lanes through the slot scheduler (hals' two big
         # GEMMs are mu-shaped — ref libnmf/nmf_mu.c:174-216; neals/snmf
@@ -619,24 +635,10 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
     return jax.jit(impl)
 
 
-#: backends that route each algorithm into the slot-scheduled dense-grid
-#: machinery. mu/hals: the packed family IS their default engine ("auto"
-#: resolves there). neals/snmf (round 4): the dense-batched blocks exist
-#: (grid_mu.BLOCKS) but "auto" deliberately stays on the vmapped generic
-#: driver — their defaults' engine family (and checkpoint fingerprints)
-#: are stable, and the whole-grid solve is an explicit backend="packed"
-#: opt-in whose win is compile time (one jit vs one per rank), not
-#: iteration throughput (they converge in ~14–21 iterations).
-_GRID_EXEC_BACKENDS = {"mu": ("auto", "packed", "pallas"),
-                       "hals": ("auto", "packed"),
-                       "neals": ("packed",),
-                       "snmf": ("packed",)}
-
-
 def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
     """Whether the whole-grid slot-scheduled solve (``nmfx.ops.sched_mu``)
     can run this configuration: an algorithm with a dense-batched block
-    (grid_mu.BLOCKS: mu, hals, neals, snmf) under the backend that routes
+    (grid_mu.BLOCKS: mu, hals, neals, snmf, kl) under the backend that routes
     it there (``_GRID_EXEC_BACKENDS`` — including the fused pallas
     kernels for mu; the scheduler keeps its slot state in the packed
     column layout those kernels consume) — with no feature/sample mesh
@@ -912,7 +914,8 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             "grid_exec='grid' needs an algorithm/backend pair that routes "
             "into the slot scheduler — mu (backend "
             "'auto'/'packed'/'pallas'), hals ('auto'/'packed'), or "
-            "neals/snmf (explicit 'packed') — and no feature/sample mesh "
+            "neals/snmf/kl (explicit 'packed') — and no feature/sample "
+            "mesh "
             f"axes; got algorithm={solver_cfg.algorithm!r}, "
             f"backend={solver_cfg.backend!r} (use grid_exec='auto' to "
             "fall back per configuration)")

@@ -190,14 +190,16 @@ def test_hals_grid_matches_per_k_vmap(data):
     _assert_outputs_match(solo_p, solo_v, (3,))
 
 
-@pytest.mark.parametrize("algorithm", ["neals", "snmf"])
+@pytest.mark.parametrize("algorithm", ["neals", "snmf", "kl"])
 def test_gram_family_grid_matches_per_k_vmap(data, algorithm):
-    """neals/snmf through the whole-grid scheduler (explicit
+    """neals/snmf/kl through the whole-grid scheduler (explicit
     backend='packed' opt-in, round 4) reproduce the vmapped generic
     driver: same stop decisions and labels, factors to float tolerance.
     Their 'auto' default stays the vmap family — the grid engine exists
     for its compile-time win (one jit for the whole sweep vs one per
-    rank), so this parity is what makes the opt-in safe."""
+    rank; for kl the slot count additionally bounds the (B, m, n)
+    quotient working set), so this parity is what makes the opt-in
+    safe."""
     scfg_v = SolverConfig(algorithm=algorithm, backend="vmap", max_iter=400)
     scfg_g = SolverConfig(algorithm=algorithm, backend="packed",
                           max_iter=400)
