@@ -337,8 +337,8 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
     the empirical fit accepted by the scheduler
     (``sched_mu._pallas_slot_clamp``, the single source of truth for the
     formula) is ``4·rk·(m_pad + 3·n_pad + rk) + 2·block_m·n_pad·a_bytes
-    ≤ 14.9 MiB`` with n_pad = n rounded up to 128 lanes (e.g. rk ≤ 480
-    at m=5120, n=512, bf16 A; rk ≤ 352 at n=1024). Beyond it Mosaic
+    ≤ 14.3 MiB`` with n_pad = n rounded up to 128 lanes (e.g. rk ≤ 480
+    at m=5120, n=512, bf16 A; rk ≤ ~368 at n=1024). Beyond it Mosaic
     rejects at compile time — use the per-iteration kernels there.
     """
     m, n = a.shape

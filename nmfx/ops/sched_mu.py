@@ -82,14 +82,17 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
 
         4·rk·(m_pad + 3·n_pad + rk) + 2·block_m·n_pad·a_bytes
 
-    must stay ≤ 14.9 MiB (≈ the 16 MiB scoped-VMEM limit minus ~1.1 MiB
+    must stay ≤ 14.3 MiB (≈ the 16 MiB scoped-VMEM limit minus ~1.7 MiB
     fixed overhead; the 3·n_pad term — one slot beyond the h/numer
     windows — matches an extra n-proportional allocation visible in the
-    measured OOM sizes). The fit separates every measured point: accepts
-    rk=480 (m=5120, n=512, bf16 — the north-star 48-slot pool at
-    k_max=10), rejects rk=512 there (measured OOM 17.08 MiB), accepts
-    rk=320 and rejects rk=384 at n=1024 (OOM 17.33 MiB), accepts rk=448
-    f32 (boundary OK). Shrinks the pool to the largest fitting slot count
+    measured OOM sizes). The fit separates every measured point with the
+    accepts maxing at 14.14 MiB (rk=448 f32, boundary OK) and the
+    rejects starting at 14.5 MiB (rk=512 f32 at block_m=128): accepts
+    rk=480 at the north star (m=5120, n=512, bf16 — the 48-slot pool at
+    k_max=10, model 14.07 MiB), rejects rk=512 there (model 15.0, OOM
+    17.08 measured), accepts rk=320 (12.39) and rejects rk=384 (14.56)
+    at n=1024. Boundary points are pinned by
+    tests/test_slot_clamps.py. Shrinks the pool to the largest fitting slot count
     instead of letting Mosaic reject at compile time (the model is
     best-effort: if it ever admits an unfittable shape, Mosaic still
     fails loudly at compile time); the queue semantics are
@@ -101,7 +104,7 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
     _, block_m, m_pad = _pallas_block_geometry(m)
     n_pad = -(-n // 128) * 128
     a_bytes = 2 if _streams_bf16_a(cfg) else jnp.dtype(cfg.dtype).itemsize
-    budget = int(14.9 * 2**20) - 2 * block_m * n_pad * a_bytes
+    budget = int(14.3 * 2**20) - 2 * block_m * n_pad * a_bytes
 
     def fits(slots: int) -> bool:
         rk = slots * k_max

@@ -1,0 +1,67 @@
+"""The scheduler's slot-pool clamps (nmfx.ops.sched_mu).
+
+Pure-arithmetic tests on the two memory models that size the pool: the
+pallas resident-W VMEM envelope (byte model calibrated on-chip in round
+4 — these tests pin the measured boundary points so a formula edit that
+shifts the envelope fails loudly) and the kl quotient clamp (the
+grid_slots-as-restart_chunk memory bound).
+"""
+
+import logging
+
+import jax.numpy as jnp
+import pytest
+
+from nmfx.config import SolverConfig
+from nmfx.ops.sched_mu import _kl_slot_clamp, _pallas_slot_clamp
+
+BF16 = SolverConfig(matmul_precision="bfloat16")
+
+
+def pallas_clamp(s, k_max, m, n, cfg=BF16):
+    return _pallas_slot_clamp(s, k_max, m, n, cfg)
+
+
+def test_pallas_envelope_measured_boundaries(monkeypatch):
+    """The fitted byte model must reproduce the on-chip OK/OOM points
+    (benchmarks/probe_vmem_envelope*.py): rk=480 fits at the north star,
+    rk=512 does not; rk=384 overflows at n=1024 while 320 fits."""
+    import nmfx.ops.sched_mu as sm
+
+    # the a_bytes predicate consults jax.default_backend(); pin the
+    # TPU-streaming answer so the test is platform-free
+    monkeypatch.setattr(sm, "_streams_bf16_a", lambda cfg: True)
+    # north star: k_max=10, 48 requested -> 48 kept (rk=480 measured OK)
+    assert pallas_clamp(48, 10, 5000, 500) == 48
+    # rk=512 measured OOM: k_max=8 at 64 requested must clamp below 64
+    assert pallas_clamp(64, 8, 5000, 500) < 64
+    # n=1024: rk=384 OOM, rk=320 OK -> clamp for k_max=32 lands in [10, 11]
+    c = pallas_clamp(48, 32, 5000, 1024)
+    assert 10 <= c <= 11
+    # a single job beyond the envelope is a clear error
+    with pytest.raises(ValueError, match="VMEM envelope"):
+        pallas_clamp(1, 600, 20000, 2048)
+
+
+def test_pallas_clamp_logs_reduction(monkeypatch, caplog):
+    import nmfx.ops.sched_mu as sm
+
+    monkeypatch.setattr(sm, "_streams_bf16_a", lambda cfg: True)
+    with caplog.at_level(logging.WARNING, logger="nmfx"):
+        pallas_clamp(64, 8, 5000, 500)
+    assert any("slot pool clamped" in r.message for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="nmfx"):
+        pallas_clamp(48, 10, 5000, 500)  # fits: silent
+    assert not caplog.records
+
+
+def test_kl_clamp_bounds_quotient_memory(caplog):
+    # north star: 133-slot ceiling -> 48 untouched
+    assert _kl_slot_clamp(48, 5000, 500, jnp.float32) == 48
+    # 20000x1000 f32: 3*80 MB per lane -> 16 slots
+    with caplog.at_level(logging.WARNING, logger="nmfx"):
+        assert _kl_slot_clamp(48, 20000, 1000, jnp.float32) == 16
+    assert any("kl scheduler" in r.message for r in caplog.records)
+    # never below one slot
+    assert _kl_slot_clamp(4, 200000, 10000, jnp.float32) == 1
