@@ -27,7 +27,8 @@ def main():
     ap.add_argument("--tails", nargs="+", default=["0", "4", "8", "16"])
     ap.add_argument("--backends", nargs="+", default=["auto", "pallas"])
     args = ap.parse_args()
-    tails = [int(t) for t in args.tails]
+    tails = [tuple(int(x) for x in t.split(",")) if "," in t else int(t)
+             for t in args.tails]
 
     ks = tuple(range(2, 11))
     a = grouped_matrix(5000, (125,) * 4, effect=2.0, seed=0)

@@ -296,7 +296,7 @@ def nmfconsensus(
     keep_factors: bool = False,
     grid_exec: str = "auto",
     grid_slots: int = 48,
-    grid_tail_slots: "int | None | str" = "auto",
+    grid_tail_slots: "int | None | str | tuple" = "auto",
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
@@ -330,8 +330,9 @@ def nmfconsensus(
     per-rank path; "grid" demands the whole-grid path (error when the
     config can't run it). ``grid_slots`` is the scheduler's per-device
     slot-pool width (``ConsensusConfig.grid_slots``); ``grid_tail_slots``
-    its straggler tail-pool width (``ConsensusConfig.grid_tail_slots`` —
-    "auto"/0-to-disable; per-job stop decisions identical either way).
+    its straggler-tail cascade — an int or decreasing tuple of pool
+    widths (``ConsensusConfig.grid_tail_slots``; "auto"/0-to-disable;
+    per-job stop decisions identical in every case).
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "

@@ -42,19 +42,29 @@ def parse_ks(spec: str) -> tuple[int, ...]:
 
 
 def _tail_slots_arg(value: str):
-    """'auto' or a non-negative int — validated at parse time so a bad
-    value is a usage error, not a late ValueError traceback."""
+    """'auto', a non-negative int, or a comma-separated decreasing
+    cascade like '24,8' — validated at parse time so a bad value is a
+    usage error, not a late ValueError traceback."""
     if value == "auto":
         return value
     try:
-        v = int(value)
+        widths = tuple(int(part) for part in value.split(","))
     except ValueError:
         raise argparse.ArgumentTypeError(
-            f"expected 'auto' or a non-negative integer, got {value!r}")
-    if v < 0:
+            f"expected 'auto', a non-negative integer, or a "
+            f"comma-separated cascade (e.g. '24,8'), got {value!r}")
+    if len(widths) == 1:
+        if widths[0] < 0:
+            raise argparse.ArgumentTypeError(
+                f"expected a non-negative integer, got {value!r}")
+        return widths[0]
+    if any(w < 1 for w in widths):
         raise argparse.ArgumentTypeError(
-            f"expected 'auto' or a non-negative integer, got {value!r}")
-    return v
+            f"cascade widths must be >= 1, got {value!r}")
+    if any(b >= a for a, b in zip(widths, widths[1:])):
+        raise argparse.ArgumentTypeError(
+            f"cascade widths must be strictly decreasing, got {value!r}")
+    return widths
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -141,12 +151,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "best at the north-star sweep")
     p.add_argument("--grid-tail-slots", default="auto",
                    type=_tail_slots_arg,
-                   help="tail-pool width of the whole-grid scheduler: once "
-                        "the queue drains, surviving stragglers compact "
-                        "into a pool this wide and finish at its cheaper "
-                        "per-iteration cost. 'auto' (default) = measured "
-                        "default; 0 disables the tail phase. Per-job "
-                        "stop decisions are identical either way")
+                   help="straggler-tail cascade of the whole-grid "
+                        "scheduler: an int or comma-separated decreasing "
+                        "widths (e.g. '24,8'). Once the queue drains, "
+                        "surviving stragglers compact into progressively "
+                        "narrower pools with cheaper per-iteration cost. "
+                        "'auto' (default) = measured default; 0 disables. "
+                        "Per-job stop decisions are identical either way")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "

@@ -240,15 +240,16 @@ class ConsensusConfig:
     #: sweep (450 jobs on one v5e chip); larger pools help only when the
     #: grid is iteration-rich relative to its stragglers
     grid_slots: int = 48
-    #: tail-pool width of the whole-grid scheduler: once the job queue
-    #: drains and at most this many jobs are still live, the survivors
-    #: compact into a pool this wide and finish at the narrow width's
+    #: straggler-tail cascade of the whole-grid scheduler: an int or a
+    #: decreasing tuple of pool widths. Once the job queue drains and at
+    #: most the next width's worth of jobs are live, the survivors
+    #: compact into that narrower pool and finish at its cheaper
     #: per-iteration cost (the straggler tail dominates the sweep wall —
     #: see nmfx/ops/sched_mu.py). "auto" = measured default; 0/None
-    #: disables the tail phase. Per-job stop decisions are identical
-    #: either way, factors within float tolerance (as for any slot-count
-    #: change); costs one extra compiled loop.
-    grid_tail_slots: int | None | str = "auto"
+    #: disables. Per-job stop decisions are identical in every case,
+    #: factors within float tolerance (as for any slot-count change);
+    #: each stage costs one extra compiled loop.
+    grid_tail_slots: "int | None | str | tuple" = "auto"
 
     def __post_init__(self):
         # dedupe preserving order: a duplicated rank would be solved twice
@@ -269,11 +270,19 @@ class ConsensusConfig:
         if self.grid_slots < 1:
             raise ValueError("grid_slots must be >= 1")
         ts = self.grid_tail_slots
-        if not (ts is None or ts == "auto"
-                or (isinstance(ts, int) and ts >= 0)):
+        if isinstance(ts, (list, tuple)):
+            ok = all(isinstance(t, int) and not isinstance(t, bool)
+                     and t >= 1 for t in ts)
+            if ok:
+                # normalize to a tuple: the value keys jit/builder caches
+                object.__setattr__(self, "grid_tail_slots", tuple(ts))
+        else:
+            ok = (ts is None or ts == "auto"
+                  or (isinstance(ts, int) and ts >= 0))
+        if not ok:
             raise ValueError(
-                f"grid_tail_slots must be 'auto', None, or an int >= 0, "
-                f"got {ts!r}")
+                f"grid_tail_slots must be 'auto', None, an int >= 0, or "
+                f"a tuple of int widths >= 1, got {self.grid_tail_slots!r}")
         if self.linkage not in LINKAGE_METHODS:
             raise ValueError(
                 f"linkage must be one of {LINKAGE_METHODS}, got "

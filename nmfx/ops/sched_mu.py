@@ -176,27 +176,40 @@ class SchedMUResult(NamedTuple):
     stop_reason: jax.Array  # (J,) i32 StopReason
 
 
-def _resolve_tail(tail_slots, s: int):
-    """Resolve the tail-pool width: None/0 disables, "auto" picks the
-    measured default, and any width >= the main pool is a no-op (there is
-    nothing to compact into)."""
+def _resolve_tail(tail_slots, s: int) -> tuple[int, ...]:
+    """Resolve the tail cascade: a strictly-decreasing tuple of pool
+    widths the survivors compact through (() disables). Accepts None/0
+    (off), "auto" (the measured default cascade), one int, or a
+    sequence of ints — widths >= the current pool (or out of order) are
+    dropped rather than erroring, so one cascade spec works across job
+    counts."""
     if tail_slots in (None, 0):
-        return None
+        return ()
     if tail_slots == "auto":
         tail_slots = _AUTO_TAIL_SLOTS
-    t = int(tail_slots)
-    if t < 1:
-        raise ValueError(f"tail_slots must be >= 1, got {t}")
-    return t if t < s else None
+    if isinstance(tail_slots, int):
+        tail_slots = (tail_slots,)
+    widths = []
+    prev = s
+    for t in tail_slots:
+        t = int(t)
+        if t < 1:
+            raise ValueError(f"tail widths must be >= 1, got {t}")
+        if t < prev:
+            widths.append(t)
+            prev = t
+    return tuple(widths)
 
 
 #: measured on the real chip (benchmarks/probe_tail_slots.py, round 4,
-#: same-session interleaved min-of-3 over tail widths {off, 4, 8, 16} at
-#: the full north star): 8 won for BOTH engines — XLA-dense 3.52 s (off)
-#: → 3.12 s, pallas 3.31 s → 3.02 s in its (slow-tunnel) session, ~9–11%
-#: off the sweep wall; 4 throttles live jobs slightly too early, 16
-#: leaves too much width under the stragglers
-_AUTO_TAIL_SLOTS = 8
+#: same-session interleaved min-of-N at the full north star): a single
+#: 8-lane tail won over {off, 4, 16} for BOTH engines (XLA-dense 3.52 s
+#: off → 3.12 s, pallas 3.31 → 3.02 s in its slow-tunnel session,
+#: ~9–11% off the wall), and the 24→8 cascade measured at parity with
+#: the single 8 (the drain window between 47 and 8 live jobs is short —
+#: most post-drain iterations belong to the last few stragglers), so
+#: the simpler single stage stays the default
+_AUTO_TAIL_SLOTS = (8,)
 
 
 @partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes",
@@ -205,7 +218,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              cfg: SolverConfig = SolverConfig(),
              slots: int = 48,
              varying_axes: tuple[str, ...] = (),
-             tail_slots: int | None | str = "auto") -> SchedMUResult:
+             tail_slots: "int | None | str | tuple[int, ...]" = "auto",
+             ) -> SchedMUResult:
     """Solve J dense zero-padded jobs through an S-slot scheduler.
 
     ``w0``/``h0``: (J, m, k_max) / (J, k_max, n) initial factors, in the
@@ -224,15 +238,17 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     its own queue at its own pace and exits independently — per-device
     work-conserving schedules over the device's job shard.
 
-    ``tail_slots``: once the queue drains and at most this many jobs are
-    still live, the survivors compact into a ``tail_slots``-wide pool
-    and finish there — straggler iterations then cost the narrow width's
-    per-iteration price instead of the full pool's (see the phase-2
-    comment in the body). "auto" (default) uses the measured default;
-    None/0 disables the tail phase (single full-width loop). Per-job
-    stop decisions are identical either way (factors drift only at the
-    float-tolerance level any width change produces); the knob affects
-    wall-clock.
+    ``tail_slots``: the straggler-tail cascade — an int or a
+    decreasing tuple of pool widths. Once the queue drains and at most
+    the next width's worth of jobs are live, the survivors compact into
+    that narrower pool and finish there — straggler iterations then
+    cost the narrow width's per-iteration price instead of the full
+    pool's (see the cascade comment in the body). "auto" (default) uses
+    the measured default; None/0 disables (single full-width loop).
+    Per-job stop decisions are identical in every case (factors drift
+    only at the float-tolerance level any width change produces); the
+    knob affects wall-clock only. Must be hashable (tuple, not list) —
+    it keys the jit cache.
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
@@ -522,50 +538,47 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
             return body
 
+        # --- straggler-tail cascade ----------------------------------
+        # The sweep's wall is dominated by its stragglers: once the
+        # queue drains, a handful of long jobs keep iterating inside a
+        # mostly-empty full-width pool, paying c(S) per iteration for a
+        # few lanes of real work (measured: the north-star k=10
+        # stragglers run thousands of iterations after the pool drains).
+        # Each cascade stage runs its pool while the queue has jobs OR
+        # more than the NEXT width's worth of slots are live; then the
+        # surviving jobs compact (a stable lane gather) into the next,
+        # narrower pool. Same bookkeeping, same result buffers; per-job
+        # stop decisions are identical to the single-phase schedule and
+        # factors agree to float tolerance (XLA/Mosaic tile GEMMs
+        # differently per batch width — measured ~1e-6 relative, the
+        # same drift any slot-count change produces).
+        def compact(st: SchedState, width: int) -> SchedState:
+            order = jnp.argsort(~st.active, stable=True)[:width]
+            wp_t, hp_t = gather_slots(st.wp, st.hp, order)
+            return SchedState(
+                wp=wp_t, hp=hp_t,
+                slot_iter=st.slot_iter[order],
+                classes=st.classes[order],
+                stable=st.stable[order],
+                dnorm=st.dnorm[order],
+                slot_job=st.slot_job[order],
+                active=st.active[order],
+                queue=st.queue,
+                out_w=st.out_w, out_h=st.out_h,
+                out_iters=st.out_iters, out_stop=st.out_stop,
+            )
+
+        st = state0
         body = make_body(make_do_block(s))
-        tail_s = _resolve_tail(tail_slots, s)
-        if tail_s is None:
-            final = lax.while_loop(lambda st: jnp.any(st.active), body,
-                                   state0)
-        else:
-            # --- two-phase tail compaction -------------------------------
-            # The sweep's wall is dominated by its stragglers: once the
-            # queue drains, a handful of long jobs keep iterating inside a
-            # mostly-empty full-width pool, paying c(S) per iteration for
-            # ≤ tail_s lanes of real work (measured: the north-star k=10
-            # stragglers run thousands of iterations after the pool
-            # drains). Phase 1 runs the full pool while the queue has
-            # jobs OR more than tail_s slots are live; then the surviving
-            # jobs compact (a stable lane gather) into a tail_s-wide
-            # pool that finishes them at the narrow width's per-iteration
-            # cost. Same bookkeeping, same result buffers; per-job stop
-            # decisions are identical to the single-phase schedule and
-            # factors agree to float tolerance (XLA/Mosaic tile GEMMs
-            # differently per batch width — measured ~1e-6 relative,
-            # the same drift any slot-count change produces).
-            def phase1_cond(st):
+        for width in _resolve_tail(tail_slots, s):
+            def stage_cond(st, width=width):
                 live = jnp.sum(st.active, dtype=jnp.int32)
                 return jnp.any(st.active) & (
-                    (st.queue < j) | (live > tail_s))
+                    (st.queue < j) | (live > width))
 
-            st1 = lax.while_loop(phase1_cond, body, state0)
-            order = jnp.argsort(~st1.active, stable=True)[:tail_s]
-            wp_t, hp_t = gather_slots(st1.wp, st1.hp, order)
-            state_t = SchedState(
-                wp=wp_t, hp=hp_t,
-                slot_iter=st1.slot_iter[order],
-                classes=st1.classes[order],
-                stable=st1.stable[order],
-                dnorm=st1.dnorm[order],
-                slot_job=st1.slot_job[order],
-                active=st1.active[order],
-                queue=st1.queue,
-                out_w=st1.out_w, out_h=st1.out_h,
-                out_iters=st1.out_iters, out_stop=st1.out_stop,
-            )
-            tail_body = make_body(make_do_block(tail_s))
-            final = lax.while_loop(lambda st: jnp.any(st.active),
-                                   tail_body, state_t)
+            st = compact(lax.while_loop(stage_cond, body, st), width)
+            body = make_body(make_do_block(width))
+        final = lax.while_loop(lambda st: jnp.any(st.active), body, st)
         out_w = final.out_w[:j]
         out_h = final.out_h[:j]
         # exact final residuals, once, from the retained per-job factors

@@ -82,7 +82,7 @@ def test_pallas_scheduler_matches_dense(jobs, slots, max_iter):
 
 
 @pytest.mark.parametrize("backend", ["auto", "pallas"])
-@pytest.mark.parametrize("tail", [1, 2, 5])
+@pytest.mark.parametrize("tail", [1, 2, 5, (4, 2), (5, 3, 1)])
 def test_tail_compaction_schedule_free(jobs, backend, tail):
     """The straggler tail phase (compact survivors into a narrow pool once
     the queue drains) is pure execution policy: per-job iterations and
@@ -90,7 +90,8 @@ def test_tail_compaction_schedule_free(jobs, backend, tail):
     disabled; factors agree to the same float tolerance as any other
     width change (GEMM tiling differs per batch width — measured ~1e-6
     relative). Exercises compaction mid-flight: 6 slots over 15 jobs with
-    tail widths below, at, and above the live-job count at drain."""
+    tail widths below, at, and above the live-job count at drain, and
+    multi-stage cascades."""
     a, w0, h0 = jobs
     cfg = SolverConfig(max_iter=600, backend=backend)
     ref = mu_sched(a, w0, h0, cfg, slots=6, tail_slots=None)
