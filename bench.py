@@ -277,11 +277,12 @@ def main():
     if args.backend == "pallas" and args.algorithm != "mu":
         p.error("--backend pallas is only implemented for --algorithm mu "
                 "(use auto to fall back per algorithm)")
-    if args.backend == "packed" and args.algorithm not in (
-            "mu", "hals", "neals", "snmf", "kl"):
+    from nmfx.config import PACKED_ALGORITHMS
+    if (args.backend == "packed"
+            and args.algorithm not in PACKED_ALGORITHMS):
         p.error("--backend packed is only implemented for --algorithm "
-                "mu/hals/neals/snmf/kl (use auto to fall back per "
-                "algorithm)")
+                f"{'/'.join(PACKED_ALGORITHMS)} (use auto to fall back "
+                "per algorithm)")
     if args.verify:
         # the gate runs the three MU engines at its own fixed scaled
         # shape — reject, rather than silently ignore, arguments that

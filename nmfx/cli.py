@@ -15,7 +15,8 @@ import sys
 import os
 
 from nmfx.config import (ALGORITHMS, INIT_METHODS, LINKAGE_METHODS,
-                         VERSION, OutputConfig, SolverConfig)
+                         PACKED_ALGORITHMS, VERSION, OutputConfig,
+                         SolverConfig)
 
 #: default persistent XLA compilation-cache location (XDG-style, overridable
 #: via --compile-cache/--no-compile-cache). The reference pays no compile
@@ -139,8 +140,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "grid", "per_k"),
                    help="(k x restart) grid execution: 'auto' solves every "
                         "rank in ONE compiled whole-grid slot-scheduled "
-                        "batch when eligible (mu or hals with the packed "
-                        "backend family, no grid shards) — the reference's "
+                        "batch when eligible (mu/hals with the packed "
+                        "backend family, or neals/snmf/kl with --backend "
+                        "packed; no grid shards) — the reference's "
                         "whole-grid job-array concurrency; 'per_k' forces "
                         "sequential ranks (one compile each); 'grid' "
                         "demands the whole-grid path")
@@ -201,10 +203,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.backend == "pallas" and args.algorithm != "mu":
         parser.error("--backend pallas is only implemented for "
                      "--algorithm mu (use auto)")
-    if args.backend == "packed" and args.algorithm not in (
-            "mu", "hals", "neals", "snmf", "kl"):
+    if (args.backend == "packed"
+            and args.algorithm not in PACKED_ALGORITHMS):
         parser.error("--backend packed is only implemented for "
-                     "--algorithm mu/hals/neals/snmf/kl (use auto)")
+                     f"--algorithm {'/'.join(PACKED_ALGORITHMS)} "
+                     "(use auto)")
     if args.verbose:
         import logging
 

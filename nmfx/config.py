@@ -12,6 +12,12 @@ import dataclasses
 from typing import Sequence
 
 ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf", "hals")
+#: algorithms with a dense-batched block (nmfx.ops.grid_mu.BLOCKS) that
+#: backend="packed" can route through the batched/scheduled machinery —
+#: the single list shared by SolverConfig validation, the CLI/bench
+#: guards, and (as the keys of sweep._GRID_EXEC_BACKENDS) the routing
+#: table itself
+PACKED_ALGORITHMS = ("mu", "hals", "neals", "snmf", "kl")
 INIT_METHODS = ("random", "nndsvd")
 LINKAGE_METHODS = ("average", "complete", "single")
 
@@ -133,11 +139,11 @@ class SolverConfig:
             raise ValueError(
                 "backend='pallas' is only implemented for algorithm='mu'; "
                 "use 'auto' to fall back per algorithm")
-        if self.backend == "packed" and self.algorithm not in (
-                "mu", "hals", "neals", "snmf", "kl"):
+        if (self.backend == "packed"
+                and self.algorithm not in PACKED_ALGORITHMS):
             raise ValueError(
                 "backend='packed' is only implemented for algorithms with "
-                "a dense-batched block (mu, hals, neals, snmf, kl); use "
+                f"a dense-batched block {PACKED_ALGORITHMS}; use "
                 "'auto' to fall back per algorithm")
         if self.algorithm not in ALGORITHMS:
             raise ValueError(
@@ -278,7 +284,8 @@ class ConsensusConfig:
                 object.__setattr__(self, "grid_tail_slots", tuple(ts))
         else:
             ok = (ts is None or ts == "auto"
-                  or (isinstance(ts, int) and ts >= 0))
+                  or (isinstance(ts, int) and not isinstance(ts, bool)
+                      and ts >= 0))
         if not ok:
             raise ValueError(
                 f"grid_tail_slots must be 'auto', None, an int >= 0, or "

@@ -275,30 +275,20 @@ def kl_block(a, wp, hp, done_mask, cfg: SolverConfig):
     numerator contraction and column/row sum are both zero, so its
     update is 0·x/(0+eps) = 0."""
     eps = cfg.div_eps
-    f32 = hp.dtype
-    if a.dtype == jnp.bfloat16:
-        wb = wp.astype(jnp.bfloat16)
-        wh = jnp.einsum("bmk,bkn->bmn", wb, hp.astype(jnp.bfloat16),
-                        preferred_element_type=f32)
-        q = a.astype(f32)[None] / (wh + eps)
-        numer = jnp.einsum("bmk,bmn->bkn", wb, q.astype(jnp.bfloat16),
-                           preferred_element_type=f32)
-    else:
-        wh = jnp.einsum("bmk,bkn->bmn", wp, hp)
-        q = a[None] / (wh + eps)
-        numer = jnp.einsum("bmk,bmn->bkn", wp, q)
+    # NOTE: unlike the other blocks, kl receives FULL-PRECISION A even
+    # under matmul_precision="bfloat16" (sched_mu._streams_bf16_a
+    # excludes kl): A feeds the elementwise quotient, where bf16
+    # truncation would be a real perturbation, not the MXU's own operand
+    # rounding. The GEMMs still run at bf16 MXU precision via the
+    # surrounding matmul_precision_ctx, matching the vmapped engine.
+    wh = jnp.einsum("bmk,bkn->bmn", wp, hp)
+    q = a[None] / (wh + eps)
+    numer = jnp.einsum("bmk,bmn->bkn", wp, q)
     h = hp * numer / (jnp.sum(wp, axis=1)[:, :, None] + eps)
     h = base.clamp(h, cfg.zero_threshold)
-    if a.dtype == jnp.bfloat16:
-        hb = h.astype(jnp.bfloat16)
-        wh = jnp.einsum("bmk,bkn->bmn", wb, hb, preferred_element_type=f32)
-        q = a.astype(f32)[None] / (wh + eps)
-        numer = jnp.einsum("bmn,bkn->bmk", q.astype(jnp.bfloat16), hb,
-                           preferred_element_type=f32)
-    else:
-        wh = jnp.einsum("bmk,bkn->bmn", wp, h)
-        q = a[None] / (wh + eps)
-        numer = jnp.einsum("bmn,bkn->bmk", q, h)
+    wh = jnp.einsum("bmk,bkn->bmn", wp, h)
+    q = a[None] / (wh + eps)
+    numer = jnp.einsum("bmn,bkn->bmk", q, h)
     w = wp * numer / (jnp.sum(h, axis=2)[:, None, :] + eps)
     w = base.clamp(w, cfg.zero_threshold)
     frozen = done_mask[:, None, None]
@@ -457,13 +447,14 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
                                       jnp.int32)),
             dnorm=vary(jnp.full((b,), jnp.inf, dtype)),
         )
+        from nmfx.ops.sched_mu import _streams_bf16_a
         a_loop = a
-        if (cfg.matmul_precision == "bfloat16" and dtype == jnp.float32
-                and jax.default_backend() == "tpu"):
+        if _streams_bf16_a(cfg):
             # one-time truncation: every loop GEMM reads A in the exact
-            # bf16 form the MXU would round it to anyway (TPU-only: other
-            # backends ignore the precision hint and run full-f32 GEMMs,
-            # so truncating there would change results)
+            # bf16 form the MXU would round it to anyway (TPU-only; kl
+            # excluded — see _streams_bf16_a; other backends ignore the
+            # precision hint and run full-f32 GEMMs, so truncating there
+            # would change results)
             a_loop = a.astype(jnp.bfloat16)
         step = partial(_step, make_block(cfg, a_true), a_loop, a_true)
 

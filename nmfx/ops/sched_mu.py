@@ -53,12 +53,17 @@ from nmfx.solvers import base
 
 def _streams_bf16_a(cfg: SolverConfig) -> bool:
     """Whether the loop streams A as one-time-truncated bf16 (the MXU
-    would round the operands to bf16 either way under this precision, so
-    results are unchanged and A's HBM traffic halves). Single source of
-    truth for both the cast site in ``mu_sched`` and the VMEM slot
-    clamp's a_bytes — the two must never disagree or the byte model is
-    off by 2x on the A-tile term."""
+    would round the GEMM operands to bf16 either way under this
+    precision, so results are unchanged and A's HBM traffic halves).
+    kl is excluded: its block consumes A in an ELEMENTWISE division
+    (the quotient A ⊘ WH), where truncation is a real ~0.4% per-element
+    perturbation the vmapped engine does not have — not a free MXU
+    rounding. Single source of truth for both the cast sites in
+    ``mu_sched``/``mu_grid`` and the VMEM slot clamp's a_bytes — the
+    sites must never disagree or the byte model is off by 2x on the
+    A-tile term."""
     return (cfg.matmul_precision == "bfloat16"
+            and cfg.algorithm != "kl"
             and jnp.dtype(cfg.dtype) == jnp.float32
             and jax.default_backend() == "tpu")
 

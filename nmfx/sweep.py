@@ -26,7 +26,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.config import (PACKED_ALGORITHMS, ConsensusConfig,
+                         InitConfig, SolverConfig)
 from nmfx.consensus import consensus_matrix, labels_from_h
 from nmfx.init import initialize, random_init
 from nmfx.solvers.base import solve
@@ -110,6 +111,11 @@ _GRID_EXEC_BACKENDS = {"mu": ("auto", "packed", "pallas"),
                        # working set — grid_slots plays restart_chunk's
                        # memory-bounding role on this path
                        "kl": ("packed",)}
+
+# the routing table and the validation list must cover the same
+# algorithms, or a backend="packed" config could validate but fall
+# through to the vmapped driver (or vice versa)
+assert set(_GRID_EXEC_BACKENDS) == set(PACKED_ALGORITHMS)
 
 
 def resolve_engine_family(solver_cfg: SolverConfig,
@@ -836,9 +842,11 @@ def sweep_one_k(a, key, k: int, restarts: int,
     reductions without re-solving. ``grid_slots`` bounds the concurrent
     lanes of the slot-scheduled backends (hals backend='packed';
     ConsensusConfig.grid_slots at the sweep level)."""
-    if not (solver_cfg.algorithm == "hals"
-            and solver_cfg.backend in ("auto", "packed")):
-        # only the slot-scheduled branch consumes the grid knobs;
+    if (solver_cfg.algorithm == "mu" or solver_cfg.backend
+            not in _GRID_EXEC_BACKENDS.get(solver_cfg.algorithm, ())):
+        # only the slot-scheduled branch consumes the grid knobs (any
+        # non-mu algorithm routed there by _GRID_EXEC_BACKENDS — the mu
+        # per-k path uses the packed driver, not the scheduler);
         # normalize so a different value cannot force a re-trace of
         # unrelated builders
         grid_slots = 48
