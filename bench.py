@@ -143,7 +143,9 @@ def run_verify(args) -> int:
     Engines: the whole-grid slot scheduler on XLA-dense blocks
     (grid-dense), the same scheduler on the fused pallas kernels
     (grid-pallas), and the sequential per-rank packed path (per-k) — the
-    three mu execution engines users can select. Asserts, per rank:
+    three mu execution engines users can select — plus a second stage
+    gating the round-4 scheduler engines (hals grid vs vmap, kl
+    packed-grid vs vmap). Asserts, per rank:
 
     * integrity (``_integrity_problems``) for every engine;
     * no MAX_ITER burns (everything converges at this shape);
@@ -188,9 +190,14 @@ def run_verify(args) -> int:
               file=sys.stderr)
 
     problems = []
-    for name, (its, stops, _, _) in results.items():
-        problems += [f"{name}: {p}"
-                     for p in _integrity_problems(scfg, its, stops)]
+    gaps = {}
+
+    def check_engine(name, cfg_e, result):
+        """Integrity + no-MAX_ITER-burn assertions, shared by every
+        engine of both stages."""
+        its, stops, _, _ = result
+        problems.extend(f"{name}: {p}"
+                        for p in _integrity_problems(cfg_e, its, stops))
         for k in ks:
             burned = stops[k] == int(StopReason.MAX_ITER)
             if burned.any():
@@ -198,35 +205,72 @@ def run_verify(args) -> int:
                     f"{name}: k={k}: {int(burned.sum())} job(s) burned to "
                     f"MAX_ITER at a shape where every engine converges")
 
-    ref_its, _, ref_cons, ref_rho = results["grid-dense"]
-    gaps = {}
-    for name in ("grid-pallas", "per-k"):
-        its, _, cons, rho = results[name]
+    def compare(name, result, ref_name, ref_result):
+        """Engine-vs-reference gaps, uniform orientation everywhere:
+        iters_ratio = this engine's mean iterations / the reference's."""
+        its, _, cons, rho = result
+        ref_its, _, ref_cons, ref_rho = ref_result
         for k in ks:
             ratio = float(its[k].mean()) / float(ref_its[k].mean())
             drho = abs(rho[k] - ref_rho[k])
             dc = float(np.max(np.abs(cons[k] - ref_cons[k])))
-            gaps[f"{name}.k{k}"] = {"iters_ratio": round(ratio, 3),
+            gaps[f"{name}.k{k}"] = {"ref": ref_name,
+                                    "iters_ratio": round(ratio, 3),
                                     "d_rho": round(drho, 4),
                                     "max_dC": round(dc, 3)}
             if not (1 / 1.6 <= ratio <= 1.6):
                 problems.append(f"{name}: k={k}: mean-iteration ratio "
-                                f"{ratio:.2f} vs grid-dense outside 1.6x")
+                                f"{ratio:.2f} vs {ref_name} outside 1.6x")
             if drho > 0.05:
                 problems.append(f"{name}: k={k}: |d rho| = {drho:.4f} "
-                                "vs grid-dense exceeds 0.05")
+                                f"vs {ref_name} exceeds 0.05")
             if dc > 0.3:
                 problems.append(f"{name}: k={k}: max |dC| = {dc:.3f} "
-                                "vs grid-dense exceeds 0.3")
+                                f"vs {ref_name} exceeds 0.3")
+
+    for name, (cfg_e, _) in engines.items():
+        check_engine(name, cfg_e, results[name])
+    for name in ("grid-pallas", "per-k"):
+        compare(name, results[name], "grid-dense", results["grid-dense"])
+
+    # --- second stage: the non-mu scheduler engines (round 4) ----------
+    # hals' default IS the grid engine (gate it against the vmapped
+    # driver); kl's whole-grid engine is the backend='packed' opt-in
+    # (gate it against its vmapped default). Same assertions as stage 1;
+    # integrity applies per engine (kl is class-stop gated, hals's
+    # ~20-iteration TolX stops are exempt by design).
+    for algo, alt_pair, ref_pair in (
+            ("hals",
+             ("hals-grid", dataclasses.replace(
+                 scfg, algorithm="hals", backend="auto"), "grid"),
+             ("hals-vmap", dataclasses.replace(
+                 scfg, algorithm="hals", backend="vmap"), "per_k")),
+            ("kl",
+             ("kl-packed-grid", dataclasses.replace(
+                 scfg, algorithm="kl", backend="packed"), "grid"),
+             ("kl-vmap", dataclasses.replace(
+                 scfg, algorithm="kl", backend="auto"), "per_k"))):
+        res = {}
+        for name, cfg_e, grid_exec in (alt_pair, ref_pair):
+            ccfg = ConsensusConfig(ks=ks, restarts=restarts, seed=123,
+                                   grid_exec=grid_exec)
+            t0 = time.perf_counter()
+            res[name] = _run_sweep_engine(a, ks, cfg_e, ccfg, icfg, mesh)
+            print(f"verify: {name} ran in "
+                  f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+            check_engine(name, cfg_e, res[name])
+        compare(alt_pair[0], res[alt_pair[0]],
+                ref_pair[0], res[ref_pair[0]])
 
     ok = not problems
     for p in problems:
         print(f"verify FAIL: {p}", file=sys.stderr)
     print(json.dumps({
         "metric": "verify_parity", "value": 1 if ok else 0, "unit": "pass",
-        "detail": {"engines": list(engines),
+        "detail": {"engines": list(engines) + ["hals-grid", "hals-vmap",
+                                               "kl-packed-grid", "kl-vmap"],
                    "shape": f"{m}x{n}, k=2..5, {restarts} restarts",
-                   "gaps_vs_grid_dense": gaps,
+                   "gaps": gaps,
                    "problems": problems}}))
     return 0 if ok else 1
 
@@ -254,9 +298,10 @@ def main():
                         "— VERDICT.md round 3)")
     p.add_argument("--verify", action="store_true",
                    help="run the cross-engine hardware parity gate "
-                        "(grid-dense vs grid-pallas vs per-k) instead of "
-                        "the benchmark; exits nonzero on any integrity or "
-                        "parity failure")
+                        "(mu: grid-dense vs grid-pallas vs per-k; hals: "
+                        "grid vs vmap; kl: packed-grid vs vmap) instead "
+                        "of the benchmark; exits nonzero on any integrity "
+                        "or parity failure")
     p.add_argument("--grid-exec", default="auto",
                    choices=("auto", "grid", "per_k"),
                    help="whole-grid single-compile execution vs sequential "
@@ -290,10 +335,10 @@ def main():
         for name in ("algorithm", "genes", "samples", "kmax", "restarts",
                      "backend", "grid_exec"):
             if getattr(args, name) != p.get_default(name):
-                p.error(f"--verify gates the mu execution engines at a "
-                        f"fixed scaled shape; --{name.replace('_', '-')} "
-                        "does not apply (only --maxiter/--precision are "
-                        "honored)")
+                p.error(f"--verify gates the mu/hals/kl execution "
+                        f"engines at a fixed scaled shape; "
+                        f"--{name.replace('_', '-')} does not apply "
+                        "(only --maxiter/--precision are honored)")
         # the gate asserts no MAX_ITER burns, which presumes the budget
         # lets every job converge (class-stability floor 402 + headroom)
         if args.maxiter < 2000:
