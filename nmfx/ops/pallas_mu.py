@@ -17,14 +17,15 @@ on-chip:
   epilogue in-register. The tiny masked H-Gram is precomputed by the caller
   (one small GEMM — not worth a kernel).
 
-Measured on a single v5e chip (bf16, R=50; see benchmarks/RESULTS.md
-"Pallas backend: regime verdict" for the round-2 protocol and its
-variance caveats): the packed XLA path wins the north-star sweep by
-~15–20%, so ``backend="packed"`` stays the default; these kernels won
-their sessions on isolated long-running large-R·k solves (k=10 at
-5000×500: lower fixed AND marginal cost, ~1.8× end-to-end) and are the
-opt-in ``backend="pallas"`` for that regime, plus the template for future
-hand-tuned paths. The whole-grid slot scheduler (``nmfx.ops.sched_mu``)
+Measured on a single v5e chip (bf16, R=50): in round 2 the packed XLA
+path won the north-star sweep by ~15–20% (see benchmarks/RESULTS.md
+"Pallas backend: regime verdict" for that protocol and its variance
+caveats); as of round 4 the FIXED fused-kernel scheduler wins it —
+1.43 vs 1.59 s same-session minima, 1.74× cheaper marginal iteration —
+and ``backend="pallas"`` is the documented fast path on TPU. The
+library default remains the packed/dense family for stability (one
+engine family across platforms and shapes; the pallas pool's VMEM
+envelope is shape-dependent), not for speed. The whole-grid slot scheduler (``nmfx.ops.sched_mu``)
 also runs on these kernels under ``backend="pallas"`` (packed-column
 slot state; one ``fused_block_iterations`` launch per check block).
 History: round 3's block kernel used input/output-aliased VMEM windows
