@@ -21,7 +21,8 @@ noticed, VERDICT.md round 3):
   TolX stops from random init) aborts with a loud error instead of
   printing a JSON line that looks like a result;
 * ``--verify`` runs the cross-engine parity gate ON THE REAL DEVICE at a
-  scaled shape — grid-dense vs grid-pallas vs per-k packed — and asserts
+  scaled shape — mu's grid-dense vs grid-pallas vs per-k packed, hals
+  grid vs vmap, kl packed-grid vs vmap — and asserts
   iteration/stop/consensus/rho agreement. This is the on-hardware
   correctness tier the CPU-forced pytest suite cannot provide (Mosaic
   compilation is exactly what interpret-mode tests bypass).
