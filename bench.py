@@ -26,6 +26,19 @@ noticed, VERDICT.md round 3):
   iteration/stop/consensus/rho agreement. This is the on-hardware
   correctness tier the CPU-forced pytest suite cannot provide (Mosaic
   compilation is exactly what interpret-mode tests bypass).
+
+Measurement protocol (round 5 — the recorded artifact now follows the
+same discipline as the ``benchmarks/probe_*`` scripts): the tunneled
+dev-chip environment swings ±50% between sessions (BASELINE.md), so one
+warm run is a sample, not a measurement. ``--reps N`` (default 3) runs N
+same-session warm reps per backend — interleaved across backends so no
+backend monopolizes a fast or slow window — and the JSON records
+min/median/all reps per backend. The headline ``value`` is the
+requested backend's min (same-session minima are the only
+cross-session-comparable statistic here); every rep passes the
+integrity gate before anything is printed. On TPU with the default
+``--backend auto`` and ``--algorithm mu``, the pallas engine is
+measured alongside as a second backend in the same session.
 """
 
 import argparse
@@ -303,6 +316,13 @@ def main():
                         "grid vs vmap; kl: packed-grid vs vmap) instead "
                         "of the benchmark; exits nonzero on any integrity "
                         "or parity failure")
+    p.add_argument("--reps", type=int, default=3,
+                   help="warm timed reps per backend (same session, "
+                        "interleaved across backends); the JSON records "
+                        "min/median/all reps and the headline is the "
+                        "requested backend's min — one warm run in this "
+                        "±50%%-variance environment is a sample, not a "
+                        "measurement")
     p.add_argument("--grid-exec", default="auto",
                    choices=("auto", "grid", "per_k"),
                    help="whole-grid single-compile execution vs sequential "
@@ -320,6 +340,8 @@ def main():
     ks = tuple(range(2, args.kmax + 1))
     if not ks:
         p.error("--kmax must be >= 2")
+    if args.reps < 1:
+        p.error("--reps must be >= 1")
     if args.backend == "pallas" and args.algorithm != "mu":
         p.error("--backend pallas is only implemented for --algorithm mu "
                 "(use auto to fall back per algorithm)")
@@ -347,11 +369,7 @@ def main():
                     "converge; a lower cap would fail the gate's "
                     "no-MAX_ITER assertion on a healthy solver")
         raise SystemExit(run_verify(args))
-    scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter,
-                        matmul_precision=args.precision,
-                        backend=args.backend)
-    ccfg = ConsensusConfig(ks=ks, restarts=args.restarts, seed=123,
-                           grid_exec=args.grid_exec)
+    seed = 123
     icfg = InitConfig()
     mesh = default_mesh()
 
@@ -361,79 +379,134 @@ def main():
     a = grouped_matrix(args.genes, tuple(sizes), effect=2.0, seed=0)
     assert a.shape == (args.genes, args.samples)
 
-    # warmup: one full sweep triggers every compile at the exact static
-    # config (a different max_iter would be a different jit cache entry);
-    # different seed than the timed run so no layer can serve cached
-    # results. TIMED: this is the cold-start number a first-time user pays
-    # (the reference has no compile step at all — its R workers start
-    # solving immediately, nmf.r:112) — recorded as cold_wall_s, with
-    # compile_wall_s ≈ cold − warm the compile share. The persistent
-    # compilation cache (CLI default-on; JAX_COMPILATION_CACHE_DIR here)
-    # collapses it on re-runs.
-    warm_cfg = ConsensusConfig(ks=ks, restarts=args.restarts,
-                               seed=ccfg.seed + 1, grid_exec=args.grid_exec)
-    t_cold = time.perf_counter()
-    warm = sweep(a, warm_cfg, scfg, icfg, mesh)
-    jax.device_get({k: warm[k].consensus for k in ks})
-    cold_wall = time.perf_counter() - t_cold
+    # which backends get measured this session: the requested one always;
+    # on TPU the default mu invocation also measures the pallas engine in
+    # the SAME session (the only way the two numbers are comparable here)
+    backends = [args.backend]
+    if (args.backend == "auto" and args.algorithm == "mu"
+            and jax.default_backend() == "tpu"):
+        backends.append("pallas")
+    cfgs = {b: SolverConfig(algorithm=args.algorithm,
+                            max_iter=args.maxiter,
+                            matmul_precision=args.precision, backend=b)
+            for b in backends}
 
-    # time with host materialization of every output inside the region:
-    # block_until_ready has been observed returning early on experimental
-    # platforms, and the pipeline is only done when consensus+stats land on
-    # host (that IS the workload's contract). ONE batched device_get — a
-    # per-array pull pays a tunnel round trip each (~50–150 ms depending on
-    # session; batching the 18 north-star pulls measured 0.4–1.4 s faster;
-    # the API pipeline batches identically)
     from nmfx.profiling import Profiler
 
-    prof = Profiler()
-    t0 = time.perf_counter()
-    with prof:
-        raw = sweep(a, ccfg, scfg, icfg, mesh, profiler=prof)
-        with prof.phase("device_to_host"):
-            host = jax.device_get(
-                {k: (raw[k].consensus, raw[k].iterations,
-                     raw[k].stop_reasons) for k in ks})
-    wall = time.perf_counter() - t0
+    def timed_sweep(scfg, seed):
+        """One timed end-to-end sweep with host materialization of every
+        output inside the region: block_until_ready has been observed
+        returning early on experimental platforms, and the pipeline is
+        only done when consensus+stats land on host (that IS the
+        workload's contract). ONE batched device_get — a per-array pull
+        pays a tunnel round trip each (~50–150 ms depending on session;
+        batching the 18 north-star pulls measured 0.4–1.4 s faster; the
+        API pipeline batches identically)."""
+        run_cfg = ConsensusConfig(ks=ks, restarts=args.restarts,
+                                  seed=seed, grid_exec=args.grid_exec)
+        prof = Profiler()
+        t0 = time.perf_counter()
+        with prof:
+            raw = sweep(a, run_cfg, scfg, icfg, mesh, profiler=prof)
+            with prof.phase("device_to_host"):
+                host = jax.device_get(
+                    {k: (raw[k].consensus, raw[k].iterations,
+                         raw[k].stop_reasons) for k in ks})
+        wall = time.perf_counter() - t0
+        return wall, prof, host
+
+    # cold runs first, one per backend: the cold sweep triggers every
+    # compile at the exact static config (a different max_iter would be a
+    # different jit cache entry); different seed than the timed reps so no
+    # layer can serve cached results. TIMED: this is the cold-start number
+    # a first-time user pays (the reference has no compile step at all —
+    # its R workers start solving immediately, nmf.r:112) — recorded as
+    # cold_wall_s, with compile_wall_s ≈ cold − warm-min the compile
+    # share. The persistent compilation cache (CLI default-on;
+    # JAX_COMPILATION_CACHE_DIR here) collapses it on re-runs.
+    cold_wall = {}
+    warm_cfg = ConsensusConfig(ks=ks, restarts=args.restarts,
+                               seed=seed + 1, grid_exec=args.grid_exec)
+    for b in backends:
+        t_cold = time.perf_counter()
+        warm = sweep(a, warm_cfg, cfgs[b], icfg, mesh)
+        jax.device_get({k: warm[k].consensus for k in ks})
+        cold_wall[b] = time.perf_counter() - t_cold
+        print(f"bench: cold {b}: {cold_wall[b]:.2f}s", file=sys.stderr)
+
+    # warm reps, interleaved across backends (rep 1 of every backend,
+    # then rep 2, ...) so a drifting session penalizes/favors no backend
+    reps = {b: [] for b in backends}  # wall seconds per rep
+    best = {}  # backend -> (wall, prof, host) of its fastest rep
+    for r in range(args.reps):
+        for b in backends:
+            wall, prof, host = timed_sweep(cfgs[b], seed)
+            # hardware-truth gate on EVERY rep: refuse to print a record
+            # any of whose runs had physically-impossible iteration
+            # counts (see module docstring)
+            its = {k: host[k][1] for k in ks}
+            problems = _integrity_problems(cfgs[b], its,
+                                           {k: host[k][2] for k in ks})
+            if problems:
+                for prob in problems:
+                    print(f"bench INTEGRITY FAILURE [{b} rep {r + 1}]: "
+                          f"{prob}", file=sys.stderr)
+                print("bench: refusing to record a physically-"
+                      "implausible run — the solver path is broken on "
+                      "this hardware (see VERDICT.md round 3 for the "
+                      "incident this gate exists to catch)",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            reps[b].append(wall)
+            if b not in best or wall < best[b][0]:
+                best[b] = (wall, prof, host)
+            print(f"bench: warm {b} rep {r + 1}/{args.reps}: {wall:.2f}s",
+                  file=sys.stderr)
+
+    def stats(walls):
+        s = sorted(walls)
+        mid = len(s) // 2
+        median = (s[mid] if len(s) % 2
+                  else 0.5 * (s[mid - 1] + s[mid]))
+        return {"min_s": round(s[0], 3), "median_s": round(median, 3),
+                "reps_s": [round(w, 3) for w in walls]}
+
+    # headline = the requested backend's same-session minimum; per-backend
+    # min/median/all-reps in detail
+    primary = args.backend
+    wall, prof, host = best[primary]
+    phase_s = {name: round(rec.seconds, 3)
+               for name, rec in prof.phases.items()}
     # the tunneled dev chip inflates transfers far beyond real PCIe/ICI
     # (measured: ~0.7 s for A's 10 MB in slow sessions); the headline
     # stays the honest full wall, but the phase split lets readers
     # separate solve throughput from environment transfer artifacts
-    phase_s = {name: round(rec.seconds, 3)
-               for name, rec in prof.phases.items()}
 
     total_restarts = len(ks) * args.restarts
     its = {k: host[k][1] for k in ks}
     iters = {k: float(v.mean()) for k, v in its.items()}
 
-    # hardware-truth gate: refuse to print a record whose iteration
-    # counts are physically impossible (see module docstring)
-    problems = _integrity_problems(scfg, its,
-                                   {k: host[k][2] for k in ks})
-    if problems:
-        for prob in problems:
-            print(f"bench INTEGRITY FAILURE: {prob}", file=sys.stderr)
-        print("bench: refusing to record a physically-implausible run — "
-              "the solver path is broken on this hardware "
-              "(see VERDICT.md round 3 for the incident this gate "
-              "exists to catch)", file=sys.stderr)
-        raise SystemExit(2)
-
     # MFU accounting for the algorithms in _MODEL_FLOPS (the pg/alspg
     # families' per-iteration FLOPs differ per line-search trial /
     # subproblem and are not modeled):
     # model FLOPs = Σ_k Σ_restart iters · flops_per_iter(k), achieved rate
-    # over the measured wall, utilization vs the devices' bf16 peak
-    model_flops = mfu = achieved = mfu_solve = None
-    solve_s = sum(rec.seconds for name, rec in prof.phases.items()
-                  if name.startswith("solve"))
+    # over the measured wall, utilization vs the devices' bf16 peak.
+    # Computed per backend from its fastest rep.
+    peak = _BF16_PEAK_FLOPS.get(jax.devices()[0].device_kind)
     flops_fn = _MODEL_FLOPS.get(args.algorithm)
-    if flops_fn is not None:
-        model_flops = sum(
-            flops_fn(args.genes, args.samples, k)
-            * float(its[k].sum()) for k in ks)
-        achieved = model_flops / wall
-        peak = _BF16_PEAK_FLOPS.get(jax.devices()[0].device_kind)
+
+    def mfu_block(b):
+        wall_b, prof_b, host_b = best[b]
+        if flops_fn is None:
+            return {"model_tflop": None, "achieved_tflop_per_s": None,
+                    "mfu": None, "mfu_solve": None}
+        its_b = {k: host_b[k][1] for k in ks}
+        model_flops = sum(flops_fn(args.genes, args.samples, k)
+                          * float(its_b[k].sum()) for k in ks)
+        achieved = model_flops / wall_b
+        mfu = mfu_solve = None
+        solve_s = sum(rec.seconds for name, rec in prof_b.phases.items()
+                      if name.startswith("solve"))
         if peak is not None:
             mfu = achieved / (peak * len(jax.devices()))
             if solve_s > 0:
@@ -442,6 +515,20 @@ def main():
                 # inflated) host transfers counted in the honest wall
                 mfu_solve = model_flops / solve_s / (
                     peak * len(jax.devices()))
+        return {"model_tflop": round(model_flops / 1e12, 3),
+                "achieved_tflop_per_s": round(achieved / 1e12, 3),
+                "mfu": None if mfu is None else round(mfu, 4),
+                "mfu_solve": (None if mfu_solve is None
+                              else round(mfu_solve, 4))}
+
+    per_backend = {}
+    for b in backends:
+        per_backend[b] = {**stats(reps[b]),
+                          "cold_wall_s": round(cold_wall[b], 3),
+                          "compile_wall_s": round(
+                              max(cold_wall[b] - min(reps[b]), 0.0), 3),
+                          **mfu_block(b)}
+
     record = {
         "metric": "consensus_sweep_wall_s",
         "value": round(wall, 3),
@@ -452,20 +539,19 @@ def main():
                       f"{args.genes}x{args.samples}, {args.algorithm}, "
                       f"maxiter={args.maxiter}, precision={args.precision}, "
                       f"backend={args.backend}, grid_exec={args.grid_exec}",
+            "protocol": f"min of {args.reps} same-session warm reps, "
+                        "backends interleaved; integrity-gated per rep",
             "restarts_per_s": round(total_restarts / wall, 2),
-            "cold_wall_s": round(cold_wall, 3),
-            "compile_wall_s": round(max(cold_wall - wall, 0.0), 3),
+            "backends": per_backend,
             "phase_s": phase_s,
             "integrity": "ok",
             "mean_iters_per_k": {str(k): round(v, 1) for k, v in
                                  iters.items()},
-            "model_tflop": (None if model_flops is None
-                            else round(model_flops / 1e12, 3)),
-            "achieved_tflop_per_s": (None if achieved is None
-                                     else round(achieved / 1e12, 3)),
-            "mfu": None if mfu is None else round(mfu, 4),
-            "mfu_solve": (None if mfu_solve is None
-                          else round(mfu_solve, 4)),
+            # primary backend's cold/compile/MFU fields mirrored at the
+            # top level for cross-round record compatibility
+            **{key: per_backend[primary][key]
+               for key in ("cold_wall_s", "compile_wall_s", "model_tflop",
+                           "achieved_tflop_per_s", "mfu", "mfu_solve")},
             "devices": [str(d) for d in jax.devices()],
         },
     }
