@@ -163,11 +163,25 @@ def run_verify(args) -> int:
 
     * integrity (``_integrity_problems``) for every engine;
     * no MAX_ITER burns (everything converges at this shape);
-    * mean iterations within a 1.6× band of grid-dense — Mosaic
-      accumulation order legitimately drifts trajectories (stop
-      iterations with them), but the round-3 corruption was 50–130×;
+    * mean AND median iterations within a 1.6× band of grid-dense —
+      Mosaic accumulation order legitimately drifts trajectories (stop
+      iterations with them), but the round-3 corruption was 50–130×,
+      and the median catches a partial corruption (a subset of
+      short-circuiting jobs) before it saturates the mean;
     * cophenetic rho within 0.05 and consensus matrices within
-      max|ΔC| ≤ 0.3 of grid-dense — the user-visible quantities.
+      max|ΔC| ≤ 0.3 AND mean|ΔC| ≤ 0.6 restart-equivalents of
+      grid-dense — the user-visible quantities (see ``compare`` for the
+      band calibration against measured legitimate drift);
+    * a third stage at the VMEM-envelope boundary shape (m=5120, n=512,
+      k≤10 → the full rk=480 resident pool, 108 jobs through 48 slots
+      so evict/reload traffic exists) comparing grid-pallas to
+      grid-dense where the slot clamp and block geometry bind.
+
+    The gate is fault-injection-proven: ``benchmarks/probe_fault_gate.py``
+    re-introduces the round-3 stale-reload corruption behind
+    ``NMFX_FAULT_INJECT_STALE_RELOAD`` and asserts this gate FAILS on
+    it while passing on trunk (artifact:
+    ``benchmarks/FAULTGATE_r05.json``).
 
     Exit code 0 = gate passed (one JSON line with the measured gaps),
     1 = failed (problems listed on stderr).
@@ -206,9 +220,9 @@ def run_verify(args) -> int:
     problems = []
     gaps = {}
 
-    def check_engine(name, cfg_e, result):
+    def check_engine(name, cfg_e, result, ks=ks):
         """Integrity + no-MAX_ITER-burn assertions, shared by every
-        engine of both stages."""
+        engine of all three stages."""
         its, stops, _, _ = result
         problems.extend(f"{name}: {p}"
                         for p in _integrity_problems(cfg_e, its, stops))
@@ -219,28 +233,68 @@ def run_verify(args) -> int:
                     f"{name}: k={k}: {int(burned.sum())} job(s) burned to "
                     f"MAX_ITER at a shape where every engine converges")
 
-    def compare(name, result, ref_name, ref_result):
+    def compare(name, result, ref_name, ref_result, ks=ks,
+                n_restarts=restarts, max_dc_band=0.3):
         """Engine-vs-reference gaps, uniform orientation everywhere:
-        iters_ratio = this engine's mean iterations / the reference's."""
+        iters_ratio = this engine's mean iterations / the reference's.
+
+        Round-5 tightening (VERDICT r4: correct drift consumed ~50% of
+        the old bands and a partial corruption could hide inside them):
+
+        * the per-k MEDIAN iteration ratio is asserted alongside the
+          mean — a subset of corrupted short-circuiting jobs drags the
+          median before it saturates the mean;
+        * mean|ΔC| is asserted in RESTART-EQUIVALENTS:
+          mean|ΔC|·R ≤ 0.6, i.e. at most ~0.6 restarts' worth of
+          average co-assignment drift. A consensus entry moves in
+          steps of 1/R, so the raw mean scales with R — normalizing
+          makes one band correct at every stage (at R=50 it equals the
+          0.012 band CROSSCHECK_r04's measured ≤0.004 suggested; at the
+          gate's R=12 it allows 0.05, measured legitimate drift 0.030);
+        * ``max_dc_band`` is per-stage: 0.3 at the structured stages,
+          None at the boundary stage, where k≥6 on 4-group data makes
+          the surplus clusters split near-ties arbitrarily — measured
+          legitimate max|ΔC| reaches 3/6 restarts there with ρ agreeing
+          to 0.0014 and iteration ratios clean, so a max-based band has
+          no signal; corruption at that stage is caught by integrity,
+          iteration quantiles, and the normalized mean|ΔC|."""
         its, _, cons, rho = result
         ref_its, _, ref_cons, ref_rho = ref_result
         for k in ks:
             ratio = float(its[k].mean()) / float(ref_its[k].mean())
+            med_ratio = (float(np.median(its[k]))
+                         / max(float(np.median(ref_its[k])), 1.0))
             drho = abs(rho[k] - ref_rho[k])
             dc = float(np.max(np.abs(cons[k] - ref_cons[k])))
+            mean_dc = float(np.mean(np.abs(cons[k] - ref_cons[k])))
             gaps[f"{name}.k{k}"] = {"ref": ref_name,
                                     "iters_ratio": round(ratio, 3),
+                                    "iters_median_ratio": round(
+                                        med_ratio, 3),
                                     "d_rho": round(drho, 4),
-                                    "max_dC": round(dc, 3)}
+                                    "max_dC": round(dc, 3),
+                                    "mean_dC": round(mean_dc, 4),
+                                    "mean_dC_restarts": round(
+                                        mean_dc * n_restarts, 3)}
             if not (1 / 1.6 <= ratio <= 1.6):
                 problems.append(f"{name}: k={k}: mean-iteration ratio "
                                 f"{ratio:.2f} vs {ref_name} outside 1.6x")
+            if not (1 / 1.6 <= med_ratio <= 1.6):
+                problems.append(f"{name}: k={k}: median-iteration ratio "
+                                f"{med_ratio:.2f} vs {ref_name} outside "
+                                "1.6x")
             if drho > 0.05:
                 problems.append(f"{name}: k={k}: |d rho| = {drho:.4f} "
                                 f"vs {ref_name} exceeds 0.05")
-            if dc > 0.3:
+            if max_dc_band is not None and dc > max_dc_band:
                 problems.append(f"{name}: k={k}: max |dC| = {dc:.3f} "
-                                f"vs {ref_name} exceeds 0.3")
+                                f"vs {ref_name} exceeds {max_dc_band}")
+            if mean_dc * n_restarts > 0.6:
+                problems.append(
+                    f"{name}: k={k}: mean |dC| = {mean_dc:.4f} "
+                    f"(x{n_restarts} restarts = "
+                    f"{mean_dc * n_restarts:.2f}) vs {ref_name} exceeds "
+                    "0.6 restart-equivalents")
 
     for name, (cfg_e, _) in engines.items():
         check_engine(name, cfg_e, results[name])
@@ -276,13 +330,49 @@ def run_verify(args) -> int:
         compare(alt_pair[0], res[alt_pair[0]],
                 ref_pair[0], res[ref_pair[0]])
 
+    # --- third stage: the VMEM-envelope boundary (round 5) -------------
+    # 48 slots × k_max=10 = 480 packed columns at m=5120, n=512 — exactly
+    # the measured resident-W envelope boundary (sched_mu._pallas_slot_
+    # clamp accepts rk=480 at this shape, model 14.07 of 14.3 MiB), so
+    # the clamp arithmetic, the 16-row-aligned block geometry, and
+    # boundary-condition Mosaic tiling are all exercised where they
+    # actually bind. 108 jobs > 48 slots forces 60 evict/reload events —
+    # the round-3 corruption path (stage 1's 48 jobs fill its 48 slots
+    # exactly, so only THIS stage exercises reloads). grid-pallas vs
+    # grid-dense only (the kernel tier is what the envelope constrains).
+    mb, nb, rb = 5120, 512, 12
+    ks_b = tuple(range(2, 11))
+    a_b = grouped_matrix(mb, (nb // 4,) * 4, effect=2.0, seed=0)
+    res_b = {}
+    for name, backend in (("bound-dense", "auto"),
+                          ("bound-pallas", "pallas")):
+        cfg_e = dataclasses.replace(scfg, backend=backend)
+        ccfg = ConsensusConfig(ks=ks_b, restarts=rb, seed=123,
+                               grid_exec="grid")
+        t0 = time.perf_counter()
+        # mesh=None (single-device) REGARDLESS of the host's device
+        # count: the stage's premise is all 108 jobs through ONE 48-slot
+        # queue — on a restart mesh each device would schedule only
+        # 108/N jobs and the reload traffic this stage exists to
+        # exercise would vanish below N's slot pool
+        res_b[name] = _run_sweep_engine(a_b, ks_b, cfg_e, ccfg, icfg,
+                                        None)
+        print(f"verify: {name} ran in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        check_engine(name, cfg_e, res_b[name], ks=ks_b)
+    compare("bound-pallas", res_b["bound-pallas"],
+            "bound-dense", res_b["bound-dense"], ks=ks_b, n_restarts=rb,
+            max_dc_band=None)
+
     ok = not problems
     for p in problems:
         print(f"verify FAIL: {p}", file=sys.stderr)
     print(json.dumps({
         "metric": "verify_parity", "value": 1 if ok else 0, "unit": "pass",
         "detail": {"engines": list(engines) + ["hals-grid", "hals-vmap",
-                                               "kl-packed-grid", "kl-vmap"],
+                                               "kl-packed-grid", "kl-vmap",
+                                               "bound-dense",
+                                               "bound-pallas"],
                    "shape": f"{m}x{n}, k=2..5, {restarts} restarts",
                    "gaps": gaps,
                    "problems": problems}}))

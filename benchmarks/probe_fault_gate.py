@@ -1,0 +1,106 @@
+"""Prove the hardware verify gate catches the round-3 bug class.
+
+Round 3 shipped a corrupted pallas scheduler: slot reloads written to an
+input/output-aliased HBM buffer never reached the VMEM-resident factor
+windows, so reloaded jobs iterated on the PREVIOUS job's converged
+factors and "converged" within a check or two (VERDICT.md round 3).
+Round 4 built ``bench.py --verify`` to make that class of bug unable to
+ship — but the gate itself was trusted, never tested (VERDICT.md round 4,
+Missing #2). This probe closes that loop:
+
+1. runs ``bench.py --verify`` clean → must PASS (exit 0);
+2. runs it again in a subprocess with ``NMFX_FAULT_INJECT_STALE_RELOAD``
+   set — ``nmfx.ops.sched_mu`` then drops the factor writes for a
+   deterministic fraction of pallas-path slot reloads while the
+   scheduler's bookkeeping proceeds, reproducing the round-3 failure
+   signature exactly — and the gate must FAIL (exit 1).
+
+Reload traffic only exists where jobs outnumber slots: the gate's
+boundary stage (108 jobs through a 48-slot pool at the VMEM-envelope
+shape — 60 evict/reload events) is what forces evictions, which is why
+that stage exists. The
+probe writes ``benchmarks/FAULTGATE_r05.json`` with both exit codes and
+the tripped assertions; overall PASS means gate-pass-on-trunk AND
+gate-fail-on-injection.
+
+Usage: python benchmarks/probe_fault_gate.py [--fraction 0.75]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_verify(extra_env: dict[str, str]) -> tuple[int, dict | None, str]:
+    """One subprocess run of bench.py --verify; returns (exit code,
+    parsed JSON record or None, stderr tail)."""
+    env = dict(os.environ)
+    # share the persistent compile cache across the two runs (the
+    # injected trace differs only in the pallas scheduler's reload
+    # subgraph; every other engine's compile is reused)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   str(pathlib.Path.home() / ".cache/nmfx/xla"))
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--verify"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    record = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            record = json.loads(line)
+    tail = "\n".join(proc.stderr.splitlines()[-25:])
+    return proc.returncode, record, tail
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fraction", type=float, default=0.75,
+                    help="fraction of slot reloads whose factor writes "
+                         "are dropped in the injected run")
+    args = ap.parse_args()
+
+    print("probe_fault_gate: clean run (expect gate PASS) ...",
+          flush=True)
+    clean_code, clean_rec, clean_err = run_verify({})
+    print(clean_err, file=sys.stderr)
+    print(f"clean exit code: {clean_code}", flush=True)
+
+    print("probe_fault_gate: injected run (expect gate FAIL) ...",
+          flush=True)
+    inj_code, inj_rec, inj_err = run_verify(
+        {"NMFX_FAULT_INJECT_STALE_RELOAD": str(args.fraction)})
+    print(inj_err, file=sys.stderr)
+    print(f"injected exit code: {inj_code}", flush=True)
+
+    ok = clean_code == 0 and inj_code != 0
+    out = {
+        "metric": "fault_gate_proof",
+        "value": 1 if ok else 0,
+        "unit": "pass",
+        "detail": {
+            "clean_exit": clean_code,
+            "injected_exit": inj_code,
+            "injected_fraction": args.fraction,
+            "clean_gaps": (clean_rec or {}).get("detail", {}).get("gaps"),
+            "injected_problems": (inj_rec or {}).get("detail", {}).get(
+                "problems"),
+        },
+    }
+    path = REPO / "benchmarks" / "FAULTGATE_r05.json"
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps({k: out[k] for k in ("metric", "value", "unit")}))
+    print(f"wrote {path}")
+    if not ok:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

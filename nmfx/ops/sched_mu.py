@@ -51,19 +51,38 @@ from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
 
 
+def _stale_reload_fraction() -> float:
+    """TEST-ONLY fault injection: fraction of pallas-path slot reloads
+    whose FACTOR writes are dropped while the scheduler's bookkeeping
+    proceeds as if the reload happened — the exact round-3 failure
+    signature (input/output-aliased VMEM windows going stale inside the
+    while_loop: reloaded jobs iterated on the previous job's converged
+    factors and "converged" in a handful of iterations; VERDICT.md
+    round 3). Read from ``NMFX_FAULT_INJECT_STALE_RELOAD`` at TRACE
+    time, so it must be set before the first ``mu_sched`` call of a
+    process (``benchmarks/probe_fault_gate.py`` runs ``bench.py
+    --verify`` in a subprocess with it set and asserts the hardware
+    gate FAILS). Never set this in production."""
+    import os
+
+    return float(os.environ.get("NMFX_FAULT_INJECT_STALE_RELOAD", "0")
+                 or 0)
+
+
 def _streams_bf16_a(cfg: SolverConfig) -> bool:
     """Whether the loop streams A as one-time-truncated bf16 (the MXU
     would round the GEMM operands to bf16 either way under this
     precision, so results are unchanged and A's HBM traffic halves).
-    kl is excluded: its block consumes A in an ELEMENTWISE division
-    (the quotient A ⊘ WH), where truncation is a real ~0.4% per-element
-    perturbation the vmapped engine does not have — not a free MXU
-    rounding. Single source of truth for both the cast sites in
-    ``mu_sched``/``mu_grid`` and the VMEM slot clamp's a_bytes — the
-    sites must never disagree or the byte model is off by 2x on the
-    A-tile term."""
+    kl is excluded by default: its block consumes A in an ELEMENTWISE
+    division (the quotient A ⊘ WH), where truncation is a real ~0.4%
+    per-element perturbation the vmapped engine does not have — not a
+    free MXU rounding; ``cfg.kl_bf16_quotient`` opts in (see the
+    measured accept/reject note on that field). Single source of truth
+    for both the cast sites in ``mu_sched``/``mu_grid`` and the VMEM
+    slot clamp's a_bytes — the sites must never disagree or the byte
+    model is off by 2x on the A-tile term."""
     return (cfg.matmul_precision == "bfloat16"
-            and cfg.algorithm != "kl"
+            and (cfg.algorithm != "kl" or cfg.kl_bf16_quotient)
             and jnp.dtype(cfg.dtype) == jnp.float32
             and jax.default_backend() == "tpu")
 
@@ -166,6 +185,10 @@ class SchedState(NamedTuple):
     slot_job: jax.Array  # (S,) i32 — job index resident in each slot
     active: jax.Array  # (S,) bool — slot holds a live job
     queue: jax.Array  # () i32 — next job index to load
+    # occupancy diagnostics (cumulative across stages; per-stage values
+    # recovered by differencing at stage boundaries)
+    n_trips: jax.Array  # () i32 — while-loop trips (check blocks) run
+    n_lanes: jax.Array  # () i32 — Σ over trips of live slots at entry
     # per-job result buffers (scatter-once at eviction)
     out_w: jax.Array  # (J+1, m, k_max) — row J is the drop target
     out_h: jax.Array  # (J+1, k_max, n)
@@ -179,6 +202,15 @@ class SchedMUResult(NamedTuple):
     iterations: jax.Array  # (J,) i32
     dnorm: jax.Array  # (J,) final RMS residual (direct form)
     stop_reason: jax.Array  # (J,) i32 StopReason
+    # scheduler occupancy diagnostics, one row per cascade stage:
+    # stage pool width, check-block trips run at that width, and the sum
+    # of live slots over those trips. Occupancy = pool_lanes /
+    # (pool_trips · pool_widths); the wall model is
+    # Σ_stage trips(stage) · c(width(stage)) — what
+    # benchmarks/probe_sched_occupancy.py decomposes
+    pool_widths: jax.Array  # (n_stages,) i32
+    pool_trips: jax.Array  # (n_stages,) i32
+    pool_lanes: jax.Array  # (n_stages,) i32
 
 
 def _resolve_tail(tail_slots, s: int) -> tuple[int, ...]:
@@ -250,10 +282,11 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     cost the narrow width's per-iteration price instead of the full
     pool's (see the cascade comment in the body). "auto" (default) uses
     the measured default; None/0 disables (single full-width loop).
-    Per-job stop decisions are identical in every case (factors drift
-    only at the float-tolerance level any width change produces); the
-    knob affects wall-clock only. Must be hashable (tuple, not list) —
-    it keys the jit cache.
+    The knob targets wall-clock only: per-job stop decisions were
+    identical on every tested workload, and factors drift only at the
+    float-tolerance level any width change produces (a near-tie label or
+    TolX delta could in principle flip a stop iteration on hardware).
+    Must be hashable (tuple, not list) — it keys the jit cache.
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
@@ -402,6 +435,19 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 return wd, hp.reshape(-1, k_max, n)
 
             def reload(wp, hp, load, gather):
+                stale_frac = _stale_reload_fraction()
+                if stale_frac > 0:
+                    # fault injection (see _stale_reload_fraction): drop
+                    # the factor write for a deterministic per-job subset
+                    # of reloads; the caller's bookkeeping still marks
+                    # the new job as loaded — factors go stale exactly
+                    # as in the round-3 aliasing bug
+                    job_hash = (gather.astype(jnp.uint32)
+                                * jnp.uint32(2654435761)
+                                & jnp.uint32((1 << 16) - 1))
+                    stale = job_hash < jnp.uint32(
+                        int(stale_frac * (1 << 16)))
+                    load = load & ~stale
                 w3 = wp.reshape(m_pad, -1, k_max)
                 wg = jnp.transpose(w0[gather], (1, 0, 2))  # (m_pad, s, k)
                 w3 = jnp.where(load[None, :, None], wg, w3)
@@ -458,6 +504,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             slot_job=vary(jnp.arange(s, dtype=jnp.int32)),
             active=vary(jnp.ones((s,), bool)),
             queue=vary(jnp.asarray(s, jnp.int32)),
+            n_trips=vary(jnp.asarray(0, jnp.int32)),
+            n_lanes=vary(jnp.asarray(0, jnp.int32)),
             out_w=vary(jnp.zeros((j + 1, m, k_max), dtype)),
             out_h=vary(jnp.zeros((j + 1, k_max, n), dtype)),
             out_iters=vary(jnp.zeros((j + 1,), jnp.int32)),
@@ -537,6 +585,9 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                     stable=jnp.where(fresh_or_done, 0, stable),
                     dnorm=jnp.where(fresh_or_done, jnp.inf, dnorm),
                     slot_job=slot_job, active=active, queue=queue,
+                    n_trips=st.n_trips + 1,
+                    n_lanes=st.n_lanes + jnp.sum(st.active,
+                                                 dtype=jnp.int32),
                     out_w=out_w, out_h=out_h, out_iters=out_iters,
                     out_stop=out_stop,
                 )
@@ -553,10 +604,11 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
         # more than the NEXT width's worth of slots are live; then the
         # surviving jobs compact (a stable lane gather) into the next,
         # narrower pool. Same bookkeeping, same result buffers; per-job
-        # stop decisions are identical to the single-phase schedule and
-        # factors agree to float tolerance (XLA/Mosaic tile GEMMs
-        # differently per batch width — measured ~1e-6 relative, the
-        # same drift any slot-count change produces).
+        # stop decisions matched the single-phase schedule on every
+        # tested workload and factors agree to float tolerance
+        # (XLA/Mosaic tile GEMMs differently per batch width — measured
+        # ~1e-6 relative, the same drift any slot-count change produces,
+        # so a near-tie check could in principle flip a stop iteration).
         def compact(st: SchedState, width: int) -> SchedState:
             order = jnp.argsort(~st.active, stable=True)[:width]
             wp_t, hp_t = gather_slots(st.wp, st.hp, order)
@@ -569,25 +621,39 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 slot_job=st.slot_job[order],
                 active=st.active[order],
                 queue=st.queue,
+                n_trips=st.n_trips, n_lanes=st.n_lanes,
                 out_w=st.out_w, out_h=st.out_h,
                 out_iters=st.out_iters, out_stop=st.out_stop,
             )
 
         st = state0
         body = make_body(make_do_block(s))
+        stage_widths = [s]
+        stage_marks = []  # cumulative (n_trips, n_lanes) at stage ends
         for width in _resolve_tail(tail_slots, s):
             def stage_cond(st, width=width):
                 live = jnp.sum(st.active, dtype=jnp.int32)
                 return jnp.any(st.active) & (
                     (st.queue < j) | (live > width))
 
-            st = compact(lax.while_loop(stage_cond, body, st), width)
+            st = lax.while_loop(stage_cond, body, st)
+            stage_marks.append((st.n_trips, st.n_lanes))
+            st = compact(st, width)
+            stage_widths.append(width)
             body = make_body(make_do_block(width))
         final = lax.while_loop(lambda st: jnp.any(st.active), body, st)
+        stage_marks.append((final.n_trips, final.n_lanes))
+        # cumulative marks → per-stage trip/lane counts
+        trips = jnp.stack([t for t, _ in stage_marks])
+        lanes = jnp.stack([l for _, l in stage_marks])
+        pool_trips = jnp.diff(trips, prepend=jnp.zeros((1,), trips.dtype))
+        pool_lanes = jnp.diff(lanes, prepend=jnp.zeros((1,), lanes.dtype))
         out_w = final.out_w[:j]
         out_h = final.out_h[:j]
         # exact final residuals, once, from the retained per-job factors
         dnorm = residual_norms_direct(a, out_w, out_h)
     return SchedMUResult(w=out_w, h=out_h,
                          iterations=final.out_iters[:j],
-                         dnorm=dnorm, stop_reason=final.out_stop[:j])
+                         dnorm=dnorm, stop_reason=final.out_stop[:j],
+                         pool_widths=jnp.asarray(stage_widths, jnp.int32),
+                         pool_trips=pool_trips, pool_lanes=pool_lanes)
