@@ -141,8 +141,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="(k x restart) grid execution: 'auto' solves every "
                         "rank in ONE compiled whole-grid slot-scheduled "
                         "batch when eligible (mu/hals with the packed "
-                        "backend family, or neals/snmf/kl with --backend "
-                        "packed; no grid shards) — the reference's "
+                        "backend family, or neals/als/snmf/kl with "
+                        "--backend packed; no grid shards) — the reference's "
                         "whole-grid job-array concurrency; 'per_k' forces "
                         "sequential ranks (one compile each); 'grid' "
                         "demands the whole-grid path")
@@ -159,7 +159,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "surviving stragglers compact into progressively "
                         "narrower pools with cheaper per-iteration cost. "
                         "'auto' (default) = measured default; 0 disables. "
-                        "Per-job stop decisions are identical either way")
+                        "Affects wall-clock only (stop decisions "
+                        "identical on all tested workloads)")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "

@@ -17,7 +17,7 @@ ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf", "hals")
 #: the single list shared by SolverConfig validation, the CLI/bench
 #: guards, and (as the keys of sweep._GRID_EXEC_BACKENDS) the routing
 #: table itself
-PACKED_ALGORITHMS = ("mu", "hals", "neals", "snmf", "kl")
+PACKED_ALGORITHMS = ("mu", "hals", "neals", "als", "snmf", "kl")
 INIT_METHODS = ("random", "nndsvd")
 LINKAGE_METHODS = ("average", "complete", "single")
 
@@ -116,6 +116,18 @@ class SolverConfig:
     #: forces the generic driver. Measured ~3.5x faster per iteration at
     #: k=10 on the north-star config (packed vs vmap).
     backend: str = "auto"
+    #: kl + backend="packed" only — stream A as one-time-truncated bf16
+    #: through the slot scheduler's loop, halving A's HBM reread traffic
+    #: like the GEMM families get by default. OFF by default because
+    #: kl's block consumes A in an ELEMENTWISE division (the quotient
+    #: A ⊘ WH), where bf16 truncation is a real ~0.4% per-element input
+    #: perturbation rather than the MXU's own operand rounding
+    #: (sched_mu._streams_bf16_a). Round-5 measurement
+    #: (benchmarks/RESULTS.md "kl bf16 quotient"): consensus/rank
+    #: selection agree with the f32 quotient at the bench shape, and the
+    #: knob is kept opt-in because the wall win is within session noise
+    #: — kl's loop is quotient-FLOP-bound, not A-bandwidth-bound.
+    kl_bf16_quotient: bool = False
     #: snmf only — Kim & Park L1 penalty on H's columns (larger = sparser)
     sparsity_beta: float = 0.01
     #: snmf only — ridge on W; None = max(A)^2 (the Kim & Park default)
@@ -252,8 +264,10 @@ class ConsensusConfig:
     #: compact into that narrower pool and finish at its cheaper
     #: per-iteration cost (the straggler tail dominates the sweep wall —
     #: see nmfx/ops/sched_mu.py). "auto" = measured default; 0/None
-    #: disables. Per-job stop decisions are identical in every case,
-    #: factors within float tolerance (as for any slot-count change);
+    #: disables. The knob targets wall-clock only: per-job stop decisions
+    #: were identical on every tested workload, and factors stay within
+    #: float tolerance (batch-width changes re-tile GEMMs, ~1e-6 factor
+    #: drift, so a near-tie stop could in principle flip an iteration);
     #: each stage costs one extra compiled loop.
     grid_tail_slots: "int | None | str | tuple" = "auto"
 

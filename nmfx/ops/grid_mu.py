@@ -214,6 +214,35 @@ def neals_block(a, wp, hp, done_mask, cfg: SolverConfig):
     return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
 
 
+def als_block(a, wp, hp, done_mask, cfg: SolverConfig):
+    """ONE dense-batched QR-free ALS iteration (see solvers/als.py for
+    the per-restart form; reference nmf_als.c:209-360): each half-step
+    is the minimum-norm least-squares solve of the OTHER factor, batched
+    over lanes with ``a`` broadcast, then clamped — the same
+    ``jnp.linalg.lstsq`` the per-restart engine uses, so trajectories
+    match it to float tolerance (hp feeds only the frozen-lane
+    passthrough: ALS re-derives H from W alone). Zero padding is
+    EXACTLY invariant under the min-norm solution: a zero W column
+    contributes a zero singular direction, and minimum-norm puts zero
+    coefficient on it, so padded H rows stay zero (and symmetrically for
+    W's half-step) — rank-deficiency is the lstsq pseudo-inverse's
+    well-defined case, not a fallback path (the reason the per-restart
+    form chose SVD lstsq over the reference's pivoted QR). bf16
+    A-streaming is sound here for the same reason as the Gram blocks:
+    every consumption of A inside lstsq is a GEMM against the SVD bases
+    (x = V·S⁻¹·Uᵀ·A), which the MXU rounds to bf16 under that precision
+    anyway — the SVD itself factors only the batched factor matrices,
+    never A."""
+    from nmfx.solvers.als import lstsq_min_norm
+
+    h = base.clamp(jax.vmap(lambda w: lstsq_min_norm(w, a))(wp),
+                   cfg.zero_threshold)
+    wt = jax.vmap(lambda hh: lstsq_min_norm(hh.T, a.T))(h)
+    w = base.clamp(jnp.transpose(wt, (0, 2, 1)), cfg.zero_threshold)
+    frozen = done_mask[:, None, None]
+    return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
+
+
 def snmf_block(a, wp, hp, done_mask, cfg: SolverConfig, eta=None):
     """ONE dense-batched sparse-NMF iteration (Kim & Park 2007; see
     solvers/snmf.py): the H-solve's L1 surrogate ``beta·ones`` couples
@@ -275,12 +304,15 @@ def kl_block(a, wp, hp, done_mask, cfg: SolverConfig):
     numerator contraction and column/row sum are both zero, so its
     update is 0·x/(0+eps) = 0."""
     eps = cfg.div_eps
-    # NOTE: unlike the other blocks, kl receives FULL-PRECISION A even
-    # under matmul_precision="bfloat16" (sched_mu._streams_bf16_a
-    # excludes kl): A feeds the elementwise quotient, where bf16
-    # truncation would be a real perturbation, not the MXU's own operand
-    # rounding. The GEMMs still run at bf16 MXU precision via the
-    # surrounding matmul_precision_ctx, matching the vmapped engine.
+    # NOTE: unlike the other blocks, kl receives FULL-PRECISION A by
+    # default even under matmul_precision="bfloat16"
+    # (sched_mu._streams_bf16_a excludes kl unless
+    # cfg.kl_bf16_quotient opts in): A feeds the elementwise quotient,
+    # where bf16 truncation is a real input perturbation, not the MXU's
+    # own operand rounding (the division below promotes a bf16 A to f32
+    # arithmetic either way). The GEMMs still run at bf16 MXU precision
+    # via the surrounding matmul_precision_ctx, matching the vmapped
+    # engine.
     wh = jnp.einsum("bmk,bkn->bmn", wp, hp)
     q = a[None] / (wh + eps)
     numer = jnp.einsum("bmk,bmn->bkn", wp, q)
@@ -301,11 +333,11 @@ def kl_block(a, wp, hp, done_mask, cfg: SolverConfig):
 #: check_convergence flags (mu/kl = class+TolX; hals/snmf =
 #: class+TolX+TolFun; neals = TolX+TolFun only, solvers/*.py)
 BLOCKS = {"mu": mu_block, "hals": hals_block, "neals": neals_block,
-          "snmf": snmf_block, "kl": kl_block}
-USES_TOLFUN = {"mu": False, "hals": True, "neals": True, "snmf": True,
-               "kl": False}
-USES_CLASS = {"mu": True, "hals": True, "neals": False, "snmf": True,
-              "kl": True}
+          "als": als_block, "snmf": snmf_block, "kl": kl_block}
+USES_TOLFUN = {"mu": False, "hals": True, "neals": True, "als": True,
+               "snmf": True, "kl": False}
+USES_CLASS = {"mu": True, "hals": True, "neals": False, "als": False,
+              "snmf": True, "kl": True}
 
 
 def conv_cfg(cfg: SolverConfig) -> SolverConfig:

@@ -29,8 +29,11 @@ _META_NAME = "registry.json"
 #: v3: fingerprint hashes ALL config field values (not just non-default
 #: ones), so changing a field's default invalidates pre-change registries.
 #: v4: keep_factors joins the payload — a registry written without
-#: per-restart factors must not silently serve a keep_factors sweep
-_FORMAT_VERSION = 4
+#: per-restart factors must not silently serve a keep_factors sweep.
+#: v5: SolverConfig gained kl_bf16_quotient (round 5) — by the v3 rule
+#: any new field invalidates pre-change registries (loud error with
+#: remediation, never stale numbers); the bump records the cause
+_FORMAT_VERSION = 5
 
 
 def _all_fields(cfg) -> dict:

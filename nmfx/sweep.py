@@ -106,6 +106,12 @@ def grid_axes_active(mesh: Mesh | None) -> bool:
 _GRID_EXEC_BACKENDS = {"mu": ("auto", "packed", "pallas"),
                        "hals": ("auto", "packed"),
                        "neals": ("packed",),
+                       # als (round 5): one whole-grid compile for the
+                       # multi-rank sweep — its lstsq half-steps batch
+                       # like neals' Gram solves (grid_mu.als_block);
+                       # the win is compile time, ~14-iteration solves
+                       # make iteration throughput a non-factor
+                       "als": ("packed",),
                        "snmf": ("packed",),
                        # kl: the slot count bounds its (B, m, n) quotient
                        # working set — grid_slots plays restart_chunk's
@@ -185,11 +191,12 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         # the batched backend IS the dense grid machinery at one rank:
         # shared-GEMM lanes through the slot scheduler (hals' two big
         # GEMMs are mu-shaped — ref libnmf/nmf_mu.c:174-216; neals/snmf
-        # batch their Gram solves, ref nmf_neals.c:200-306). For hals,
+        # batch their Gram solves, ref nmf_neals.c:200-306; als batches
+        # its lstsq half-steps, ref nmf_als.c:209-360). For hals,
         # "auto" resolves here too so its execution family is the same
         # on every sweep path (the checkpoint fingerprint hashes that
         # family; vmap is the explicit backend="vmap" choice); for
-        # neals/snmf the grid engine is the explicit "packed" opt-in
+        # neals/als/snmf the grid engine is the explicit "packed" opt-in
         # (_GRID_EXEC_BACKENDS)
         grid_fn = _build_grid_exec_sweep_fn(
             (k,), restarts, solver_cfg, init_cfg, label_rule, mesh,
@@ -644,7 +651,8 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
 def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
     """Whether the whole-grid slot-scheduled solve (``nmfx.ops.sched_mu``)
     can run this configuration: an algorithm with a dense-batched block
-    (grid_mu.BLOCKS: mu, hals, neals, snmf, kl) under the backend that routes
+    (grid_mu.BLOCKS: mu, hals, neals, als, snmf, kl) under the backend
+    that routes
     it there (``_GRID_EXEC_BACKENDS`` — including the fused pallas
     kernels for mu; the scheduler keeps its slot state in the packed
     column layout those kernels consume) — with no feature/sample mesh
@@ -843,12 +851,14 @@ def sweep_one_k(a, key, k: int, restarts: int,
     lanes of the slot-scheduled backends (hals backend='packed';
     ConsensusConfig.grid_slots at the sweep level)."""
     if (solver_cfg.algorithm == "mu" or solver_cfg.backend
-            not in _GRID_EXEC_BACKENDS.get(solver_cfg.algorithm, ())):
+            not in _GRID_EXEC_BACKENDS.get(solver_cfg.algorithm, ())
+            or grid_axes_active(mesh)):
         # only the slot-scheduled branch consumes the grid knobs (any
         # non-mu algorithm routed there by _GRID_EXEC_BACKENDS — the mu
-        # per-k path uses the packed driver, not the scheduler);
-        # normalize so a different value cannot force a re-trace of
-        # unrelated builders
+        # per-k path uses the packed driver, not the scheduler, and a
+        # feature/sample-sharded mesh takes the grid-sharded builder,
+        # which has no slot pool); normalize so a different value cannot
+        # force a re-trace of unrelated builders
         grid_slots = 48
         grid_tail_slots = "auto"
     fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh,

@@ -190,10 +190,11 @@ def test_hals_grid_matches_per_k_vmap(data):
     _assert_outputs_match(solo_p, solo_v, (3,))
 
 
-@pytest.mark.parametrize("algorithm", ["neals", "snmf", "kl"])
+@pytest.mark.parametrize("algorithm", ["neals", "als", "snmf", "kl"])
 def test_gram_family_grid_matches_per_k_vmap(data, algorithm):
-    """neals/snmf/kl through the whole-grid scheduler (explicit
-    backend='packed' opt-in, round 4) reproduce the vmapped generic
+    """neals/als/snmf/kl through the whole-grid scheduler (explicit
+    backend='packed' opt-in; als joined in round 5 — its min-norm lstsq
+    half-steps batch like neals' Gram solves) reproduce the vmapped generic
     driver: same stop decisions and labels, factors to float tolerance.
     Their 'auto' default stays the vmap family — the grid engine exists
     for its compile-time win (one jit for the whole sweep vs one per

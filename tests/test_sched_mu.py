@@ -177,7 +177,9 @@ def test_single_job_matches_solve(jobs):
                                rtol=2e-4, atol=2e-5)
 
 
-def test_non_mu_rejected(jobs):
+def test_unblocked_algorithm_rejected(jobs):
+    # pg has no dense-batched block (grid_mu.BLOCKS) — als joined the
+    # scheduler in round 5, so it no longer serves as the reject case
     a, w0, h0 = jobs
-    with pytest.raises(ValueError, match="mu"):
-        mu_sched(a, w0, h0, SolverConfig(algorithm="als"))
+    with pytest.raises(ValueError, match="scheduler"):
+        mu_sched(a, w0, h0, SolverConfig(algorithm="pg"))
