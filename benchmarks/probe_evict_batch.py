@@ -1,0 +1,57 @@
+"""Evict-batch hysteresis A/B: mu_sched(evict_batch=E) at the north star.
+
+Round-5 measurement behind the `evict_batch` knob's default (1) — see
+RESULTS.md "Evict-batch hysteresis". Interleaved min-of-N, both engines,
+E in {1, 4, 8}. Per-job recorded results are invariant on CPU
+(bit-identical); on hardware, reload timing shifts jobs' column
+positions and Mosaic tiling drift moves stop iterations a few percent
+(the same benign class as slot-count changes) — reported, not asserted.
+
+Usage: PYTHONPATH=. python benchmarks/probe_evict_batch.py [--reps 5]
+"""
+import argparse, time
+import jax, jax.numpy as jnp, numpy as np
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.sched_mu import mu_sched
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--reps", type=int, default=5)
+args = ap.parse_args()
+ks = tuple(range(10, 1, -1)); k_max = 10; restarts = 50
+a = grouped_matrix(5000, (125,)*4, effect=2.0, seed=0)
+root = jax.random.PRNGKey(123)
+w0l, h0l, job_ks = [], [], []
+for k in ks:
+    keys = jax.random.split(jax.random.fold_in(root, k), restarts)
+    w0s, h0s = jax.vmap(lambda kk, k=k: initialize(kk, a, k, InitConfig(), jnp.float32))(keys)
+    w0l.append(jnp.pad(w0s, ((0,0),(0,0),(0,k_max-k))))
+    h0l.append(jnp.pad(h0s, ((0,0),(0,k_max-k),(0,0))))
+    job_ks += [k]*restarts
+w0 = jnp.concatenate(w0l); h0 = jnp.concatenate(h0l); job_ks = tuple(job_ks)
+
+cells = [(b, e) for b in ("auto", "pallas") for e in (1, 4, 8)]
+def run(backend, eb):
+    cfg = SolverConfig(algorithm="mu", max_iter=10000,
+                       matmul_precision="bfloat16", backend=backend)
+    t0 = time.perf_counter()
+    r = mu_sched(a, w0, h0, cfg, slots=48, job_ks=job_ks, evict_batch=eb)
+    its = np.asarray(r.iterations); np.asarray(r.w[0])
+    return time.perf_counter() - t0, int(its.sum()), np.asarray(r.pool_trips)
+
+ref_iters = {}
+for c in cells:
+    t0 = time.perf_counter(); _, itot, trips = run(*c)
+    print(f"warm {c}: {time.perf_counter()-t0:.1f}s iters={itot} trips={trips}", flush=True)
+    ref_iters.setdefault(c[0], itot)
+    print(f"  iters vs {c[0]} E=1: {itot/ref_iters[c[0]]:.4f}x", flush=True)
+walls = {c: [] for c in cells}
+for rep in range(args.reps):
+    for c in cells:
+        w, _, _ = run(*c)
+        walls[c].append(w)
+        print(f"rep {rep} {c}: {w:.3f}s", flush=True)
+for c in cells:
+    ws = sorted(walls[c])
+    print(f"{c}: min={ws[0]:.3f}s median={ws[len(ws)//2]:.3f}s all={[round(x,3) for x in ws]}")

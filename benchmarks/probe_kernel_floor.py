@@ -1,0 +1,44 @@
+"""Kernel-floor probes: fused_block_iterations marginal vs tile size.
+
+Round-5 decomposition (RESULTS.md "Where the pallas wall actually is"):
+one pallas_call per measurement with a 448-iteration delta isolates the
+kernel from the scheduler. Found: 62.9 us/pool-iter at rk=480 /
+block_m=512 (131 ns per column-iteration, 1.23x the no-overlap
+compute+memory roofline); block_m=1024 neutral, 2560 3x worse — the
+512-row tiling already sits at the kernel's operating point.
+
+Usage: PYTHONPATH=. python benchmarks/probe_kernel_floor.py
+"""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from nmfx.ops.pallas_mu import fused_block_iterations
+
+m, n = 5120, 512
+key = jax.random.PRNGKey(0)
+a = jax.random.uniform(key, (m, n), jnp.float32).astype(jnp.bfloat16)
+cells = [(512, 480), (1024, 448), (1024, 384), (2560, 384), (2560, 320), (512, 384)]
+for block_m, rk in cells:
+    kw, kh = jax.random.split(jax.random.PRNGKey(1))
+    wp = jax.random.uniform(kw, (m, rk), jnp.float32)
+    hp = jax.random.uniform(kh, (rk, n), jnp.float32)
+    fcol = jnp.zeros((1, rk), jnp.float32)
+    def run(iters):
+        t0 = time.perf_counter()
+        out = fused_block_iterations(a, wp, hp, fcol, k=8, iters=iters,
+                                     block_m=block_m,
+                                     matmul_precision="bfloat16")
+        np.asarray(out[0][0])
+        return time.perf_counter() - t0
+    try:
+        for it in (64, 512):
+            run(it)  # compile
+        lo = min(run(64) for _ in range(5))
+        hi = min(run(512) for _ in range(5))
+        per = (hi - lo) / (512 - 64)
+        cols_rate = rk / per * 1e-6
+        # model-flops rate for k-true columns == rk here (no padding)
+        flops = (4 * m * n + 0) * rk / 8 * 8  # 4mn per column pair? report raw
+        print(f"block_m={block_m} rk={rk}: {per*1e6:.1f} us/iter "
+              f"({per/rk*1e9:.1f} ns/col-iter) lo={lo:.3f} hi={hi:.3f}", flush=True)
+    except Exception as e:
+        print(f"block_m={block_m} rk={rk}: FAILED {type(e).__name__}: {str(e)[:200]}", flush=True)

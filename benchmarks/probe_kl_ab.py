@@ -1,0 +1,105 @@
+"""Same-session interleaved kl A/B: vmap vs packed-grid on an IDENTICAL
+k-range, plus the bf16-quotient decision.
+
+VERDICT r4 Weak #5: the round-4 "38% faster warm" kl claim compared
+k={2,4,6} (vmap) against k=2..4 (packed) — overlapping but not
+identical sweeps. This probe closes it: both engines run the SAME
+k-range in one session, interleaved, min-of-N. It also measures the
+round-5 ``SolverConfig.kl_bf16_quotient`` opt-in (stream A as bf16
+through the packed-grid loop, halving A's HBM reread): wall delta plus
+the consensus/rank-selection drift it introduces — the accept/reject
+evidence for that knob's default.
+
+Usage: PYTHONPATH=. python benchmarks/probe_kl_ab.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.cophenetic import rank_selection
+from nmfx.datasets import grouped_matrix
+from nmfx.sweep import default_mesh, sweep
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--genes", type=int, default=5000)
+    ap.add_argument("--samples", type=int, default=500)
+    ap.add_argument("--ks", type=int, nargs="+", default=[2, 3, 4, 5, 6])
+    ap.add_argument("--restarts", type=int, default=20)
+    args = ap.parse_args()
+
+    ks = tuple(args.ks)
+    a = grouped_matrix(args.genes, (args.samples // 4,) * 4, effect=2.0,
+                       seed=0)
+    icfg = InitConfig()
+    mesh = default_mesh()
+
+    cells = {
+        "kl-vmap": dict(backend="vmap", grid_exec="per_k",
+                        kl_bf16_quotient=False),
+        "kl-packed": dict(backend="packed", grid_exec="grid",
+                          kl_bf16_quotient=False),
+        "kl-packed-bf16q": dict(backend="packed", grid_exec="grid",
+                                kl_bf16_quotient=True),
+    }
+
+    def run(backend, grid_exec, kl_bf16_quotient):
+        scfg = SolverConfig(algorithm="kl", max_iter=10000,
+                            matmul_precision="bfloat16", backend=backend,
+                            kl_bf16_quotient=kl_bf16_quotient)
+        ccfg = ConsensusConfig(ks=ks, restarts=args.restarts, seed=123,
+                               grid_exec=grid_exec)
+        t0 = time.perf_counter()
+        raw = sweep(a, ccfg, scfg, icfg, mesh)
+        host = jax.device_get({k: (raw[k].consensus, raw[k].iterations)
+                               for k in ks})
+        wall = time.perf_counter() - t0
+        return wall, host
+
+    results = {}
+    for name, kw in cells.items():
+        t0 = time.perf_counter()
+        _, host = run(**kw)
+        results[name] = host
+        print(f"warm {name}: {time.perf_counter() - t0:.1f}s "
+              f"mean_iters="
+              f"{ {k: round(float(host[k][1].mean()), 1) for k in ks} }",
+              flush=True)
+
+    # parity of the bf16-quotient opt-in vs the f32 packed engine, and
+    # packed vs vmap (the same-range check VERDICT asked for)
+    for name, ref in (("kl-packed", "kl-vmap"),
+                      ("kl-packed-bf16q", "kl-packed")):
+        for k in ks:
+            dc = float(np.max(np.abs(results[name][k][0]
+                                     - results[ref][k][0])))
+            rho_a = rank_selection(np.asarray(results[name][k][0]), k)[0]
+            rho_b = rank_selection(np.asarray(results[ref][k][0]), k)[0]
+            dit = float(results[name][k][1].mean()
+                        / max(results[ref][k][1].mean(), 1.0))
+            print(f"{name} vs {ref} k={k}: max|dC|={dc:.4f} "
+                  f"|d rho|={abs(rho_a - rho_b):.4f} "
+                  f"iters_ratio={dit:.3f}", flush=True)
+
+    walls = {name: [] for name in cells}
+    for rep in range(args.reps):
+        for name, kw in cells.items():
+            w, _ = run(**kw)
+            walls[name].append(w)
+            print(f"rep {rep} {name}: {w:.3f}s", flush=True)
+    for name, ws in walls.items():
+        ws = sorted(ws)
+        print(f"{name}: min={ws[0]:.3f}s median={ws[len(ws) // 2]:.3f}s "
+              f"all={[round(x, 3) for x in ws]}")
+
+
+if __name__ == "__main__":
+    main()

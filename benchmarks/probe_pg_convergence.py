@@ -1,0 +1,86 @@
+"""pg/alspg at the reference's own stopping rule — no budget truncation.
+
+VERDICT r4 Missing #3: every published nmfx pg/alspg number was
+budget-truncated (100-iter / 20×100 caps), so "matching-or-beating" was
+never demonstrated under the reference stop
+``projnorm < tol·initgrad`` (reference nmf_pg.c:228-243,
+nmf_alspg.c:193-209). This probe runs the rule honestly at two scales:
+
+1. **Reference-fixture scale** (1000×40, the bundled 20+20x1000.gct's
+   shape class): k=2..5 × 10 restarts, tol_pg=1e-4 (Lin's customary
+   tolerance — the reference's own driver default is tol=2e-16,
+   setdefaultopts.c:51, which NEVER fires; 1e-4 is the strictest
+   published practice), maxiter=10000 (the reference R-flow's cap,
+   nmf.r:13). Reports the stop-reason split, iteration distribution,
+   and wall.
+2. **Bench shape** (5000×500, k=4 × 50 restarts): single timed run each
+   at the same rule — pg to maxiter=10000, alspg to maxiter=2000 outer
+   (its outer iterations each run two ≤1000-step NNLS chains; 2000
+   outer already exceeds any observed stop by 4× and a 10000-outer run
+   is ~17 min of pure chain latency — recorded as such, not hidden).
+
+Usage: PYTHONPATH=. python benchmarks/probe_pg_convergence.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import time
+
+import jax
+import numpy as np
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.solvers.base import StopReason
+from nmfx.sweep import default_mesh, sweep
+
+
+def run_case(a, algorithm, ks, restarts, max_iter, label):
+    scfg = SolverConfig(algorithm=algorithm, max_iter=max_iter,
+                        matmul_precision="bfloat16")
+    ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=123,
+                           grid_exec="per_k")
+    mesh = default_mesh()
+    t0 = time.perf_counter()
+    raw = sweep(a, ccfg, scfg, InitConfig(), mesh)
+    host = jax.device_get({k: (raw[k].iterations, raw[k].stop_reasons)
+                           for k in ks})
+    wall = time.perf_counter() - t0
+    print(f"\n{label}: wall={wall:.1f}s (includes compile on first call)")
+    for k in ks:
+        its, stops = host[k]
+        reasons = collections.Counter(
+            StopReason(int(r)).name for r in stops)
+        print(f"  k={k}: iters min/median/max = {int(its.min())}/"
+              f"{int(np.median(its))}/{int(its.max())}; stops: "
+              f"{dict(reasons)}")
+    return wall
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-large", action="store_true",
+                    help="only the reference-fixture-scale runs")
+    args = ap.parse_args()
+
+    # 1. reference fixture scale
+    a_small = grouped_matrix(1000, (20, 20), effect=2.0, seed=0)
+    for algo in ("pg", "alspg"):
+        run_case(a_small, algo, range(2, 6), 10, 10000,
+                 f"{algo} @ 1000x40, k=2..5 x 10, tol_pg rule, "
+                 "maxiter=10000")
+
+    if args.skip_large:
+        return
+    # 2. bench shape, single timed runs
+    a_big = grouped_matrix(5000, (125,) * 4, effect=2.0, seed=0)
+    run_case(a_big, "pg", [4], 50, 10000,
+             "pg @ 5000x500, k=4 x 50, tol_pg rule, maxiter=10000")
+    run_case(a_big, "alspg", [4], 50, 2000,
+             "alspg @ 5000x500, k=4 x 50, tol_pg rule, maxiter=2000")
+
+
+if __name__ == "__main__":
+    main()

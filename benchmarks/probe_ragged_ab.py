@@ -1,0 +1,105 @@
+"""Same-session interleaved A/B: ragged (class-blocked) vs uniform
+pallas pool vs dense scheduler, full north-star job grid.
+
+Round-5 context: the occupancy probe showed the uniform pallas pool at
+98.5% slot occupancy with bookkeeping ~free — the wall is the kernel
+marginal times trips. But 40% of the uniform pool's packed columns are
+zero padding at the k=2..10 mix (Σk/(|ks|·k_max)), and padded columns
+burn GEMM cycles like real ones. The ragged pool (sched_mu._ragged_*)
+eliminates padding with class-blocked variable-width slots; column-work
+arithmetic predicts ~1.33× on the main stage
+(Σ k·iters(k) / (k_max·Σ iters(k)) ≈ 0.75 at iters ∝ k^1.5).
+
+Protocol per BASELINE.md: one process, all configs compiled first, then
+interleaved timed reps; compare same-session minima only.
+
+Usage: PYTHONPATH=. python benchmarks/probe_ragged_ab.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.sched_mu import mu_sched
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--genes", type=int, default=5000)
+    ap.add_argument("--samples", type=int, default=500)
+    ap.add_argument("--kmax", type=int, default=10)
+    ap.add_argument("--restarts", type=int, default=50)
+    args = ap.parse_args()
+
+    ks = tuple(range(args.kmax, 1, -1))  # LPT dispatch order
+    k_max = max(ks)
+    a = grouped_matrix(args.genes, (args.samples // 4,) * 4, effect=2.0,
+                       seed=0)
+    root = jax.random.PRNGKey(123)
+    w0l, h0l, job_ks = [], [], []
+    for k in ks:
+        keys = jax.random.split(jax.random.fold_in(root, k), args.restarts)
+        w0s, h0s = jax.vmap(
+            lambda kk, k=k: initialize(kk, a, k, InitConfig(),
+                                       jnp.float32))(keys)
+        w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+        h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+        job_ks += [k] * args.restarts
+    w0 = jnp.concatenate(w0l)
+    h0 = jnp.concatenate(h0l)
+    job_ks = tuple(job_ks)
+
+    cells = {
+        "dense": dict(backend="auto", ragged=False),
+        "pallas-uniform": dict(backend="pallas", ragged=False),
+        "pallas-ragged": dict(backend="pallas", ragged=True),
+    }
+
+    def run(backend, ragged):
+        cfg = SolverConfig(algorithm="mu", max_iter=10000,
+                           matmul_precision="bfloat16", backend=backend)
+        t0 = time.perf_counter()
+        r = mu_sched(a, w0, h0, cfg, slots=48, job_ks=job_ks,
+                     ragged=ragged)
+        its = np.asarray(r.iterations)
+        np.asarray(r.w[0])
+        return time.perf_counter() - t0, its, \
+            (np.asarray(r.pool_widths), np.asarray(r.pool_trips),
+             np.asarray(r.pool_lanes))
+
+    its_ref = None
+    for name, kw in cells.items():
+        t0 = time.perf_counter()
+        _, its, stages = run(**kw)
+        print(f"warm {name}: {time.perf_counter() - t0:.1f}s "
+              f"iters_total={int(its.sum())} stages={stages}", flush=True)
+        if its_ref is None:
+            its_ref = its
+        else:
+            ratio = float(its.mean() / its_ref.mean())
+            print(f"  mean-iteration ratio vs dense: {ratio:.3f}")
+
+    walls = {name: [] for name in cells}
+    for rep in range(args.reps):
+        for name, kw in cells.items():
+            w, _, _ = run(**kw)
+            walls[name].append(w)
+            print(f"rep {rep} {name}: {w:.3f}s", flush=True)
+
+    for name, ws in walls.items():
+        ws = sorted(ws)
+        print(f"{name}: min={ws[0]:.3f}s median={ws[len(ws) // 2]:.3f}s "
+              f"all={[round(x, 3) for x in ws]}")
+
+
+if __name__ == "__main__":
+    main()

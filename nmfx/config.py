@@ -118,15 +118,17 @@ class SolverConfig:
     backend: str = "auto"
     #: kl + backend="packed" only — stream A as one-time-truncated bf16
     #: through the slot scheduler's loop, halving A's HBM reread traffic
-    #: like the GEMM families get by default. OFF by default because
-    #: kl's block consumes A in an ELEMENTWISE division (the quotient
-    #: A ⊘ WH), where bf16 truncation is a real ~0.4% per-element input
-    #: perturbation rather than the MXU's own operand rounding
-    #: (sched_mu._streams_bf16_a). Round-5 measurement
-    #: (benchmarks/RESULTS.md "kl bf16 quotient"): consensus/rank
-    #: selection agree with the f32 quotient at the bench shape, and the
-    #: knob is kept opt-in because the wall win is within session noise
-    #: — kl's loop is quotient-FLOP-bound, not A-bandwidth-bound.
+    #: like the GEMM families get by default. OFF and measured-REJECTED
+    #: (round 5, benchmarks/probe_kl_ab.py, same-session interleaved
+    #: min-of-5 at 5000×500 k=2..6×20): 3.70 s vs the f32 quotient's
+    #: 2.94 s AND +7–11% iterations at k≥5 — kl's block consumes A in
+    #: an ELEMENTWISE division (the quotient A ⊘ WH), where bf16
+    #: truncation is a real ~0.4% per-element input perturbation rather
+    #: than the MXU's own operand rounding, and the perturbed quotient
+    #: both upsets the class-stability counters and upcasts to f32
+    #: before dividing anyway (no FLOP saving — kl is
+    #: quotient-FLOP-bound, not A-bandwidth-bound). The knob stays so
+    #: the rejection is reproducible (sched_mu._streams_bf16_a).
     kl_bf16_quotient: bool = False
     #: snmf only — Kim & Park L1 penalty on H's columns (larger = sparser)
     sparsity_beta: float = 0.01

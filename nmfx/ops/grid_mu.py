@@ -243,32 +243,44 @@ def als_block(a, wp, hp, done_mask, cfg: SolverConfig):
     return jnp.where(frozen, wp, w), jnp.where(frozen, hp, h)
 
 
-def snmf_block(a, wp, hp, done_mask, cfg: SolverConfig, eta=None):
+def snmf_block(a, wp, hp, done_mask, cfg: SolverConfig, eta=None,
+               pad_live=None):
     """ONE dense-batched sparse-NMF iteration (Kim & Park 2007; see
     solvers/snmf.py): the H-solve's L1 surrogate ``beta·ones`` couples
-    components, so it is masked to each lane's LIVE components (nonzero W
-    columns) — zero-padded lanes of the mixed-rank grid would otherwise
-    leak the coupling into real components. A component whose W column
-    genuinely dies mid-solve drops out of the coupling (the per-restart
-    form keeps its zero row in the system); that degenerate case aside,
-    the engines agree to float tolerance. The W-solve's ridge is
-    diagonal and needs no mask. ``eta``: the Kim & Park ``max(A)²``
-    ridge, precomputed ONCE by the drivers from the FULL-PRECISION A
-    (``make_block``) — computing it here from ``a`` would use the
-    bf16-truncated loop matrix under that precision and re-reduce O(mn)
-    every iteration."""
+    components, so it is masked to each lane's TRUE-k components
+    (``pad_live``) — zero-padded lanes of the mixed-rank grid would
+    otherwise leak the coupling into real components. The mask is BY
+    PADDING, not by nonzero-W: a component whose W column genuinely dies
+    mid-solve stays in the coupling exactly as the per-restart form
+    keeps its zero row in the k×k ones matrix — sparse NMF actively
+    kills components at k above the data's structure, and dropping them
+    changes the LIVE components' solve (round-5 measurement: a
+    nonzero-W mask diverged to max|ΔC|=1.0 / mean|ΔC|≈0.3 from the
+    vmapped engine once deaths began; the padding mask restores exact
+    stop/label parity — tests/test_grid_exec.py dead-component test).
+    The W-solve's ridge is diagonal and needs no mask.
+
+    ``eta``: the Kim & Park ``max(A)²`` ridge, precomputed ONCE by the
+    drivers from the FULL-PRECISION A (``make_block``) — computing it
+    here from ``a`` would use the bf16-truncated loop matrix under that
+    precision and re-reduce O(mn) every iteration. ``pad_live``:
+    (B, k_max) bool, True on each lane's true-k columns, resolved by the
+    DRIVERS from the initial factors (every true column of W0|H0 is
+    nonzero at init; death keeps pad_live True, padding never does)."""
     f32 = wp.dtype
-    if eta is None:
+    if eta is None or pad_live is None:
         # a direct BLOCKS["snmf"] call would be tempted to derive eta
-        # from `a` here — which under bf16 streaming is the TRUNCATED
-        # loop operand, the exact hazard the docstring describes. Fail
-        # fast instead of silently drifting from the per-restart form.
-        raise ValueError("snmf_block requires eta resolved by "
-                         "make_block(cfg, a_full) from the "
-                         "full-precision matrix")
+        # from `a` (under bf16 streaming: the TRUNCATED loop operand)
+        # and pad_live from the CURRENT factors (where death is
+        # indistinguishable from padding) — the exact hazards the
+        # docstring describes. Fail fast instead of silently drifting
+        # from the per-restart form.
+        raise ValueError("snmf_block requires eta and pad_live resolved "
+                         "by the driver (make_block(cfg, a_full) + the "
+                         "initial-factor padding mask)")
     beta = jnp.asarray(cfg.sparsity_beta, f32)
     k_max = wp.shape[2]
-    live = jnp.any(wp != 0, axis=1)  # (B, k_max) — padded cols are zero
+    live = pad_live  # (B, k_max)
     ones_mask = (live[:, :, None] & live[:, None, :]).astype(f32)
     if a.dtype == jnp.bfloat16:
         wb = wp.astype(jnp.bfloat16)
@@ -349,6 +361,26 @@ def conv_cfg(cfg: SolverConfig) -> SolverConfig:
         import dataclasses
         return dataclasses.replace(cfg, use_class_stop=False)
     return cfg
+
+
+def pad_live_mask(w0, h0, job_ks=None):
+    """(B, k_max) bool — True on each lane's TRUE-k components, the
+    single source of the snmf beta-coupling mask (see ``snmf_block``).
+
+    With ``job_ks`` (the per-lane true ranks, known to the sweep
+    builders, which construct the lanes) the mask is exact:
+    ``col < k_lane``. Without it (direct driver calls) the mask is
+    inferred from the INITIAL factors — correct for uniform-random init
+    (every true entry is nonzero a.s.), but NNDSVD can produce an
+    exact-zero trailing component (sigma_j = 0 at k above rank(A)),
+    which the inference would misclassify as padding and drop from the
+    coupling where the per-restart engine keeps it. Callers that know
+    the lane composition must pass ``job_ks``."""
+    if job_ks is not None:
+        k_max = w0.shape[2]
+        return jnp.asarray(
+            [[c < k for c in range(k_max)] for k in job_ks], bool)
+    return jnp.any(w0 != 0, axis=1) | jnp.any(h0 != 0, axis=2)
 
 
 def make_block(cfg: SolverConfig, a_full):
@@ -436,12 +468,14 @@ def _check(a_res, state: GridState, cfg: SolverConfig) -> GridState:
                           dnorm=dnorm)
 
 
-@partial(jax.jit, static_argnames=("cfg", "varying_axes"))
+@partial(jax.jit, static_argnames=("cfg", "varying_axes", "job_ks"))
 def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
             cfg: SolverConfig = SolverConfig(),
-            varying_axes: tuple[str, ...] = ()) -> GridMUResult:
+            varying_axes: tuple[str, ...] = (),
+            job_ks: "tuple[int, ...] | None" = None) -> GridMUResult:
     """Solve a dense zero-padded lane batch (every grid cell, any mix of
-    ranks) with shared-GEMM iterations.
+    ranks) with shared-GEMM iterations. ``job_ks``: optional per-lane
+    true ranks (see ``pad_live_mask`` — exact snmf coupling masks).
 
     Semantically equivalent to running ``mu_packed`` per rank on the same
     initial factors (same update rule, same convergence tests, same
@@ -488,7 +522,13 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
             # precision hint and run full-f32 GEMMs, so truncating there
             # would change results)
             a_loop = a.astype(jnp.bfloat16)
-        step = partial(_step, make_block(cfg, a_true), a_loop, a_true)
+        block = make_block(cfg, a_true)
+        if cfg.algorithm == "snmf":
+            # each lane's true-k padding mask (mid-solve death must NOT
+            # drop a component from the beta coupling — see snmf_block /
+            # pad_live_mask)
+            block = partial(block, pad_live=pad_live_mask(w0, h0, job_ks))
+        step = partial(_step, block, a_loop, a_true)
 
         def cond(s: GridState):
             return jnp.any(~s.done) & (s.iteration + cfg.check_every

@@ -178,7 +178,8 @@ def fused_h_update(a: jax.Array, wp: jax.Array, hp: jax.Array, *, k: int,
     )(a, wp, hp)
 
 
-def _block_kernel(a_ref, frozen_ref, frozenr_ref, w_in_ref, h_in_ref,
+def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
+                  w_in_ref, h_in_ref,
                   w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref, numer_acc,
                   gram_acc, *, block_m: int, k: int, eps: float,
                   zero_threshold: float, matmul_dtype):
@@ -221,10 +222,11 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, w_in_ref, h_in_ref,
 
         pl.run_scoped(init, pltpu.SemaphoreType.DMA((2,)))
     last_it = it == pl.num_programs(0) - 1
-    rk = gram_acc.shape[0]
-    rows = jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 0) // k
-    cols = jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 1) // k
-    bd = rows == cols
+    # block-diagonal Gram mask from per-column segment (job) ids — the
+    # (rk, 1)/(1, rk) pair broadcasts to the (rk, rk) same-job mask.
+    # Uniform-k pools pass seg = iota // k; the ragged (class-blocked)
+    # pool passes its variable-width job ids (see ragged_layout)
+    bd = seg_row_ref[:] == seg_col_ref[:]
     # Mosaic note: masks and stats stay strictly 2-D (keepdims reductions,
     # pre-shaped (1, rk)/(rk, 1) frozen inputs) — inserting a minor dim on
     # a non-32-bit value (bool masks) is unsupported on TPU
@@ -311,7 +313,8 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
                            iters: int = 2, block_m: int = 512,
                            eps: float = 1e-9, zero_threshold: float = 0.0,
                            matmul_precision: str = "default",
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           seg_ids: "jax.Array | None" = None):
     """``iters`` full MU iterations (both half-updates) in ONE pallas_call
     with the packed factors VMEM-resident throughout — the whole-solve
     launch count drops from ~4 kernels per iteration-pair to 1.
@@ -352,6 +355,10 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
         zero_threshold=zero_threshold,
         matmul_dtype=_matmul_dtype(matmul_precision))
     frozen_rows = frozen_cols.reshape(rk, 1)
+    if seg_ids is None:
+        # uniform pool: every job spans k consecutive columns
+        seg_ids = jnp.arange(rk, dtype=jnp.int32) // k
+    seg_ids = seg_ids.astype(jnp.int32)
 
     def const(shape):
         return pl.BlockSpec(shape, lambda i, p, t: (0, 0),
@@ -367,6 +374,7 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
             pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
                          memory_space=pltpu.VMEM),
             const((1, rk)), const((rk, 1)),
+            const((rk, 1)), const((1, rk)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -385,7 +393,8 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
             pltpu.VMEM((rk, rk), jnp.float32),
         ],
         interpret=interpret,
-    )(a, frozen_cols, frozen_rows, wp, hp)
+    )(a, frozen_cols, frozen_rows, seg_ids.reshape(rk, 1),
+      seg_ids.reshape(1, rk), wp, hp)
 
 
 @functools.partial(jax.jit, static_argnames=(

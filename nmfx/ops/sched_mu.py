@@ -42,6 +42,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from nmfx.config import SolverConfig
@@ -69,6 +70,22 @@ def _stale_reload_fraction() -> float:
                  or 0)
 
 
+def _stale_load_mask(load, gather):
+    """Apply the stale-reload fault injection to a reload mask: drop the
+    factor write for a deterministic per-job subset (Knuth-hash of the
+    job id) while the caller's bookkeeping proceeds on the UNMASKED
+    flags. Single source of the injected failure signature for BOTH
+    reload paths (uniform ``reload`` and the ragged evict) — the
+    hardware gate's fault-injection proof depends on the two injecting
+    the identical fault class. Identity when the env hook is unset."""
+    stale_frac = _stale_reload_fraction()
+    if stale_frac <= 0:
+        return load
+    job_hash = (gather.astype(jnp.uint32) * jnp.uint32(2654435761)
+                & jnp.uint32((1 << 16) - 1))
+    return load & ~(job_hash < jnp.uint32(int(stale_frac * (1 << 16))))
+
+
 def _streams_bf16_a(cfg: SolverConfig) -> bool:
     """Whether the loop streams A as one-time-truncated bf16 (the MXU
     would round the GEMM operands to bf16 either way under this
@@ -94,6 +111,21 @@ def _pallas_block_geometry(m: int):
     tiles = ceil_div(m, 512)
     block_m = ceil_div(ceil_div(m, tiles), 16) * 16
     return tiles, block_m, tiles * block_m
+
+
+def _pallas_max_rk(m: int, n: int, cfg: SolverConfig) -> int:
+    """Largest packed column count the resident-W block kernel's VMEM
+    envelope admits at this shape (the inequality documented in
+    ``_pallas_slot_clamp``; shared by the uniform clamp and the ragged
+    pool's column budget)."""
+    _, block_m, m_pad = _pallas_block_geometry(m)
+    n_pad = -(-n // 128) * 128
+    a_bytes = 2 if _streams_bf16_a(cfg) else jnp.dtype(cfg.dtype).itemsize
+    budget = int(14.3 * 2**20) - 2 * block_m * n_pad * a_bytes
+    rk = 0
+    while 4 * (rk + 1) * (m_pad + 3 * n_pad + (rk + 1)) <= budget:
+        rk += 1
+    return rk
 
 
 def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
@@ -125,14 +157,8 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
     GEMMs), so any reduction below the requested pool is logged at
     WARNING.
     """
-    _, block_m, m_pad = _pallas_block_geometry(m)
-    n_pad = -(-n // 128) * 128
-    a_bytes = 2 if _streams_bf16_a(cfg) else jnp.dtype(cfg.dtype).itemsize
-    budget = int(14.3 * 2**20) - 2 * block_m * n_pad * a_bytes
-
     def fits(slots: int) -> bool:
-        rk = slots * k_max
-        return 4 * rk * (m_pad + 3 * n_pad + rk) <= budget
+        return slots * k_max <= _pallas_max_rk(m, n, cfg)
 
     if not fits(1):
         raise ValueError(
@@ -172,6 +198,300 @@ def _kl_slot_clamp(s: int, m: int, n: int, dtype) -> int:
     return clamped
 
 
+class _RaggedClass(NamedTuple):
+    """Static description of one rank class in the ragged pool."""
+    k: int  # true rank of this class's jobs
+    jobs: tuple  # global job indices, dispatch order
+    slots: int  # resident slots allocated to the class
+    off: int  # first packed column of the class's span
+
+
+def _ragged_iters_est(k: int) -> float:
+    """Expected class-stability stop iteration by rank — the empirical
+    north-star profile (BENCH_r04 mean_iters_per_k: flat ≈515 through
+    k=4, then ≈ k^1.45 growth; a naive k^1.5-everywhere model
+    mis-allocated the round-5 prototype 4× — see RESULTS.md round-5
+    ragged section). Only schedule QUALITY depends on this; results
+    never do."""
+    return 515.0 * max(1.0, k / 4.0) ** 1.45
+
+
+def _ragged_layout(job_ks: tuple, budget_cols: int) -> list:
+    """Partition a mixed-rank job list into rank classes and allocate
+    slots by GREEDY MINIMAX: start at one slot per class and repeatedly
+    give a slot to the class with the largest estimated remaining
+    makespan (jobs × expected iterations / slots), while
+    ``Σ slots_c·k_c ≤ budget_cols``.
+
+    Zero-padding waste is the uniform pool's structural cost: at the
+    north-star mix (k=2..10) only Σk/(|ks|·k_max) = 60% of its packed
+    columns are true columns, and padded columns burn GEMM cycles like
+    real ones. Class-blocked slots eliminate the padding entirely; the
+    while_loop's trip count is ``max_c trips_c`` (every trip advances
+    all classes), so the allocation target is equal per-class DRAIN
+    TIME — the classic multiprocessor-makespan shape, solved greedily
+    over integer slots (proportional-to-column-work allocation is the
+    continuous optimum but integer rounding at 1-2-slot classes
+    measured 4× worse; RESULTS.md round 5). The allocation only affects
+    SCHEDULE quality, never results: trajectories are per-job (each
+    job's columns see only its own lane of the batched GEMMs).
+    """
+    by_k: dict = {}
+    for i, k in enumerate(job_ks):
+        by_k.setdefault(int(k), []).append(i)
+    ks_desc = sorted(by_k, reverse=True)  # LPT flavor: widest first
+    if sum(k for k in ks_desc) > budget_cols:
+        raise ValueError(
+            f"ragged pool: one slot per rank class needs "
+            f"{sum(k for k in ks_desc)} columns, budget is {budget_cols} "
+            "(VMEM envelope); use backend='packed'")
+    load = {k: len(by_k[k]) * _ragged_iters_est(k) for k in ks_desc}
+    slots = {k: 1 for k in ks_desc}
+    while True:
+        spare = budget_cols - sum(slots[k] * k for k in ks_desc)
+        grow = [k for k in ks_desc
+                if slots[k] < len(by_k[k]) and k <= spare]
+        if not grow:
+            break
+        best = max(grow, key=lambda k: load[k] / slots[k])
+        slots[best] += 1
+    layout, off = [], 0
+    for k in ks_desc:
+        layout.append(_RaggedClass(k=k, jobs=tuple(by_k[k]),
+                                   slots=slots[k], off=off))
+        off += slots[k] * k
+    return layout
+
+
+class _RaggedState(NamedTuple):
+    """Per-class scheduler state for the ragged pool (tuples indexed by
+    class, static length; every class runs its own queue inside the one
+    while_loop — the shared kernel advances all classes together)."""
+    wp: jax.Array  # (m_pad, RK) packed columns, class-major
+    hp: jax.Array  # (RK, n)
+    slot_iter: tuple  # per class (S_c,) i32
+    classes: tuple  # per class (S_c, n) i32
+    stable: tuple  # per class (S_c,) i32
+    slot_job: tuple  # per class (S_c,) i32 — GLOBAL job ids
+    active: tuple  # per class (S_c,) bool
+    queue: tuple  # per class () i32 — next index into the class job list
+    n_trips: jax.Array  # () i32
+    n_lanes: jax.Array  # () i32 — live SLOTS summed over trips
+    out_w: jax.Array  # (J+1, m, k_max)
+    out_h: jax.Array
+    out_iters: jax.Array
+    out_stop: jax.Array
+
+
+def _make_ragged_stage(layout, a_loop, w0, h0, cfg: SolverConfig,
+                       kern_kw, vary, out0, *, m, m_pad, n, k_max, j,
+                       tw, drain_tail) -> "_RaggedState":
+    """Run the class-blocked main stage: one ``lax.while_loop`` whose
+    body advances EVERY class's slots through one
+    ``fused_block_iterations`` launch over the class-major packed
+    columns (per-column segment ids give each job its own Gram block —
+    no padding columns exist), then does per-class convergence
+    bookkeeping and per-class queue evict/reload under one global
+    ``lax.cond``. Runs until every queue drains and at most ``tw`` jobs
+    survive (``drain_tail``) or to completion. ``w0`` is the
+    (J, m_pad, k_max) zero-padded job store; per-class slices
+    ``[:, :, :k_c]`` are exact because padding is trailing."""
+    from nmfx.ops.pallas_mu import fused_block_iterations
+
+    ce = cfg.check_every
+    seg, slot_base = [], 0
+    for c in layout:
+        seg.append(np.repeat(np.arange(c.slots) + slot_base, c.k))
+        slot_base += c.slots
+    seg_ids = jnp.asarray(np.concatenate(seg).astype(np.int32))
+    sqrteps = jnp.sqrt(jnp.finfo(jnp.float32).eps)
+
+    def ratio(diff, ref):
+        return diff / (sqrteps + ref)
+
+    col_sl = {}
+    off = 0
+    for c in layout:
+        col_sl[c] = slice(off, off + c.slots * c.k)
+        off += c.slots * c.k
+
+    def init_state():
+        wseg, hseg = [], []
+        per = {f: [] for f in ("slot_iter", "classes", "stable",
+                               "slot_job", "active", "queue")}
+        for c in layout:
+            init_ids = jnp.asarray(c.jobs[:c.slots], jnp.int32)
+            wseg.append(jnp.transpose(w0[init_ids][:, :, :c.k],
+                                      (1, 0, 2)).reshape(m_pad, -1))
+            hseg.append(h0[init_ids][:, :c.k, :].reshape(-1, n))
+            per["slot_iter"].append(vary(jnp.zeros((c.slots,), jnp.int32)))
+            per["classes"].append(vary(jnp.full((c.slots, n), -1,
+                                                jnp.int32)))
+            per["stable"].append(vary(jnp.zeros((c.slots,), jnp.int32)))
+            per["slot_job"].append(vary(init_ids))
+            per["active"].append(vary(jnp.ones((c.slots,), bool)))
+            per["queue"].append(vary(jnp.asarray(c.slots, jnp.int32)))
+        return _RaggedState(
+            wp=jnp.concatenate(wseg, axis=1), hp=jnp.concatenate(hseg),
+            slot_iter=tuple(per["slot_iter"]),
+            classes=tuple(per["classes"]), stable=tuple(per["stable"]),
+            slot_job=tuple(per["slot_job"]), active=tuple(per["active"]),
+            queue=tuple(per["queue"]),
+            n_trips=vary(jnp.asarray(0, jnp.int32)),
+            n_lanes=vary(jnp.asarray(0, jnp.int32)), **out0)
+
+    def body(st: _RaggedState) -> _RaggedState:
+        fcol = jnp.concatenate([
+            jnp.repeat(~st.active[ci] | (st.slot_iter[ci] >= cfg.max_iter),
+                       c.k)
+            for ci, c in enumerate(layout)]).astype(jnp.float32)[None, :]
+        wp, hp, wd, wm, hd, hm = fused_block_iterations(
+            a_loop, st.wp, st.hp, fcol, k=k_max, iters=ce,
+            seg_ids=seg_ids, **kern_kw)
+
+        it_new, classes, stable, finished, reason = [], [], [], [], []
+        for ci, c in enumerate(layout):
+            sl = col_sl[c]
+            it_c = jnp.minimum(st.slot_iter[ci] + ce, cfg.max_iter)
+            delta_c = None
+            if cfg.use_tol_checks:
+                wd_c = jnp.max(wd[0, sl].reshape(c.slots, c.k), axis=1)
+                wm_c = jnp.max(wm[0, sl].reshape(c.slots, c.k), axis=1)
+                hd_c = jnp.max(hd[sl, 0].reshape(c.slots, c.k), axis=1)
+                hm_c = jnp.max(hm[sl, 0].reshape(c.slots, c.k), axis=1)
+                delta_c = jnp.maximum(ratio(wd_c, wm_c),
+                                      ratio(hd_c, hm_c))
+            labels_c = jnp.argmax(hp[sl].reshape(c.slots, c.k, n),
+                                  axis=1).astype(jnp.int32)
+            cls_c, stb_c, conv_c, _, rsn_c = batch_convergence(
+                cfg, it_c, new_classes=labels_c, delta=delta_c,
+                n_glob=n, classes=st.classes[ci], stable=st.stable[ci],
+                done=~st.active[ci],
+                done_iter=jnp.zeros_like(it_c),
+                stop_reason=jnp.full_like(it_c, base.StopReason.MAX_ITER))
+            it_new.append(it_c)
+            classes.append(cls_c)
+            stable.append(stb_c)
+            reason.append(rsn_c)
+            finished.append(st.active[ci]
+                            & (conv_c | (it_c >= cfg.max_iter)))
+
+        def evict_reload(ops):
+            wp, hp, out_w, out_h, out_iters, out_stop, slot_job, active, \
+                queue = ops
+            slot_job, active, queue = (list(slot_job), list(active),
+                                       list(queue))
+            for ci, c in enumerate(layout):
+                sl = col_sl[c]
+                fin = finished[ci]
+                w3 = wp[:, sl].reshape(m_pad, c.slots, c.k)
+                wdense = jnp.pad(jnp.transpose(w3, (1, 0, 2))[:, :m, :],
+                                 ((0, 0), (0, 0), (0, k_max - c.k)))
+                h3 = hp[sl].reshape(c.slots, c.k, n)
+                hdense = jnp.pad(h3, ((0, 0), (0, k_max - c.k), (0, 0)))
+                idx = jnp.where(fin, slot_job[ci], j)
+                out_w = out_w.at[idx].set(wdense)
+                out_h = out_h.at[idx].set(hdense)
+                out_iters = out_iters.at[idx].set(it_new[ci])
+                out_stop = out_stop.at[idx].set(reason[ci])
+                # per-class prefix-sum claim of the class's queued jobs
+                claim = jnp.cumsum(fin, dtype=jnp.int32)
+                new_pos = queue[ci] + claim - 1
+                load_book = fin & (new_pos < len(c.jobs))
+                jobs_c = jnp.asarray(c.jobs, jnp.int32)
+                gids = jobs_c[jnp.where(load_book, new_pos, 0)]
+                # fault-injection hook shared with the uniform reload
+                # (identity when unset) — the gate's boundary stage can
+                # route through THIS path for mixed-rank jobs
+                load = _stale_load_mask(load_book, gids)
+                wg = jnp.transpose(w0[gids][:, :, :c.k], (1, 0, 2))
+                w3 = jnp.where(load[None, :, None], wg, w3)
+                wp = wp.at[:, sl].set(w3.reshape(m_pad, -1))
+                hg = h0[gids][:, :c.k, :]
+                h3 = jnp.where(load[:, None, None], hg, h3)
+                hp = hp.at[sl].set(h3.reshape(-1, n))
+                slot_job[ci] = jnp.where(load_book, jobs_c[
+                    jnp.where(load_book, new_pos, 0)],
+                    jnp.where(fin, j, slot_job[ci]))
+                active[ci] = jnp.where(fin, load_book, active[ci])
+                queue[ci] = queue[ci] + jnp.sum(load_book,
+                                                dtype=jnp.int32)
+            return (wp, hp, out_w, out_h, out_iters, out_stop,
+                    tuple(slot_job), tuple(active), tuple(queue))
+
+        any_fin = jnp.any(jnp.concatenate(finished))
+        ops = (wp, hp, st.out_w, st.out_h, st.out_iters, st.out_stop,
+               st.slot_job, st.active, st.queue)
+        (wp, hp, out_w, out_h, out_iters, out_stop, slot_job, active,
+         queue) = lax.cond(any_fin, evict_reload, lambda ops: ops, ops)
+        return _RaggedState(
+            wp=wp, hp=hp,
+            slot_iter=tuple(jnp.where(finished[ci], 0, it_new[ci])
+                            for ci in range(len(layout))),
+            classes=tuple(jnp.where(finished[ci][:, None], -1,
+                                    classes[ci])
+                          for ci in range(len(layout))),
+            stable=tuple(jnp.where(finished[ci], 0, stable[ci])
+                         for ci in range(len(layout))),
+            slot_job=slot_job, active=active, queue=queue,
+            n_trips=st.n_trips + 1,
+            n_lanes=st.n_lanes + sum(
+                jnp.sum(a_c, dtype=jnp.int32) for a_c in st.active),
+            out_w=out_w, out_h=out_h, out_iters=out_iters,
+            out_stop=out_stop)
+
+    def cond(st: _RaggedState):
+        any_active = jnp.any(jnp.concatenate(st.active))
+        if not drain_tail:
+            return any_active
+        live = sum(jnp.sum(a_c, dtype=jnp.int32) for a_c in st.active)
+        pending = jnp.stack([
+            st.queue[ci] < len(c.jobs)
+            for ci, c in enumerate(layout)]).any()
+        return any_active & (pending | (live > tw))
+
+    return lax.while_loop(cond, body, init_state())
+
+
+def _ragged_to_uniform(st_r: "_RaggedState", layout, tw, *, m_pad, n,
+                       k_max, j, dtype) -> "SchedState":
+    """Gather the ragged stage's survivors into a ``tw``-slot uniform
+    k_max-padded pool positioned for the standard tail loop: per-class
+    spans → dense (S_c, m_pad, k_c) views → zero-padded to k_max →
+    global stable gather of the live slots. Queues are drained by the
+    ragged stage's condition, so the uniform queue starts empty
+    (``queue = j`` — no further loads)."""
+    wdense, hdense = [], []
+    off = 0
+    for c in layout:
+        sl = slice(off, off + c.slots * c.k)
+        off += c.slots * c.k
+        w3 = st_r.wp[:, sl].reshape(m_pad, c.slots, c.k)
+        wdense.append(jnp.pad(jnp.transpose(w3, (1, 0, 2)),
+                              ((0, 0), (0, 0), (0, k_max - c.k))))
+        hdense.append(jnp.pad(st_r.hp[sl].reshape(c.slots, c.k, n),
+                              ((0, 0), (0, k_max - c.k), (0, 0))))
+    wdense = jnp.concatenate(wdense)  # (S_total, m_pad, k_max)
+    hdense = jnp.concatenate(hdense)
+    active = jnp.concatenate(st_r.active)
+    order = jnp.argsort(~active, stable=True)[:tw]
+    wp = jnp.transpose(wdense[order], (1, 0, 2)).reshape(m_pad, -1)
+    hp = hdense[order].reshape(-1, n)
+    return SchedState(
+        wp=wp, hp=hp,
+        slot_iter=jnp.concatenate(st_r.slot_iter)[order],
+        classes=jnp.concatenate(st_r.classes)[order],
+        stable=jnp.concatenate(st_r.stable)[order],
+        dnorm=jnp.full((tw,), jnp.inf, dtype),
+        slot_job=jnp.concatenate(st_r.slot_job)[order],
+        active=active[order],
+        pending=jnp.zeros((tw,), bool),
+        queue=jnp.asarray(j, jnp.int32),
+        n_trips=st_r.n_trips, n_lanes=st_r.n_lanes,
+        out_w=st_r.out_w, out_h=st_r.out_h,
+        out_iters=st_r.out_iters, out_stop=st_r.out_stop)
+
+
 class SchedState(NamedTuple):
     # slot-resident solver state (no cross-block w_prev/h_prev: the TolX
     # delta is between the block's last two steps, both inside `body`)
@@ -184,6 +504,7 @@ class SchedState(NamedTuple):
     # scheduler state
     slot_job: jax.Array  # (S,) i32 — job index resident in each slot
     active: jax.Array  # (S,) bool — slot holds a live job
+    pending: jax.Array  # (S,) bool — finished, factors not yet harvested
     queue: jax.Array  # () i32 — next job index to load
     # occupancy diagnostics (cumulative across stages; per-stage values
     # recovered by differencing at stage boundaries)
@@ -250,12 +571,16 @@ _AUTO_TAIL_SLOTS = (8,)
 
 
 @partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes",
-                                  "tail_slots"))
+                                  "tail_slots", "job_ks", "ragged",
+                                  "evict_batch"))
 def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              cfg: SolverConfig = SolverConfig(),
              slots: int = 48,
              varying_axes: tuple[str, ...] = (),
              tail_slots: "int | None | str | tuple[int, ...]" = "auto",
+             job_ks: "tuple[int, ...] | None" = None,
+             ragged: "bool | None" = None,
+             evict_batch: int = 1,
              ) -> SchedMUResult:
     """Solve J dense zero-padded jobs through an S-slot scheduler.
 
@@ -287,6 +612,24 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     float-tolerance level any width change produces (a near-tie label or
     TolX delta could in principle flip a stop iteration on hardware).
     Must be hashable (tuple, not list) — it keys the jit cache.
+
+    ``job_ks``: per-job true ranks (static tuple). Enables the exact
+    snmf coupling mask (``grid_mu.pad_live_mask``) and unlocks the
+    RAGGED class-blocked pool on the pallas block-kernel route.
+    ``ragged``: None/False = uniform pool (the default — the measured
+    round-5 verdict; see the comment at the resolution site and
+    RESULTS.md's ragged section); True = opt in (requires pallas +
+    job_ks + block-aligned max_iter). The ragged pool allocates each
+    rank class variable-width slots (``_ragged_layout``) so NO packed
+    column is padding — the uniform pool burns k_max−k zero columns per
+    job, ~40% of its GEMM work at the north-star mix — then hands the
+    ≤8 surviving stragglers to the standard uniform tail; it measured
+    NET SLOWER at the north star (tail triples, per-trip class
+    bookkeeping ~1.5×), which is why it is not the default. Per-job
+    trajectories and stop decisions match the uniform pool to the same
+    float tolerance as any width change. ``evict_batch``: harvest
+    hysteresis (see ``harvest``); recorded per-job results are
+    invariant, default 1 (measured no clear win).
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
@@ -303,7 +646,21 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     j, m, k_max = w0.shape
     n = h0.shape[2]
     s = min(slots, j)
-    if use_pallas:
+    ce_ok = cfg.max_iter % cfg.check_every == 0
+    if ragged and not (use_pallas and ce_ok and job_ks is not None):
+        raise ValueError(
+            "ragged=True needs backend='pallas', job_ks, and max_iter a "
+            "multiple of check_every (the block-kernel route)")
+    # ragged default: OFF. Measured round 5 (benchmarks/probe_ragged_ab,
+    # same-session min-of-5): the class-blocked pool cut main-stage trips
+    # 4687 → 4129 as designed, but its straggler tail tripled (balanced
+    # classes leave no deep straggler to keep the wide stage alive while
+    # late-dispatched jobs catch up) and the 9-class unrolled
+    # bookkeeping/evict body costs ~1.5× per trip — net 1.74 s vs the
+    # uniform pool's 1.32 s at the north star. Kept as an opt-in for
+    # mixes where padding waste is extreme (k_max >> typical k).
+    use_ragged = False if ragged is None else bool(ragged)
+    if use_pallas and not use_ragged:
         s = _pallas_slot_clamp(s, k_max, m, n, cfg)
     if cfg.algorithm == "kl":
         s = _kl_slot_clamp(s, m, n, dtype)
@@ -331,13 +688,14 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             the per-step max_iter fence, prev snapshot before the last
             step, and the layout-specific TolX delta — shared by the dense
             path and the pallas per-iteration fallback so the fence/delta
-            semantics cannot diverge."""
-            def do_block(wp, hp, active, slot_iter):
+            semantics cannot diverge. ``slot_job`` rides along for blocks
+            with per-job auxiliaries (snmf's padding mask)."""
+            def do_block(wp, hp, active, slot_iter, slot_job):
                 for i in range(ce):
                     frozen = ~active | (slot_iter + i >= cfg.max_iter)
                     if i == ce - 1:
                         wprev, hprev = wp, hp
-                    wp, hp = step_fn(wp, hp, frozen)
+                    wp, hp = step_fn(wp, hp, frozen, slot_job)
                 return wp, hp, delta_fn(wp, hp, wprev, hprev)
 
             return do_block
@@ -382,7 +740,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                     # no per-step mask here: slot_iter is always a
                     # multiple of check_every, so a slot crosses the cap
                     # only at a block boundary.
-                    def do_block(wp, hp, active, slot_iter):
+                    def do_block(wp, hp, active, slot_iter, slot_job):
+                        del slot_job  # mu-only path: no per-job auxiliaries
                         frozen = ~active | (slot_iter >= cfg.max_iter)
                         fcol = jnp.repeat(frozen, k_max).astype(
                             jnp.float32)[None, :]
@@ -402,7 +761,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
                 bd = block_diag_mask(width, k_max, dtype)
 
-                def _one_step(wp, hp, frozen):
+                def _one_step(wp, hp, frozen, slot_job):
+                    del slot_job  # mu-only path: no per-job auxiliaries
                     frozen_col = jnp.repeat(frozen, k_max)
                     hn = fused_h_update(a_loop, wp, hp, k=k_max, **kern_kw)
                     hn = jnp.where(frozen_col[:, None], hp, hn)
@@ -435,19 +795,12 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 return wd, hp.reshape(-1, k_max, n)
 
             def reload(wp, hp, load, gather):
-                stale_frac = _stale_reload_fraction()
-                if stale_frac > 0:
-                    # fault injection (see _stale_reload_fraction): drop
-                    # the factor write for a deterministic per-job subset
-                    # of reloads; the caller's bookkeeping still marks
-                    # the new job as loaded — factors go stale exactly
-                    # as in the round-3 aliasing bug
-                    job_hash = (gather.astype(jnp.uint32)
-                                * jnp.uint32(2654435761)
-                                & jnp.uint32((1 << 16) - 1))
-                    stale = job_hash < jnp.uint32(
-                        int(stale_frac * (1 << 16)))
-                    load = load & ~stale
+                # fault-injection hook (identity when unset): drop the
+                # factor write for a deterministic per-job subset of
+                # reloads while the caller's bookkeeping still marks the
+                # new job as loaded — factors go stale exactly as in the
+                # round-3 aliasing bug (_stale_load_mask)
+                load = _stale_load_mask(load, gather)
                 w3 = wp.reshape(m_pad, -1, k_max)
                 wg = jnp.transpose(w0[gather], (1, 0, 2))  # (m_pad, s, k)
                 w3 = jnp.where(load[None, :, None], wg, w3)
@@ -462,6 +815,24 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 return w3.reshape(m_pad, -1), h3.reshape(-1, n)
         else:
             block = make_block(cfg, a)
+            if cfg.algorithm == "snmf":
+                # per-job true-k padding masks (snmf_block /
+                # grid_mu.pad_live_mask — exact when the caller passes
+                # job_ks); row j is the drop target for finished slots —
+                # all-False, and its lane is frozen
+                from nmfx.ops.grid_mu import pad_live_mask
+
+                pad_jobs = jnp.concatenate(
+                    [pad_live_mask(w0, h0, job_ks),
+                     jnp.zeros((1, k_max), bool)])
+
+                def step_fn(wp, hp, frozen, slot_job):
+                    return block(a_loop, wp, hp, frozen, cfg,
+                                 pad_live=pad_jobs[slot_job])
+            else:
+                def step_fn(wp, hp, frozen, slot_job):
+                    del slot_job
+                    return block(a_loop, wp, hp, frozen, cfg)
 
             def init_slots():
                 return w0[:s], h0[:s]
@@ -475,10 +846,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
             def make_do_block(width):
                 del width  # the dense blocks are batch-width-free
-                return stepped_block(
-                    lambda wp, hp, frozen: block(a_loop, wp, hp, frozen,
-                                                 cfg),
-                    dense_deltas)
+                return stepped_block(step_fn, dense_deltas)
 
             def slot_labels(hp):
                 return jnp.argmax(hp, axis=1).astype(jnp.int32)
@@ -494,18 +862,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             def gather_slots(wp, hp, order):
                 return wp[order], hp[order]
 
-        wp0, hp0 = init_slots()
-        state0 = SchedState(
-            wp=wp0, hp=hp0,
-            slot_iter=vary(jnp.zeros((s,), jnp.int32)),
-            classes=vary(jnp.full((s, n), -1, jnp.int32)),
-            stable=vary(jnp.zeros((s,), jnp.int32)),
-            dnorm=vary(jnp.full((s,), jnp.inf, dtype)),
-            slot_job=vary(jnp.arange(s, dtype=jnp.int32)),
-            active=vary(jnp.ones((s,), bool)),
-            queue=vary(jnp.asarray(s, jnp.int32)),
-            n_trips=vary(jnp.asarray(0, jnp.int32)),
-            n_lanes=vary(jnp.asarray(0, jnp.int32)),
+        out0 = dict(
             out_w=vary(jnp.zeros((j + 1, m, k_max), dtype)),
             out_h=vary(jnp.zeros((j + 1, k_max, n), dtype)),
             out_iters=vary(jnp.zeros((j + 1,), jnp.int32)),
@@ -513,12 +870,49 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                                    jnp.int32)),
         )
 
+        def harvest(st: SchedState) -> SchedState:
+            """Scatter every PENDING slot's converged factors into the
+            result buffers and reload queued jobs into those slots — the
+            heavy half of eviction (dense-view transpose, (J+1, m,
+            k_max) scatters, W0/H0 gathers), batched behind the
+            ``evict_batch`` hysteresis. Iteration counts/stop reasons
+            were already recorded at finish time (cheap small scatters),
+            so delaying the harvest never changes recorded results —
+            only WHEN successor jobs start."""
+            wdv, hdv = dense_views(st.wp, st.hp)
+            idx = jnp.where(st.pending, st.slot_job, j)  # j = drop row
+            out_w = st.out_w.at[idx].set(wdv)
+            out_h = st.out_h.at[idx].set(hdv)
+            # prefix-sum claim of the next queued jobs (dtypes pinned to
+            # int32: under jax_enable_x64 jnp.sum/cumsum would otherwise
+            # promote to int64 and break the lax.cond's
+            # equal-output-types contract with the no-harvest branch)
+            claim = jnp.cumsum(st.pending, dtype=jnp.int32)
+            new_job = st.queue + claim - 1
+            load = st.pending & (new_job < j)
+            gather = jnp.where(load, new_job, st.slot_job)
+            wp, hp = reload(st.wp, st.hp, load, gather)
+            slot_job = jnp.where(load, new_job,
+                                 jnp.where(st.pending, j, st.slot_job))
+            active = st.active | load
+            queue = st.queue + jnp.sum(load, dtype=jnp.int32)
+            return st._replace(wp=wp, hp=hp, out_w=out_w, out_h=out_h,
+                               slot_job=slot_job, active=active,
+                               pending=jnp.zeros_like(st.pending),
+                               queue=queue)
+
+        def maybe_harvest(st: SchedState) -> SchedState:
+            """Unconditional-call form for stage boundaries: a stage can
+            exit with 0 < pending < evict_batch, and the compaction
+            gather would drop un-harvested factors."""
+            return lax.cond(jnp.any(st.pending), harvest, lambda s: s, st)
+
         def make_body(do_block):
             def body(st: SchedState) -> SchedState:
                 # --- one check block: check_every solver iterations with
                 # the per-slot max_iter fence, returning the TolX delta --
                 wp, hp, delta = do_block(st.wp, st.hp, st.active,
-                                         st.slot_iter)
+                                         st.slot_iter, st.slot_job)
                 it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
                 if not cfg.use_tol_checks:
                     delta = None
@@ -539,58 +933,48 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 # stops
                 finished = st.active & (conv | (it_new >= cfg.max_iter))
 
-                # --- evict + reload, under lax.cond: the vast majority
-                # of check blocks finish NO job, and inside a
-                # (non-vmapped) while_loop body the cond is a real branch
-                # — the result-buffer scatters, W0/H0 gathers, factor
-                # rewrites (and, on the packed layout, the dense-view
-                # transpose) are skipped entirely on no-evict blocks
-                # instead of running as masked no-ops every 2 iterations
-                def evict_reload(ops):
-                    wp, hp, out_w, out_h, out_iters, out_stop, slot_job, \
-                        active, queue = ops
-                    wdv, hdv = dense_views(wp, hp)
-                    idx = jnp.where(finished, slot_job, j)  # j = drop row
-                    out_w = out_w.at[idx].set(wdv)
-                    out_h = out_h.at[idx].set(hdv)
-                    out_iters = out_iters.at[idx].set(it_new)
-                    out_stop = out_stop.at[idx].set(reason)
-                    # prefix-sum claim of the next queued jobs (dtypes
-                    # pinned to int32: under jax_enable_x64
-                    # jnp.sum/cumsum would otherwise promote to int64 and
-                    # break the lax.cond's equal-output-types contract
-                    # with the no-evict branch)
-                    claim = jnp.cumsum(finished, dtype=jnp.int32)
-                    new_job = queue + claim - 1
-                    load = finished & (new_job < j)
-                    gather = jnp.where(load, new_job, slot_job)
-                    wp, hp = reload(wp, hp, load, gather)
-                    slot_job = jnp.where(load, new_job,
-                                         jnp.where(finished, j, slot_job))
-                    active = jnp.where(finished, load, active)
-                    queue = queue + jnp.sum(load, dtype=jnp.int32)
-                    return (wp, hp, out_w, out_h, out_iters, out_stop,
-                            slot_job, active, queue)
-
-                ops = (wp, hp, st.out_w, st.out_h, st.out_iters,
-                       st.out_stop, st.slot_job, st.active, st.queue)
-                (wp, hp, out_w, out_h, out_iters, out_stop, slot_job,
-                 active, queue) = lax.cond(jnp.any(finished), evict_reload,
-                                           lambda ops: ops, ops)
-                fresh_or_done = finished
-                return SchedState(
+                # record the CHEAP per-job outcomes immediately (tiny
+                # (J+1,) integer scatters — iteration counts and stop
+                # reasons are exact regardless of when the factors are
+                # harvested); the slot freezes (inactive+pending) with
+                # its converged factors in place
+                idx_f = jnp.where(finished, st.slot_job, j)
+                out_iters = st.out_iters.at[idx_f].set(it_new)
+                out_stop = st.out_stop.at[idx_f].set(reason)
+                pending = st.pending | finished
+                active = st.active & ~finished
+                st = st._replace(
                     wp=wp, hp=hp,
-                    slot_iter=jnp.where(fresh_or_done, 0, it_new),
-                    classes=jnp.where(fresh_or_done[:, None], -1, classes),
-                    stable=jnp.where(fresh_or_done, 0, stable),
-                    dnorm=jnp.where(fresh_or_done, jnp.inf, dnorm),
-                    slot_job=slot_job, active=active, queue=queue,
+                    # inactive slots hold their counter: a pending slot
+                    # waits frozen at 0 until harvest, so its successor
+                    # job starts at iteration 0 no matter how long the
+                    # evict_batch hysteresis delayed the reload
+                    slot_iter=jnp.where(
+                        finished, 0,
+                        jnp.where(st.active, it_new, st.slot_iter)),
+                    classes=jnp.where(finished[:, None], -1, classes),
+                    stable=jnp.where(finished, 0, stable),
+                    dnorm=jnp.where(finished, jnp.inf, dnorm),
+                    active=active, pending=pending,
                     n_trips=st.n_trips + 1,
                     n_lanes=st.n_lanes + jnp.sum(st.active,
                                                  dtype=jnp.int32),
-                    out_w=out_w, out_h=out_h, out_iters=out_iters,
-                    out_stop=out_stop,
-                )
+                    out_iters=out_iters, out_stop=out_stop)
+
+                # --- harvest + reload, under lax.cond: the vast
+                # majority of check blocks finish NO job, and inside a
+                # (non-vmapped) while_loop body the cond is a real
+                # branch. evict_batch > 1 additionally batches
+                # completions: a finished slot idles frozen until
+                # enough peers finish (or nothing else runs), cutting
+                # the heavy branch's firing rate ~evict_batch× for a
+                # few idle slot-trips of queue delay
+                fire = (jnp.sum(pending, dtype=jnp.int32)
+                        >= jnp.minimum(evict_batch,
+                                       jnp.sum(pending | active,
+                                               dtype=jnp.int32)))
+                return lax.cond(fire & jnp.any(pending), harvest,
+                                lambda s: s, st)
 
             return body
 
@@ -620,29 +1004,71 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 dnorm=st.dnorm[order],
                 slot_job=st.slot_job[order],
                 active=st.active[order],
+                pending=st.pending[order],
                 queue=st.queue,
                 n_trips=st.n_trips, n_lanes=st.n_lanes,
                 out_w=st.out_w, out_h=st.out_h,
                 out_iters=st.out_iters, out_stop=st.out_stop,
             )
 
-        st = state0
-        body = make_body(make_do_block(s))
-        stage_widths = [s]
-        stage_marks = []  # cumulative (n_trips, n_lanes) at stage ends
-        for width in _resolve_tail(tail_slots, s):
-            def stage_cond(st, width=width):
-                live = jnp.sum(st.active, dtype=jnp.int32)
-                return jnp.any(st.active) & (
-                    (st.queue < j) | (live > width))
+        if use_ragged:
+            # --- ragged main stage: class-blocked variable-width pool —
+            # zero padding columns; the uniform machinery takes over for
+            # the straggler tail (survivors gathered into a narrow
+            # k_max-padded pool, where padding costs ~nothing at width 8)
+            # column budget: the VMEM envelope, capped by the user's
+            # slot knob in column units (grid_slots=48 × k_max=10 ≡ the
+            # uniform pool's 480-column optimum at the north star)
+            layout = _ragged_layout(
+                job_ks, min(_pallas_max_rk(m, n, cfg), s * k_max))
+            s_total = sum(c.slots for c in layout)
+            tail_w = _resolve_tail(tail_slots, s_total)
+            tw = tail_w[-1] if tail_w else 1
+            st_r = _make_ragged_stage(
+                layout, a_loop, w0, h0, cfg, kern_kw, vary, out0,
+                m=m, m_pad=m_pad, n=n, k_max=k_max, j=j, tw=tw,
+                drain_tail=bool(tail_w))
+            stage_widths = [s_total, tw]
+            stage_marks = [(st_r.n_trips, st_r.n_lanes)]
+            st = _ragged_to_uniform(st_r, layout, tw, m_pad=m_pad, n=n,
+                                    k_max=k_max, j=j, dtype=dtype)
+            final = lax.while_loop(lambda st: jnp.any(st.active),
+                                   make_body(make_do_block(tw)), st)
+            stage_marks.append((final.n_trips, final.n_lanes))
+        else:
+            wp0, hp0 = init_slots()
+            st = SchedState(
+                wp=wp0, hp=hp0,
+                slot_iter=vary(jnp.zeros((s,), jnp.int32)),
+                classes=vary(jnp.full((s, n), -1, jnp.int32)),
+                stable=vary(jnp.zeros((s,), jnp.int32)),
+                dnorm=vary(jnp.full((s,), jnp.inf, dtype)),
+                slot_job=vary(jnp.arange(s, dtype=jnp.int32)),
+                active=vary(jnp.ones((s,), bool)),
+                pending=vary(jnp.zeros((s,), bool)),
+                queue=vary(jnp.asarray(s, jnp.int32)),
+                n_trips=vary(jnp.asarray(0, jnp.int32)),
+                n_lanes=vary(jnp.asarray(0, jnp.int32)),
+                **out0,
+            )
+            body = make_body(make_do_block(s))
+            stage_widths = [s]
+            stage_marks = []  # cumulative (trips, lanes) at stage ends
+            for width in _resolve_tail(tail_slots, s):
+                def stage_cond(st, width=width):
+                    live = jnp.sum(st.active | st.pending,
+                                   dtype=jnp.int32)
+                    return (jnp.any(st.active) | jnp.any(st.pending)) & (
+                        (st.queue < j) | (live > width))
 
-            st = lax.while_loop(stage_cond, body, st)
-            stage_marks.append((st.n_trips, st.n_lanes))
-            st = compact(st, width)
-            stage_widths.append(width)
-            body = make_body(make_do_block(width))
-        final = lax.while_loop(lambda st: jnp.any(st.active), body, st)
-        stage_marks.append((final.n_trips, final.n_lanes))
+                st = maybe_harvest(lax.while_loop(stage_cond, body, st))
+                stage_marks.append((st.n_trips, st.n_lanes))
+                st = compact(st, width)
+                stage_widths.append(width)
+                body = make_body(make_do_block(width))
+            final = maybe_harvest(
+                lax.while_loop(lambda st: jnp.any(st.active), body, st))
+            stage_marks.append((final.n_trips, final.n_lanes))
         # cumulative marks → per-stage trip/lane counts
         trips = jnp.stack([t for t, _ in stage_marks])
         lanes = jnp.stack([l for _, l in stage_marks])

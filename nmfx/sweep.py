@@ -725,7 +725,9 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
                 for k in ks]
             w0, h0 = _init_lanes(a, rank_keys)
             res = mu_sched(a, w0, h0, solver_cfg, slots=slots,
-                           tail_slots=tail_slots)
+                           tail_slots=tail_slots,
+                           job_ks=tuple(k for k in ks
+                                        for _ in range(padded)))
             out: dict[int, KSweepOutput] = {}
             for g, k in enumerate(ks):
                 sl = slice(g * padded, g * padded + restarts)
@@ -750,7 +752,9 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
         rank_keys = [(k, keys[g]) for g, k in enumerate(ks)]
         w0, h0 = _init_lanes(a, rank_keys)
         res = mu_sched(a, w0, h0, solver_cfg, slots=slots,
-                       varying_axes=(RESTART_AXIS,), tail_slots=tail_slots)
+                       varying_axes=(RESTART_AXIS,), tail_slots=tail_slots,
+                       job_ks=tuple(k for k in ks
+                                    for _ in range(r_local)))
         gidx = (lax.axis_index(RESTART_AXIS) * r_local
                 + jnp.arange(r_local))
         valid = gidx < restarts

@@ -279,3 +279,50 @@ def test_grid_resume_solves_only_missing_ranks(data, tmp_path):
     np.testing.assert_allclose(np.asarray(resumed[KS[2]].consensus),
                                np.asarray(fresh[KS[2]].consensus),
                                atol=1e-6)
+
+
+def test_snmf_dead_component_parity():
+    """snmf engines agree even when W columns genuinely DIE mid-solve —
+    the case sparse NMF actively encourages at k above the data's
+    structure (VERDICT r4 Weak #6 / ADVICE r4). The grid block masks the
+    beta L1 coupling by PADDING (each lane's true-k columns, from the
+    initial factors), not by nonzero-W: a round-5 measurement of the
+    nonzero-W mask showed the engines diverging to max|dC|=1.0 once
+    components died (dead components dropped from the coupling change
+    the LIVE components' solves), while the padding mask keeps the dead
+    row in the k x k ones system exactly like the per-restart form
+    (solvers/snmf.py)."""
+    a = grouped_matrix(120, (10, 10), effect=2.0, seed=0)  # 2 real groups
+    ks = (4, 5)  # above the structure: components die under sparsity
+    found_death = False
+    for beta in (0.5, 8.0):
+        scfg_v = SolverConfig(algorithm="snmf", backend="vmap",
+                              max_iter=400, sparsity_beta=beta)
+        scfg_g = SolverConfig(algorithm="snmf", backend="packed",
+                              max_iter=400, sparsity_beta=beta)
+        cc = dict(ks=ks, restarts=4)
+        v = sweep(a, ConsensusConfig(grid_exec="per_k", keep_factors=True,
+                                     **cc), scfg_v, InitConfig())
+        g = sweep(a, ConsensusConfig(grid_exec="grid", keep_factors=True,
+                                     **cc), scfg_g, InitConfig())
+        for k in ks:
+            wv = np.asarray(v[k].all_w)
+            wg = np.asarray(g[k].all_w)
+            dead_v = int((np.abs(wv).sum(axis=1) == 0).sum())
+            dead_g = int((np.abs(wg).sum(axis=1) == 0).sum())
+            # engines must kill the SAME components...
+            assert dead_v == dead_g, (beta, k, dead_v, dead_g)
+            found_death = found_death or dead_v > 0
+            # ...and produce the same consensus and stop decisions
+            np.testing.assert_allclose(np.asarray(g[k].consensus),
+                                       np.asarray(v[k].consensus),
+                                       atol=1e-6)
+            np.testing.assert_array_equal(np.asarray(g[k].labels),
+                                          np.asarray(v[k].labels))
+            dit = np.abs(np.asarray(g[k].iterations)
+                         - np.asarray(v[k].iterations))
+            # float-tolerance trajectory drift may move a stop by one
+            # check (2 iterations); anything more is semantic divergence
+            assert dit.max() <= 2, (beta, k, dit)
+    # the fixture must actually exercise the divergence-prone case
+    assert found_death, "no component ever died; fixture too easy"

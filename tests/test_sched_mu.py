@@ -183,3 +183,62 @@ def test_unblocked_algorithm_rejected(jobs):
     a, w0, h0 = jobs
     with pytest.raises(ValueError, match="scheduler"):
         mu_sched(a, w0, h0, SolverConfig(algorithm="pg"))
+
+
+JOB_KS = tuple(k for k in KS for _ in range(R))
+
+
+@pytest.mark.parametrize("backend", ["auto", "pallas"])
+def test_evict_batch_is_schedule_only(jobs, backend):
+    """evict_batch (round-5 harvest hysteresis) batches the heavy half
+    of eviction behind pending slots; recorded per-job results must be
+    EXACTLY invariant — the prototype leaked the pending slots'
+    iteration counters into their successors (reload started at the
+    waited-trips count) and this is the regression lock. On hardware,
+    reload timing shifts column positions and Mosaic drift can move
+    stops a few percent (benign, gate-covered); on CPU the runs are
+    bit-identical."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(algorithm="mu", backend=backend, max_iter=600)
+    base = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS)
+    for eb in (4, 8):
+        r = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS,
+                     evict_batch=eb)
+        np.testing.assert_array_equal(np.asarray(base.iterations),
+                                      np.asarray(r.iterations))
+        np.testing.assert_array_equal(np.asarray(base.stop_reason),
+                                      np.asarray(r.stop_reason))
+        np.testing.assert_array_equal(np.asarray(base.w),
+                                      np.asarray(r.w))
+        np.testing.assert_array_equal(np.asarray(base.h),
+                                      np.asarray(r.h))
+
+
+def test_ragged_pool_matches_uniform(jobs):
+    """The opt-in ragged class-blocked pool (mu_sched(ragged=True)) must
+    reproduce the uniform pool's per-job stop decisions exactly and its
+    factors to float tolerance — trajectories are per-job, only the
+    schedule (and GEMM tiling) changes. Exercises mixed-rank classes,
+    per-class queues with reloads (slots < jobs), the tail handover,
+    and composition with evict_batch."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600)
+    base = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS)
+    for eb in (1, 8):
+        r = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS, ragged=True,
+                     evict_batch=eb)
+        np.testing.assert_array_equal(np.asarray(base.iterations),
+                                      np.asarray(r.iterations))
+        np.testing.assert_array_equal(np.asarray(base.stop_reason),
+                                      np.asarray(r.stop_reason))
+        np.testing.assert_allclose(np.asarray(base.w), np.asarray(r.w),
+                                   rtol=2e-4, atol=5e-5)
+        np.testing.assert_allclose(np.asarray(base.h), np.asarray(r.h),
+                                   rtol=2e-4, atol=5e-5)
+    # the ragged stage's occupancy diagnostics: a main stage at the
+    # class-blocked width plus the uniform tail
+    assert np.asarray(r.pool_widths).shape[0] == 2
+    with pytest.raises(ValueError, match="ragged"):
+        mu_sched(a, w0, h0, SolverConfig(algorithm="mu", backend="auto",
+                                         max_iter=600),
+                 slots=6, job_ks=JOB_KS, ragged=True)
