@@ -1,0 +1,126 @@
+"""bf16-factor-storage pool A/B: the round-5 'wide pool' experiment.
+
+The round-5 decomposition left one kernel-level lever: storing the slot
+pool's W/H as bf16 halves the W round-trip per check block AND fits
+~1.5× more packed columns in the VMEM envelope (wider GEMMs, fewer
+trips). Unlike bf16 A-streaming this is a REAL numerics change — each
+block store quantizes the factor state (~0.4% relative), the class
+counters see noisier labels, and iterations can grow (+18% measured on
+the tiny CPU fixture). This probe measures whether width wins at the
+north star, separating the two effects:
+
+* f32-48: the shipping pool (rk=480)
+* bf16-48: storage effect only (same width)
+* bf16-wide: storage + width (the envelope's bf16 maximum)
+
+plus per-k iteration ratios and consensus drift vs f32-48 (labels →
+consensus per rank from the returned factors, restart-normalized
+mean|ΔC| as in the verify gate).
+
+Usage: PYTHONPATH=. python benchmarks/probe_bf16_pool.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.consensus import consensus_matrix, labels_from_h
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.sched_mu import _pallas_max_rk, mu_sched
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--genes", type=int, default=5000)
+    ap.add_argument("--samples", type=int, default=500)
+    ap.add_argument("--kmax", type=int, default=10)
+    ap.add_argument("--restarts", type=int, default=50)
+    args = ap.parse_args()
+
+    ks = tuple(range(args.kmax, 1, -1))
+    k_max = max(ks)
+    a = grouped_matrix(args.genes, (args.samples // 4,) * 4, effect=2.0,
+                      seed=0)
+    root = jax.random.PRNGKey(123)
+    w0l, h0l, job_ks = [], [], []
+    for k in ks:
+        keys = jax.random.split(jax.random.fold_in(root, k), args.restarts)
+        w0s, h0s = jax.vmap(
+            lambda kk, k=k: initialize(kk, a, k, InitConfig(),
+                                       jnp.float32))(keys)
+        w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+        h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+        job_ks += [k] * args.restarts
+    w0 = jnp.concatenate(w0l)
+    h0 = jnp.concatenate(h0l)
+    job_ks = tuple(job_ks)
+
+    cfg = SolverConfig(algorithm="mu", max_iter=10000,
+                       matmul_precision="bfloat16", backend="pallas")
+    wide = _pallas_max_rk(args.genes, args.samples, cfg,
+                          factor_bytes=2) // k_max
+    print(f"bf16 envelope admits {wide} slots "
+          f"(f32: {_pallas_max_rk(args.genes, args.samples, cfg) // k_max})",
+          flush=True)
+    cells = {
+        "f32-48": dict(slots=48, factor_dtype=None),
+        "bf16-48": dict(slots=48, factor_dtype="bfloat16"),
+        f"bf16-{wide}": dict(slots=wide, factor_dtype="bfloat16"),
+    }
+
+    def run(slots, factor_dtype):
+        t0 = time.perf_counter()
+        r = mu_sched(a, w0, h0, cfg, slots=slots, job_ks=job_ks,
+                     factor_dtype=factor_dtype)
+        its = np.asarray(r.iterations)
+        h = np.asarray(r.h)
+        wall = time.perf_counter() - t0
+        return wall, its, h
+
+    results = {}
+    for name, kw in cells.items():
+        t0 = time.perf_counter()
+        _, its, h = run(**kw)
+        results[name] = (its, h)
+        print(f"warm {name}: {time.perf_counter() - t0:.1f}s "
+              f"iters_total={int(its.sum())}", flush=True)
+
+    # parity vs f32-48: per-k iteration ratio + restart-normalized
+    # consensus drift (the verify gate's invariants)
+    ref_its, ref_h = results["f32-48"]
+    r_per_k = args.restarts
+    for name in list(cells)[1:]:
+        its, h = results[name]
+        for gi, k in enumerate(ks):
+            sl = slice(gi * r_per_k, (gi + 1) * r_per_k)
+            ratio = its[sl].mean() / ref_its[sl].mean()
+            lab = jax.vmap(labels_from_h)(jnp.asarray(h[sl, :k, :]))
+            lab_r = jax.vmap(labels_from_h)(jnp.asarray(ref_h[sl, :k, :]))
+            dc = np.abs(np.asarray(consensus_matrix(lab, k))
+                        - np.asarray(consensus_matrix(lab_r, k)))
+            print(f"{name} vs f32-48 k={k}: iters_ratio={ratio:.3f} "
+                  f"mean|dC|*R={dc.mean() * r_per_k:.3f} "
+                  f"max|dC|={dc.max():.3f}", flush=True)
+
+    walls = {name: [] for name in cells}
+    for rep in range(args.reps):
+        for name, kw in cells.items():
+            wall, _, _ = run(**kw)
+            walls[name].append(wall)
+            print(f"rep {rep} {name}: {wall:.3f}s", flush=True)
+    for name, ws in walls.items():
+        ws = sorted(ws)
+        print(f"{name}: min={ws[0]:.3f}s median={ws[len(ws) // 2]:.3f}s "
+              f"all={[round(x, 3) for x in ws]}")
+
+
+if __name__ == "__main__":
+    main()

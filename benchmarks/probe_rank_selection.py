@@ -1,0 +1,49 @@
+"""rank_selection='device' vs 'host' end-to-end (api.nmfconsensus).
+
+Round-5 datapoint for the "consensus never leaves HBM" north star
+(SURVEY §2c): the on-device average-linkage hclust + cophenetic path
+(ops/hclust_jax.py) vs the host path (one n^2 consensus pull per rank +
+the native C++ NN-cached UPGMA). Interleaved min-of-N through the
+tunneled chip. See RESULTS.md "Device-side rank selection in the
+pipeline" for the measured verdict and its environment caveat.
+
+Usage: PYTHONPATH=. python benchmarks/probe_rank_selection.py
+"""
+import argparse, time
+from nmfx.api import nmfconsensus
+from nmfx.config import SolverConfig
+from nmfx.datasets import grouped_matrix
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--reps", type=int, default=3)
+args = ap.parse_args()
+
+cases = {
+    "n=500": dict(a=grouped_matrix(5000, (125,) * 4, effect=2.0, seed=0),
+                  ks=(2, 3, 4, 5), restarts=20),
+    "n=2000": dict(a=grouped_matrix(2000, (500,) * 4, effect=2.0, seed=0),
+                   ks=(2, 3, 4), restarts=12),
+}
+scfg = SolverConfig(algorithm="mu", max_iter=2000,
+                    matmul_precision="bfloat16")
+for label, case in cases.items():
+    def run(mode):
+        t0 = time.perf_counter()
+        res = nmfconsensus(case["a"], ks=case["ks"],
+                           restarts=case["restarts"], solver_cfg=scfg,
+                           rank_selection=mode)
+        assert res.best_k is not None
+        return time.perf_counter() - t0
+    walls = {}
+    for mode in ("host", "device"):
+        print(f"warm {label} {mode}: {run(mode):.1f}s", flush=True)
+        walls[mode] = []
+    for rep in range(args.reps):
+        for mode in ("host", "device"):
+            walls[mode].append(run(mode))
+            print(f"rep {rep} {label} {mode}: {walls[mode][-1]:.3f}s",
+                  flush=True)
+    for mode, ws in walls.items():
+        ws = sorted(ws)
+        print(f"{label} {mode}: min={ws[0]:.3f}s "
+              f"all={[round(x, 3) for x in ws]}")

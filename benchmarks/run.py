@@ -35,12 +35,14 @@ def _time_sweep(a, ks, restarts, scfg, warm_seed=999, seed=123):
         return out, jax.device_get(
             {k: (out[k].consensus, out[k].iterations) for k in ks})
 
-    run(warm_seed)  # compile
+    t0 = time.perf_counter()
+    run(warm_seed)  # compile — timed: the first-run cost a user pays
+    cold = time.perf_counter() - t0
     t0 = time.perf_counter()
     _, host = run(seed)
     wall = time.perf_counter() - t0
     iters = float(np.mean([host[k][1].mean() for k in ks]))
-    return wall, iters
+    return wall, iters, cold
 
 
 def main():
@@ -72,20 +74,35 @@ def main():
     }
     print(f"# per-solver: {m}x{n}, k={list(ks)}, {restarts} restarts/k, "
           f"maxiter={args.maxiter} (pg: 100; alspg: 20x100 sub)")
-    print(f"{'solver':8s} {'wall s':>8s} {'restarts/s':>11s} "
-          f"{'mean iters':>11s}")
+    print(f"{'solver':14s} {'wall s':>8s} {'cold s':>8s} "
+          f"{'restarts/s':>11s} {'mean iters':>11s}")
+    # the round-4/5 whole-grid opt-ins measured alongside their
+    # defaults: one compile for the whole sweep vs one per rank
+    # (derived from the routing table; mu/hals excluded because the
+    # grid scheduler already IS their "auto" engine)
+    from nmfx.config import PACKED_ALGORITHMS
+
+    packed_optins = tuple(a for a in PACKED_ALGORITHMS
+                          if a not in ("mu", "hals"))
     for algo in ALGORITHMS:
         kw = dict(max_iter=args.maxiter)
         kw.update(per_solver.get(algo, {}))
-        scfg = SolverConfig(algorithm=algo, matmul_precision="bfloat16",
-                            **kw)
-        wall, iters = _time_sweep(a, ks, restarts, scfg)
-        rps = len(ks) * restarts / wall
-        results["solvers"][algo] = {"wall_s": round(wall, 3),
-                                    "restarts_per_s": round(rps, 2),
-                                    "mean_iters": round(iters, 1),
-                                    "max_iter": kw["max_iter"]}
-        print(f"{algo:8s} {wall:8.2f} {rps:11.1f} {iters:11.0f}")
+        variants = [("", "auto")]
+        if algo in packed_optins:
+            variants.append(("+packed", "packed"))
+        for suffix, backend in variants:
+            scfg = SolverConfig(algorithm=algo,
+                                matmul_precision="bfloat16",
+                                backend=backend, **kw)
+            wall, iters, cold = _time_sweep(a, ks, restarts, scfg)
+            rps = len(ks) * restarts / wall
+            results["solvers"][algo + suffix] = {
+                "wall_s": round(wall, 3), "cold_s": round(cold, 3),
+                "restarts_per_s": round(rps, 2),
+                "mean_iters": round(iters, 1),
+                "max_iter": kw["max_iter"]}
+            print(f"{algo + suffix:14s} {wall:8.2f} {cold:8.2f} "
+                  f"{rps:11.1f} {iters:11.0f}")
 
     sizes = ([(500, 60), (1000, 120)] if args.quick
              else [(1000, 100), (5000, 500), (20000, 1000)])
@@ -95,7 +112,7 @@ def main():
         sa = grouped_matrix(sm, tuple([sn // 4] * 4), effect=2.0, seed=0)
         scfg = SolverConfig(algorithm="mu", max_iter=args.maxiter,
                             matmul_precision="bfloat16")
-        wall, _ = _time_sweep(sa, ks, restarts, scfg)
+        wall, _, _cold = _time_sweep(sa, ks, restarts, scfg)
         results["scaling"].append({"shape": [sm, sn],
                                    "wall_s": round(wall, 3)})
         print(f"{f'{sm}x{sn}':>16s} {wall:8.2f} "

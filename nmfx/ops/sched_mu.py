@@ -113,23 +113,41 @@ def _pallas_block_geometry(m: int):
     return tiles, block_m, tiles * block_m
 
 
-def _pallas_max_rk(m: int, n: int, cfg: SolverConfig) -> int:
+def _pallas_max_rk(m: int, n: int, cfg: SolverConfig,
+                   factor_bytes: "int | None" = None) -> int:
     """Largest packed column count the resident-W block kernel's VMEM
     envelope admits at this shape (the inequality documented in
     ``_pallas_slot_clamp``; shared by the uniform clamp and the ragged
-    pool's column budget)."""
+    pool's column budget).
+
+    ``factor_bytes=2`` models the bf16-factor-storage experiment: the
+    W/H windows halve while the f32 numer/gram scratch stays — modeled
+    as ``2·rk·m_pad + 10·rk·n_pad + 4·rk²`` against a CONSERVATIVE
+    13.5 MiB budget (unlike the f32 model, this variant is not
+    boundary-probed on hardware; Mosaic still rejects loudly if the
+    model ever over-admits)."""
     _, block_m, m_pad = _pallas_block_geometry(m)
     n_pad = -(-n // 128) * 128
     a_bytes = 2 if _streams_bf16_a(cfg) else jnp.dtype(cfg.dtype).itemsize
-    budget = int(14.3 * 2**20) - 2 * block_m * n_pad * a_bytes
+    if factor_bytes == 2:
+        budget = int(13.5 * 2**20) - 2 * block_m * n_pad * a_bytes
+
+        def need(rk):
+            return 2 * rk * m_pad + 10 * rk * n_pad + 4 * rk * rk
+    else:
+        budget = int(14.3 * 2**20) - 2 * block_m * n_pad * a_bytes
+
+        def need(rk):
+            return 4 * rk * (m_pad + 3 * n_pad + rk)
     rk = 0
-    while 4 * (rk + 1) * (m_pad + 3 * n_pad + (rk + 1)) <= budget:
+    while need(rk + 1) <= budget:
         rk += 1
     return rk
 
 
 def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
-                       cfg: SolverConfig) -> int:
+                       cfg: SolverConfig,
+                       factor_bytes: "int | None" = None) -> int:
     """Clamp the slot pool to the resident-W block kernel's VMEM envelope.
 
     Empirical v5e model (round 4, benchmarks/probe_vmem_envelope*.py —
@@ -158,7 +176,7 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
     WARNING.
     """
     def fits(slots: int) -> bool:
-        return slots * k_max <= _pallas_max_rk(m, n, cfg)
+        return slots * k_max <= _pallas_max_rk(m, n, cfg, factor_bytes)
 
     if not fits(1):
         raise ValueError(
@@ -572,7 +590,7 @@ _AUTO_TAIL_SLOTS = (8,)
 
 @partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes",
                                   "tail_slots", "job_ks", "ragged",
-                                  "evict_batch"))
+                                  "evict_batch", "factor_dtype"))
 def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              cfg: SolverConfig = SolverConfig(),
              slots: int = 48,
@@ -581,6 +599,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              job_ks: "tuple[int, ...] | None" = None,
              ragged: "bool | None" = None,
              evict_batch: int = 1,
+             factor_dtype: "str | None" = None,
              ) -> SchedMUResult:
     """Solve J dense zero-padded jobs through an S-slot scheduler.
 
@@ -629,7 +648,15 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     trajectories and stop decisions match the uniform pool to the same
     float tolerance as any width change. ``evict_batch``: harvest
     hysteresis (see ``harvest``); recorded per-job results are
-    invariant, default 1 (measured no clear win).
+    invariant, default 1 (measured no clear win). ``factor_dtype``:
+    None (storage dtype) or "bfloat16" — the wide-pool experiment
+    (pallas + block-aligned max_iter + uniform pool only): slot W/H
+    stored bf16, halving the per-block W round-trip and widening the
+    VMEM envelope ~1.5×. Measured and REJECTED as a default (round 5,
+    benchmarks/probe_bf16_pool.py): quantized factors hit bf16 fixed
+    points, halving iteration counts to the class-stability floor and
+    moving consensus outside the verify gate's band — kept only so the
+    rejection is reproducible.
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
@@ -660,8 +687,19 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     # uniform pool's 1.32 s at the north star. Kept as an opt-in for
     # mixes where padding waste is extreme (k_max >> typical k).
     use_ragged = False if ragged is None else bool(ragged)
+    if factor_dtype not in (None, "bfloat16"):
+        raise ValueError(f"factor_dtype must be None or 'bfloat16', got "
+                         f"{factor_dtype!r}")
+    fdtype = jnp.bfloat16 if factor_dtype == "bfloat16" else None
+    if fdtype is not None and not (use_pallas and ce_ok
+                                   and not use_ragged):
+        raise ValueError(
+            "factor_dtype='bfloat16' is the pallas block-kernel wide-pool"
+            " experiment: backend='pallas', max_iter a multiple of "
+            "check_every, uniform (non-ragged) pool")
     if use_pallas and not use_ragged:
-        s = _pallas_slot_clamp(s, k_max, m, n, cfg)
+        s = _pallas_slot_clamp(s, k_max, m, n, cfg,
+                               factor_bytes=2 if fdtype else None)
     if cfg.algorithm == "kl":
         s = _kl_slot_clamp(s, m, n, dtype)
     ce = cfg.check_every
@@ -722,10 +760,24 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                            matmul_precision=cfg.matmul_precision,
                            interpret=interp)
 
+            # bf16-factor-storage experiment (factor_dtype="bfloat16"):
+            # the slot pool's W/H live as bf16 between check blocks —
+            # halves the W round-trip per block AND ~1.6x more columns
+            # fit the VMEM envelope. A REAL numerics change (each store
+            # quantizes the factor state ~0.4% relative, so TolX cannot
+            # fire below that and trajectories drift within the gate's
+            # bands), unlike the result-invariant bf16 A-streaming.
+            pool_dtype = fdtype or dtype
+
+            def to_pool(x):
+                return x.astype(pool_dtype) if fdtype is not None else x
+
             def init_slots():
                 # (s, m_pad, k) → packed (m_pad, s·k)
-                return (jnp.transpose(w0[:s], (1, 0, 2)).reshape(m_pad, -1),
-                        h0[:s].reshape(s * k_max, n))
+                return (to_pool(jnp.transpose(w0[:s],
+                                              (1, 0, 2)).reshape(m_pad,
+                                                                 -1)),
+                        to_pool(h0[:s].reshape(s * k_max, n)))
 
             def make_do_block(width):
                 """Width-specific check block (the tail pool re-derives it
@@ -792,7 +844,9 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             def dense_views(wp, hp):
                 wd = jnp.transpose(wp.reshape(m_pad, -1, k_max),
                                    (1, 0, 2))[:, :m, :]
-                return wd, hp.reshape(-1, k_max, n)
+                # result buffers stay full precision
+                return (wd.astype(dtype),
+                        hp.reshape(-1, k_max, n).astype(dtype))
 
             def reload(wp, hp, load, gather):
                 # fault-injection hook (identity when unset): drop the
@@ -802,9 +856,12 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 # round-3 aliasing bug (_stale_load_mask)
                 load = _stale_load_mask(load, gather)
                 w3 = wp.reshape(m_pad, -1, k_max)
-                wg = jnp.transpose(w0[gather], (1, 0, 2))  # (m_pad, s, k)
+                # gathers cast to the pool dtype so where() cannot
+                # promote the bf16 carry back to f32
+                wg = to_pool(jnp.transpose(w0[gather],
+                                           (1, 0, 2)))  # (m_pad, s, k)
                 w3 = jnp.where(load[None, :, None], wg, w3)
-                h3 = jnp.where(load[:, None, None], h0[gather],
+                h3 = jnp.where(load[:, None, None], to_pool(h0[gather]),
                                hp.reshape(-1, k_max, n))
                 return w3.reshape(m_pad, -1), h3.reshape(-1, n)
 

@@ -20,6 +20,24 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _isolate_compile_cache(tmp_path_factory):
+    """Point the CLI's default-on persistent compile cache at a
+    per-SESSION tmp dir. Without this, tests that invoke
+    ``nmfx.cli.main`` share the USER's ~/.cache/nmfx/xla — and a cache
+    entry half-written by a concurrent real-TPU bench in another
+    process segfaults the reader inside jax's cache deserialization
+    (observed round 5: the full suite died at a cache read while TPU
+    probes were running). Session scope keeps intra-run compile reuse
+    between CLI tests while isolating them from other processes."""
+    from nmfx import cli
+
+    old = cli._DEFAULT_COMPILE_CACHE
+    cli._DEFAULT_COMPILE_CACHE = str(tmp_path_factory.mktemp("xla_cache"))
+    yield
+    cli._DEFAULT_COMPILE_CACHE = old
+
+
 @pytest.fixture(scope="session")
 def two_group_data():
     """Synthetic 2-group expression-like matrix (fixture factory standing in

@@ -123,8 +123,10 @@ def test_non_mu_rejected(problem):
 
 
 def test_backend_validation():
+    # pg has no dense-batched block (als joined PACKED_ALGORITHMS in
+    # round 5, so it no longer serves as the reject case)
     with pytest.raises(ValueError, match="packed"):
-        SolverConfig(algorithm="als", backend="packed")
+        SolverConfig(algorithm="pg", backend="packed")
     with pytest.raises(ValueError, match="backend"):
         SolverConfig(backend="bogus")
 

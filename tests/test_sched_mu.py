@@ -242,3 +242,36 @@ def test_ragged_pool_matches_uniform(jobs):
         mu_sched(a, w0, h0, SolverConfig(algorithm="mu", backend="auto",
                                          max_iter=600),
                  slots=6, job_ks=JOB_KS, ragged=True)
+
+
+def test_factor_dtype_bf16_pool(jobs):
+    """The factor_dtype='bfloat16' wide-pool EXPERIMENT (measured and
+    rejected as a default — see probe_bf16_pool.py / RESULTS.md): the
+    knob must validate its preconditions and produce a finite,
+    converging solve with f32 result buffers. Trajectory equality is
+    deliberately NOT asserted — bf16 factor storage is a real numerics
+    change (on hardware it reaches bf16 fixed points and stops at the
+    class floor)."""
+    from nmfx.solvers.base import StopReason
+
+    a, w0, h0 = jobs
+    cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600)
+    r = mu_sched(a, w0, h0, cfg, slots=6, factor_dtype="bfloat16")
+    assert np.asarray(r.w).dtype == np.float32
+    assert np.isfinite(np.asarray(r.w)).all()
+    assert np.isfinite(np.asarray(r.dnorm)).all()
+    its = np.asarray(r.iterations)
+    assert (its > 0).all() and (its <= 600).all()
+    assert set(np.asarray(r.stop_reason)) <= {int(StopReason.CLASS_STABLE),
+                                              int(StopReason.TOL_X),
+                                              int(StopReason.MAX_ITER)}
+    # preconditions are enforced, not silently ignored
+    with pytest.raises(ValueError, match="factor_dtype"):
+        mu_sched(a, w0, h0, cfg, slots=6, factor_dtype="float16")
+    with pytest.raises(ValueError, match="bfloat16"):
+        mu_sched(a, w0, h0, SolverConfig(algorithm="mu", backend="auto",
+                                         max_iter=600),
+                 slots=6, factor_dtype="bfloat16")
+    with pytest.raises(ValueError, match="bfloat16"):
+        mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS, ragged=True,
+                 factor_dtype="bfloat16")
