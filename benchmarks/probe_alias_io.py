@@ -1,0 +1,171 @@
+"""On-chip bisect + A/B for alias_io (round 5): donate the block
+kernel's w_in/h_in buffers as its outputs.
+
+Round 3 shipped input/output-aliased VMEM windows WITHOUT an explicit
+DMA and the windows went stale inside while_loop bodies (the corruption
+the fault-injection gate now proves catchable). alias_io is a different
+design — the data path is the explicit step-0 DMA; the alias only lets
+XLA update the while-carry in place, targeting the ~30 µs/trip factor
+copies the round-5 profiler trace attributed to the carry. Because this
+is the same HAZARD CLASS, this probe replays the round-4 bisect at
+three levels before any timing:
+
+1. standalone kernel: aliased vs not, bit-exact outputs;
+2. the round-3 failure shape: the kernel inside a lax.while_loop whose
+   body REWRITES slot columns between calls (simulated reloads) — the
+   exact pattern that exposed the stale windows;
+3. the full scheduler: mu_sched(alias_io=True) vs False — per-job stop
+   iterations bit-equal ON HARDWARE is not expected (position/timing
+   drift), so level 3 asserts the verify-gate invariants instead
+   (iteration ratios, restart-normalized consensus drift), then times
+   interleaved min-of-N.
+
+After this probe, the decision gate is `bench.py --verify` (incl. the
+reload-exercising boundary stage) + `probe_fault_gate.py` on the
+aliased build.
+
+Usage: PYTHONPATH=. python benchmarks/probe_alias_io.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.consensus import consensus_matrix, labels_from_h
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.pallas_mu import fused_block_iterations
+from nmfx.ops.sched_mu import mu_sched
+
+
+def level1(a, wp, hp, fcol, k):
+    outs = {}
+    for alias in (False, True):
+        outs[alias] = fused_block_iterations(
+            a, wp, hp, fcol, k=k, iters=2,
+            matmul_precision="bfloat16", alias_io=alias)
+    for i, name in enumerate(("wp", "hp", "wd", "wm", "hd", "hm")):
+        x, y = np.asarray(outs[False][i]), np.asarray(outs[True][i])
+        assert np.array_equal(x, y), f"level1: {name} differs"
+    print("level1 standalone: bit-exact", flush=True)
+
+
+def level2(a, wp, hp, k):
+    """Kernel inside a while_loop whose body rewrites a slot's columns
+    between calls — the round-3 staleness pattern."""
+    rk = wp.shape[1]
+    fcol = jnp.zeros((1, rk), jnp.float32)
+    fresh_w = jnp.ones((wp.shape[0], k), wp.dtype) * 0.5
+    fresh_h = jnp.ones((k, hp.shape[1]), hp.dtype) * 0.5
+
+    def make(alias):
+        def body(c):
+            i, w, h = c
+            w, h, *_ = fused_block_iterations(
+                a, w, h, fcol, k=k, iters=2,
+                matmul_precision="bfloat16", alias_io=alias)
+            # rewrite slot 1's columns every other trip (a "reload"):
+            # the next call MUST see these values
+            do = (i % 2) == 0
+            w = jnp.where(do, w.at[:, k:2 * k].set(fresh_w), w)
+            h = jnp.where(do, h.at[k:2 * k, :].set(fresh_h), h)
+            return i + 1, w, h
+
+        _, w, h = lax.while_loop(lambda c: c[0] < 20, body,
+                                 (jnp.asarray(0), wp, hp))
+        return np.asarray(w), np.asarray(h)
+
+    w0, h0 = make(False)
+    w1, h1 = make(True)
+    assert np.array_equal(w0, w1), "level2: W diverged under aliasing"
+    assert np.array_equal(h0, h1), "level2: H diverged under aliasing"
+    print("level2 while_loop + slot rewrites: bit-exact", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    # levels 1-2 at a small padded shape
+    m, n, k, slots = 1024, 128, 4, 6
+    key = jax.random.PRNGKey(0)
+    ka, kw, kh = jax.random.split(key, 3)
+    a = jax.random.uniform(ka, (m, n), jnp.float32).astype(jnp.bfloat16)
+    wp = jax.random.uniform(kw, (m, slots * k), jnp.float32)
+    hp = jax.random.uniform(kh, (slots * k, n), jnp.float32)
+    fcol = jnp.zeros((1, slots * k), jnp.float32)
+    level1(a, wp, hp, fcol, k)
+    level2(a, wp, hp, k)
+
+    # level 3: full scheduler, gate invariants + interleaved timing
+    ks = tuple(range(10, 1, -1))
+    k_max = 10
+    restarts = 50
+    big = grouped_matrix(5000, (125,) * 4, effect=2.0, seed=0)
+    root = jax.random.PRNGKey(123)
+    w0l, h0l = [], []
+    for kk_ in ks:
+        keys = jax.random.split(jax.random.fold_in(root, kk_), restarts)
+        w0s, h0s = jax.vmap(
+            lambda q, kk_=kk_: initialize(q, big, kk_, InitConfig(),
+                                          jnp.float32))(keys)
+        w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - kk_))))
+        h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - kk_), (0, 0))))
+    w0g = jnp.concatenate(w0l)
+    h0g = jnp.concatenate(h0l)
+    cfg = SolverConfig(algorithm="mu", max_iter=10000,
+                       matmul_precision="bfloat16", backend="pallas")
+
+    def run(alias):
+        t0 = time.perf_counter()
+        r = mu_sched(big, w0g, h0g, cfg, slots=48, alias_io=alias)
+        its = np.asarray(r.iterations)
+        h = np.asarray(r.h)
+        return time.perf_counter() - t0, its, h
+
+    res = {}
+    for alias in (False, True):
+        t0 = time.perf_counter()
+        wall, its, h = run(alias)
+        res[alias] = (wall, its, h)
+        print(f"warm alias={alias}: {time.perf_counter() - t0:.1f}s "
+              f"iters_total={int(its.sum())}", flush=True)
+    _, its0, h0_ = res[False]
+    _, its1, h1_ = res[True]
+    for gi, kk_ in enumerate(ks):
+        sl = slice(gi * restarts, (gi + 1) * restarts)
+        ratio = its1[sl].mean() / its0[sl].mean()
+        lab0 = jax.vmap(labels_from_h)(jnp.asarray(h0_[sl, :kk_, :]))
+        lab1 = jax.vmap(labels_from_h)(jnp.asarray(h1_[sl, :kk_, :]))
+        dc = np.abs(np.asarray(consensus_matrix(lab1, kk_))
+                    - np.asarray(consensus_matrix(lab0, kk_)))
+        line = (f"level3 k={kk_}: iters_ratio={ratio:.3f} "
+                f"mean|dC|*R={dc.mean() * restarts:.3f} "
+                f"max|dC|={dc.max():.3f}")
+        print(line, flush=True)
+        assert 1 / 1.6 < ratio < 1.6, line
+        assert dc.mean() * restarts <= 0.6, line
+
+    walls = {False: [], True: []}
+    for rep in range(args.reps):
+        for alias in (False, True):
+            w_, _, _ = run(alias)
+            walls[alias].append(w_)
+            print(f"rep {rep} alias={alias}: {w_:.3f}s", flush=True)
+    for alias, ws in walls.items():
+        ws = sorted(ws)
+        print(f"alias={alias}: min={ws[0]:.3f}s "
+              f"median={ws[len(ws) // 2]:.3f}s "
+              f"all={[round(x, 3) for x in ws]}")
+
+
+if __name__ == "__main__":
+    main()

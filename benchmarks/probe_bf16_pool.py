@@ -70,6 +70,14 @@ def main():
     print(f"bf16 envelope admits {wide} slots "
           f"(f32: {_pallas_max_rk(args.genes, args.samples, cfg) // k_max})",
           flush=True)
+    # the probe separates storage from width; at shapes where the bf16
+    # envelope admits <= 48 slots the two cells would collide (or the
+    # '48' cell would be silently clamped) and the A/B would mislabel
+    # what ran — fail loudly instead
+    assert wide > 48, (
+        f"bf16 envelope admits only {wide} slots at this shape; the "
+        "storage-vs-width separation needs wide > 48 — pick a smaller "
+        "n or lower --kmax")
     cells = {
         "f32-48": dict(slots=48, factor_dtype=None),
         "bf16-48": dict(slots=48, factor_dtype="bfloat16"),

@@ -307,14 +307,15 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "iters", "block_m", "eps", "zero_threshold", "matmul_precision",
-    "interpret"))
+    "interpret", "alias_io"))
 def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
                            frozen_cols: jax.Array, *, k: int,
                            iters: int = 2, block_m: int = 512,
                            eps: float = 1e-9, zero_threshold: float = 0.0,
                            matmul_precision: str = "default",
                            interpret: bool = False,
-                           seg_ids: "jax.Array | None" = None):
+                           seg_ids: "jax.Array | None" = None,
+                           alias_io: bool = False):
     """``iters`` full MU iterations (both half-updates) in ONE pallas_call
     with the packed factors VMEM-resident throughout — the whole-solve
     launch count drops from ~4 kernels per iteration-pair to 1.
@@ -366,10 +367,23 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
 
     # w0/h0 stay in HBM (ANY); the kernel DMAs them into the resident
     # output windows exactly once — same total traffic as the round-3
-    # aliased design, without relying on custom-call aliasing semantics
+    # aliased design, without relying on custom-call aliasing semantics.
+    # alias_io=True (round 5) ADDITIONALLY donates the w_in/h_in HBM
+    # buffers as the output buffers — this is NOT the round-3 design:
+    # the DATA path stays the explicit step-0 DMA (never the alias), the
+    # alias only lets XLA update the while-carry in place instead of
+    # copying the packed factors every trip (~30 µs/trip measured in the
+    # round-5 trace). The read-before-write order holds because the
+    # constant-index output windows write back after the final grid
+    # step, long after the step-0 DMA read. Gate-validated: the
+    # fault-injection-proven `bench.py --verify` (incl. the
+    # reload-exercising boundary stage) must pass with this on — see
+    # benchmarks/probe_alias_io.py for the bit-exactness bisect.
+    alias = {5: 0, 6: 1} if alias_io else {}
     return pl.pallas_call(
         kernel,
         grid=(iters, 2, nt),
+        input_output_aliases=alias,
         in_specs=[
             pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
                          memory_space=pltpu.VMEM),

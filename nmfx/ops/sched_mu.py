@@ -590,7 +590,8 @@ _AUTO_TAIL_SLOTS = (8,)
 
 @partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes",
                                   "tail_slots", "job_ks", "ragged",
-                                  "evict_batch", "factor_dtype"))
+                                  "evict_batch", "factor_dtype",
+                                  "alias_io"))
 def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              cfg: SolverConfig = SolverConfig(),
              slots: int = 48,
@@ -600,6 +601,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              ragged: "bool | None" = None,
              evict_batch: int = 1,
              factor_dtype: "str | None" = None,
+             alias_io: bool = False,
              ) -> SchedMUResult:
     """Solve J dense zero-padded jobs through an S-slot scheduler.
 
@@ -656,7 +658,10 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     benchmarks/probe_bf16_pool.py): quantized factors hit bf16 fixed
     points, halving iteration counts to the class-stability floor and
     moving consensus outside the verify gate's band — kept only so the
-    rejection is reproducible.
+    rejection is reproducible. ``alias_io``: donate the block kernel's
+    input buffers as outputs (bit-exact at every bisect level — the
+    explicit DMA is the data path — but measured ~8% SLOWER than the
+    carry copies it targets; default off, see probe_alias_io.py).
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
@@ -697,6 +702,14 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             "factor_dtype='bfloat16' is the pallas block-kernel wide-pool"
             " experiment: backend='pallas', max_iter a multiple of "
             "check_every, uniform (non-ragged) pool")
+    if alias_io and not (use_pallas and ce_ok and not use_ragged):
+        # enforced, not silently ignored: the ragged stage and the
+        # per-iteration fallback never thread the donation, so a user
+        # "benchmarking alias_io" there would measure an unaliased build
+        raise ValueError(
+            "alias_io=True is the uniform pallas block-kernel route "
+            "only: backend='pallas', max_iter a multiple of "
+            "check_every, non-ragged")
     if use_pallas and not use_ragged:
         s = _pallas_slot_clamp(s, k_max, m, n, cfg,
                                factor_bytes=2 if fdtype else None)
@@ -799,7 +812,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                             jnp.float32)[None, :]
                         wp, hp, wd, wm, hd, hm = fused_block_iterations(
                             a_loop, wp, hp, fcol, k=k_max, iters=ce,
-                            **kern_kw)
+                            alias_io=alias_io, **kern_kw)
 
                         def lane_max(x):  # (1, rk)/(rk, 1) → per-slot max
                             return jnp.max(x.reshape(-1, k_max), axis=1)

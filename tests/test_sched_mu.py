@@ -275,3 +275,22 @@ def test_factor_dtype_bf16_pool(jobs):
     with pytest.raises(ValueError, match="bfloat16"):
         mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS, ragged=True,
                  factor_dtype="bfloat16")
+
+
+def test_alias_io_schedule_free(jobs):
+    """alias_io donates the block kernel's input buffers as outputs —
+    the round-3 hazard class, so its invariant is the strongest one:
+    BIT-EXACT results vs the non-aliased path (the explicit step-0 DMA
+    is the data path; the alias only affects buffer reuse). Verified
+    on hardware at three levels by benchmarks/probe_alias_io.py; this
+    locks the interpret-mode equivalence in CI."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600)
+    base = mu_sched(a, w0, h0, cfg, slots=6)
+    al = mu_sched(a, w0, h0, cfg, slots=6, alias_io=True)
+    np.testing.assert_array_equal(np.asarray(base.iterations),
+                                  np.asarray(al.iterations))
+    np.testing.assert_array_equal(np.asarray(base.stop_reason),
+                                  np.asarray(al.stop_reason))
+    np.testing.assert_array_equal(np.asarray(base.w), np.asarray(al.w))
+    np.testing.assert_array_equal(np.asarray(base.h), np.asarray(al.h))
