@@ -38,6 +38,48 @@ def _isolate_compile_cache(tmp_path_factory):
     cli._DEFAULT_COMPILE_CACHE = old
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jit_caches_between_modules():
+    """Drop compiled executables between test modules. A full
+    single-process suite accumulates many hundreds of CPU executables
+    and the XLA CPU compiler was observed to SEGFAULT deep into the
+    suite (reproducibly at the shapes-fuzz module, in
+    backend_compile_and_load — an upstream accumulation bug, not a test
+    bug: the same module passes standalone). Clearing per module keeps
+    the per-process executable population bounded at the cost of some
+    recompilation."""
+    yield
+    jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _restore_compile_cache_config():
+    """``cli.main`` enables the persistent compile cache via a GLOBAL
+    ``jax.config`` update, which would otherwise stay active for every
+    test after the CLI tests — routing all later compiles through
+    jax's cache writer, which segfaults deterministically on this
+    platform partway through the suite (reproduced 3× at
+    test_solver_shapes_fuzz, stack in compilation_cache.put/get).
+    Restore the setting after each test so only the CLI tests
+    themselves run cached."""
+    old = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    # restore UNCONDITIONALLY: a test that restores the dir itself
+    # would otherwise skip the reset below and leave jax's memoized
+    # cache object (and is_cache_used latch) alive; cli.main also
+    # lowers the min-compile-time threshold globally
+    jax.config.update("jax_compilation_cache_dir", old)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      old_min)
+    # the config alone is not enough: jax initializes its cache object
+    # at most once per process and keeps using it after the config
+    # reverts — drop it so post-CLI tests really compile uncached
+    from jax._src import compilation_cache
+
+    compilation_cache.reset_cache()
+
+
 @pytest.fixture(scope="session")
 def two_group_data():
     """Synthetic 2-group expression-like matrix (fixture factory standing in

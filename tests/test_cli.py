@@ -98,22 +98,18 @@ def test_cli_keep_factors_saves_factors(gct_path, tmp_path, capsys):
 
 
 def test_cli_compile_cache_flag(gct_path, tmp_path, capsys):
-    import jax
-
     cache = str(tmp_path / "xla-cache")
-    before = jax.config.jax_compilation_cache_dir
-    try:
-        rc = main([gct_path, "--ks", "2", "--restarts", "2",
-                   "--maxiter", "50", "--no-files",
-                   "--compile-cache", cache])
-        assert rc == 0
-        import os
+    # process-wide config is restored (and jax's memoized cache object
+    # reset) by conftest's _restore_compile_cache_config fixture — an
+    # in-test finally restoring the dir would defeat the fixture's
+    # change detection and skip the reset
+    rc = main([gct_path, "--ks", "2", "--restarts", "2",
+               "--maxiter", "50", "--no-files",
+               "--compile-cache", cache])
+    assert rc == 0
+    import os
 
-        assert os.path.isdir(cache)  # cache directory created and used
-    finally:
-        # process-wide config: don't leak the persistent cache into the
-        # rest of the suite
-        jax.config.update("jax_compilation_cache_dir", before)
+    assert os.path.isdir(cache)  # cache directory created and used
 
 
 def test_cli_kl_and_nndsvd_on_grid_shards(gct_path, capsys):
