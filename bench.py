@@ -418,9 +418,33 @@ def main():
                    help="whole-grid single-compile execution vs sequential "
                         "per-rank (ConsensusConfig.grid_exec)")
     p.add_argument("--target-s", type=float, default=10.0)
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory: a "
+                        "SECOND bench session re-loads this session's "
+                        "compiled programs from disk instead of paying "
+                        "cold_wall_s again (the jax_compilation_cache_dir "
+                        "the CLI enables by default; recorded in the JSON "
+                        "so cold numbers are interpretable)")
     args = p.parse_args()
 
     import jax
+
+    if args.compile_cache:
+        import os
+
+        # best-effort like the CLI's default-on cache: an unwritable
+        # path degrades to benchmarking uncached, never a traceback
+        try:
+            os.makedirs(args.compile_cache, exist_ok=True)
+        except OSError as e:
+            print(f"bench: compilation cache disabled ({e})",
+                  file=sys.stderr)
+            args.compile_cache = None
+        else:
+            jax.config.update("jax_compilation_cache_dir",
+                              args.compile_cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.1)
     import numpy as np
 
     from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
@@ -561,6 +585,117 @@ def main():
         return {"min_s": round(s[0], 3), "median_s": round(median, 3),
                 "reps_s": [round(w, 3) for w in walls]}
 
+    # --- executable-reuse serving stage (nmfx.exec_cache) --------------
+    # Two-request pipeline through the shape-bucketed AOT cache: request
+    # 1 pays the bucket's one-time compile (measured); request 2 is a
+    # DIFFERENT true shape in the same bucket — its dispatch must be
+    # compile-free (the cache-hit path) and its host→device transfer was
+    # prefetched during request 1's solve, so the only non-overlapped
+    # transfer left is its own device→host pull. Integrity-gated like
+    # every other number printed here.
+    def run_serving_stage():
+        from nmfx.exec_cache import ExecCache
+
+        scfg_s = cfgs[args.backend]
+        ccfg_s = ConsensusConfig(ks=ks, restarts=args.restarts, seed=seed,
+                                 grid_exec=args.grid_exec)
+        cache = ExecCache()
+        if not cache.cacheable(ccfg_s, scfg_s, mesh):
+            return {"skipped": "configuration not exec-cacheable "
+                               "(see ExecCache.cacheable)"}
+        # second dataset: ~4% smaller per dim, clamped per-dimension to
+        # stay inside the first request's bucket (a shrink can cross a
+        # lattice point at shapes near a bucket's floor)
+        bucket = cache.bucket_shape(args.genes, args.samples)
+        m2 = max(1, args.genes - max(1, args.genes // 25))
+        if cache.bucket_shape(m2, 1)[0] != bucket[0]:
+            m2 = args.genes
+        n2 = max(4, args.samples - max(1, args.samples // 25))
+        if cache.bucket_shape(1, n2)[1] != bucket[1]:
+            n2 = args.samples
+        sizes2 = [n2 // 4] * 4
+        sizes2[0] += n2 % 4
+        a2 = grouped_matrix(m2, tuple(sizes2), effect=2.0, seed=1)
+
+        # request 1: miss — AOT compile (via the public entry record) +
+        # solve
+        t0 = time.perf_counter()
+        entry1, _ = cache.executable(a.shape, ccfg_s, scfg_s, icfg, mesh)
+        placed1 = cache.prefetch(a, scfg_s, mesh)
+        out1 = cache.run_sweep(placed1, ccfg_s, scfg_s, icfg, mesh)
+        dispatch1_s = time.perf_counter() - t0  # includes the compile
+        # double-buffer: request 2's transfer starts while 1 solves
+        placed2 = cache.prefetch(a2, scfg_s, mesh)
+        # measured upper bound on request 2's non-overlapped h2d: the
+        # host wait for the in-flight prefetched transfer at dispatch
+        # time (conservative — the wait itself still overlaps request
+        # 1's device compute, and the device only consumes a_pad after
+        # request 1 drains; measured rather than assumed 0 so a slow
+        # link shows up here instead of hiding in dispatch/compute)
+        t = time.perf_counter()
+        jax.block_until_ready(placed2.a_pad)
+        req2_h2d_block_s = time.perf_counter() - t
+        # request 2 dispatch: cache hit — lookup + true-shape init only
+        t2 = time.perf_counter()
+        out2 = cache.run_sweep(placed2, ccfg_s, scfg_s, icfg, mesh)
+        dispatch2_s = time.perf_counter() - t2  # the hit-path compile wall
+        # request 1's results stream back while request 2 computes
+        t = time.perf_counter()
+        host1 = jax.device_get({k: (out1[k].iterations,
+                                    out1[k].stop_reasons) for k in ks})
+        req1_block_s = time.perf_counter() - t
+        # request 2: separate remaining compute from the d2h pull its
+        # async fetches could not hide
+        t = time.perf_counter()
+        jax.block_until_ready([out2[k].consensus for k in ks])
+        req2_compute_s = time.perf_counter() - t
+        t = time.perf_counter()
+        host2 = jax.device_get({k: (out2[k].consensus, out2[k].iterations,
+                                    out2[k].stop_reasons) for k in ks})
+        req2_d2h_block_s = time.perf_counter() - t
+        total_s = time.perf_counter() - t0
+
+        for name, host in (("req1", {k: (None, v[0], v[1])
+                                     for k, v in host1.items()}),
+                           ("req2", host2)):
+            problems = _integrity_problems(
+                scfg_s, {k: host[k][1] for k in ks},
+                {k: host[k][2] for k in ks})
+            if problems:
+                for prob in problems:
+                    print(f"bench INTEGRITY FAILURE [serving {name}]: "
+                          f"{prob}", file=sys.stderr)
+                raise SystemExit(2)
+
+        # non-overlapped transfer on the cache-hit request: h2d was
+        # prefetched behind request 1's solve (0 blocked), leaving only
+        # the final d2h pull; compare against the main bench's per-rep
+        # blocking h2d+d2h from THIS session (and readers can compare
+        # phase_s across rounds the same way)
+        main_xfer_s = (phase_s.get("host_to_device", 0.0)
+                       + phase_s.get("device_to_host", 0.0))
+        nonoverlap_s = req2_h2d_block_s + req2_d2h_block_s
+        return {
+            "bucket": list(cache.bucket_shape(args.genes, args.samples)),
+            "shapes": [[args.genes, args.samples], [m2, n2]],
+            "miss_dispatch_s": round(dispatch1_s, 3),
+            "miss_compile_s": round(entry1.compile_s, 3),
+            "hit_dispatch_s": round(dispatch2_s, 3),
+            "hit_compile_free": dispatch2_s < 1.0,
+            "req1_result_block_s": round(req1_block_s, 3),
+            "req2_compute_block_s": round(req2_compute_s, 3),
+            "req2_h2d_block_s": round(req2_h2d_block_s, 3),
+            "req2_d2h_block_s": round(req2_d2h_block_s, 3),
+            "req2_nonoverlapped_xfer_s": round(nonoverlap_s, 3),
+            "main_path_xfer_s": round(main_xfer_s, 3),
+            "xfer_reduction_vs_main": (
+                None if main_xfer_s <= 0
+                else round(1.0 - nonoverlap_s / main_xfer_s, 3)),
+            "pipeline_total_s": round(total_s, 3),
+            "cache_stats": cache.stats,
+            "integrity": "ok",
+        }
+
     # headline = the requested backend's same-session minimum; per-backend
     # min/median/all-reps in detail
     primary = args.backend
@@ -619,6 +754,9 @@ def main():
                               max(cold_wall[b] - min(reps[b]), 0.0), 3),
                           **mfu_block(b)}
 
+    serving = run_serving_stage()
+    print(f"bench: serving stage: {json.dumps(serving)}", file=sys.stderr)
+
     record = {
         "metric": "consensus_sweep_wall_s",
         "value": round(wall, 3),
@@ -634,6 +772,11 @@ def main():
             "restarts_per_s": round(total_restarts / wall, 2),
             "backends": per_backend,
             "phase_s": phase_s,
+            "exec_cache": serving,
+            # cold_wall_s/compile_wall_s are first-session numbers; with
+            # a persistent cache dir a second session's cold run re-loads
+            # these programs from disk instead of recompiling
+            "persistent_compile_cache": args.compile_cache,
             "integrity": "ok",
             "mean_iters_per_k": {str(k): round(v, 1) for k, v in
                                  iters.items()},

@@ -12,10 +12,12 @@ accumulation kept on-device.
 
 from nmfx.config import (
     ConsensusConfig,
+    ExecCacheConfig,
     InitConfig,
     OutputConfig,
     SolverConfig,
 )
+from nmfx.exec_cache import ExecCache
 from nmfx.io import read_dataset, read_gct, read_res, write_gct
 from nmfx.api import (
     ConsensusResult,
@@ -39,6 +41,8 @@ from nmfx.config import VERSION as __version__
 __all__ = [
     "ConsensusConfig",
     "ConsensusResult",
+    "ExecCache",
+    "ExecCacheConfig",
     "InitConfig",
     "OutputConfig",
     "RestartResult",

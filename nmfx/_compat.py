@@ -1,0 +1,101 @@
+"""Version tolerance for the narrow slice of jax API that moved between
+releases.
+
+nmfx targets the current jax API (``jax.shard_map`` with ``check_vma``,
+``lax.pcast``, the ``jax_num_cpu_devices`` config) but must also run on
+the LTS-ish jaxlibs baked into accelerator images (observed: 0.4.x,
+where shard_map still lives in ``jax.experimental.shard_map`` with the
+``check_rep`` spelling, ``pcast`` does not exist, and virtual CPU
+devices are forced through ``XLA_FLAGS``). Every call site imports the
+symbol from here instead of feature-testing locally, so the supported
+surface — and the fallbacks' semantics — live in one place:
+
+* ``shard_map``: ``check_vma`` maps onto ``check_rep`` on old jax. All
+  nmfx call sites pass ``check_vma=False`` (the replication checker
+  cannot see through the argmin-over-gathered-candidates epilogues), so
+  the semantic gap between the two checkers is never exercised.
+* ``pcast``: identity on old jax. Its only job is lifting
+  constant-initialized carries to device-varying for the NEW
+  varying-manual-axes checker; with ``check_rep=False`` there is no
+  checker to satisfy and the values are already correct.
+* ``force_cpu_devices``: the ``jax_num_cpu_devices`` config when it
+  exists, else ``--xla_force_host_platform_device_count`` via
+  ``XLA_FLAGS`` — both only effective before backend initialization,
+  exactly like the config they stand in for.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax import lax
+
+__all__ = ["shard_map", "pcast", "force_cpu_devices"]
+
+
+# The sweep's key-chain contracts — restart r's key is independent of mesh
+# shape and padding (api.restart_factors), and a padded restart batch is a
+# prefix-extension of the unpadded one (sweep._pad_count) — hold only under
+# the partitionable threefry PRNG, where split(key, n) is prefix-stable.
+# Current jax has no other mode; 0.4.x defaults the flag OFF, which
+# silently breaks every mesh-vs-unmeshed parity guarantee. Flip it at
+# import, before any key is made (keys themselves are mode-independent;
+# only derived streams change, uniformly for the whole process).
+try:
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)
+except AttributeError:  # newer jax: partitionable is the only behavior
+    pass
+
+
+if hasattr(jax, "shard_map"):  # jax >= 0.6: the top-level API
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:  # jax 0.4.x/0.5.x: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(lax, "pcast"):
+    pcast = lax.pcast
+else:
+
+    def pcast(x, axis_name, *, to):  # noqa: ARG001 - mirror lax.pcast
+        return x
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` where it exists (newer jax);
+    the runtime's client handle on 0.4.x, which predates the accessor."""
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU platform for tests/dry runs.
+
+    Must run before the XLA backend initializes (same constraint as the
+    config it wraps); on old jax the XLA_FLAGS route is additionally
+    inherited by subprocesses, which the multi-process tests rely on.
+    """
+    # replace (not just append) any inherited count: a pytest parent's
+    # XLA_FLAGS propagates into worker subprocesses that want their own
+    kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # old jax: XLA_FLAGS above already did it
+        pass

@@ -300,6 +300,7 @@ def nmfconsensus(
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
+    exec_cache=None,
 ) -> ConsensusResult:
     """Full consensus-NMF rank sweep (the reference's ``runExample`` pipeline,
     nmf.r:6-14, minus the hardcoded paths).
@@ -333,6 +334,13 @@ def nmfconsensus(
     its straggler-tail cascade — an int or decreasing tuple of pool
     widths (``ConsensusConfig.grid_tail_slots``; "auto"/0-to-disable;
     per-job stop decisions identical in every case).
+
+    ``exec_cache``: an ``nmfx.exec_cache.ExecCache`` serving this and
+    future calls — repeat requests whose dataset shapes land in an
+    already-compiled bucket skip the sweep's trace+compile entirely
+    (results are shape-exact: the bucket only pads the execution).
+    Ignored for non-cacheable configurations and checkpointed runs; see
+    ``docs/serving.md``.
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
@@ -374,7 +382,7 @@ def nmfconsensus(
         profiler = NullProfiler()
 
     raw = sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
-                profiler=profiler)
+                profiler=profiler, exec_cache=exec_cache)
 
     # Device-path rank selection is dispatched for every k BEFORE anything
     # is pulled to host, so the clustering overlaps the transfer below.

@@ -68,6 +68,24 @@ def _tail_slots_arg(value: str):
     return widths
 
 
+def _warm_shapes_arg(value: str) -> tuple[tuple[int, int], ...]:
+    """'5000x500,20000x1000' -> ((5000, 500), (20000, 1000)); validated
+    at parse time so a bad spec is a usage error."""
+    shapes = []
+    for part in value.split(","):
+        try:
+            m, n = part.strip().lower().split("x")
+            shapes.append((int(m), int(n)))
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected comma-separated MxN shapes (e.g. "
+                f"'5000x500,20000x1000'), got {value!r}")
+        if shapes[-1][0] < 1 or shapes[-1][1] < 1:
+            raise argparse.ArgumentTypeError(
+                f"shape dims must be >= 1, got {part.strip()!r}")
+    return tuple(shapes)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="nmfx",
@@ -161,6 +179,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "'auto' (default) = measured default; 0 disables. "
                         "Affects wall-clock only (stop decisions "
                         "identical on all tested workloads)")
+    p.add_argument("--exec-cache", action="store_true",
+                   help="serve the sweep through the shape-bucketed "
+                        "executable-reuse layer (nmfx.exec_cache): one "
+                        "AOT-compiled executable per padded-shape bucket, "
+                        "reused across datasets of nearby shapes — "
+                        "results are shape-exact (see docs/serving.md)")
+    p.add_argument("--warm-shapes", default=None, metavar="MxN[,MxN...]",
+                   type=_warm_shapes_arg,
+                   help="pre-compile the exec-cache executables for these "
+                        "dataset shapes' buckets before the run (e.g. "
+                        "'5000x500,20000x1000') — makes warmup explicit "
+                        "and batchable at startup instead of paying the "
+                        "20-odd-second sweep compile on first traffic; "
+                        "implies --exec-cache")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "
@@ -268,17 +300,63 @@ def main(argv: list[str] | None = None) -> int:
             mesh = grid_mesh(None, args.feature_shards, args.sample_shards)
         except ValueError as e:
             parser.error(str(e))
+    # ONE SolverConfig for warmup and the run: the exec-cache key hashes
+    # it, so warming with a copy that could drift from the run's config
+    # would silently compile a never-hit executable
+    run_scfg = SolverConfig(algorithm=args.algorithm,
+                            max_iter=args.maxiter,
+                            matmul_precision=args.precision,
+                            backend=args.backend,
+                            restart_chunk=args.restart_chunk)
+    exec_cache = None
+    if args.exec_cache or args.warm_shapes:
+        from nmfx.config import ConsensusConfig, InitConfig
+        from nmfx.exec_cache import ExecCache
+        from nmfx.sweep import default_mesh
+
+        if mesh is not None:
+            parser.error("--exec-cache does not compose with "
+                         "--feature-shards/--sample-shards (the grid "
+                         "builders do their own shape padding)")
+        if args.checkpoint_dir is not None:
+            # sweep() routes checkpointed runs past the cache — erroring
+            # here beats silently paying the warmup compile twice
+            parser.error("--exec-cache/--warm-shapes do not compose with "
+                         "--checkpoint-dir (checkpointed sweeps resume "
+                         "through the registry path, which bypasses the "
+                         "executable cache)")
+        exec_cache = ExecCache()
+        if args.warm_shapes:
+            cache_mesh = None if args.no_mesh else default_mesh()
+            # must mirror nmfconsensus' own ConsensusConfig construction
+            # field-for-field (same key requirement as run_scfg above) —
+            # wire any new sweep-shaping CLI flag into BOTH
+            warm_ccfg = ConsensusConfig(
+                ks=args.ks, restarts=args.restarts, seed=args.seed,
+                label_rule=args.label_rule, linkage=args.linkage,
+                keep_factors=args.keep_factors,
+                grid_exec=args.grid_exec, grid_slots=args.grid_slots,
+                grid_tail_slots=args.grid_tail_slots)
+            if not exec_cache.cacheable(warm_ccfg, run_scfg, cache_mesh):
+                parser.error(
+                    "--warm-shapes needs an exec-cacheable configuration "
+                    "(an algorithm/backend the whole-grid scheduler runs "
+                    "— see ExecCache.cacheable)")
+            for rec in exec_cache.warm(args.warm_shapes, warm_ccfg,
+                                       run_scfg,
+                                       InitConfig(method=args.init),
+                                       cache_mesh):
+                print(f"nmfx: warmed bucket {rec['bucket']} for shape "
+                      f"{rec['shape']} in {rec['compile_s']}s"
+                      + (" (already warm)" if rec["cache_hit"] else ""),
+                      file=sys.stderr)
     with profiler:
         result = nmfconsensus(
             args.dataset,
             ks=args.ks,
             restarts=args.restarts,
             seed=args.seed,
-            solver_cfg=SolverConfig(algorithm=args.algorithm,
-                                    max_iter=args.maxiter,
-                                    matmul_precision=args.precision,
-                                    backend=args.backend,
-                                    restart_chunk=args.restart_chunk),
+            solver_cfg=run_scfg,
             init=args.init,
             label_rule=args.label_rule,
             linkage=args.linkage,
@@ -292,6 +370,7 @@ def main(argv: list[str] | None = None) -> int:
             output=output,
             checkpoint_dir=args.checkpoint_dir,
             profiler=profiler,
+            exec_cache=exec_cache,
         )
     if args.save_result:
         result.save(args.save_result)

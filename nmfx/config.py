@@ -313,6 +313,53 @@ class ConsensusConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ExecCacheConfig:
+    """Executable-reuse policy for the serving layer (``nmfx/exec_cache.py``).
+
+    The sweep's trace+compile dwarfs a warm solve (measured 22.3 s compile
+    against 1.85 s solve at the north star, BENCH_r05), and XLA keys
+    executables by EXACT shape — so serving datasets of nearby shapes
+    recompiles from scratch every time. The cache instead rounds incoming
+    ``(m, n)`` up to a coarse padded-shape lattice and reuses one compiled
+    executable per bucket; zero padding is exactly invariant under every
+    grid solver (the invariant the feature/sample sharding already relies
+    on — see ``nmfx/ops/grid_mu.py``), and pad rows/columns are masked out
+    of consensus/labels/dnorms inside the executable.
+    """
+
+    #: lattice quanta: shapes round up to a multiple of a step that starts
+    #: at the quantum and doubles once the dimension exceeds
+    #: ``growth_steps`` steps — relative padding overhead stays below
+    #: 2/growth_steps while the bucket count stays logarithmic. The
+    #: defaults land the north-star 5000×500 on 5120×512 (the
+    #: hardware-probed VMEM boundary shape): m steps are multiples of the
+    #: pallas block row alignment, n steps of the 128-lane tile
+    m_quantum: int = 256
+    n_quantum: int = 64
+    growth_steps: int = 8
+    #: LRU bound on LIVE compiled executables (each holds device buffers
+    #: for its constants and its compiled program — evicting drops the
+    #: reference so a re-request recompiles). The NNDSVD route's small
+    #: per-true-shape lane-init jits live in a separate module-level
+    #: pool (``sweep.bucketed_lane_init_fn``, lru_cache(128)) outside
+    #: this bound — orders of magnitude smaller than a sweep executable
+    #: each; the random-init fast path allocates none
+    max_entries: int = 8
+    #: donate the per-request initial-factor stacks to the executable
+    #: (they are rebuilt per request, so aliasing them away is safe;
+    #: applied only on backends where XLA honors donation)
+    donate_inits: bool = True
+
+    def __post_init__(self):
+        if self.m_quantum < 1 or self.n_quantum < 1:
+            raise ValueError("bucket quanta must be >= 1")
+        if self.growth_steps < 1:
+            raise ValueError("growth_steps must be >= 1")
+        if self.max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class OutputConfig:
     """File outputs (reference writes to hardcoded './temp*', nmf.r:157-159)."""
 

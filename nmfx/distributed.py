@@ -50,7 +50,9 @@ def initialize(coordinator_address: str | None = None,
     NOTE: must run before the XLA backend initializes — do not call
     ``jax.devices()``/``jax.process_count()`` (or run any computation) first.
     """
-    if jax.distributed.is_initialized():
+    from nmfx._compat import distributed_is_initialized
+
+    if distributed_is_initialized():
         return
     explicit = {k: v for k, v in (
         ("coordinator_address", coordinator_address),

@@ -1,0 +1,381 @@
+"""Executable-reuse serving layer: shape-bucketed AOT sweep cache plus a
+double-buffered host↔device pipeline.
+
+The reference amortizes nothing — every run re-spawns its R workers and
+re-loads ``libnmf.so`` from scratch (``nmf.r:53-119``). The TPU port
+inherited an analogous cold-start tax at a worse exchange rate: XLA keys
+compiled executables by EXACT input shape, so a service sweeping datasets
+of nearby-but-different shapes pays the full trace+compile — measured
+22.3 s against a 1.85 s warm solve at the north star (BENCH_r05) — on
+*every* new shape. Both MPI-FAUN (arxiv 1609.09154) and the distributed
+out-of-memory NMF line (arxiv 2202.09518) identify data movement, not
+FLOPs, as the binding constraint for alternating-update NMF at scale;
+this module attacks both ends:
+
+* **Shape buckets** (``ExecCacheConfig``): incoming ``(m, n)`` rounds up
+  to a coarse lattice (quantum-aligned steps that double as the
+  dimension grows, so relative padding overhead stays bounded while the
+  bucket count stays logarithmic). One executable serves every dataset
+  in its bucket: A is zero-padded, the initial factors are drawn at the
+  TRUE shape outside the executable (``sweep.bucketed_lane_init_fn``) and
+  zero-padded in, and the executable masks pad columns out of
+  labels/consensus and renormalizes dnorms from dynamic true dims
+  (``sweep._build_bucketed_sweep_fn``) — the same exact-zero padding
+  invariant the feature/sample sharding already relies on.
+* **AOT compilation**: executables are built with
+  ``jax.jit(...).lower(...).compile()``, so warmup is explicit (CLI
+  ``--warm-shapes``), batchable at startup, and measurable
+  (``compile.cache_miss`` phase; hits mark ``compile.cache_hit``).
+  Entries are LRU-bounded (``max_entries``) — each live executable pins
+  device memory for its program.
+* **Transfer overlap**: :meth:`ExecCache.prefetch` starts the next
+  request's host→device transfer while the current sweep runs (the
+  transfer also overlaps the request's own lane-init compute, which for
+  random init never touches A); :func:`start_host_fetch` begins
+  non-blocking device→host copies of finished results so they stream
+  back during subsequent compute instead of paying one end barrier. The
+  lane-init buffers are donated to the executable where the backend
+  honors donation (``donate_inits``) — they are rebuilt per request, so
+  aliasing them away is free (cf. the proven-safe donation note in
+  ``pallas_mu.fused_block_iterations``).
+
+Cache keys cover everything that changes the compiled program: bucket
+shape, the rank set, restart count, the full SolverConfig (its dataclass
+hash — the solver-config fingerprint), label rule, keep_factors, the
+scheduler knobs, the mesh, and the jax version + backend platform.
+InitConfig is deliberately NOT in the key: initialization runs outside
+the executable, which is what makes one bucket executable serve every
+init scheme and true shape.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nmfx.config import (ConsensusConfig, ExecCacheConfig, InitConfig,
+                         SolverConfig)
+from nmfx.sweep import (KSweepOutput, _pad_count,
+                        _build_bucketed_sweep_fn, bucketed_lane_init_fn,
+                        grid_axes_active, grid_exec_ok)
+
+__all__ = ["ExecCache", "PlacedMatrix", "start_host_fetch", "bucket_dim"]
+
+
+def bucket_dim(x: int, quantum: int, growth_steps: int = 8) -> int:
+    """Round ``x`` up to the shape lattice: multiples of a step that
+    starts at ``quantum`` and doubles whenever the dimension exceeds
+    ``growth_steps`` steps — relative padding overhead stays below
+    2/growth_steps (the last doubling can land the step at up to
+    2x/growth_steps), bucket count logarithmic in the dimension.
+    (Defaults land the north-star 5000×500 on 5120×512, the
+    hardware-probed VMEM boundary shape.)"""
+    if x < 1:
+        raise ValueError(f"dimension must be >= 1, got {x}")
+    step = quantum
+    while step * growth_steps < x:
+        step *= 2
+    return -(-x // step) * step
+
+
+def start_host_fetch(tree) -> None:
+    """Begin non-blocking device→host copies for every array leaf.
+
+    The copies enqueue behind whatever compute produces the arrays and
+    populate each array's host-side cache, so a later ``device_get`` /
+    ``np.asarray`` finds the data already resident instead of paying a
+    blocking round trip per batch — results stream back WHILE the next
+    rank/request computes. Safe on any backend; arrays without an async
+    copy path are skipped (the later device_get then behaves as before).
+    """
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # no async path: the eventual device_get still works
+
+
+class PlacedMatrix(NamedTuple):
+    """A dataset already padded to its bucket and placed (possibly still
+    in flight — ``device_put`` is asynchronous) on device."""
+
+    a_pad: jax.Array  # (m_pad, n_pad), zero-padded
+    true_shape: tuple[int, int]
+    bucket: tuple[int, int]
+
+
+class _Entry(NamedTuple):
+    fn: "jax.stages.Wrapped"  # the jitted builder output (traceable)
+    compiled: "jax.stages.Compiled"  # the AOT executable actually called
+    bucket: tuple[int, int]
+    compile_s: float
+
+
+class ExecCache:
+    """LRU of AOT-compiled, shape-bucketed sweep executables.
+
+    One instance is meant to live for a serving process's lifetime and be
+    passed to ``nmfconsensus(exec_cache=...)`` / ``sweep(exec_cache=...)``
+    on every request; repeat requests whose shapes fall in a warm bucket
+    skip compilation entirely. Thread-hostile by design (like jit's own
+    caches): serialize requests or shard caches per worker.
+    """
+
+    def __init__(self, cfg: ExecCacheConfig = ExecCacheConfig()):
+        self.cfg = cfg
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- policy ------------------------------------------------------------
+    def bucket_shape(self, m: int, n: int) -> tuple[int, int]:
+        return (bucket_dim(m, self.cfg.m_quantum, self.cfg.growth_steps),
+                bucket_dim(n, self.cfg.n_quantum, self.cfg.growth_steps))
+
+    def cacheable(self, ccfg: ConsensusConfig, scfg: SolverConfig,
+                  mesh=None) -> bool:
+        """Whether this (config, mesh) can serve through the bucketed
+        executables: the whole-grid slot-scheduled engine must be able to
+        run it (``grid_exec_ok`` — excludes feature/sample-sharded
+        meshes, whose builders do their own shape padding) under a
+        grid-capable ``grid_exec`` mode, in a single-process job
+        (multi-host sweeps coordinate registry broadcasts the cache does
+        not replicate)."""
+        return (grid_exec_ok(scfg, mesh)
+                and ccfg.grid_exec in ("auto", "grid")
+                and not grid_axes_active(mesh)
+                and jax.process_count() == 1)
+
+    def _key(self, bucket: tuple[int, int], ccfg: ConsensusConfig,
+             scfg: SolverConfig, icfg: InitConfig, mesh) -> tuple:
+        tail = ccfg.grid_tail_slots
+        if isinstance(tail, list):
+            tail = tuple(tail)
+        # random init is baked INTO the executable (the zero-compile hit
+        # path), so its config keys the entry; NNDSVD lane batches are
+        # built outside per true shape and leave the executable
+        # init-agnostic
+        init_key = icfg if icfg.method == "random" else "external"
+        return (bucket, tuple(sorted(ccfg.ks, reverse=True)),
+                ccfg.restarts, scfg, init_key, ccfg.label_rule,
+                ccfg.keep_factors, ccfg.grid_slots, tail, mesh,
+                jax.__version__, jax.default_backend())
+
+    def _donate(self) -> bool:
+        # donation is a no-op-with-warning on backends that ignore it;
+        # keep the logs clean there
+        return (self.cfg.donate_inits
+                and jax.default_backend() in ("tpu", "gpu"))
+
+    # -- compilation -------------------------------------------------------
+    def executable(self, shape: tuple[int, int], ccfg: ConsensusConfig,
+                   scfg: SolverConfig = SolverConfig(),
+                   icfg: InitConfig = InitConfig(), mesh=None,
+                   profiler=None) -> tuple[_Entry, bool]:
+        """The (entry, was_hit) for a request shape — compiling AOT on
+        miss, LRU-touching on hit. ``shape`` is the TRUE (m, n); the
+        entry is keyed by its bucket, so any same-bucket shape returns
+        the same executable."""
+        prof = profiler if profiler is not None else _null()
+        bucket = self.bucket_shape(*shape)
+        inside_init = icfg.method == "random"
+        key = self._key(bucket, ccfg, scfg, icfg, mesh)
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            prof.mark("compile.cache_hit")
+            return entry, True
+        self.misses += 1
+        with prof.phase("compile.cache_miss"):
+            t0 = time.perf_counter()
+            tail = (tuple(ccfg.grid_tail_slots)
+                    if isinstance(ccfg.grid_tail_slots, list)
+                    else ccfg.grid_tail_slots)
+            fn = _build_bucketed_sweep_fn(
+                tuple(ccfg.ks), ccfg.restarts, scfg, ccfg.label_rule,
+                mesh, ccfg.keep_factors, ccfg.grid_slots, tail, bucket,
+                donate_inits=self._donate(),
+                init_cfg=icfg if inside_init else None)
+            m_pad, n_pad = bucket
+            dtype = jnp.dtype(scfg.dtype)
+            padded = _pad_count(ccfg.restarts, mesh)
+            k_max = max(ccfg.ks)
+            b = len(ccfg.ks) * padded  # ConsensusConfig dedupes ks
+            sharding = (NamedSharding(mesh, P()) if mesh is not None
+                        else None)
+
+            def struct(shape_, dt):
+                if sharding is None:
+                    return jax.ShapeDtypeStruct(shape_, dt)
+                return jax.ShapeDtypeStruct(shape_, dt, sharding=sharding)
+
+            i32 = (struct((), jnp.int32), struct((), jnp.int32),
+                   struct((), jnp.int32))
+            if inside_init:
+                # fn(a_pad, root_key, m_true, n_true, flip_floor)
+                compiled = fn.lower(
+                    struct((m_pad, n_pad), dtype),
+                    struct((), jax.random.key(0).dtype), *i32).compile()
+            else:
+                # fn(a_pad, w0, h0, m_true, n_true, flip_floor)
+                compiled = fn.lower(
+                    struct((m_pad, n_pad), dtype),
+                    struct((b, m_pad, k_max), dtype),
+                    struct((b, k_max, n_pad), dtype), *i32).compile()
+            compile_s = time.perf_counter() - t0
+        entry = _Entry(fn, compiled, bucket, compile_s)
+        self._entries[key] = entry
+        while len(self._entries) > self.cfg.max_entries:
+            # the compiled program's memory is held by entry.compiled;
+            # dropping the dict reference releases it (entry.fn is the
+            # lru_cached builder, whose own jit cache was never
+            # populated — this layer only calls .lower().compile())
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        return entry, False
+
+    def warm(self, shapes: Sequence[tuple[int, int]],
+             ccfg: ConsensusConfig, scfg: SolverConfig = SolverConfig(),
+             icfg: InitConfig = InitConfig(), mesh=None,
+             profiler=None) -> list[dict]:
+        """Batch-compile the executables for each shape's bucket at
+        startup (the CLI's ``--warm-shapes``). Returns one record per
+        shape: its bucket, whether it was already warm, and the compile
+        seconds paid."""
+        report = []
+        for m, n in shapes:
+            entry, hit = self.executable((m, n), ccfg, scfg, icfg, mesh,
+                                         profiler)
+            report.append({"shape": (m, n), "bucket": entry.bucket,
+                           "cache_hit": hit,
+                           "compile_s": round(entry.compile_s, 3)})
+        return report
+
+    @property
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "hits": self.hits,
+                "misses": self.misses, "evictions": self.evictions,
+                "max_entries": self.cfg.max_entries}
+
+    # -- the host<->device pipeline ---------------------------------------
+    def prefetch(self, a, scfg: SolverConfig = SolverConfig(),
+                 mesh=None, profiler=None) -> PlacedMatrix:
+        """Pad ``a`` to its bucket and START its host→device transfer.
+
+        ``device_put`` is asynchronous: this returns immediately, so
+        calling it for request i+1 right after dispatching request i's
+        solve overlaps the transfer with compute — the double-buffering
+        half of the pipeline. Passing the returned handle to
+        :meth:`run_sweep` skips the placement wait entirely.
+        """
+        prof = profiler if profiler is not None else _null()
+        dtype = jnp.dtype(scfg.dtype)
+        m, n = a.shape
+        bucket = self.bucket_shape(m, n)
+        m_pad, n_pad = bucket
+        with prof.phase("xfer.overlap"):
+            if isinstance(a, jax.Array):
+                a_pad = jnp.pad(jnp.asarray(a, dtype),
+                                ((0, m_pad - m), (0, n_pad - n)))
+            else:
+                ah = np.zeros(bucket, dtype)
+                ah[:m, :n] = np.asarray(a, dtype)
+                a_pad = ah
+            if mesh is not None:
+                a_pad = jax.device_put(a_pad, NamedSharding(mesh, P()))
+            else:
+                a_pad = jax.device_put(a_pad)
+        return PlacedMatrix(a_pad, (m, n), bucket)
+
+    def run_sweep(self, a, ccfg: ConsensusConfig,
+                  scfg: SolverConfig = SolverConfig(),
+                  icfg: InitConfig = InitConfig(), mesh=None, *,
+                  profiler=None) -> dict[int, KSweepOutput]:
+        """One full (k × restart) sweep through the bucketed executable —
+        the drop-in serving counterpart of ``sweep.sweep`` (same result
+        contract: true-shape per-k ``KSweepOutput``).
+
+        ``a`` may be a raw matrix or a :class:`PlacedMatrix` from
+        :meth:`prefetch`. Under a NullProfiler nothing here blocks: the
+        solve dispatches asynchronously and the results' host copies are
+        started non-blocking, so callers that pipeline requests get full
+        transfer/compute overlap; a real profiler deliberately blocks
+        per phase for honest attribution (its documented contract).
+        """
+        prof = profiler if profiler is not None else _null()
+        if not self.cacheable(ccfg, scfg, mesh):
+            raise ValueError(
+                "configuration is not cacheable (see ExecCache.cacheable)"
+                " — route it through nmfx.sweep.sweep instead")
+        placed = (a if isinstance(a, PlacedMatrix)
+                  else self.prefetch(a, scfg, mesh, profiler=prof))
+        m_true, n_true = placed.true_shape
+        entry, _ = self.executable(placed.true_shape, ccfg, scfg, icfg,
+                                   mesh, prof)
+        # host-side (the executable's static n is the bucket width, so
+        # it cannot compute floor(tol·n_true) itself), via the SAME
+        # helper batch_convergence uses — decision parity by sharing
+        from nmfx.ops.packed_mu import flip_budget
+
+        flip = flip_budget(scfg.class_flip_tol, n_true)
+        dev_args = (jnp.asarray(m_true, jnp.int32),
+                    jnp.asarray(n_true, jnp.int32),
+                    jnp.asarray(flip, jnp.int32))
+        rep = NamedSharding(mesh, P()) if mesh is not None else None
+        if rep is not None:
+            dev_args = tuple(jax.device_put(x, rep) for x in dev_args)
+        if icfg.method == "random":
+            # init happens INSIDE the executable with dynamic true dims
+            # (sweep._dyn_lane_init): a new shape in a warm bucket costs
+            # zero compilation
+            root = jax.random.key(ccfg.seed)
+            if rep is not None:
+                root = jax.device_put(root, rep)
+            solve_args = (placed.a_pad, root, *dev_args)
+        else:
+            with prof.phase("exec_cache.init") as sync:
+                # NNDSVD factors the true matrix: its lane batch is a
+                # small per-true-shape jit outside the executable
+                init_fn = bucketed_lane_init_fn(
+                    placed.true_shape, tuple(ccfg.ks),
+                    _pad_count(ccfg.restarts, mesh), icfg, scfg.dtype,
+                    placed.bucket)
+                a_true = placed.a_pad[:m_true, :n_true]
+                w0, h0 = sync(init_fn(a_true, jax.random.key(ccfg.seed)))
+            if rep is not None:
+                w0 = jax.device_put(w0, rep)
+                h0 = jax.device_put(h0, rep)
+            solve_args = (placed.a_pad, w0, h0, *dev_args)
+        with prof.phase("solve.grid") as sync:
+            raw = sync(entry.compiled(*solve_args))
+        out = {k: _unpad(v, m_true, n_true) for k, v in raw.items()}
+        with prof.phase("xfer.overlap"):
+            start_host_fetch(out)
+        return out
+
+
+def _unpad(out_k: KSweepOutput, m: int, n: int) -> KSweepOutput:
+    """Slice one rank's padded outputs back to the request's true shape
+    (lazy device-side views; per-restart stats are already exact)."""
+    return out_k._replace(
+        consensus=out_k.consensus[:n, :n],
+        labels=out_k.labels[:, :n],
+        best_w=out_k.best_w[:m, :],
+        best_h=out_k.best_h[:, :n],
+        all_w=None if out_k.all_w is None else out_k.all_w[:, :m, :],
+        all_h=None if out_k.all_h is None else out_k.all_h[:, :, :n])
+
+
+def _null():
+    from nmfx.profiling import NullProfiler
+
+    return NullProfiler()

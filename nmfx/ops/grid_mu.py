@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nmfx._compat import pcast
 from nmfx.config import SolverConfig
 from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
@@ -377,6 +378,12 @@ def pad_live_mask(w0, h0, job_ks=None):
     coupling where the per-restart engine keeps it. Callers that know
     the lane composition must pass ``job_ks``."""
     if job_ks is not None:
+        if len(job_ks) != w0.shape[0]:
+            # clamped gathers would otherwise pair lanes with the wrong
+            # ranks silently (ADVICE.md round 5)
+            raise ValueError(
+                f"job_ks has {len(job_ks)} entries but the lane batch "
+                f"carries {w0.shape[0]} jobs")
         k_max = w0.shape[2]
         return jnp.asarray(
             [[c < k for c in range(k_max)] for k in job_ks], bool)
@@ -488,6 +495,24 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
         raise ValueError(
             f"the dense-batched grid drivers implement {tuple(BLOCKS)}, "
             f"got algorithm={cfg.algorithm!r}")
+    if job_ks is not None and len(job_ks) != h0.shape[0]:
+        raise ValueError(
+            f"job_ks has {len(job_ks)} entries but w0/h0 carry "
+            f"{h0.shape[0]} lanes — per-lane true ranks must match the "
+            "batch exactly")
+    if cfg.algorithm == "snmf" and job_ks is None:
+        # the inferred mask is exact for uniform-random init but NNDSVD
+        # can yield an exact-zero trailing component that it would
+        # misclassify as padding, dropping it from the beta coupling
+        # where the per-restart engine keeps it (see pad_live_mask)
+        import logging
+
+        logging.getLogger("nmfx").warning(
+            "mu_grid: snmf without job_ks infers the padding mask from "
+            "the initial factors; an NNDSVD init whose trailing "
+            "component is exactly zero would be misclassified as "
+            "padding — pass job_ks (the per-lane true ranks) when the "
+            "lane composition is known")
     cfg = conv_cfg(cfg)
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
@@ -499,7 +524,7 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
         def vary(x):
             for ax in varying_axes:
-                x = lax.pcast(x, ax, to="varying")
+                x = pcast(x, ax, to="varying")
             return x
 
         state0 = GridState(

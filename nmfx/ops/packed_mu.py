@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nmfx._compat import pcast
 from nmfx.config import SolverConfig
 from nmfx.solvers import base
 from nmfx.solvers.mu import _mu_update
@@ -218,9 +219,20 @@ def _labels(hp: jax.Array, r: int) -> jax.Array:
     return jnp.argmax(hp.reshape(r, -1, n), axis=1).astype(jnp.int32)
 
 
+def flip_budget(class_flip_tol: float, n: int) -> int:
+    """The class-stability flip budget ``floor(class_flip_tol · n)``, in
+    exact double math. The +eps before flooring matters: 0.3 · 10 is
+    2.999... in binary float and a bare ``int()`` would land one flip
+    below the documented floor. Single source for the in-executable rule
+    below AND the serving layer's host-side computation
+    (``exec_cache.run_sweep``), whose cached/uncached stop-decision
+    parity depends on the two being identical."""
+    return int(class_flip_tol * n + 1e-9)
+
+
 def batch_convergence(cfg: SolverConfig, it, *, new_classes, delta, n_glob,
                       classes, stable, done, done_iter, stop_reason,
-                      mism_reduce=None):
+                      mism_reduce=None, flip_floor=None):
     """(B,)-batched convergence bookkeeping shared by the packed and
     whole-grid formulations: the noise-tolerant class-stability snapshot
     rule plus the TolX test, with per-lane freeze flags — mirroring
@@ -231,16 +243,20 @@ def batch_convergence(cfg: SolverConfig, it, *, new_classes, delta, n_glob,
     caller's per-lane maxchange ratio, precomputed because its reductions
     are layout- and sharding-specific (or None when ``use_tol_checks`` is
     off); ``mism_reduce`` psums label mismatches when labels are
-    column-sharded. Returns the five updated bookkeeping arrays."""
+    column-sharded. ``flip_floor`` overrides the ``floor(class_flip_tol ·
+    n_glob)`` flip budget with a precomputed (possibly traced) i32 scalar
+    — the shape-bucketed executables compute it host-side from the TRUE
+    sample count in exact double math, since their static n is the padded
+    bucket width and a traced f32 ``floor`` would round differently.
+    Returns the five updated bookkeeping arrays."""
     is_check = (it > 1) & (it % cfg.check_every == 0)
     active = is_check & (~done)
     done_in = done
     reason = stop_reason
 
     if cfg.use_class_stop:
-        # +eps before flooring: 0.3 * 10 is 2.999... in binary float and
-        # int() would land one flip below the documented floor(tol * n)
-        flip_tol = int(cfg.class_flip_tol * n_glob + 1e-9)
+        flip_tol = (flip_budget(cfg.class_flip_tol, n_glob)
+                    if flip_floor is None else flip_floor)
         mism = jnp.sum((new_classes != classes).astype(jnp.int32), axis=1)
         if mism_reduce is not None:
             mism = mism_reduce(mism)
@@ -493,7 +509,7 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
         bd = block_diag_mask(r, k, dtype)
         def vary(x):
             for ax in varying_axes:
-                x = lax.pcast(x, ax, to="varying")
+                x = pcast(x, ax, to="varying")
             return x
 
         state0 = PackedState(

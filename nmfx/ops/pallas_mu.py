@@ -329,13 +329,24 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
     iteration of the block (max|Δ| and max|prev| over the column/row,
     reduced per lane by the caller).
 
-    The initial factors are NOT aliased onto the outputs: they arrive in
-    HBM (``memory_space=ANY``) and the kernel DMAs them into the resident
-    windows once at the first grid step. Round 3's
-    ``input_output_aliases`` formulation was bit-exact standalone but
-    silently read stale VMEM inside a ``lax.while_loop``/``lax.cond``
-    body on real hardware (see ``_block_kernel``'s comment and VERDICT.md
-    round 3); do not reintroduce it.
+    The DATA path for the initial factors is never an alias: they arrive
+    in HBM (``memory_space=ANY``) and the kernel DMAs them into the
+    resident windows once at the first grid step. Round 3's design made
+    the alias itself the data path (inputs aliased onto the VMEM output
+    windows, no explicit copy) — bit-exact standalone but silently
+    reading stale VMEM inside a ``lax.while_loop``/``lax.cond`` body on
+    real hardware (see ``_block_kernel``'s comment and VERDICT.md round
+    3); do not reintroduce THAT. ``alias_io=True`` is a different,
+    gate-validated thing: pure XLA buffer DONATION of the w/h HBM
+    buffers on top of the explicit step-0 DMA — the DMA still moves the
+    data, the alias only lets the while-loop carry update in place
+    instead of copying the packed factors every trip. It stays safe
+    because the constant-index output windows write back only after the
+    final grid step, long after the step-0 DMA has read the inputs (see
+    the ``alias_io`` note at the ``pallas_call`` below and
+    ``benchmarks/probe_alias_io.py`` for the bit-exactness bisect;
+    measured ~8% slower than the carry copies on v5e, so it stays
+    opt-in).
 
     VMEM budget (measured on v5e, round 4 —
     ``benchmarks/probe_vmem_envelope*.py``): W full-resident dominates;

@@ -45,11 +45,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from nmfx._compat import pcast
 from nmfx.config import SolverConfig
 from nmfx.ops.grid_mu import (BLOCKS, USES_TOLFUN, conv_cfg,
                               make_block, tolfun_update)
 from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
+
+
+#: once-per-process latch for the fault-injection banner below
+_stale_reload_warned = False
 
 
 def _stale_reload_fraction() -> float:
@@ -63,11 +68,29 @@ def _stale_reload_fraction() -> float:
     time, so it must be set before the first ``mu_sched`` call of a
     process (``benchmarks/probe_fault_gate.py`` runs ``bench.py
     --verify`` in a subprocess with it set and asserts the hardware
-    gate FAILS). Never set this in production."""
+    gate FAILS). Never set this in production — the banner below makes
+    sure an *inherited* env var (say, from a test-harness environment
+    spawning this process) cannot corrupt a run silently."""
     import os
 
-    return float(os.environ.get("NMFX_FAULT_INJECT_STALE_RELOAD", "0")
+    frac = float(os.environ.get("NMFX_FAULT_INJECT_STALE_RELOAD", "0")
                  or 0)
+    if frac > 0:
+        global _stale_reload_warned
+        if not _stale_reload_warned:
+            _stale_reload_warned = True
+            import logging
+            import sys
+
+            banner = (
+                "NMFX_FAULT_INJECT_STALE_RELOAD=%g is ACTIVE: slot "
+                "reloads are being deliberately corrupted (test-only "
+                "fault injection for the bench.py --verify gate). "
+                "Results from this process are INVALID — unset the "
+                "variable for real runs." % frac)
+            print(f"nmfx: *** {banner} ***", file=sys.stderr)
+            logging.getLogger("nmfx").warning(banner)
+    return frac
 
 
 def _stale_load_mask(load, gather):
@@ -303,7 +326,7 @@ class _RaggedState(NamedTuple):
 
 def _make_ragged_stage(layout, a_loop, w0, h0, cfg: SolverConfig,
                        kern_kw, vary, out0, *, m, m_pad, n, k_max, j,
-                       tw, drain_tail) -> "_RaggedState":
+                       tw, drain_tail, flip_floor=None) -> "_RaggedState":
     """Run the class-blocked main stage: one ``lax.while_loop`` whose
     body advances EVERY class's slots through one
     ``fused_block_iterations`` launch over the class-major packed
@@ -386,7 +409,8 @@ def _make_ragged_stage(layout, a_loop, w0, h0, cfg: SolverConfig,
                 n_glob=n, classes=st.classes[ci], stable=st.stable[ci],
                 done=~st.active[ci],
                 done_iter=jnp.zeros_like(it_c),
-                stop_reason=jnp.full_like(it_c, base.StopReason.MAX_ITER))
+                stop_reason=jnp.full_like(it_c, base.StopReason.MAX_ITER),
+                flip_floor=flip_floor)
             it_new.append(it_c)
             classes.append(cls_c)
             stable.append(stb_c)
@@ -602,6 +626,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              evict_batch: int = 1,
              factor_dtype: "str | None" = None,
              alias_io: bool = False,
+             flip_floor: "jax.Array | None" = None,
              ) -> SchedMUResult:
     """Solve J dense zero-padded jobs through an S-slot scheduler.
 
@@ -662,6 +687,11 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     input buffers as outputs (bit-exact at every bisect level — the
     explicit DMA is the data path — but measured ~8% SLOWER than the
     carry copies it targets; default off, see probe_alias_io.py).
+    ``flip_floor``: precomputed class-stability flip budget (i32 scalar,
+    may be traced) overriding ``floor(class_flip_tol · n)`` — the
+    shape-bucketed executables pass the TRUE sample count's budget while
+    n is the padded bucket width (``nmfx/exec_cache.py``; see
+    ``packed_mu.batch_convergence``).
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
@@ -677,6 +707,14 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     h0 = jnp.asarray(h0, dtype)
     j, m, k_max = w0.shape
     n = h0.shape[2]
+    if job_ks is not None and len(job_ks) != j:
+        # fail loudly: JAX clamps out-of-bounds gathers/scatters, so a
+        # wrong-length tuple would silently pair jobs with the wrong
+        # ranks (phantom ids gather wrong W0/H0 rows; a short tuple
+        # leaves jobs unsolved at zero factors) — ADVICE.md round 5
+        raise ValueError(
+            f"job_ks has {len(job_ks)} entries but w0/h0 carry {j} jobs "
+            "— per-job true ranks must match the job batch exactly")
     s = min(slots, j)
     ce_ok = cfg.max_iter % cfg.check_every == 0
     if ragged and not (use_pallas and ce_ok and job_ks is not None):
@@ -727,7 +765,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
         def vary(x):
             for ax in varying_axes:
-                x = lax.pcast(x, ax, to="varying")
+                x = pcast(x, ax, to="varying")
             return x
 
         # --- layout hooks: dense (S, m, k) lanes under XLA, or packed
@@ -992,7 +1030,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                     done=~st.active,
                     done_iter=jnp.zeros_like(st.slot_iter),
                     stop_reason=jnp.full_like(st.slot_iter,
-                                              base.StopReason.MAX_ITER))
+                                              base.StopReason.MAX_ITER),
+                    flip_floor=flip_floor)
                 dnorm = st.dnorm
                 if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
                     wd, hd = dense_views(wp, hp)
@@ -1097,7 +1136,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             st_r = _make_ragged_stage(
                 layout, a_loop, w0, h0, cfg, kern_kw, vary, out0,
                 m=m, m_pad=m_pad, n=n, k_max=k_max, j=j, tw=tw,
-                drain_tail=bool(tail_w))
+                drain_tail=bool(tail_w), flip_floor=flip_floor)
             stage_widths = [s_total, tw]
             stage_marks = [(st_r.n_trips, st_r.n_lanes)]
             st = _ragged_to_uniform(st_r, layout, tw, m_pad=m_pad, n=n,

@@ -78,6 +78,13 @@ class Profiler:
             rec.seconds += time.perf_counter() - t0
             rec.count += 1
 
+    def mark(self, name: str) -> None:
+        """Record an instantaneous event as a zero-duration phase
+        occurrence — the count column is the payload (e.g. the serving
+        layer's ``compile.cache_hit`` marks, where the whole point is
+        that no time was spent)."""
+        self.phases.setdefault(name, PhaseRecord(name)).count += 1
+
     # -- reporting ---------------------------------------------------------
     def total_seconds(self) -> float:
         if self._t_total is not None:
@@ -110,6 +117,9 @@ class NullProfiler(Profiler):
     @contextlib.contextmanager
     def phase(self, name: str):
         yield lambda x: x
+
+    def mark(self, name: str) -> None:
+        pass
 
     def report(self) -> str:
         return "profiling disabled"

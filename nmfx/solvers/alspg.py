@@ -71,7 +71,11 @@ def step(a, state: base.State, cfg: SolverConfig,
         w=jnp.where(hit, w, w_new),
         h=jnp.where(hit, h, res_h.x),
         done=state.done | hit,
-        stop_reason=jnp.where(hit, base.StopReason.PG_TOL, state.stop_reason),
+        # int32-pinned, as in pg.step: an IntEnum is not weakly typed on
+        # every jax, and int64 promotion under x64 would split the cond
+        # branches' State dtypes
+        stop_reason=jnp.where(hit, jnp.int32(base.StopReason.PG_TOL),
+                              state.stop_reason),
         aux=Aux(jnp.where(hit, aux.gradw, res_w.grad.T),
                 jnp.where(hit, aux.gradh, res_h.grad),
                 aux.initgrad,

@@ -145,7 +145,11 @@ def step(a, state: base.State, cfg: SolverConfig,
             w=jnp.where(hit, w, wn),
             h=jnp.where(hit, h, hn),
             done=state.done | hit,
-            stop_reason=jnp.where(hit, base.StopReason.PG_TOL,
+            # int32-pinned: an IntEnum is not weakly typed on every jax,
+            # and under x64 the promotion to int64 would make this cond
+            # branch's State disagree with first_iter's
+            stop_reason=jnp.where(hit,
+                                  jnp.int32(base.StopReason.PG_TOL),
                                   state.stop_reason),
             aux=Aux(aux.initgrad,
                     jnp.where(hit, aux.obj, obj),

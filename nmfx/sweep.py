@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nmfx._compat import shard_map
 from nmfx.config import (PACKED_ALGORITHMS, ConsensusConfig,
                          InitConfig, SolverConfig)
 from nmfx.consensus import consensus_matrix, labels_from_h
@@ -399,9 +400,9 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     # varying-manual-axes checker cannot infer that through the argmin-
     # over-gathered-candidates pattern, and no varying→invariant pcast
     # exists to assert it
-    sharded = jax.shard_map(shard_body, mesh=mesh,
-                            in_specs=(P(), P(RESTART_AXIS)),
-                            out_specs=P(), check_vma=False)
+    sharded = shard_map(shard_body, mesh=mesh,
+                        in_specs=(P(), P(RESTART_AXIS)),
+                        out_specs=P(), check_vma=False)
 
     def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
         a = jnp.asarray(a, dtype)
@@ -639,7 +640,7 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
         if (m_pad, n_pad) != (m_true, n_true):
             a = jnp.pad(a, ((0, m_pad - m_true), (0, n_pad - n_true)))
         keys = jax.random.split(key, padded)
-        sharded = jax.shard_map(
+        sharded = shard_map(
             partial(shard_body, m_true=m_true, n_true=n_true),
             mesh=mesh, in_specs=(a_specs, key_specs, w0_specs, h0_specs),
             out_specs=P(), check_vma=False)
@@ -773,9 +774,9 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
     # check_vma=False for the same reason as the per-k packed builder: the
     # outputs ARE replicated but the checker can't see it through the
     # argmin-over-gathered-candidates pattern
-    sharded = jax.shard_map(shard_body, mesh=mesh,
-                            in_specs=(P(), P(None, RESTART_AXIS)),
-                            out_specs=P(), check_vma=False)
+    sharded = shard_map(shard_body, mesh=mesh,
+                        in_specs=(P(), P(None, RESTART_AXIS)),
+                        out_specs=P(), check_vma=False)
 
     def impl(a: jax.Array, root_key: jax.Array) -> dict[int, KSweepOutput]:
         a = jnp.asarray(a, dtype)
@@ -785,6 +786,277 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
         return sharded(a, keys)
 
     return jax.jit(impl)
+
+
+@lru_cache(maxsize=128)
+def bucketed_lane_init_fn(true_shape: tuple[int, int], ks: tuple[int, ...],
+                          padded_restarts: int, init_cfg: InitConfig,
+                          dtype_str: str, bucket_shape: tuple[int, int]):
+    """Jitted lane-initializer for the shape-bucketed executables
+    (``nmfx/exec_cache.py``): draws every (k, restart) cell's W0/H0 at the
+    TRUE shape from the canonical keys — ``fold_in(root, k)`` split over
+    the restart axis, exactly the per-k/grid paths' chain — then
+    zero-pads to the bucket lattice, rank-major, rank-descending.
+
+    Init happens OUTSIDE the cached sweep executable on purpose: random
+    draws are shape-keyed (drawing at the padded shape would change every
+    restart vs the exact-shape sweep) and NNDSVD factors the true matrix.
+    The per-true-shape compile this costs is the cheap one — a vmapped
+    draw or one SVD — while the 20-odd-second sweep compile stays keyed
+    by bucket. Padding rows/columns start exactly zero and stay exactly
+    zero under every grid solver (``grid_mu`` module docstring), the same
+    invariant the feature/sample sharding relies on.
+    """
+    m_true, n_true = true_shape
+    m_pad, n_pad = bucket_shape
+    ks = tuple(sorted(ks, reverse=True))  # LPT dispatch order
+    k_max = max(ks)
+    dtype = jnp.dtype(dtype_str)
+
+    def build(a_true: jax.Array, root_key: jax.Array):
+        w0l, h0l = [], []
+        for k in ks:
+            keys = jax.random.split(jax.random.fold_in(root_key, k),
+                                    padded_restarts)
+            w0s, h0s = jax.vmap(
+                lambda kk, k=k: initialize(kk, a_true, k, init_cfg,
+                                           dtype))(keys)
+            w0l.append(jnp.pad(w0s, ((0, 0), (0, m_pad - m_true),
+                                     (0, k_max - k))))
+            h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k),
+                                     (0, n_pad - n_true))))
+        return jnp.concatenate(w0l), jnp.concatenate(h0l)
+
+    return jax.jit(build)
+
+
+def _dyn_lane_init(init_cfg: InitConfig, dtype, n_pad: int, m_pad: int,
+                   k_max: int):
+    """Lane initializer with DYNAMIC true dims for the bucketed
+    executables: reproduces ``random_init``'s exact (m_true, n_true)
+    draws from inside a bucket-shaped jit, zero-padded to the lattice.
+
+    Exactness rests on two properties of the partitionable threefry PRNG
+    (enforced by ``nmfx._compat``; pinned by
+    tests/test_exec_cache.py::test_threefry_flat_index_properties):
+    draws are counter-based per FLAT element index, so (a) a draw with
+    the same trailing column count is row-prefix-stable —
+    ``uniform(kw, (m_pad, k))[:m_true]`` equals the true W0 draw — and
+    (b) a 1-D draw gathered at ``i·n_true + j`` equals element (i, j) of
+    the true 2-D H0 draw. Pad entries are masked to exact zero, the
+    padding invariant every grid solver preserves."""
+    minval, maxval = init_cfg.minval, init_cfg.maxval
+
+    def init_one(kk, k, m_true, n_true):
+        kw, kh = jax.random.split(kk)
+        w = jax.random.uniform(kw, (m_pad, k), dtype, minval, maxval)
+        w = jnp.where(jnp.arange(m_pad)[:, None] < m_true, w, 0.0)
+        hu = jax.random.uniform(kh, (k * n_pad,), dtype, minval, maxval)
+        i = jnp.arange(k)[:, None]
+        j = jnp.arange(n_pad)[None, :]
+        # max gather index (k-1)·n_true + n_pad-1 < k·n_pad: in bounds
+        h = jnp.where(j < n_true, hu[i * n_true + j], 0.0)
+        return w, h
+
+    def build(rank_keys, m_true, n_true):
+        """[(k, (r,) keys)] → padded (B, m_pad, k_max) / (B, k_max, n_pad)
+        lane stacks, rank-major (the ``_init_lanes`` layout)."""
+        w0l, h0l = [], []
+        for k, keys in rank_keys:
+            w0s, h0s = jax.vmap(
+                lambda kk, k=k: init_one(kk, k, m_true, n_true))(keys)
+            w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+            h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+        return jnp.concatenate(w0l), jnp.concatenate(h0l)
+
+    return build
+
+
+@lru_cache(maxsize=32)
+def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
+                             solver_cfg: SolverConfig, label_rule: str,
+                             mesh: Mesh | None, keep_factors: bool,
+                             grid_slots: int, grid_tail_slots,
+                             bucket_shape: tuple[int, int],
+                             donate_inits: bool = False,
+                             init_cfg: InitConfig | None = None):
+    """Sweep builder for the shape-bucketed executable-reuse layer
+    (``nmfx/exec_cache.py``): the whole-grid slot-scheduled solve of
+    ``_build_grid_exec_sweep_fn``, restructured so ONE compiled
+    executable serves every dataset whose shape rounds up to
+    ``bucket_shape``.
+
+    With ``init_cfg`` (random init only) the built function is
+
+        fn(a_pad, root_key, m_true, n_true, flip_floor) -> {k: KSweepOutput}
+
+    — initialization happens INSIDE the executable with dynamic true
+    dims (``_dyn_lane_init``), so a new true shape in a warm bucket
+    costs literally zero compilation. Without it (the NNDSVD route,
+    whose SVD factors the true matrix) the signature is
+
+        fn(a_pad, w0, h0, m_true, n_true, flip_floor)
+
+    with the lane batch pre-built per true shape by
+    ``bucketed_lane_init_fn`` (a small per-shape jit — the one compile
+    NNDSVD requests still pay).
+
+    ``a_pad`` is the zero-padded (m_pad, n_pad) matrix and ``m_true``/
+    ``n_true``/``flip_floor`` are DYNAMIC i32 scalars: the executable
+    masks pad columns out of labels (-1) and hence the one-hot consensus
+    reduction, rescales the RMS dnorms from the padded to the true
+    normalizer (the residual sums themselves get exact-zero pad
+    contributions), and threads the true sample count's class-stability
+    flip budget into the scheduler (``mu_sched(flip_floor=...)``) — so
+    nothing user-visible depends on the bucket, only on the data.
+    Outputs keep padded extents (the cache's host layer slices them);
+    per-restart stats are exact.
+
+    ``donate_inits`` donates the external lane-batch buffers to the
+    executable (they are rebuilt per request; ignored for the
+    inside-init signature, which has none).
+    """
+    from nmfx.ops.sched_mu import mu_sched
+
+    ks = tuple(sorted(ks, reverse=True))
+    k_max = max(ks)
+    m_pad, n_pad = bucket_shape
+    padded = _pad_count(restarts, mesh)
+    dtype = jnp.dtype(solver_cfg.dtype)
+    inside_init = init_cfg is not None
+    if inside_init and init_cfg.method != "random":
+        raise ValueError(
+            "inside-executable init is the random-init fast path; NNDSVD "
+            "lane batches are built per true shape (pass init_cfg=None)")
+    dyn_init = (_dyn_lane_init(init_cfg, dtype, n_pad, m_pad, k_max)
+                if inside_init else None)
+    donate = (1, 2) if donate_inits and not inside_init else ()
+
+    def _true_scale(m_true, n_true, ref_dtype):
+        # pad entries contribute exact zeros to the Frobenius sums, so
+        # only the √(mn) normalizer differs; float math — i32 m·n can
+        # overflow at large shapes
+        true_mn = (m_true.astype(jnp.float32)
+                   * n_true.astype(jnp.float32))
+        return jnp.sqrt(float(m_pad * n_pad) / true_mn).astype(ref_dtype)
+
+    def _rank_keys(root_key, r):
+        """The canonical per-(k, restart) key chain of the per-k/grid
+        paths: fold_in(root, k), split over the (padded) restart axis."""
+        return [(k, jax.random.split(jax.random.fold_in(root_key, k), r))
+                for k in ks]
+
+    if (mesh is None or RESTART_AXIS not in mesh.axis_names
+            or mesh.shape[RESTART_AXIS] == 1):
+        job_ks = tuple(k for k in ks for _ in range(padded))
+
+        def run(a_pad, w0, h0, m_true, n_true,
+                flip_floor) -> dict[int, KSweepOutput]:
+            a_pad = jnp.asarray(a_pad, dtype)
+            res = mu_sched(a_pad, w0, h0, solver_cfg, slots=grid_slots,
+                           tail_slots=grid_tail_slots, job_ks=job_ks,
+                           flip_floor=flip_floor)
+            scale = _true_scale(m_true, n_true, res.dnorm.dtype)
+            valid = jnp.arange(n_pad) < n_true
+            out: dict[int, KSweepOutput] = {}
+            for g, k in enumerate(ks):
+                sl = slice(g * padded, g * padded + restarts)
+                hk = res.h[sl, :k, :]
+                wk = res.w[sl, :, :k]
+                labels = jax.vmap(partial(labels_from_h,
+                                          rule=label_rule))(hk)
+                # pad columns → -1: one_hot drops them from the
+                # consensus reduction and the host layer slices them off
+                labels = jnp.where(valid[None, :], labels, -1)
+                cons = consensus_matrix(labels, k)
+                dnorm = res.dnorm[sl] * scale
+                best = jnp.argmin(dnorm)
+                extra = (wk, hk) if keep_factors else (None, None)
+                out[k] = KSweepOutput(cons, res.iterations[sl], dnorm,
+                                      res.stop_reason[sl], labels,
+                                      wk[best], hk[best], *extra)
+            return out
+
+        if inside_init:
+
+            def impl(a_pad, root_key, m_true, n_true, flip_floor):
+                w0, h0 = dyn_init(_rank_keys(root_key, padded),
+                                  m_true, n_true)
+                return run(a_pad, w0, h0, m_true, n_true, flip_floor)
+
+            return jax.jit(impl)
+
+        return jax.jit(run, donate_argnums=donate)
+
+    n_shards = mesh.shape[RESTART_AXIS]
+    r_local = padded // n_shards
+    job_ks_loc = tuple(k for k in ks for _ in range(r_local))
+
+    def shard_core(a_pad, w0, h0, m_true, n_true,
+                   flip_floor) -> dict[int, KSweepOutput]:
+        res = mu_sched(a_pad, w0, h0, solver_cfg, slots=grid_slots,
+                       varying_axes=(RESTART_AXIS,),
+                       tail_slots=grid_tail_slots, job_ks=job_ks_loc,
+                       flip_floor=flip_floor)
+        scale = _true_scale(m_true, n_true, res.dnorm.dtype)
+        valid_col = jnp.arange(n_pad) < n_true
+        gidx = (lax.axis_index(RESTART_AXIS) * r_local
+                + jnp.arange(r_local))
+        valid_lane = gidx < restarts
+        out: dict[int, KSweepOutput] = {}
+        for g, k in enumerate(ks):
+            sl = slice(g * r_local, (g + 1) * r_local)
+            hk = res.h[sl, :k, :]
+            wk = res.w[sl, :, :k]
+            labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hk)
+            labels = jnp.where(valid_col[None, :], labels, -1)
+            out[k] = _sharded_rank_output(k, labels, res.iterations[sl],
+                                          res.dnorm[sl] * scale,
+                                          res.stop_reason[sl], wk, hk,
+                                          valid_lane, restarts,
+                                          keep_factors)
+        return out
+
+    if inside_init:
+
+        def shard_body_keys(a_pad, keys, m_true, n_true, flip_floor):
+            # keys: this shard's (n_ks, r_local) key block — same
+            # canonical chain, just sharded before the per-lane draws
+            w0, h0 = dyn_init([(k, keys[g]) for g, k in enumerate(ks)],
+                              m_true, n_true)
+            return shard_core(a_pad, w0, h0, m_true, n_true, flip_floor)
+
+        sharded = shard_map(shard_body_keys, mesh=mesh,
+                            in_specs=(P(), P(None, RESTART_AXIS),
+                                      P(), P(), P()),
+                            out_specs=P(), check_vma=False)
+
+        def impl(a_pad, root_key, m_true, n_true, flip_floor):
+            a_pad = jnp.asarray(a_pad, dtype)
+            keys = jnp.stack([kk for _, kk in _rank_keys(root_key,
+                                                         padded)])
+            return sharded(a_pad, keys, m_true, n_true, flip_floor)
+
+        return jax.jit(impl)
+
+    def shard_body(a_pad, w0s, h0s, m_true, n_true, flip_floor):
+        w0 = w0s.reshape(len(ks) * r_local, m_pad, k_max)
+        h0 = h0s.reshape(len(ks) * r_local, k_max, n_pad)
+        return shard_core(a_pad, w0, h0, m_true, n_true, flip_floor)
+
+    sharded = shard_map(shard_body, mesh=mesh,
+                        in_specs=(P(), P(None, RESTART_AXIS),
+                                  P(None, RESTART_AXIS), P(), P(), P()),
+                        out_specs=P(), check_vma=False)
+
+    def impl(a_pad, w0, h0, m_true, n_true,
+             flip_floor) -> dict[int, KSweepOutput]:
+        a_pad = jnp.asarray(a_pad, dtype)
+        w0s = w0.reshape(len(ks), padded, m_pad, k_max)
+        h0s = h0.reshape(len(ks), padded, k_max, n_pad)
+        return sharded(a_pad, w0s, h0s, m_true, n_true, flip_floor)
+
+    return jax.jit(impl, donate_argnums=donate)
 
 
 def grid_mesh(restart_shards: int | None = None,
@@ -874,7 +1146,8 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
           solver_cfg: SolverConfig = SolverConfig(),
           init_cfg: InitConfig = InitConfig(),
           mesh: Mesh | None = None,
-          registry=None, profiler=None) -> dict[int, KSweepOutput]:
+          registry=None, profiler=None,
+          exec_cache=None) -> dict[int, KSweepOutput]:
     """Full (k × restart) grid — by default as ONE whole-grid solve.
 
     Under ``cfg.grid_exec`` "grid"/"auto" (and an eligible config, see
@@ -889,11 +1162,22 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     With a ``registry`` (nmfx.registry.SweepRegistry), each finished rank is
     checkpointed and a re-run resumes from the completed ranks instead of
     recomputing them (SURVEY.md §5 checkpoint/resume); under grid
-    execution the still-missing ranks form one (smaller) grid solve."""
+    execution the still-missing ranks form one (smaller) grid solve.
+
+    ``exec_cache`` (nmfx.exec_cache.ExecCache): serve the sweep through
+    the shape-bucketed executable-reuse layer when the configuration is
+    cacheable (:meth:`ExecCache.cacheable`) — repeat requests whose
+    shapes land in an already-compiled bucket skip the trace+compile
+    entirely. Falls back to the normal path for non-cacheable configs
+    and for checkpointed (``registry``) runs."""
     if profiler is None:
         from nmfx.profiling import NullProfiler
 
         profiler = NullProfiler()
+    if (exec_cache is not None and registry is None
+            and exec_cache.cacheable(cfg, solver_cfg, mesh)):
+        return exec_cache.run_sweep(a, cfg, solver_cfg, init_cfg, mesh,
+                                    profiler=profiler)
     # Multi-host discipline: every process must take the same compute-vs-skip
     # branch for each k, or the skippers never join the collectives compiled
     # into the sharded sweep and the job deadlocks. The coordinator (the only
@@ -952,6 +1236,13 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
         t0 = time.perf_counter()
         with profiler.phase("solve.grid") as sync:
             solved = sync(fn(a_dev, root))
+        from nmfx.exec_cache import start_host_fetch
+
+        with profiler.phase("xfer.overlap"):
+            # begin non-blocking device→host copies NOW: by the time the
+            # pipeline's batched device_get runs (after rank-selection
+            # dispatch), the results are already streaming/resident
+            start_host_fetch(solved)
         out.update(solved)
         if 0 < _log.level <= logging.INFO and coord:
             iters = {k: float(np.asarray(v.iterations).mean())
@@ -975,6 +1266,13 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
                                       solver_cfg, init_cfg, cfg.label_rule,
                                       mesh, cfg.keep_factors,
                                       cfg.grid_slots, cfg.grid_tail_slots))
+        from nmfx.exec_cache import start_host_fetch
+
+        with profiler.phase("xfer.overlap"):
+            # non-blocking: rank k's results stream to host while rank
+            # k+1 compiles/solves, instead of all ranks paying one end
+            # barrier at the pipeline's device_get
+            start_host_fetch(out[k])
         if 0 < _log.level <= logging.INFO and coord:
             # reading the stats forces a device sync, trading the k-grid's
             # async dispatch pipelining for live progress. Gated on a level

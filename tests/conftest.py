@@ -13,8 +13,9 @@ time still win, as long as no test module touches devices at import time.
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from nmfx._compat import force_cpu_devices
+
+force_cpu_devices(8)
 
 import numpy as np
 import pytest

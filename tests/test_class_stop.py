@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from nmfx._compat import shard_map
 from nmfx.config import SolverConfig
 from nmfx.ops import packed_mu as pm
 from nmfx.solvers import base
@@ -237,7 +238,7 @@ def test_sharded_check_counts_global_mismatches():
         out = pm._check(st, cfg, r, sample_axis="s", n_total=n_glob)
         return out.stable, out.done
 
-    stable, done = jax.jit(jax.shard_map(
+    stable, done = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P(None, "s"), P(None, "s")),
         out_specs=(P(), P()), check_vma=False))(hp, snap_j)
     # 4 global mismatches > flip_tol=2: reset, no fire
@@ -248,7 +249,7 @@ def test_sharded_check_counts_global_mismatches():
     cur2 = snap.copy()
     cur2[0, [0, 8]] = 1
     hp2 = jnp.asarray(one_hot_hp(cur2))
-    stable2, done2 = jax.jit(jax.shard_map(
+    stable2, done2 = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P(None, "s"), P(None, "s")),
         out_specs=(P(), P()), check_vma=False))(hp2, snap_j)
     assert int(np.asarray(stable2)[0]) == 3

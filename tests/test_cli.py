@@ -169,3 +169,36 @@ def test_cli_version(capsys):
         main(["--version"])
     assert e.value.code == 0
     assert nmfx.__version__ in capsys.readouterr().out
+
+
+def test_cli_exec_cache_and_warm_shapes(gct_path, capsys):
+    # warmup shares the run's bucket: the sweep itself must HIT the
+    # warmed executable (demo.gct is 60x16; warm a nearby shape)
+    rc = main([gct_path, "--ks", "2-3", "--restarts", "4",
+               "--maxiter", "150", "--no-files",
+               "--warm-shapes", "64x16"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "warmed bucket" in cap.err
+
+
+def test_cli_warm_shapes_validation(gct_path):
+    with pytest.raises(SystemExit):
+        main([gct_path, "--warm-shapes", "60xx16", "--no-files"])
+    with pytest.raises(SystemExit):
+        main([gct_path, "--warm-shapes", "60x0", "--no-files"])
+    with pytest.raises(SystemExit):
+        # exec cache + grid shards don't compose
+        main([gct_path, "--exec-cache", "--feature-shards", "2",
+              "--no-files"])
+    with pytest.raises(SystemExit):
+        # pg can't run through the whole-grid scheduler
+        main([gct_path, "--warm-shapes", "64x16", "--algorithm", "pg",
+              "--no-files"])
+
+
+def test_cli_exec_cache_rejects_checkpoint_dir(gct_path, tmp_path):
+    with pytest.raises(SystemExit):
+        main([gct_path, "--exec-cache", "--checkpoint-dir",
+              str(tmp_path / "ckpt"), "--no-files"])

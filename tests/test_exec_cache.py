@@ -1,0 +1,278 @@
+"""Executable-reuse serving layer (nmfx/exec_cache.py): bucket policy,
+hit/miss keying, LRU eviction, and — the load-bearing property — exact
+numerical equivalence of padded-bucket sweeps to exact-shape sweeps."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from nmfx.config import ConsensusConfig, ExecCacheConfig, InitConfig, \
+    SolverConfig
+from nmfx.exec_cache import ExecCache, bucket_dim, start_host_fetch
+from nmfx.sweep import sweep
+
+CCFG = ConsensusConfig(ks=(2, 3), restarts=6, seed=3, grid_exec="grid",
+                       grid_slots=4)
+SCFG = SolverConfig(max_iter=200)
+
+
+@pytest.fixture(scope="module")
+def serve_data():
+    from nmfx.datasets import two_group_matrix
+
+    # two different true shapes that share a bucket under the default
+    # lattice (both round up to (256, 64))
+    return (two_group_matrix(n_genes=120, n_per_group=12, seed=7),
+            two_group_matrix(n_genes=100, n_per_group=10, seed=9))
+
+
+# --- bucket policy --------------------------------------------------------
+
+def test_bucket_dim_properties():
+    for q in (64, 256):
+        prev = 0
+        for x in (1, q - 1, q, q + 1, 7 * q, 8 * q + 1, 1000, 5000, 99999):
+            b = bucket_dim(x, q)
+            assert b >= x
+            assert b % q == 0
+            assert b >= prev or x < prev  # monotonic in x
+            # bounded relative padding: the step stops doubling once
+            # step·growth_steps >= x, so step <= x/(growth_steps/2)
+            assert b <= x * (1 + 2 / 8) + q
+            prev = b
+
+
+def test_bucket_north_star_lands_on_probed_boundary_shape():
+    cache = ExecCache()
+    # the hardware-probed VMEM boundary shape (bench.py --verify stage 3)
+    assert cache.bucket_shape(5000, 500) == (5120, 512)
+    assert cache.bucket_shape(4832, 488) == (5120, 512)  # same bucket
+
+
+def test_bucket_dim_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        bucket_dim(0, 64)
+
+
+# --- keying / LRU ---------------------------------------------------------
+
+#: keying/LRU tests compile real executables — keep them tiny (one rank,
+#: two restarts) so the suite's compile budget goes to the equivalence
+#: tests instead
+_CCFG_TINY = ConsensusConfig(ks=(2,), restarts=2, seed=3,
+                             grid_exec="grid", grid_slots=2)
+_SCFG_TINY = SolverConfig(max_iter=20)
+
+
+def test_same_bucket_hits_different_config_misses(serve_data):
+    a1, a2 = serve_data
+    cache = ExecCache()
+    cache.executable(a1.shape, _CCFG_TINY, _SCFG_TINY)
+    assert cache.stats["misses"] == 1
+    _, hit = cache.executable(a2.shape, _CCFG_TINY, _SCFG_TINY)  # same bucket
+    assert hit and cache.stats["hits"] == 1
+    # any solver-config change re-keys (the config fingerprint)
+    _, hit = cache.executable(
+        a1.shape, _CCFG_TINY, dataclasses.replace(_SCFG_TINY, max_iter=30))
+    assert not hit
+    # so does the rank set / restart count / label rule
+    _, hit = cache.executable(
+        a1.shape, dataclasses.replace(_CCFG_TINY, restarts=3), _SCFG_TINY)
+    assert not hit
+    assert cache.stats["misses"] == 3
+
+
+def test_lru_eviction_order():
+    cache = ExecCache(ExecCacheConfig(max_entries=2))
+    cfgs = [dataclasses.replace(_SCFG_TINY, max_iter=20 + 2 * i)
+            for i in range(3)]
+    for c in cfgs:
+        cache.executable((60, 20), _CCFG_TINY, c)
+    assert cache.stats["entries"] == 2
+    assert cache.stats["evictions"] == 1
+    # evicted: recompile
+    _, hit = cache.executable((60, 20), _CCFG_TINY, cfgs[0])
+    assert not hit
+    _, hit = cache.executable((60, 20), _CCFG_TINY, cfgs[2])  # resident
+    assert hit
+
+
+def test_cacheable_gating():
+    cache = ExecCache()
+    assert cache.cacheable(CCFG, SCFG, None)
+    # pg has no dense-batched block — the scheduler can't run it
+    assert not cache.cacheable(CCFG, SolverConfig(algorithm="pg"), None)
+    assert not cache.cacheable(
+        dataclasses.replace(CCFG, grid_exec="per_k"), SCFG, None)
+    with pytest.raises(ValueError):
+        cache.run_sweep(np.ones((8, 4)),
+                        dataclasses.replace(CCFG, grid_exec="per_k"), SCFG)
+
+
+# --- padded-bucket numerical equivalence ----------------------------------
+
+@pytest.mark.parametrize("mesh_on", [False, True])
+def test_padded_equivalence_to_exact_sweep(serve_data, mesh_on):
+    """The acceptance property: a bucketed sweep (padded A, masked
+    consensus, rescaled dnorms, threaded flip budget) must reproduce the
+    exact-shape sweep — consensus allclose and identical labels — for
+    BOTH true shapes sharing the bucket."""
+    from nmfx.sweep import default_mesh
+
+    mesh = default_mesh() if mesh_on else None
+    cache = ExecCache()
+    icfg = InitConfig()
+    for a in serve_data:
+        ref = sweep(a, CCFG, SCFG, icfg, mesh)
+        got = cache.run_sweep(a, CCFG, SCFG, icfg, mesh)
+        for k in CCFG.ks:
+            np.testing.assert_array_equal(np.asarray(got[k].labels),
+                                          np.asarray(ref[k].labels))
+            np.testing.assert_allclose(np.asarray(got[k].consensus),
+                                       np.asarray(ref[k].consensus),
+                                       atol=1e-6)
+            np.testing.assert_allclose(np.asarray(got[k].dnorms),
+                                       np.asarray(ref[k].dnorms),
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(np.asarray(got[k].iterations),
+                                          np.asarray(ref[k].iterations))
+            assert got[k].consensus.shape == (a.shape[1], a.shape[1])
+            assert got[k].best_w.shape == (a.shape[0], k)
+            assert got[k].best_h.shape == (k, a.shape[1])
+    # both shapes served from one executable
+    assert cache.stats == {**cache.stats, "entries": 1, "misses": 1,
+                           "hits": 1}
+
+
+def test_keep_factors_unpadded(serve_data):
+    a, _ = serve_data
+    cache = ExecCache()
+    ccfg = dataclasses.replace(CCFG, keep_factors=True)
+    out = cache.run_sweep(a, ccfg, SCFG, InitConfig())
+    m, n = a.shape
+    for k in ccfg.ks:
+        assert out[k].all_w.shape == (ccfg.restarts, m, k)
+        assert out[k].all_h.shape == (ccfg.restarts, k, n)
+
+
+def test_prefetch_handle_round_trip(serve_data):
+    a, _ = serve_data
+    cache = ExecCache()
+    placed = cache.prefetch(a, SCFG)
+    assert placed.true_shape == a.shape
+    assert placed.a_pad.shape == placed.bucket
+    out = cache.run_sweep(placed, CCFG, SCFG, InitConfig())
+    ref = cache.run_sweep(a, CCFG, SCFG, InitConfig())
+    for k in CCFG.ks:
+        np.testing.assert_array_equal(np.asarray(out[k].labels),
+                                      np.asarray(ref[k].labels))
+
+
+def test_start_host_fetch_is_safe_everywhere():
+    # arrays, Nones, nested pytrees — never raises, never blocks
+    import jax.numpy as jnp
+
+    start_host_fetch({"x": jnp.ones((3,)), "y": None,
+                      "z": [np.ones(2), jnp.zeros(())]})
+
+
+def test_threefry_flat_index_properties():
+    """The two partitionable-threefry properties the inside-executable
+    init (sweep._dyn_lane_init) rests on: draws are counter-based per
+    FLAT element index, so (a) same-column-count draws are
+    row-prefix-stable and (b) a 1-D draw gathered at i·n_true + j equals
+    the true 2-D draw. If a jax upgrade ever breaks these, the bucketed
+    executables would silently produce different (still valid, but not
+    exact-sweep-equal) restarts — fail here instead."""
+    import jax.numpy as jnp
+
+    key = jax.random.key(42)
+    wp = jax.random.uniform(key, (1024, 3), jnp.float32, 0.2, 0.9)
+    wt = jax.random.uniform(key, (970, 3), jnp.float32, 0.2, 0.9)
+    np.testing.assert_array_equal(np.asarray(wp[:970]), np.asarray(wt))
+    hu = jax.random.uniform(key, (3 * 256,), jnp.float32, 0.2, 0.9)
+    ht = jax.random.uniform(key, (3, 197), jnp.float32, 0.2, 0.9)
+    i = jnp.arange(3)[:, None]
+    j = jnp.arange(197)[None, :]
+    np.testing.assert_array_equal(np.asarray(hu[i * 197 + j]),
+                                  np.asarray(ht))
+
+
+# --- flip-floor threading -------------------------------------------------
+
+def test_flip_floor_override_matches_static_rule():
+    """mu_sched(flip_floor=0) must reproduce class_flip_tol=0.0's exact
+    reference rule even when cfg says otherwise — the bucketed
+    executables rely on the override to carry the TRUE sample count's
+    budget past the padded static n."""
+    import jax.numpy as jnp
+
+    from nmfx.ops.sched_mu import mu_sched
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.uniform(0.1, 1.0, (64, 24)), jnp.float32)
+    w0 = jnp.asarray(rng.uniform(0.1, 1.0, (6, 64, 3)), jnp.float32)
+    h0 = jnp.asarray(rng.uniform(0.1, 1.0, (6, 3, 24)), jnp.float32)
+    cfg_loose = SolverConfig(max_iter=300, class_flip_tol=0.5)
+    cfg_strict = SolverConfig(max_iter=300, class_flip_tol=0.0)
+    forced = mu_sched(a, w0, h0, cfg_loose, slots=3,
+                      flip_floor=jnp.asarray(0, jnp.int32))
+    strict = mu_sched(a, w0, h0, cfg_strict, slots=3)
+    np.testing.assert_array_equal(np.asarray(forced.iterations),
+                                  np.asarray(strict.iterations))
+    np.testing.assert_array_equal(np.asarray(forced.stop_reason),
+                                  np.asarray(strict.stop_reason))
+
+
+# --- api integration ------------------------------------------------------
+
+def test_nmfconsensus_exec_cache_parity(serve_data):
+    from nmfx.api import nmfconsensus
+
+    a, _ = serve_data
+    kwargs = dict(ks=(2, 3), restarts=5, seed=11, max_iter=200)
+    ref = nmfconsensus(a, **kwargs)
+    cache = ExecCache()
+    got = nmfconsensus(a, exec_cache=cache, **kwargs)
+    assert cache.stats["misses"] == 1  # the sweep really went through it
+    for k in (2, 3):
+        np.testing.assert_allclose(got.per_k[k].consensus,
+                                   ref.per_k[k].consensus, atol=1e-6)
+        assert got.per_k[k].rho == ref.per_k[k].rho
+        np.testing.assert_array_equal(got.per_k[k].membership,
+                                      ref.per_k[k].membership)
+    assert got.best_k == ref.best_k
+
+
+def test_exec_cache_leaves_persistent_cache_config_alone(serve_data):
+    """The exec cache must not touch jax's persistent compilation-cache
+    config (the conftest cache-reset fixture isolates THAT between
+    tests; the serving cache is a separate, in-process layer)."""
+    a, _ = serve_data
+    before = (jax.config.jax_compilation_cache_dir,
+              jax.config.jax_persistent_cache_min_compile_time_secs)
+    ExecCache().run_sweep(a, CCFG, SCFG, InitConfig())
+    assert (jax.config.jax_compilation_cache_dir,
+            jax.config.jax_persistent_cache_min_compile_time_secs) == before
+
+
+def test_nndsvd_external_init_route(serve_data):
+    """NNDSVD requests take the external lane-batch route (the SVD
+    factors the true matrix, so init cannot move inside the bucketed
+    executable) — results must still match the exact-shape sweep."""
+    a, _ = serve_data
+    icfg = InitConfig(method="nndsvd")
+    ref = sweep(a, CCFG, SCFG, icfg, None)
+    cache = ExecCache()
+    got = cache.run_sweep(a, CCFG, SCFG, icfg, None)
+    for k in CCFG.ks:
+        np.testing.assert_array_equal(np.asarray(got[k].labels),
+                                      np.asarray(ref[k].labels))
+        np.testing.assert_allclose(np.asarray(got[k].consensus),
+                                   np.asarray(ref[k].consensus), atol=1e-6)
+    # a random-init request under the same sweep config is a DIFFERENT
+    # executable (random init is baked in; nndsvd's is external)
+    cache.executable(a.shape, CCFG, SCFG, InitConfig())
+    assert cache.stats["misses"] == 2

@@ -16,8 +16,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = textwrap.dedent("""
     import json, os, sys
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    from nmfx._compat import force_cpu_devices
+    force_cpu_devices(4)
     coord, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     import nmfx
     import nmfx.distributed as dist
@@ -51,8 +51,8 @@ _GRID_WORKER = textwrap.dedent("""
     # the process boundary — the DCN analogue. (With 4 devices per
     # process and a restart axis of 2, each factorization's grid would
     # sit wholly inside one process and test nothing new.)
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from nmfx._compat import force_cpu_devices
+    force_cpu_devices(2)
     coord, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     import nmfx.distributed as dist
     dist.initialize(coordinator_address=coord, num_processes=2,
@@ -98,6 +98,16 @@ def _run_workers(worker_src: str, tmp_path, out_prefix: str):
             _, e = p.communicate()
         if p.returncode != 0:
             errs.append(e[-3000:])
+    if errs and all("Multiprocess computations aren't implemented"
+                    in e for e in errs):
+        # old jaxlibs' CPU backend has no cross-process collectives at
+        # all — the contract under test cannot exist here (it is
+        # exercised for real on TPU pods); newer jaxlibs run it via the
+        # virtual-device CPU platform
+        import pytest
+
+        pytest.skip("this jaxlib's CPU backend lacks multi-process "
+                    "collectives")
     assert not errs, errs
     return [json.loads((tmp_path / f"{out_prefix}{i}.json").read_text())
             for i in range(2)]

@@ -294,3 +294,40 @@ def test_alias_io_schedule_free(jobs):
                                   np.asarray(al.stop_reason))
     np.testing.assert_array_equal(np.asarray(base.w), np.asarray(al.w))
     np.testing.assert_array_equal(np.asarray(base.h), np.asarray(al.h))
+
+
+def test_job_ks_length_validation(jobs):
+    """A wrong-length job_ks must fail loudly instead of silently
+    corrupting results through clamped gathers (ADVICE.md round 5)."""
+    a, w0, h0 = jobs
+    cfg = SolverConfig(max_iter=10)
+    with pytest.raises(ValueError, match="job_ks"):
+        mu_sched(a, w0, h0, cfg, slots=4, job_ks=JOB_KS[:-1])
+    with pytest.raises(ValueError, match="job_ks"):
+        mu_grid(a, w0, h0, cfg, job_ks=JOB_KS + (2,))
+    from nmfx.ops.grid_mu import pad_live_mask
+
+    with pytest.raises(ValueError, match="job_ks"):
+        pad_live_mask(w0, h0, JOB_KS[:3])
+
+
+def test_fault_inject_env_banner(jobs, monkeypatch, capsys):
+    """An inherited NMFX_FAULT_INJECT_STALE_RELOAD must announce itself
+    loudly — the hook corrupts results by design, and a silent inherited
+    env var would poison a production run (ADVICE.md round 5)."""
+    from nmfx.ops import sched_mu
+
+    monkeypatch.setenv("NMFX_FAULT_INJECT_STALE_RELOAD", "0.5")
+    monkeypatch.setattr(sched_mu, "_stale_reload_warned", False)
+    assert sched_mu._stale_reload_fraction() == 0.5
+    err = capsys.readouterr().err
+    assert "NMFX_FAULT_INJECT_STALE_RELOAD" in err
+    assert "INVALID" in err
+    # once per process, not once per trace
+    sched_mu._stale_reload_fraction()
+    assert "NMFX_FAULT_INJECT_STALE_RELOAD" not in capsys.readouterr().err
+    # unset: no banner, identity behavior
+    monkeypatch.delenv("NMFX_FAULT_INJECT_STALE_RELOAD")
+    monkeypatch.setattr(sched_mu, "_stale_reload_warned", False)
+    assert sched_mu._stale_reload_fraction() == 0.0
+    assert "NMFX_FAULT_INJECT" not in capsys.readouterr().err
