@@ -21,8 +21,10 @@ noticed, VERDICT.md round 3):
   TolX stops from random init) aborts with a loud error instead of
   printing a JSON line that looks like a result;
 * ``--verify`` runs the cross-engine parity gate ON THE REAL DEVICE at a
-  scaled shape — mu's grid-dense vs grid-pallas vs per-k packed, hals
-  grid vs vmap, kl packed-grid vs vmap — and asserts
+  scaled shape — mu's grid-dense vs grid-pallas vs per-k packed (the
+  pallas engine under its default check_block cadence), hals grid vs
+  vmap, and the kl/als/neals/snmf packed-grid opt-ins vs their vmapped
+  defaults — and asserts
   iteration/stop/consensus/rho agreement. This is the on-hardware
   correctness tier the CPU-forced pytest suite cannot provide (Mosaic
   compilation is exactly what interpret-mode tests bypass).
@@ -156,10 +158,14 @@ def run_verify(args) -> int:
 
     Engines: the whole-grid slot scheduler on XLA-dense blocks
     (grid-dense), the same scheduler on the fused pallas kernels
-    (grid-pallas), and the sequential per-rank packed path (per-k) — the
-    three mu execution engines users can select — plus a second stage
-    gating the round-4 scheduler engines (hals grid vs vmap, kl
-    packed-grid vs vmap). Asserts, per rank:
+    (grid-pallas — under the default check_block cadence, so the
+    round-6 launch-resident multi-check path is what gets gated), and
+    the sequential per-rank packed path (per-k) — the three mu
+    execution engines users can select — plus a second stage gating
+    EVERY non-mu scheduler engine against its vmapped default (hals
+    grid vs vmap; the kl/als/neals/snmf backend='packed' opt-ins —
+    round 6 closed the als/neals/snmf coverage gap). Asserts, per
+    rank:
 
     * integrity (``_integrity_problems``) for every engine;
     * no MAX_ITER burns (everything converges at this shape);
@@ -301,12 +307,17 @@ def run_verify(args) -> int:
     for name in ("grid-pallas", "per-k"):
         compare(name, results[name], "grid-dense", results["grid-dense"])
 
-    # --- second stage: the non-mu scheduler engines (round 4) ----------
+    # --- second stage: the non-mu scheduler engines (round 4/6) --------
     # hals' default IS the grid engine (gate it against the vmapped
-    # driver); kl's whole-grid engine is the backend='packed' opt-in
-    # (gate it against its vmapped default). Same assertions as stage 1;
-    # integrity applies per engine (kl is class-stop gated, hals's
-    # ~20-iteration TolX stops are exempt by design).
+    # driver); the kl/als/neals/snmf whole-grid engines are the
+    # backend='packed' opt-ins (gated against their vmapped defaults —
+    # round 6 closed the coverage gap: the user-selectable
+    # als/neals/snmf packed engines shipped UNGATED through round 5,
+    # exactly the round-3 failure class, and they converge in ~14–21
+    # iterations so the stage costs seconds). Same assertions as
+    # stage 1; integrity applies per engine (kl is class-stop gated;
+    # hals/snmf's ~20-iteration TolX stops and als/neals' ~14-iteration
+    # TolX/TolFun stops are exempt by design).
     for algo, alt_pair, ref_pair in (
             ("hals",
              ("hals-grid", dataclasses.replace(
@@ -317,7 +328,22 @@ def run_verify(args) -> int:
              ("kl-packed-grid", dataclasses.replace(
                  scfg, algorithm="kl", backend="packed"), "grid"),
              ("kl-vmap", dataclasses.replace(
-                 scfg, algorithm="kl", backend="auto"), "per_k"))):
+                 scfg, algorithm="kl", backend="auto"), "per_k")),
+            ("als",
+             ("als-packed-grid", dataclasses.replace(
+                 scfg, algorithm="als", backend="packed"), "grid"),
+             ("als-vmap", dataclasses.replace(
+                 scfg, algorithm="als", backend="auto"), "per_k")),
+            ("neals",
+             ("neals-packed-grid", dataclasses.replace(
+                 scfg, algorithm="neals", backend="packed"), "grid"),
+             ("neals-vmap", dataclasses.replace(
+                 scfg, algorithm="neals", backend="auto"), "per_k")),
+            ("snmf",
+             ("snmf-packed-grid", dataclasses.replace(
+                 scfg, algorithm="snmf", backend="packed"), "grid"),
+             ("snmf-vmap", dataclasses.replace(
+                 scfg, algorithm="snmf", backend="auto"), "per_k"))):
         res = {}
         for name, cfg_e, grid_exec in (alt_pair, ref_pair):
             ccfg = ConsensusConfig(ks=ks, restarts=restarts, seed=123,
@@ -369,10 +395,13 @@ def run_verify(args) -> int:
         print(f"verify FAIL: {p}", file=sys.stderr)
     print(json.dumps({
         "metric": "verify_parity", "value": 1 if ok else 0, "unit": "pass",
-        "detail": {"engines": list(engines) + ["hals-grid", "hals-vmap",
-                                               "kl-packed-grid", "kl-vmap",
-                                               "bound-dense",
-                                               "bound-pallas"],
+        "detail": {"engines": list(engines) + [
+                       "hals-grid", "hals-vmap",
+                       "kl-packed-grid", "kl-vmap",
+                       "als-packed-grid", "als-vmap",
+                       "neals-packed-grid", "neals-vmap",
+                       "snmf-packed-grid", "snmf-vmap",
+                       "bound-dense", "bound-pallas"],
                    "shape": f"{m}x{n}, k=2..5, {restarts} restarts",
                    "gaps": gaps,
                    "problems": problems}}))
@@ -403,9 +432,9 @@ def main():
     p.add_argument("--verify", action="store_true",
                    help="run the cross-engine hardware parity gate "
                         "(mu: grid-dense vs grid-pallas vs per-k; hals: "
-                        "grid vs vmap; kl: packed-grid vs vmap) instead "
-                        "of the benchmark; exits nonzero on any integrity "
-                        "or parity failure")
+                        "grid vs vmap; kl/als/neals/snmf: packed-grid vs "
+                        "vmap) instead of the benchmark; exits nonzero "
+                        "on any integrity or parity failure")
     p.add_argument("--reps", type=int, default=3,
                    help="warm timed reps per backend (same session, "
                         "interleaved across backends); the JSON records "
@@ -766,7 +795,9 @@ def main():
             "config": f"k=2..{args.kmax} x {args.restarts} restarts, "
                       f"{args.genes}x{args.samples}, {args.algorithm}, "
                       f"maxiter={args.maxiter}, precision={args.precision}, "
-                      f"backend={args.backend}, grid_exec={args.grid_exec}",
+                      f"backend={args.backend}, grid_exec={args.grid_exec}, "
+                      "check_block=auto (pallas block-kernel route -> 4, "
+                      "else 1)",
             "protocol": f"min of {args.reps} same-session warm reps, "
                         "backends interleaved; integrity-gated per rep",
             "restarts_per_s": round(total_restarts / wall, 2),

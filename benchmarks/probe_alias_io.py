@@ -14,7 +14,7 @@ three levels before any timing:
 2. the round-3 failure shape: the kernel inside a lax.while_loop whose
    body REWRITES slot columns between calls (simulated reloads) — the
    exact pattern that exposed the stale windows;
-3. the full scheduler: mu_sched(alias_io=True) vs False — per-job stop
+3. the full scheduler: experimental.alias_io=True vs False — per-job stop
    iterations bit-equal ON HARDWARE is not expected (position/timing
    drift), so level 3 asserts the verify-gate invariants instead
    (iteration ratios, restart-normalized consensus drift), then times
@@ -125,8 +125,14 @@ def main():
                        matmul_precision="bfloat16", backend="pallas")
 
     def run(alias):
+        import dataclasses
+
+        from nmfx.config import ExperimentalConfig
+
+        cfg_a = dataclasses.replace(
+            cfg, experimental=ExperimentalConfig(alias_io=alias))
         t0 = time.perf_counter()
-        r = mu_sched(big, w0g, h0g, cfg, slots=48, alias_io=alias)
+        r = mu_sched(big, w0g, h0g, cfg_a, slots=48)
         its = np.asarray(r.iterations)
         h = np.asarray(r.h)
         return time.perf_counter() - t0, its, h

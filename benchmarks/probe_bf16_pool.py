@@ -85,9 +85,14 @@ def main():
     }
 
     def run(slots, factor_dtype):
+        import dataclasses
+
+        from nmfx.config import ExperimentalConfig
+
+        cfg_f = dataclasses.replace(
+            cfg, experimental=ExperimentalConfig(factor_dtype=factor_dtype))
         t0 = time.perf_counter()
-        r = mu_sched(a, w0, h0, cfg, slots=slots, job_ks=job_ks,
-                     factor_dtype=factor_dtype)
+        r = mu_sched(a, w0, h0, cfg_f, slots=slots, job_ks=job_ks)
         its = np.asarray(r.iterations)
         h = np.asarray(r.h)
         wall = time.perf_counter() - t0

@@ -33,10 +33,14 @@ w0 = jnp.concatenate(w0l); h0 = jnp.concatenate(h0l); job_ks = tuple(job_ks)
 
 cells = [(b, e) for b in ("auto", "pallas") for e in (1, 4, 8)]
 def run(backend, eb):
+    from nmfx.config import ExperimentalConfig
+
     cfg = SolverConfig(algorithm="mu", max_iter=10000,
-                       matmul_precision="bfloat16", backend=backend)
+                       matmul_precision="bfloat16", backend=backend,
+                       check_block=1,
+                       experimental=ExperimentalConfig(evict_batch=eb))
     t0 = time.perf_counter()
-    r = mu_sched(a, w0, h0, cfg, slots=48, job_ks=job_ks, evict_batch=eb)
+    r = mu_sched(a, w0, h0, cfg, slots=48, job_ks=job_ks)
     its = np.asarray(r.iterations); np.asarray(r.w[0])
     return time.perf_counter() - t0, int(its.sum()), np.asarray(r.pool_trips)
 

@@ -5,7 +5,7 @@ VERDICT r4 Weak #5: the round-4 "38% faster warm" kl claim compared
 k={2,4,6} (vmap) against k=2..4 (packed) — overlapping but not
 identical sweeps. This probe closes it: both engines run the SAME
 k-range in one session, interleaved, min-of-N. It also measures the
-round-5 ``SolverConfig.kl_bf16_quotient`` opt-in (stream A as bf16
+round-5 ``ExperimentalConfig.kl_bf16_quotient`` opt-in (stream A as bf16
 through the packed-grid loop, halving A's HBM reread): wall delta plus
 the consensus/rank-selection drift it introduces — the accept/reject
 evidence for that knob's default.
@@ -52,9 +52,12 @@ def main():
     }
 
     def run(backend, grid_exec, kl_bf16_quotient):
+        from nmfx.config import ExperimentalConfig
+
         scfg = SolverConfig(algorithm="kl", max_iter=10000,
                             matmul_precision="bfloat16", backend=backend,
-                            kl_bf16_quotient=kl_bf16_quotient)
+                            experimental=ExperimentalConfig(
+                                kl_bf16_quotient=kl_bf16_quotient))
         ccfg = ConsensusConfig(ks=ks, restarts=args.restarts, seed=123,
                                grid_exec=grid_exec)
         t0 = time.perf_counter()

@@ -65,11 +65,14 @@ def main():
     }
 
     def run(backend, ragged):
+        from nmfx.config import ExperimentalConfig
+
         cfg = SolverConfig(algorithm="mu", max_iter=10000,
-                           matmul_precision="bfloat16", backend=backend)
+                           matmul_precision="bfloat16", backend=backend,
+                           check_block=1,
+                           experimental=ExperimentalConfig(ragged=ragged))
         t0 = time.perf_counter()
-        r = mu_sched(a, w0, h0, cfg, slots=48, job_ks=job_ks,
-                     ragged=ragged)
+        r = mu_sched(a, w0, h0, cfg, slots=48, job_ks=job_ks)
         its = np.asarray(r.iterations)
         np.asarray(r.w[0])
         return time.perf_counter() - t0, its, \

@@ -13,6 +13,7 @@ accumulation kept on-device.
 from nmfx.config import (
     ConsensusConfig,
     ExecCacheConfig,
+    ExperimentalConfig,
     InitConfig,
     OutputConfig,
     SolverConfig,
@@ -40,6 +41,7 @@ from nmfx.config import VERSION as __version__
 
 __all__ = [
     "ConsensusConfig",
+    "ExperimentalConfig",
     "ConsensusResult",
     "ExecCache",
     "ExecCacheConfig",

@@ -68,6 +68,21 @@ def _tail_slots_arg(value: str):
     return widths
 
 
+def _check_block_arg(value: str):
+    """'auto' or a positive int — validated at parse time."""
+    if value == "auto":
+        return value
+    try:
+        n = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or a positive integer, got {value!r}")
+    if n < 1:
+        raise argparse.ArgumentTypeError(
+            f"check-block must be >= 1, got {value!r}")
+    return n
+
+
 def _warm_shapes_arg(value: str) -> tuple[tuple[int, int], ...]:
     """'5000x500,20000x1000' -> ((5000, 500), (20000, 1000)); validated
     at parse time so a bad spec is a usage error."""
@@ -111,6 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap on restarts solved concurrently in the vmapped "
                         "driver (bounds peak memory for kl's m*n "
                         "intermediates; results are identical)")
+    p.add_argument("--check-block", default="auto", type=_check_block_arg,
+                   help="check blocks batched per scheduler trip "
+                        "(SolverConfig.check_block): convergence is still "
+                        "evaluated every check-every iterations, but the "
+                        "per-trip machinery fires once per N checks. "
+                        "'auto' (default) = 4 on the pallas block-kernel "
+                        "scheduler, 1 elsewhere; see docs/design.md "
+                        "'Check cadence'")
     p.add_argument("--rank-selection", default="host",
                    choices=("host", "device"),
                    help="where hclust/cophenetic/cutree run: host numpy/C++ "
@@ -307,7 +330,8 @@ def main(argv: list[str] | None = None) -> int:
                             max_iter=args.maxiter,
                             matmul_precision=args.precision,
                             backend=args.backend,
-                            restart_chunk=args.restart_chunk)
+                            restart_chunk=args.restart_chunk,
+                            check_block=args.check_block)
     exec_cache = None
     if args.exec_cache or args.warm_shapes:
         from nmfx.config import ConsensusConfig, InitConfig

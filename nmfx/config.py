@@ -27,6 +27,93 @@ VERSION = "0.1.0"
 
 
 @dataclasses.dataclass(frozen=True)
+class ExperimentalConfig:
+    """Measured-but-not-default opt-ins, grouped so the primary surfaces
+    (``SolverConfig``, ``mu_sched``) stay small.
+
+    Every knob here was BUILT AND MEASURED on real hardware and lost to
+    the shipping configuration for a documented reason (see
+    benchmarks/RESULTS.md round 5 and the per-field notes below), or is
+    a numerics experiment whose hardware verdict is still pending. They
+    are kept so the measurements are reproducible and so workloads
+    unlike the north star can opt in.
+
+    Keep/remove policy: a knob stays while its rejection rationale is
+    workload-shaped (it may win elsewhere: ``ragged`` for extreme
+    padding mixes, ``evict_batch`` for heavy evict traffic) or while a
+    round's measurement plan names it; a knob whose rejection is
+    *arithmetic* (cannot win anywhere) is removed outright — rejections
+    by construction are recorded in RESULTS.md, not kept as code. Each
+    knob must keep a regression test pinning its semantics for as long
+    as it ships.
+    """
+
+    #: ragged class-blocked slot pool (pallas block-kernel route only):
+    #: eliminates ALL packed-column padding. Measured NET SLOWER at the
+    #: north star (tail trips triple, multi-class bookkeeping ~1.5x per
+    #: trip — RESULTS.md round 5); kept for mixes with extreme padding
+    #: waste (k_max >> typical k)
+    ragged: bool = False
+    #: per-class expected-iteration overrides for the ragged layout's
+    #: greedy-minimax slot allocation, as a hashable tuple of
+    #: (k, expected_iters) pairs — derive from a previous run's
+    #: ``SchedMUResult.iterations`` via
+    #: ``nmfx.ops.sched_mu.ragged_estimates_from_iterations``. None uses
+    #: the built-in north-star model (``_ragged_iters_est``), which WARNs
+    #: when the job mix departs its calibrated profile. Only schedule
+    #: quality depends on these; results never do.
+    ragged_iters_est: "tuple[tuple[int, float], ...] | None" = None
+    #: harvest hysteresis: batch the heavy half of slot eviction until
+    #: this many slots are pending. Recorded per-job results are exactly
+    #: invariant; measured no clear win at the north star (round 5)
+    evict_batch: int = 1
+    #: slot-pool factor storage (pallas block-kernel route only):
+    #: None = the solve dtype; "bfloat16" = both factors bf16 (round-5
+    #: experiment, REJECTED as a default: quantized labels hit a bf16
+    #: fixed point and the class-stability counter coasts to the floor);
+    #: "bfloat16_w" = W stored bf16 with H kept at the solve dtype (the
+    #: round-6 variant: the label-bearing factor never quantizes, so the
+    #: round-5 freeze cannot start from the labels, while W — 10 of the
+    #: ~11 MB of per-launch factor round-trip at the north star — still
+    #: moves at half the bytes). An f32-master/error-feedback variant
+    #: was analyzed and rejected by arithmetic: a residual accumulator
+    #: must either live in bf16 storage (where sub-ulp residuals round
+    #: away — a no-op) or round-trip alongside the bf16 factors (f32
+    #: traffic parity — no win); see RESULTS.md round 6.
+    factor_dtype: "str | None" = None
+    #: donate the block kernel's input buffers as outputs. Bit-exact at
+    #: every bisect level (the explicit step-0 DMA is the data path) but
+    #: measured ~8% SLOWER than the while-carry copies it targets
+    #: (round 5, probe_alias_io.py)
+    alias_io: bool = False
+    #: kl + backend="packed" only — stream A as one-time-truncated bf16
+    #: through the slot scheduler, halving A's HBM reread traffic like
+    #: the GEMM families get by default. Measured-REJECTED (round 5,
+    #: probe_kl_ab.py): slower than the f32 quotient AND +7-11%
+    #: iterations at k>=5 — kl consumes A in an ELEMENTWISE division
+    #: where bf16 truncation is a real ~0.4% input perturbation, and the
+    #: quotient upcasts to f32 before dividing anyway (kl is
+    #: quotient-FLOP-bound, not A-bandwidth-bound)
+    kl_bf16_quotient: bool = False
+
+    def __post_init__(self):
+        if self.factor_dtype not in (None, "bfloat16", "bfloat16_w"):
+            raise ValueError(
+                "experimental.factor_dtype must be None, 'bfloat16' or "
+                f"'bfloat16_w', got {self.factor_dtype!r}")
+        if self.evict_batch < 1:
+            raise ValueError("experimental.evict_batch must be >= 1")
+        if self.ragged_iters_est is not None:
+            est = tuple((int(k), float(v))
+                        for k, v in self.ragged_iters_est)
+            if any(v <= 0 for _, v in est):
+                raise ValueError(
+                    "experimental.ragged_iters_est iteration estimates "
+                    "must be positive")
+            object.__setattr__(self, "ragged_iters_est", est)
+
+
+@dataclasses.dataclass(frozen=True)
 class SolverConfig:
     """Per-factorization solver settings.
 
@@ -61,6 +148,26 @@ class SolverConfig:
     tol_pg: float = 1e-4
     #: check convergence every `check_every` iterations (reference: even iters)
     check_every: int = 2
+    #: how many ``check_every``-iteration check blocks one scheduler trip
+    #: (or batched-solver loop body) executes back-to-back before the
+    #: per-trip machinery — while-carry copies, the evict/reload
+    #: ``lax.cond``, host-side bookkeeping — runs once for all of them.
+    #: The CHECK CADENCE never changes: convergence is still evaluated
+    #: at every ``check_every`` boundary (the pallas block kernel exports
+    #: per-boundary label snapshots and TolX stats from its VMEM-resident
+    #: factors; the XLA engines interleave the checks between sub-blocks
+    #: exactly), so stop decisions are preserved — on the XLA engines
+    #: exactly, on the pallas engine up to the gate-checkable slot-drift
+    #: class (a job that stops at an interior boundary keeps iterating to
+    #: the end of its in-flight launch, so its recorded factors carry up
+    #: to ``(check_block-1)*check_every`` post-stop iterations — the same
+    #: benign class as slot-count drift; iteration counts and stop
+    #: reasons are exact). "auto" resolves to 4 on the pallas
+    #: block-kernel slot scheduler (where the round-5 trace put ~47 us of
+    #: per-trip non-kernel overhead against a 136 us kernel, and the
+    #: longer VMEM residency also amortizes the W round-trip) and to 1
+    #: everywhere else. See docs/design.md "Check cadence".
+    check_block: "int | str" = "auto"
     #: consecutive stable class checks before stopping (mu only)
     stable_checks: int = 200
     #: enable class-stability early stop (mu; the only live stop in the reference)
@@ -115,21 +222,23 @@ class SolverConfig:
     #: through the fused Pallas TPU kernels (nmfx.ops.pallas_mu); "vmap"
     #: forces the generic driver. Measured ~3.5x faster per iteration at
     #: k=10 on the north-star config (packed vs vmap).
+    #: Engine-parity note for kl + backend="packed" (the whole-grid
+    #: opt-in): at high k relative to the data's structure (k=5/6 on the
+    #: 4-group north-star benchmark matrix) the packed-grid engine's
+    #: consensus drifts from the vmapped default by up to
+    #: max|dC|*R ~ 5 restart-equivalents on a handful of boundary
+    #: samples (round 5 measured max|dC| <= 0.25 at R=20, rho identical,
+    #: iteration ratios 0.95-0.97) — surplus-cluster near-ties split
+    #: differently between the engines' reduction orders, the same
+    #: over-clustering drift class the hardware gate bounds;
+    #: tests/test_kl_drift.py pins the band. At k <= 4 the engines agree
+    #: exactly.
     backend: str = "auto"
-    #: kl + backend="packed" only — stream A as one-time-truncated bf16
-    #: through the slot scheduler's loop, halving A's HBM reread traffic
-    #: like the GEMM families get by default. OFF and measured-REJECTED
-    #: (round 5, benchmarks/probe_kl_ab.py, same-session interleaved
-    #: min-of-5 at 5000×500 k=2..6×20): 3.70 s vs the f32 quotient's
-    #: 2.94 s AND +7–11% iterations at k≥5 — kl's block consumes A in
-    #: an ELEMENTWISE division (the quotient A ⊘ WH), where bf16
-    #: truncation is a real ~0.4% per-element input perturbation rather
-    #: than the MXU's own operand rounding, and the perturbed quotient
-    #: both upsets the class-stability counters and upcasts to f32
-    #: before dividing anyway (no FLOP saving — kl is
-    #: quotient-FLOP-bound, not A-bandwidth-bound). The knob stays so
-    #: the rejection is reproducible (sched_mu._streams_bf16_a).
-    kl_bf16_quotient: bool = False
+    #: measured-rejected / still-experimental opt-ins, grouped behind one
+    #: documented surface (see ExperimentalConfig for the keep/remove
+    #: policy): the ragged pool, evict hysteresis, slot-pool factor
+    #: dtypes, kernel buffer donation, and the kl bf16 quotient
+    experimental: ExperimentalConfig = ExperimentalConfig()
     #: snmf only — Kim & Park L1 penalty on H's columns (larger = sparser)
     sparsity_beta: float = 0.01
     #: snmf only — ridge on W; None = max(A)^2 (the Kim & Park default)
@@ -167,6 +276,11 @@ class SolverConfig:
             raise ValueError("max_iter must be >= 1")
         if self.check_every < 1:
             raise ValueError("check_every must be >= 1")
+        cb = self.check_block
+        if not (cb == "auto" or (isinstance(cb, int)
+                                 and not isinstance(cb, bool) and cb >= 1)):
+            raise ValueError(
+                f"check_block must be 'auto' or an int >= 1, got {cb!r}")
         if self.matmul_precision not in ("default", "bfloat16", "highest"):
             raise ValueError(
                 "matmul_precision must be 'default', 'bfloat16' or 'highest',"

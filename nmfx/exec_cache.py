@@ -41,8 +41,12 @@ this module attacks both ends:
 
 Cache keys cover everything that changes the compiled program: bucket
 shape, the rank set, restart count, the full SolverConfig (its dataclass
-hash — the solver-config fingerprint), label rule, keep_factors, the
-scheduler knobs, the mesh, and the jax version + backend platform.
+hash — the solver-config fingerprint, which since round 6 includes the
+``check_block`` cadence field and the nested ``experimental`` knobs, so
+the bucket key versions on the new cadence/experimental fields
+automatically — two configs differing only in cadence compile and cache
+separately), label rule, keep_factors, the scheduler knobs, the mesh,
+and the jax version + backend platform.
 InitConfig is deliberately NOT in the key: initialization runs outside
 the executable, which is what makes one bucket executable serve every
 init scheme and true shape.

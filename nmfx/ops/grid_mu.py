@@ -320,7 +320,8 @@ def kl_block(a, wp, hp, done_mask, cfg: SolverConfig):
     # NOTE: unlike the other blocks, kl receives FULL-PRECISION A by
     # default even under matmul_precision="bfloat16"
     # (sched_mu._streams_bf16_a excludes kl unless
-    # cfg.kl_bf16_quotient opts in): A feeds the elementwise quotient,
+    # cfg.experimental.kl_bf16_quotient opts in): A feeds the
+    # elementwise quotient,
     # where bf16 truncation is a real input perturbation, not the MXU's
     # own operand rounding (the division below promotes a bf16 A to f32
     # arithmetic either way). The GEMMs still run at bf16 MXU precision
@@ -555,13 +556,20 @@ def mu_grid(a: jax.Array, w0: jax.Array, h0: jax.Array,
             block = partial(block, pad_live=pad_live_mask(w0, h0, job_ks))
         step = partial(_step, block, a_loop, a_true)
 
+        # check_block: N check blocks per while-loop trip ("auto" = 1
+        # here), checks interleaved between sub-blocks — stop decisions
+        # exact, the loop cond amortized N-fold (see packed_mu's
+        # identical resolution)
+        ncheck = 1 if cfg.check_block == "auto" else int(cfg.check_block)
+
         def cond(s: GridState):
-            return jnp.any(~s.done) & (s.iteration + cfg.check_every
-                                       <= cfg.max_iter)
+            return jnp.any(~s.done) & (
+                s.iteration + cfg.check_every * ncheck <= cfg.max_iter)
 
         def body(s: GridState):
-            for i in range(cfg.check_every):
-                s = step(s, cfg, check=(i == cfg.check_every - 1))
+            for _ in range(ncheck):
+                for i in range(cfg.check_every):
+                    s = step(s, cfg, check=(i == cfg.check_every - 1))
             return s
 
         final = lax.while_loop(cond, body, state0)

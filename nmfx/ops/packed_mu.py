@@ -536,13 +536,24 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
                        feature_axis=feature_axis, sample_axis=sample_axis,
                        n_total=n_total)
 
+        # check_block batches N check blocks per while-loop trip ("auto"
+        # resolves to 1 here: the fixed-batch driver's per-trip overhead
+        # is one cond evaluation against a full-width batch iteration).
+        # Checks still run at every check_every boundary — between the
+        # unrolled sub-blocks — so stop decisions are EXACT; converged
+        # restarts freeze before the next sub-block as always, and the
+        # loop merely evaluates its condition (and any residual
+        # done-lane masking work) once per N blocks.
+        ncheck = 1 if cfg.check_block == "auto" else int(cfg.check_block)
+
         def cond(s: PackedState):
-            return jnp.any(~s.done) & (s.iteration + cfg.check_every
-                                       <= cfg.max_iter)
+            return jnp.any(~s.done) & (
+                s.iteration + cfg.check_every * ncheck <= cfg.max_iter)
 
         def body(s: PackedState):
-            for i in range(cfg.check_every):
-                s = step(s, cfg, r, check=(i == cfg.check_every - 1))
+            for _ in range(ncheck):
+                for i in range(cfg.check_every):
+                    s = step(s, cfg, r, check=(i == cfg.check_every - 1))
             return s
 
         final = lax.while_loop(cond, body, state0)

@@ -179,10 +179,9 @@ def fused_h_update(a: jax.Array, wp: jax.Array, hp: jax.Array, *, k: int,
 
 
 def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
-                  w_in_ref, h_in_ref,
-                  w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref, numer_acc,
-                  gram_acc, *, block_m: int, k: int, eps: float,
-                  zero_threshold: float, matmul_dtype):
+                  *rest, block_m: int, k: int, eps: float,
+                  zero_threshold: float, matmul_dtype,
+                  check_every: int = 0, check_block: int = 1):
     """One grid step of the resident-W block kernel (see
     fused_block_iterations). Grid = (iters, 2 phases, nt m-tiles); w_ref /
     h_ref are FULL output blocks that stay VMEM-resident across every
@@ -193,10 +192,38 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
     tile (also pre-masking HHᵀ into gram_acc for phase 1); phase 1 updates
     W tile-locally. The final iteration also accumulates per-column
     max|Δ| / max|prev| into the four small stat outputs — the TolX
-    ingredients — so convergence checks need no extra factor snapshot."""
+    ingredients — so convergence checks need no extra factor snapshot.
+
+    ``check_block > 1`` is the launch-resident multi-check mode (round
+    6): the grid spans ``check_block`` check sub-blocks of
+    ``check_every`` iterations each, the factors staying VMEM-resident
+    throughout. At every sub-block BOUNDARY iteration the kernel (a)
+    records the TolX stats into that boundary's row of the (now
+    per-boundary) stat outputs and (b) DMAs the freshly-updated H out to
+    that boundary's slice of the ``h_checks`` HBM output — the label
+    snapshot the scheduler's per-check class-stability bookkeeping
+    replays, one while-loop trip per ``check_block`` checks. Two extra
+    per-lane inputs carry the iteration fence: ``budget``/``budgetr``
+    hold each lane's remaining iteration allowance (``max_iter -
+    slot_iter`` at launch entry), and a lane freezes in-kernel once the
+    launch-local iteration index reaches it — so a lane crossing its cap
+    mid-launch stops at exactly the right boundary without a host trip.
+    """
     it = pl.program_id(0)
     ph = pl.program_id(1)
     t = pl.program_id(2)
+    if check_block > 1:
+        (budget_ref, budgetr_ref, w_in_ref, h_in_ref,
+         w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref, hck_ref,
+         numer_acc, gram_acc) = rest
+        # boundary bookkeeping: which check sub-block this iteration
+        # closes (valid only when is_boundary holds)
+        is_boundary = (it + 1) % check_every == 0
+        bidx = (it + 1) // check_every - 1
+    else:
+        (w_in_ref, h_in_ref,
+         w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref,
+         numer_acc, gram_acc) = rest
 
     # One-shot manual DMA of the initial factors (HBM, memory_space=ANY)
     # into the VMEM-resident output windows at the very first grid step.
@@ -232,6 +259,14 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
     # a non-32-bit value (bool masks) is unsupported on TPU
     frozen_c = frozen_ref[:] > 0.0  # (1, rk) — W-phase column mask
     frozen_r = frozenr_ref[:] > 0.0  # (rk, 1) — H-phase row mask
+    if check_block > 1:
+        # per-lane iteration fence: budget holds the lane's remaining
+        # allowance at launch entry (a multiple of check_every, like the
+        # launch-local index) — the lane freezes for the rest of the
+        # launch once `it` reaches it
+        it_f = it.astype(jnp.float32)
+        frozen_c = frozen_c | (budget_ref[:] <= it_f)
+        frozen_r = frozen_r | (budgetr_ref[:] <= it_f)
 
     @pl.when((ph == 0) & (t == 0))
     def _():
@@ -262,11 +297,32 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
             hn = jnp.where(frozen_r, h0, hn)
             h_ref[:] = hn.astype(h_ref.dtype)
 
-            @pl.when(last_it)
-            def _():
-                hd_ref[:] = jnp.max(jnp.abs(hn - h0), axis=1,
-                                    keepdims=True)
-                hm_ref[:] = jnp.max(jnp.abs(h0), axis=1, keepdims=True)
+            if check_block > 1:
+                rk = h_ref.shape[0]
+
+                @pl.when(is_boundary)
+                def _():
+                    # this boundary's H-side TolX stats + the label
+                    # snapshot the scheduler replays the check against
+                    sl = pl.dslice(bidx * rk, rk)
+                    hd_ref[sl, :] = jnp.max(jnp.abs(hn - h0), axis=1,
+                                            keepdims=True)
+                    hm_ref[sl, :] = jnp.max(jnp.abs(h0), axis=1,
+                                            keepdims=True)
+
+                    def snap(sem):
+                        dma = pltpu.make_async_copy(
+                            h_ref, hck_ref.at[bidx], sem.at[0])
+                        dma.start()
+                        dma.wait()
+
+                    pl.run_scoped(snap, pltpu.SemaphoreType.DMA((1,)))
+            else:
+                @pl.when(last_it)
+                def _():
+                    hd_ref[:] = jnp.max(jnp.abs(hn - h0), axis=1,
+                                        keepdims=True)
+                    hm_ref[:] = jnp.max(jnp.abs(h0), axis=1, keepdims=True)
             # pre-mask HHᵀ for phase 1 (gram_acc is free now)
             hc = _maybe_cast(hn, matmul_dtype)
             gram_acc[:] = jnp.where(bd, jax.lax.dot_general(
@@ -289,25 +345,42 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
         wn = jnp.where(frozen_c, wt0, wn)
         w_ref[pl.dslice(t * block_m, block_m), :] = wn.astype(w_ref.dtype)
 
-        @pl.when(last_it)
-        def _():
-            wd_t = jnp.max(jnp.abs(wn - wt0), axis=0, keepdims=True)
-            wm_t = jnp.max(jnp.abs(wt0), axis=0, keepdims=True)
-
-            @pl.when(t == 0)
+        if check_block > 1:
+            @pl.when(is_boundary)
             def _():
-                wd_ref[:] = wd_t
-                wm_ref[:] = wm_t
+                wd_t = jnp.max(jnp.abs(wn - wt0), axis=0, keepdims=True)
+                wm_t = jnp.max(jnp.abs(wt0), axis=0, keepdims=True)
+                row = pl.dslice(bidx, 1)
 
-            @pl.when(t > 0)
+                @pl.when(t == 0)
+                def _():
+                    wd_ref[row, :] = wd_t
+                    wm_ref[row, :] = wm_t
+
+                @pl.when(t > 0)
+                def _():
+                    wd_ref[row, :] = jnp.maximum(wd_ref[row, :], wd_t)
+                    wm_ref[row, :] = jnp.maximum(wm_ref[row, :], wm_t)
+        else:
+            @pl.when(last_it)
             def _():
-                wd_ref[:] = jnp.maximum(wd_ref[:], wd_t)
-                wm_ref[:] = jnp.maximum(wm_ref[:], wm_t)
+                wd_t = jnp.max(jnp.abs(wn - wt0), axis=0, keepdims=True)
+                wm_t = jnp.max(jnp.abs(wt0), axis=0, keepdims=True)
+
+                @pl.when(t == 0)
+                def _():
+                    wd_ref[:] = wd_t
+                    wm_ref[:] = wm_t
+
+                @pl.when(t > 0)
+                def _():
+                    wd_ref[:] = jnp.maximum(wd_ref[:], wd_t)
+                    wm_ref[:] = jnp.maximum(wm_ref[:], wm_t)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "iters", "block_m", "eps", "zero_threshold", "matmul_precision",
-    "interpret", "alias_io"))
+    "interpret", "alias_io", "check_block"))
 def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
                            frozen_cols: jax.Array, *, k: int,
                            iters: int = 2, block_m: int = 512,
@@ -315,10 +388,35 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
                            matmul_precision: str = "default",
                            interpret: bool = False,
                            seg_ids: "jax.Array | None" = None,
-                           alias_io: bool = False):
+                           alias_io: bool = False,
+                           check_block: int = 1,
+                           budget_cols: "jax.Array | None" = None):
     """``iters`` full MU iterations (both half-updates) in ONE pallas_call
     with the packed factors VMEM-resident throughout — the whole-solve
     launch count drops from ~4 kernels per iteration-pair to 1.
+
+    ``check_block > 1`` (round 6 — the launch-resident convergence
+    engine): ONE pallas_call runs ``check_block`` check sub-blocks of
+    ``iters`` iterations back-to-back, the factors staying VMEM-resident
+    across ALL of them (the W/H HBM round-trip amortizes over
+    ``check_block`` checks instead of one). The TolX stat outputs grow a
+    per-boundary leading extent — ``wdiff``/``wmax`` become
+    (check_block, R·k), ``hdiff``/``hmax`` (check_block·R·k, 1), row b
+    measured across the LAST iteration of sub-block b — and a seventh
+    output ``h_checks`` (check_block, R·k, n) carries the H snapshot at
+    each boundary (DMA'd straight from the resident window: labels and
+    class-stability flip counting replay per check against these, so the
+    CHECK CADENCE is unchanged while the scheduler trip rate drops
+    ``check_block``-fold). ``budget_cols`` (1, R·k) f32 is REQUIRED in
+    this mode: each lane's remaining iteration allowance at launch entry
+    (``max_iter − slot_iter``; a multiple of ``iters``) — the in-kernel
+    fence freezes a lane that crosses its cap mid-launch at exactly the
+    right boundary. Frozen-lane and numerical semantics per sub-block
+    are identical to ``check_block`` separate launches EXCEPT that a
+    lane whose stop condition fires at an interior boundary keeps
+    iterating to the end of the launch (the caller records its stop
+    iteration from the boundary data; its factors carry the extra
+    in-launch iterations — the gate-checkable slot-drift class).
 
     ``frozen_cols``: (1, R·k) f32, >0 marks a frozen (converged/inactive)
     lane whose columns must not change — callers must keep it constant
@@ -361,11 +459,15 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
     rk = wp.shape[1]
     if m % block_m:
         raise ValueError(f"m={m} must be a multiple of block_m={block_m}")
+    if check_block > 1 and budget_cols is None:
+        raise ValueError("check_block > 1 needs budget_cols (each lane's "
+                         "remaining iteration allowance at launch entry)")
     nt = m // block_m
     kernel = functools.partial(
         _block_kernel, block_m=block_m, k=k, eps=eps,
         zero_threshold=zero_threshold,
-        matmul_dtype=_matmul_dtype(matmul_precision))
+        matmul_dtype=_matmul_dtype(matmul_precision),
+        check_every=iters, check_block=check_block)
     frozen_rows = frozen_cols.reshape(rk, 1)
     if seg_ids is None:
         # uniform pool: every job spans k consecutive columns
@@ -390,36 +492,56 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
     # fault-injection-proven `bench.py --verify` (incl. the
     # reload-exercising boundary stage) must pass with this on — see
     # benchmarks/probe_alias_io.py for the bit-exactness bisect.
-    alias = {5: 0, 6: 1} if alias_io else {}
+    in_specs = [
+        pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
+                     memory_space=pltpu.VMEM),
+        const((1, rk)), const((rk, 1)),
+        const((rk, 1)), const((1, rk)),
+    ]
+    operands = [a, frozen_cols, frozen_rows, seg_ids.reshape(rk, 1),
+                seg_ids.reshape(1, rk)]
+    if check_block > 1:
+        in_specs += [const((1, rk)), const((rk, 1))]
+        budget_cols = budget_cols.astype(jnp.float32).reshape(1, rk)
+        operands += [budget_cols, budget_cols.reshape(rk, 1)]
+    w_in_idx = len(operands)
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    operands += [wp, hp]
+    alias = {w_in_idx: 0, w_in_idx + 1: 1} if alias_io else {}
+    nck = check_block
+    out_specs = [const((m, rk)), const((rk, n)), const((nck, rk)),
+                 const((nck, rk)), const((nck * rk, 1)),
+                 const((nck * rk, 1))]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, rk), wp.dtype),
+        jax.ShapeDtypeStruct((rk, n), hp.dtype),
+        jax.ShapeDtypeStruct((nck, rk), jnp.float32),
+        jax.ShapeDtypeStruct((nck, rk), jnp.float32),
+        jax.ShapeDtypeStruct((nck * rk, 1), jnp.float32),
+        jax.ShapeDtypeStruct((nck * rk, 1), jnp.float32),
+    ]
+    if check_block > 1:
+        # per-boundary H snapshots live in HBM (ANY) — written by one
+        # small DMA per boundary straight from the resident H window, so
+        # they cost no VMEM and ~rk·n bytes of traffic per check (the
+        # same H read the separate-launch design's external labels paid)
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_shape.append(
+            jax.ShapeDtypeStruct((nck, rk, n), hp.dtype))
     return pl.pallas_call(
         kernel,
-        grid=(iters, 2, nt),
+        grid=(iters * check_block, 2, nt),
         input_output_aliases=alias,
-        in_specs=[
-            pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
-                         memory_space=pltpu.VMEM),
-            const((1, rk)), const((rk, 1)),
-            const((rk, 1)), const((1, rk)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[const((m, rk)), const((rk, n)), const((1, rk)),
-                   const((1, rk)), const((rk, 1)), const((rk, 1))],
-        out_shape=[
-            jax.ShapeDtypeStruct((m, rk), wp.dtype),
-            jax.ShapeDtypeStruct((rk, n), hp.dtype),
-            jax.ShapeDtypeStruct((1, rk), jnp.float32),
-            jax.ShapeDtypeStruct((1, rk), jnp.float32),
-            jax.ShapeDtypeStruct((rk, 1), jnp.float32),
-            jax.ShapeDtypeStruct((rk, 1), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((rk, n), jnp.float32),
             pltpu.VMEM((rk, rk), jnp.float32),
         ],
         interpret=interpret,
-    )(a, frozen_cols, frozen_rows, seg_ids.reshape(rk, 1),
-      seg_ids.reshape(1, rk), wp, hp)
+    )(*operands)
 
 
 @functools.partial(jax.jit, static_argnames=(

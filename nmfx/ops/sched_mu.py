@@ -116,13 +116,14 @@ def _streams_bf16_a(cfg: SolverConfig) -> bool:
     kl is excluded by default: its block consumes A in an ELEMENTWISE
     division (the quotient A ⊘ WH), where truncation is a real ~0.4%
     per-element perturbation the vmapped engine does not have — not a
-    free MXU rounding; ``cfg.kl_bf16_quotient`` opts in (see the
+    free MXU rounding; ``cfg.experimental.kl_bf16_quotient`` opts in (see the
     measured accept/reject note on that field). Single source of truth
     for both the cast sites in ``mu_sched``/``mu_grid`` and the VMEM
     slot clamp's a_bytes — the sites must never disagree or the byte
     model is off by 2x on the A-tile term."""
     return (cfg.matmul_precision == "bfloat16"
-            and (cfg.algorithm != "kl" or cfg.kl_bf16_quotient)
+            and (cfg.algorithm != "kl"
+                 or cfg.experimental.kl_bf16_quotient)
             and jnp.dtype(cfg.dtype) == jnp.float32
             and jax.default_backend() == "tpu")
 
@@ -137,31 +138,48 @@ def _pallas_block_geometry(m: int):
 
 
 def _pallas_max_rk(m: int, n: int, cfg: SolverConfig,
-                   factor_bytes: "int | None" = None) -> int:
+                   factor_dtype: "str | None" = None,
+                   check_block: int = 1) -> int:
     """Largest packed column count the resident-W block kernel's VMEM
     envelope admits at this shape (the inequality documented in
     ``_pallas_slot_clamp``; shared by the uniform clamp and the ragged
     pool's column budget).
 
-    ``factor_bytes=2`` models the bf16-factor-storage experiment: the
-    W/H windows halve while the f32 numer/gram scratch stays — modeled
-    as ``2·rk·m_pad + 10·rk·n_pad + 4·rk²`` against a CONSERVATIVE
-    13.5 MiB budget (unlike the f32 model, this variant is not
-    boundary-probed on hardware; Mosaic still rejects loudly if the
-    model ever over-admits)."""
+    ``factor_dtype="bfloat16"`` models the round-5 bf16-factor-storage
+    experiment: the W/H windows halve while the f32 numer/gram scratch
+    stays — modeled as ``2·rk·m_pad + 10·rk·n_pad + 4·rk²`` against a
+    CONSERVATIVE 13.5 MiB budget. ``"bfloat16_w"`` (round 6) halves only
+    the W window: ``2·rk·m_pad + 12·rk·n_pad + 4·rk²`` against the same
+    conservative budget (neither bf16 variant is boundary-probed on
+    hardware; Mosaic still rejects loudly if the model ever over-admits).
+    ``check_block > 1`` adds the per-boundary stat windows (the H
+    snapshots live in HBM and cost no VMEM): ``16·check_block·rk + 8·rk``
+    bytes — ~64 KB at the north star, inside the fitted model's measured
+    slack, but counted so the boundary stays honest."""
     _, block_m, m_pad = _pallas_block_geometry(m)
     n_pad = -(-n // 128) * 128
     a_bytes = 2 if _streams_bf16_a(cfg) else jnp.dtype(cfg.dtype).itemsize
-    if factor_bytes == 2:
+    # per-boundary TolX stat outputs (wd/wm (N, rk) + hd/hm (N·rk, 1),
+    # f32) plus the two (·, rk) budget-fence inputs
+    def check_extra(rk):
+        if check_block <= 1:
+            return 0
+        return 16 * check_block * rk + 8 * rk
+
+    if factor_dtype in ("bfloat16", "bfloat16_w"):
+        # bf16 W window; the n-proportional term keeps f32 numer/extra
+        # plus the H window at 2 ("bfloat16") or 4 ("bfloat16_w") bytes
+        h_mult = 10 if factor_dtype == "bfloat16" else 12
         budget = int(13.5 * 2**20) - 2 * block_m * n_pad * a_bytes
 
         def need(rk):
-            return 2 * rk * m_pad + 10 * rk * n_pad + 4 * rk * rk
+            return (2 * rk * m_pad + h_mult * rk * n_pad + 4 * rk * rk
+                    + check_extra(rk))
     else:
         budget = int(14.3 * 2**20) - 2 * block_m * n_pad * a_bytes
 
         def need(rk):
-            return 4 * rk * (m_pad + 3 * n_pad + rk)
+            return 4 * rk * (m_pad + 3 * n_pad + rk) + check_extra(rk)
     rk = 0
     while need(rk + 1) <= budget:
         rk += 1
@@ -170,7 +188,8 @@ def _pallas_max_rk(m: int, n: int, cfg: SolverConfig,
 
 def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
                        cfg: SolverConfig,
-                       factor_bytes: "int | None" = None) -> int:
+                       factor_dtype: "str | None" = None,
+                       check_block: int = 1) -> int:
     """Clamp the slot pool to the resident-W block kernel's VMEM envelope.
 
     Empirical v5e model (round 4, benchmarks/probe_vmem_envelope*.py —
@@ -199,7 +218,8 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
     WARNING.
     """
     def fits(slots: int) -> bool:
-        return slots * k_max <= _pallas_max_rk(m, n, cfg, factor_bytes)
+        return slots * k_max <= _pallas_max_rk(m, n, cfg, factor_dtype,
+                                               check_block)
 
     if not fits(1):
         raise ValueError(
@@ -253,11 +273,70 @@ def _ragged_iters_est(k: int) -> float:
     k=4, then ≈ k^1.45 growth; a naive k^1.5-everywhere model
     mis-allocated the round-5 prototype 4× — see RESULTS.md round-5
     ragged section). Only schedule QUALITY depends on this; results
-    never do."""
+    never do. Workloads whose iteration profile departs the calibration
+    should pass measured estimates instead
+    (``ExperimentalConfig.ragged_iters_est``, derived from a previous
+    run via :func:`ragged_estimates_from_iterations`) — ``_resolve_est``
+    WARNs when the default model is extrapolating."""
     return 515.0 * max(1.0, k / 4.0) ** 1.45
 
 
-def _ragged_layout(job_ks: tuple, budget_cols: int) -> list:
+def ragged_estimates_from_iterations(job_ks, iterations
+                                     ) -> tuple[tuple[int, float], ...]:
+    """Per-class mean stop iterations from a previous run's recorded
+    ``SchedMUResult.iterations`` (or any per-job iteration array aligned
+    with ``job_ks``) — the measured replacement for the built-in
+    north-star model, in the hashable form
+    ``ExperimentalConfig.ragged_iters_est`` takes. The scheduler's own
+    ``pool_trips``/``pool_lanes`` counters bound the same quantity per
+    stage; the per-job counts are strictly finer, so they are the
+    calibration source."""
+    its = np.asarray(iterations, dtype=np.float64)
+    if len(job_ks) != its.shape[0]:
+        raise ValueError(
+            f"job_ks has {len(job_ks)} entries but iterations carries "
+            f"{its.shape[0]} jobs")
+    by_k: dict[int, list[float]] = {}
+    for k, it in zip(job_ks, its):
+        by_k.setdefault(int(k), []).append(float(it))
+    return tuple(sorted((k, float(np.mean(v))) for k, v in by_k.items()))
+
+
+def _resolve_est(iters_est, job_ks, max_iter: int):
+    """The per-rank iteration-estimate function the ragged layout
+    allocates slots with: caller-measured estimates when provided, else
+    the built-in north-star model — WARNING when that model is
+    extrapolating outside its calibrated profile (ranks beyond k=10, or
+    an iteration cap below the class-stability stop range it was fitted
+    on), since a bad estimate cost the round-5 prototype 4× (RESULTS.md
+    round-5 ragged section)."""
+    if iters_est is not None:
+        table = {int(k): float(v) for k, v in iters_est}
+        missing = sorted({int(k) for k in job_ks} - set(table))
+        if missing:
+            raise ValueError(
+                "experimental.ragged_iters_est is missing estimates for "
+                f"rank classes {missing}")
+        return lambda k: table[int(k)]
+    ks = {int(k) for k in job_ks}
+    if max(ks) > 10 or max_iter < 1030:
+        import logging
+        logging.getLogger("nmfx").warning(
+            "ragged slot allocation is using the built-in iteration "
+            "model calibrated on the north-star profile (mu, k=2..10, "
+            "class-stability stops ~515..2000 iterations; BENCH_r04) — "
+            "this job mix (k in %s, max_iter=%d) departs it, so the "
+            "greedy-minimax allocation may be poor (the round-5 "
+            "prototype lost 4x to a mis-calibrated model). Pass "
+            "measured per-class estimates via "
+            "ExperimentalConfig.ragged_iters_est (see "
+            "ragged_estimates_from_iterations)",
+            sorted(ks), max_iter)
+    return _ragged_iters_est
+
+
+def _ragged_layout(job_ks: tuple, budget_cols: int,
+                   iters_est=None, max_iter: int = 10000) -> list:
     """Partition a mixed-rank job list into rank classes and allocate
     slots by GREEDY MINIMAX: start at one slot per class and repeatedly
     give a slot to the class with the largest estimated remaining
@@ -286,7 +365,8 @@ def _ragged_layout(job_ks: tuple, budget_cols: int) -> list:
             f"ragged pool: one slot per rank class needs "
             f"{sum(k for k in ks_desc)} columns, budget is {budget_cols} "
             "(VMEM envelope); use backend='packed'")
-    load = {k: len(by_k[k]) * _ragged_iters_est(k) for k in ks_desc}
+    est = _resolve_est(iters_est, job_ks, max_iter)
+    load = {k: len(by_k[k]) * est(k) for k in ks_desc}
     slots = {k: 1 for k in ks_desc}
     while True:
         spare = budget_cols - sum(slots[k] * k for k in ks_desc)
@@ -613,19 +693,13 @@ _AUTO_TAIL_SLOTS = (8,)
 
 
 @partial(jax.jit, static_argnames=("cfg", "slots", "varying_axes",
-                                  "tail_slots", "job_ks", "ragged",
-                                  "evict_batch", "factor_dtype",
-                                  "alias_io"))
+                                  "tail_slots", "job_ks"))
 def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
              cfg: SolverConfig = SolverConfig(),
              slots: int = 48,
              varying_axes: tuple[str, ...] = (),
              tail_slots: "int | None | str | tuple[int, ...]" = "auto",
              job_ks: "tuple[int, ...] | None" = None,
-             ragged: "bool | None" = None,
-             evict_batch: int = 1,
-             factor_dtype: "str | None" = None,
-             alias_io: bool = False,
              flip_floor: "jax.Array | None" = None,
              ) -> SchedMUResult:
     """Solve J dense zero-padded jobs through an S-slot scheduler.
@@ -662,42 +736,39 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     ``job_ks``: per-job true ranks (static tuple). Enables the exact
     snmf coupling mask (``grid_mu.pad_live_mask``) and unlocks the
     RAGGED class-blocked pool on the pallas block-kernel route.
-    ``ragged``: None/False = uniform pool (the default — the measured
-    round-5 verdict; see the comment at the resolution site and
-    RESULTS.md's ragged section); True = opt in (requires pallas +
-    job_ks + block-aligned max_iter). The ragged pool allocates each
-    rank class variable-width slots (``_ragged_layout``) so NO packed
-    column is padding — the uniform pool burns k_max−k zero columns per
-    job, ~40% of its GEMM work at the north-star mix — then hands the
-    ≤8 surviving stragglers to the standard uniform tail; it measured
-    NET SLOWER at the north star (tail triples, per-trip class
-    bookkeeping ~1.5×), which is why it is not the default. Per-job
-    trajectories and stop decisions match the uniform pool to the same
-    float tolerance as any width change. ``evict_batch``: harvest
-    hysteresis (see ``harvest``); recorded per-job results are
-    invariant, default 1 (measured no clear win). ``factor_dtype``:
-    None (storage dtype) or "bfloat16" — the wide-pool experiment
-    (pallas + block-aligned max_iter + uniform pool only): slot W/H
-    stored bf16, halving the per-block W round-trip and widening the
-    VMEM envelope ~1.5×. Measured and REJECTED as a default (round 5,
-    benchmarks/probe_bf16_pool.py): quantized factors hit bf16 fixed
-    points, halving iteration counts to the class-stability floor and
-    moving consensus outside the verify gate's band — kept only so the
-    rejection is reproducible. ``alias_io``: donate the block kernel's
-    input buffers as outputs (bit-exact at every bisect level — the
-    explicit DMA is the data path — but measured ~8% SLOWER than the
-    carry copies it targets; default off, see probe_alias_io.py).
     ``flip_floor``: precomputed class-stability flip budget (i32 scalar,
     may be traced) overriding ``floor(class_flip_tol · n)`` — the
     shape-bucketed executables pass the TRUE sample count's budget while
     n is the padded bucket width (``nmfx/exec_cache.py``; see
     ``packed_mu.batch_convergence``).
+
+    ``cfg.check_block`` (round 6) batches N check blocks per while-loop
+    trip: on the pallas block-kernel route ONE ``fused_block_iterations``
+    launch runs all N blocks with the factors VMEM-resident and exports
+    per-boundary label snapshots + TolX stats, against which the
+    class-stability/TolX bookkeeping replays each check exactly; on the
+    XLA-dense route (and the pallas per-iteration fallback) the N blocks
+    run sequentially with the bookkeeping interleaved — exact semantics
+    there. Either way the heavy per-trip machinery (while-carry copies,
+    the evict/reload ``lax.cond``, harvest scatters) fires once per N
+    checks. See ``SolverConfig.check_block`` for the drift contract and
+    the "auto" resolution.
+
+    The measured-rejected opt-ins — ragged class-blocked pool, evict
+    hysteresis, slot-pool factor dtypes, kernel buffer donation — live
+    in ``cfg.experimental`` (``nmfx.ExperimentalConfig``), not in this
+    signature; see that class for each knob's measured verdict and the
+    keep/remove policy.
     """
     if cfg.algorithm not in BLOCKS:
         raise ValueError(
             f"the slot scheduler implements {tuple(BLOCKS)}, got "
             f"algorithm={cfg.algorithm!r}")
     cfg = conv_cfg(cfg)
+    exp = cfg.experimental
+    evict_batch = exp.evict_batch
+    factor_dtype = exp.factor_dtype
+    alias_io = exp.alias_io
     use_pallas = cfg.backend == "pallas"
     if use_pallas and cfg.algorithm != "mu":
         raise ValueError("the pallas slot scheduler is mu-only")
@@ -717,10 +788,11 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             "— per-job true ranks must match the job batch exactly")
     s = min(slots, j)
     ce_ok = cfg.max_iter % cfg.check_every == 0
-    if ragged and not (use_pallas and ce_ok and job_ks is not None):
+    if exp.ragged and not (use_pallas and ce_ok and job_ks is not None):
         raise ValueError(
-            "ragged=True needs backend='pallas', job_ks, and max_iter a "
-            "multiple of check_every (the block-kernel route)")
+            "experimental.ragged=True needs backend='pallas', job_ks, "
+            "and max_iter a multiple of check_every (the block-kernel "
+            "route)")
     # ragged default: OFF. Measured round 5 (benchmarks/probe_ragged_ab,
     # same-session min-of-5): the class-blocked pool cut main-stage trips
     # 4687 → 4129 as designed, but its straggler tail tripled (balanced
@@ -729,28 +801,43 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     # bookkeeping/evict body costs ~1.5× per trip — net 1.74 s vs the
     # uniform pool's 1.32 s at the north star. Kept as an opt-in for
     # mixes where padding waste is extreme (k_max >> typical k).
-    use_ragged = False if ragged is None else bool(ragged)
-    if factor_dtype not in (None, "bfloat16"):
-        raise ValueError(f"factor_dtype must be None or 'bfloat16', got "
-                         f"{factor_dtype!r}")
-    fdtype = jnp.bfloat16 if factor_dtype == "bfloat16" else None
-    if fdtype is not None and not (use_pallas and ce_ok
-                                   and not use_ragged):
+    use_ragged = bool(exp.ragged)
+    # the block-kernel route: one fused launch per check block (and the
+    # only route where check_block batches INSIDE the kernel)
+    blk_route = use_pallas and ce_ok and not use_ragged
+    ncheck = cfg.check_block
+    if ncheck == "auto":
+        # resolved per engine: the round-5 trace decomposition puts the
+        # per-trip non-kernel overhead (~47 µs of carry copies + cond +
+        # bookkeeping against a 136 µs kernel) on the pallas scheduler;
+        # the dense engine's bookkeeping measured within noise there, so
+        # its default cadence stays 1 (the knob remains available)
+        ncheck = 4 if blk_route else 1
+    ncheck = int(ncheck)
+    if ncheck > 1 and use_ragged:
         raise ValueError(
-            "factor_dtype='bfloat16' is the pallas block-kernel wide-pool"
-            " experiment: backend='pallas', max_iter a multiple of "
-            "check_every, uniform (non-ragged) pool")
-    if alias_io and not (use_pallas and ce_ok and not use_ragged):
+            "check_block > 1 requires the uniform pool "
+            "(experimental.ragged=False) — the ragged stage's per-class "
+            "bookkeeping is check-per-trip")
+    fdtype = jnp.bfloat16 if factor_dtype else None
+    if fdtype is not None and not blk_route:
+        raise ValueError(
+            "experimental.factor_dtype='bfloat16'/'bfloat16_w' is the "
+            "pallas block-kernel pool experiment: backend='pallas', "
+            "max_iter a multiple of check_every, uniform (non-ragged) "
+            "pool")
+    if alias_io and not blk_route:
         # enforced, not silently ignored: the ragged stage and the
         # per-iteration fallback never thread the donation, so a user
         # "benchmarking alias_io" there would measure an unaliased build
         raise ValueError(
-            "alias_io=True is the uniform pallas block-kernel route "
-            "only: backend='pallas', max_iter a multiple of "
-            "check_every, non-ragged")
+            "experimental.alias_io=True is the uniform pallas "
+            "block-kernel route only: backend='pallas', max_iter a "
+            "multiple of check_every, non-ragged")
     if use_pallas and not use_ragged:
         s = _pallas_slot_clamp(s, k_max, m, n, cfg,
-                               factor_bytes=2 if fdtype else None)
+                               factor_dtype=factor_dtype,
+                               check_block=ncheck)
     if cfg.algorithm == "kl":
         s = _kl_slot_clamp(s, m, n, dtype)
     ce = cfg.check_every
@@ -811,24 +898,35 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                            matmul_precision=cfg.matmul_precision,
                            interpret=interp)
 
-            # bf16-factor-storage experiment (factor_dtype="bfloat16"):
-            # the slot pool's W/H live as bf16 between check blocks —
-            # halves the W round-trip per block AND ~1.6x more columns
-            # fit the VMEM envelope. A REAL numerics change (each store
-            # quantizes the factor state ~0.4% relative, so TolX cannot
-            # fire below that and trajectories drift within the gate's
-            # bands), unlike the result-invariant bf16 A-streaming.
-            pool_dtype = fdtype or dtype
+            # bf16-factor-storage experiments (experimental.factor_dtype):
+            # "bfloat16" (round 5) stores BOTH pool factors bf16 — halves
+            # the W round-trip per block and widens the VMEM envelope
+            # ~1.6x, but the quantized H freezes labels at a bf16 fixed
+            # point (measured-rejected, probe_bf16_pool.py).
+            # "bfloat16_w" (round 6) stores only W bf16 and keeps H — the
+            # label-bearing factor — at the solve dtype: the round-5
+            # freeze cannot start from the labels, while W (10 of the
+            # ~11 MB per-launch factor round-trip at the north star)
+            # still moves at half the bytes. Both are REAL numerics
+            # changes (per-iteration stores quantize the affected
+            # factor), unlike the result-invariant bf16 A-streaming.
+            w_pool = jnp.bfloat16 if factor_dtype else dtype
+            h_pool = (jnp.bfloat16 if factor_dtype == "bfloat16"
+                      else dtype)
 
-            def to_pool(x):
-                return x.astype(pool_dtype) if fdtype is not None else x
+            def to_pool_w(x):
+                return x.astype(w_pool) if factor_dtype else x
+
+            def to_pool_h(x):
+                return (x.astype(h_pool) if factor_dtype == "bfloat16"
+                        else x)
 
             def init_slots():
                 # (s, m_pad, k) → packed (m_pad, s·k)
-                return (to_pool(jnp.transpose(w0[:s],
-                                              (1, 0, 2)).reshape(m_pad,
-                                                                 -1)),
-                        to_pool(h0[:s].reshape(s * k_max, n)))
+                return (to_pool_w(jnp.transpose(w0[:s],
+                                                (1, 0, 2)).reshape(m_pad,
+                                                                   -1)),
+                        to_pool_h(h0[:s].reshape(s * k_max, n)))
 
             def make_do_block(width):
                 """Width-specific check block (the tail pool re-derives it
@@ -888,6 +986,45 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
 
                 return stepped_block(_one_step, packed_deltas)
 
+            def make_do_multi(width):
+                """The launch-resident multi-check block (check_block > 1,
+                block-kernel route only): ONE fused launch runs ncheck
+                check blocks with the factors VMEM-resident, the per-lane
+                max_iter fence enforced in-kernel (budget columns), and
+                each boundary's labels/TolX delta recovered from the
+                kernel's exported snapshots/stats — so the while-loop
+                body replays ncheck exact checks per trip."""
+                rk = width * k_max
+
+                def do_multi(wp, hp, active, slot_iter, slot_job):
+                    del slot_job  # mu-only path: no per-job auxiliaries
+                    frozen = ~active | (slot_iter >= cfg.max_iter)
+                    fcol = jnp.repeat(frozen, k_max).astype(
+                        jnp.float32)[None, :]
+                    budget = jnp.repeat(
+                        jnp.maximum(cfg.max_iter - slot_iter, 0),
+                        k_max).astype(jnp.float32)[None, :]
+                    wp, hp, wd, wm, hd, hm, hck = fused_block_iterations(
+                        a_loop, wp, hp, fcol, k=k_max, iters=ce,
+                        alias_io=alias_io, check_block=ncheck,
+                        budget_cols=budget, **kern_kw)
+
+                    def lane_max(x):  # (rk,) → per-slot max
+                        return jnp.max(x.reshape(-1, k_max), axis=1)
+
+                    deltas, labels = [], []
+                    for b in range(ncheck):
+                        deltas.append(jnp.maximum(
+                            ratio(lane_max(wd[b]), lane_max(wm[b])),
+                            ratio(lane_max(hd[b * rk:(b + 1) * rk, 0]),
+                                  lane_max(hm[b * rk:(b + 1) * rk, 0]))))
+                        labels.append(jnp.argmax(
+                            hck[b].reshape(-1, k_max, n),
+                            axis=1).astype(jnp.int32))
+                    return wp, hp, deltas, labels
+
+                return do_multi
+
             def slot_labels(hp):
                 return jnp.argmax(hp.reshape(-1, k_max, n),
                                   axis=1).astype(jnp.int32)
@@ -909,10 +1046,10 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 w3 = wp.reshape(m_pad, -1, k_max)
                 # gathers cast to the pool dtype so where() cannot
                 # promote the bf16 carry back to f32
-                wg = to_pool(jnp.transpose(w0[gather],
-                                           (1, 0, 2)))  # (m_pad, s, k)
+                wg = to_pool_w(jnp.transpose(w0[gather],
+                                             (1, 0, 2)))  # (m_pad, s, k)
                 w3 = jnp.where(load[None, :, None], wg, w3)
-                h3 = jnp.where(load[:, None, None], to_pool(h0[gather]),
+                h3 = jnp.where(load[:, None, None], to_pool_h(h0[gather]),
                                hp.reshape(-1, k_max, n))
                 return w3.reshape(m_pad, -1), h3.reshape(-1, n)
 
@@ -955,6 +1092,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             def make_do_block(width):
                 del width  # the dense blocks are batch-width-free
                 return stepped_block(step_fn, dense_deltas)
+
+            make_do_multi = None  # XLA route: sub-blocks run sequentially
 
             def slot_labels(hp):
                 return jnp.argmax(hp, axis=1).astype(jnp.int32)
@@ -1015,60 +1154,94 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             gather would drop un-harvested factors."""
             return lax.cond(jnp.any(st.pending), harvest, lambda s: s, st)
 
-        def make_body(do_block):
-            def body(st: SchedState) -> SchedState:
-                # --- one check block: check_every solver iterations with
-                # the per-slot max_iter fence, returning the TolX delta --
-                wp, hp, delta = do_block(st.wp, st.hp, st.active,
-                                         st.slot_iter, st.slot_job)
-                it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
-                if not cfg.use_tol_checks:
-                    delta = None
-                classes, stable, conv, _, reason = batch_convergence(
-                    cfg, it_new, new_classes=slot_labels(hp), delta=delta,
-                    n_glob=n, classes=st.classes, stable=st.stable,
-                    done=~st.active,
-                    done_iter=jnp.zeros_like(st.slot_iter),
-                    stop_reason=jnp.full_like(st.slot_iter,
-                                              base.StopReason.MAX_ITER),
-                    flip_floor=flip_floor)
-                dnorm = st.dnorm
-                if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
-                    wd, hd = dense_views(wp, hp)
-                    dnorm, conv, reason = tolfun_update(
-                        a, wd, hd, it_new, cfg, dnorm=dnorm, done=conv,
-                        done_in=~st.active, stop_reason=reason)
-                # conv folds in ~active (passed as `done`); isolate fresh
-                # stops
-                finished = st.active & (conv | (it_new >= cfg.max_iter))
+        def apply_check(st: SchedState, wp, hp, delta,
+                        new_labels) -> SchedState:
+            """ONE convergence check's bookkeeping — the class-stability
+            snapshot rule, TolX, the TolFun residual test where the
+            algorithm uses it, the max_iter fence, and the cheap per-job
+            outcome scatters. ``wp``/``hp`` are the factors the check's
+            results freeze with (on the multi-check launch the interior
+            checks see the launch-final factors — the documented drift
+            class; labels/deltas are the boundary-exact kernel exports)."""
+            it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
+            if not cfg.use_tol_checks:
+                delta = None
+            classes, stable, conv, _, reason = batch_convergence(
+                cfg, it_new, new_classes=new_labels, delta=delta,
+                n_glob=n, classes=st.classes, stable=st.stable,
+                done=~st.active,
+                done_iter=jnp.zeros_like(st.slot_iter),
+                stop_reason=jnp.full_like(st.slot_iter,
+                                          base.StopReason.MAX_ITER),
+                flip_floor=flip_floor)
+            dnorm = st.dnorm
+            if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
+                wd, hd = dense_views(wp, hp)
+                dnorm, conv, reason = tolfun_update(
+                    a, wd, hd, it_new, cfg, dnorm=dnorm, done=conv,
+                    done_in=~st.active, stop_reason=reason)
+            # conv folds in ~active (passed as `done`); isolate fresh
+            # stops
+            finished = st.active & (conv | (it_new >= cfg.max_iter))
 
-                # record the CHEAP per-job outcomes immediately (tiny
-                # (J+1,) integer scatters — iteration counts and stop
-                # reasons are exact regardless of when the factors are
-                # harvested); the slot freezes (inactive+pending) with
-                # its converged factors in place
-                idx_f = jnp.where(finished, st.slot_job, j)
-                out_iters = st.out_iters.at[idx_f].set(it_new)
-                out_stop = st.out_stop.at[idx_f].set(reason)
-                pending = st.pending | finished
-                active = st.active & ~finished
+            # record the CHEAP per-job outcomes immediately (tiny
+            # (J+1,) integer scatters — iteration counts and stop
+            # reasons are exact regardless of when the factors are
+            # harvested); the slot freezes (inactive+pending) with
+            # its converged factors in place
+            idx_f = jnp.where(finished, st.slot_job, j)
+            out_iters = st.out_iters.at[idx_f].set(it_new)
+            out_stop = st.out_stop.at[idx_f].set(reason)
+            return st._replace(
+                wp=wp, hp=hp,
+                # inactive slots hold their counter: a pending slot
+                # waits frozen at 0 until harvest, so its successor
+                # job starts at iteration 0 no matter how long the
+                # evict_batch hysteresis delayed the reload
+                slot_iter=jnp.where(
+                    finished, 0,
+                    jnp.where(st.active, it_new, st.slot_iter)),
+                classes=jnp.where(finished[:, None], -1, classes),
+                stable=jnp.where(finished, 0, stable),
+                dnorm=jnp.where(finished, jnp.inf, dnorm),
+                active=st.active & ~finished,
+                pending=st.pending | finished,
+                out_iters=out_iters, out_stop=out_stop)
+
+        def make_body(width):
+            """The while-loop body at this pool width: ncheck check
+            blocks, then ONE harvest decision. On the pallas block-kernel
+            route with check_block > 1 all ncheck blocks run inside one
+            fused launch (do_multi) and the checks replay against its
+            boundary exports; everywhere else the blocks run
+            sequentially with the bookkeeping interleaved (exact
+            semantics — converged lanes freeze before the next
+            sub-block). Either way the per-trip machinery below the loop
+            — carry copies, the evict/reload cond — fires once per
+            ncheck checks."""
+            multi = blk_route and ncheck > 1
+            do_multi = make_do_multi(width) if multi else None
+            do_block = None if multi else make_do_block(width)
+
+            def body(st: SchedState) -> SchedState:
+                entry_active = st.active
+                if multi:
+                    wp, hp, deltas, labels = do_multi(
+                        st.wp, st.hp, st.active, st.slot_iter,
+                        st.slot_job)
+                    for b in range(ncheck):
+                        st = apply_check(st, wp, hp, deltas[b], labels[b])
+                else:
+                    for _ in range(ncheck):
+                        wp, hp, delta = do_block(st.wp, st.hp, st.active,
+                                                 st.slot_iter,
+                                                 st.slot_job)
+                        st = apply_check(st, wp, hp, delta,
+                                         slot_labels(hp))
                 st = st._replace(
-                    wp=wp, hp=hp,
-                    # inactive slots hold their counter: a pending slot
-                    # waits frozen at 0 until harvest, so its successor
-                    # job starts at iteration 0 no matter how long the
-                    # evict_batch hysteresis delayed the reload
-                    slot_iter=jnp.where(
-                        finished, 0,
-                        jnp.where(st.active, it_new, st.slot_iter)),
-                    classes=jnp.where(finished[:, None], -1, classes),
-                    stable=jnp.where(finished, 0, stable),
-                    dnorm=jnp.where(finished, jnp.inf, dnorm),
-                    active=active, pending=pending,
                     n_trips=st.n_trips + 1,
-                    n_lanes=st.n_lanes + jnp.sum(st.active,
-                                                 dtype=jnp.int32),
-                    out_iters=out_iters, out_stop=out_stop)
+                    n_lanes=st.n_lanes + jnp.sum(entry_active,
+                                                 dtype=jnp.int32))
 
                 # --- harvest + reload, under lax.cond: the vast
                 # majority of check blocks finish NO job, and inside a
@@ -1078,11 +1251,11 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 # enough peers finish (or nothing else runs), cutting
                 # the heavy branch's firing rate ~evict_batch× for a
                 # few idle slot-trips of queue delay
-                fire = (jnp.sum(pending, dtype=jnp.int32)
+                fire = (jnp.sum(st.pending, dtype=jnp.int32)
                         >= jnp.minimum(evict_batch,
-                                       jnp.sum(pending | active,
+                                       jnp.sum(st.pending | st.active,
                                                dtype=jnp.int32)))
-                return lax.cond(fire & jnp.any(pending), harvest,
+                return lax.cond(fire & jnp.any(st.pending), harvest,
                                 lambda s: s, st)
 
             return body
@@ -1129,7 +1302,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             # slot knob in column units (grid_slots=48 × k_max=10 ≡ the
             # uniform pool's 480-column optimum at the north star)
             layout = _ragged_layout(
-                job_ks, min(_pallas_max_rk(m, n, cfg), s * k_max))
+                job_ks, min(_pallas_max_rk(m, n, cfg), s * k_max),
+                iters_est=exp.ragged_iters_est, max_iter=cfg.max_iter)
             s_total = sum(c.slots for c in layout)
             tail_w = _resolve_tail(tail_slots, s_total)
             tw = tail_w[-1] if tail_w else 1
@@ -1142,7 +1316,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             st = _ragged_to_uniform(st_r, layout, tw, m_pad=m_pad, n=n,
                                     k_max=k_max, j=j, dtype=dtype)
             final = lax.while_loop(lambda st: jnp.any(st.active),
-                                   make_body(make_do_block(tw)), st)
+                                   make_body(tw), st)
             stage_marks.append((final.n_trips, final.n_lanes))
         else:
             wp0, hp0 = init_slots()
@@ -1160,7 +1334,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 n_lanes=vary(jnp.asarray(0, jnp.int32)),
                 **out0,
             )
-            body = make_body(make_do_block(s))
+            body = make_body(s)
             stage_widths = [s]
             stage_marks = []  # cumulative (trips, lanes) at stage ends
             for width in _resolve_tail(tail_slots, s):
@@ -1174,7 +1348,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 stage_marks.append((st.n_trips, st.n_lanes))
                 st = compact(st, width)
                 stage_widths.append(width)
-                body = make_body(make_do_block(width))
+                body = make_body(width)
             final = maybe_harvest(
                 lax.while_loop(lambda st: jnp.any(st.active), body, st))
             stage_marks.append((final.n_trips, final.n_lanes))

@@ -32,8 +32,12 @@ _META_NAME = "registry.json"
 #: per-restart factors must not silently serve a keep_factors sweep.
 #: v5: SolverConfig gained kl_bf16_quotient (round 5) — by the v3 rule
 #: any new field invalidates pre-change registries (loud error with
-#: remediation, never stale numbers); the bump records the cause
-_FORMAT_VERSION = 5
+#: remediation, never stale numbers); the bump records the cause.
+#: v6: round 6 — SolverConfig gained check_block (a cadence field whose
+#: pallas drift class is real numerics) and the experimental knobs
+#: (incl. kl_bf16_quotient, moved) regrouped under
+#: SolverConfig.experimental, changing the hashed field map
+_FORMAT_VERSION = 6
 
 
 def _all_fields(cfg) -> dict:

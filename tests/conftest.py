@@ -22,6 +22,28 @@ import pytest
 
 
 @pytest.fixture(scope="session", autouse=True)
+def _xdist_worker_compile_cache(tmp_path_factory):
+    """Under pytest-xdist, give EACH worker process its own persistent
+    XLA compile cache. The round-5 incident class — a cache entry
+    half-written by one process segfaulting a concurrent reader inside
+    jax's cache deserialization — was a SHARED-directory problem;
+    per-worker directories keep the compile amortization (workers re-use
+    their own entries across modules) with no cross-process readers by
+    construction. No-op outside xdist (PYTEST_XDIST_WORKER unset): the
+    single-process tier-1 run stays uncached, exactly as before."""
+    import os
+
+    worker = os.environ.get("PYTEST_XDIST_WORKER")
+    if worker is None:
+        yield
+        return
+    cache_dir = str(tmp_path_factory.mktemp(f"xla_cache_{worker}"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    yield
+
+
+@pytest.fixture(scope="session", autouse=True)
 def _isolate_compile_cache(tmp_path_factory):
     """Point the CLI's default-on persistent compile cache at a
     per-SESSION tmp dir. Without this, tests that invoke

@@ -1,0 +1,238 @@
+"""check_block cadence semantics (round 6 — kernel-resident convergence
+checking).
+
+The contract (SolverConfig.check_block, docs/design.md "Check cadence"):
+batching N check blocks per scheduler trip NEVER changes the check
+cadence — convergence is still evaluated at every ``check_every``
+boundary — so per-job stop ITERATIONS and stop REASONS are exactly
+invariant on every engine. Factors are exactly invariant on the XLA
+engines (converged lanes freeze between sub-blocks); on the pallas
+block-kernel engine a lane that stops at an interior boundary of its
+in-flight launch keeps iterating to the launch end, so its recorded
+factors carry up to ``(check_block-1)*check_every`` post-stop iterations
+— the same benign drift class as slot-count drift, bounded here at the
+consensus level by the hardware gate's restart-equivalent band.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.grid_mu import mu_grid
+from nmfx.ops.packed_mu import mu_packed
+from nmfx.ops.sched_mu import mu_sched
+from nmfx.sweep import sweep
+
+KS = (4, 3, 2)
+R = 5
+
+
+@pytest.fixture(scope="module")
+def jobs():
+    a = jnp.asarray(grouped_matrix(200, (10, 10, 10), effect=2.0, seed=0),
+                    jnp.float32)
+    k_max = max(KS)
+    root = jax.random.key(123)
+    w0l, h0l = [], []
+    for k in KS:
+        keys = jax.random.split(jax.random.fold_in(root, k), R)
+        w0s, h0s = jax.vmap(
+            lambda kk, k=k: initialize(kk, a, k, InitConfig(),
+                                       jnp.float32))(keys)
+        w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+        h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+    return a, jnp.concatenate(w0l), jnp.concatenate(h0l)
+
+
+def _cfg(backend, check_block, max_iter=600):
+    return SolverConfig(max_iter=max_iter, backend=backend,
+                        check_block=check_block)
+
+
+@pytest.mark.parametrize("ncheck", [2, 4])
+def test_pallas_multi_check_decisions_exact(jobs, ncheck):
+    """The pallas block-kernel route at check_block=N: stop iterations
+    and reasons EXACTLY equal the N=1 schedule (the kernel's exported
+    boundary snapshots/stats replay the same checks), factors within the
+    documented post-stop drift class."""
+    a, w0, h0 = jobs
+    ref = mu_sched(a, w0, h0, _cfg("pallas", 1), slots=6)
+    got = mu_sched(a, w0, h0, _cfg("pallas", ncheck), slots=6)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    # factors: the drift class, not exactness — a converged job carries
+    # at most (N-1)*check_every extra MU iterations, which near a class-
+    # stable fixed point moves entries at the few-percent level
+    w_ref, w_got = np.asarray(ref.w), np.asarray(got.w)
+    denom = np.maximum(np.abs(w_ref), 1e-3)
+    assert np.max(np.abs(w_ref - w_got) / denom) < 0.25
+    # and the user-visible labels barely move: the per-job label flip
+    # fraction stays inside the class-stability tolerance band
+    l_ref = np.asarray(jnp.argmax(ref.h, axis=1))
+    l_got = np.asarray(jnp.argmax(got.h, axis=1))
+    flip_frac = (l_ref != l_got).mean(axis=1)
+    assert flip_frac.max() <= 0.05, flip_frac
+
+
+def test_pallas_auto_resolution_matches_explicit(jobs):
+    """check_block='auto' (the default) resolves to 4 on the pallas
+    block-kernel route — bit-identical to the explicit value."""
+    a, w0, h0 = jobs
+    auto = mu_sched(a, w0, h0, SolverConfig(max_iter=600,
+                                            backend="pallas"), slots=6)
+    explicit = mu_sched(a, w0, h0, _cfg("pallas", 4), slots=6)
+    np.testing.assert_array_equal(np.asarray(auto.iterations),
+                                  np.asarray(explicit.iterations))
+    np.testing.assert_array_equal(np.asarray(auto.w),
+                                  np.asarray(explicit.w))
+
+
+def test_dense_sched_check_block_bit_exact(jobs):
+    """The XLA-dense scheduler at check_block=N interleaves the checks
+    between sequential sub-blocks — converged lanes freeze before the
+    next sub-block, so results are BIT-exact vs N=1 (only the harvest
+    cadence changes, and harvests never change recorded results)."""
+    a, w0, h0 = jobs
+    ref = mu_sched(a, w0, h0, _cfg("auto", 1), slots=6)
+    for ncheck in (2, 4):
+        got = mu_sched(a, w0, h0, _cfg("auto", ncheck), slots=6)
+        np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                      np.asarray(got.iterations))
+        np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                      np.asarray(got.stop_reason))
+        np.testing.assert_array_equal(np.asarray(ref.w),
+                                      np.asarray(got.w))
+        np.testing.assert_array_equal(np.asarray(ref.h),
+                                      np.asarray(got.h))
+
+
+def test_pallas_multi_check_max_iter_fence(jobs):
+    """A cap crossing mid-launch: the in-kernel budget fence freezes the
+    lane at exactly max_iter, so every job records max_iter/MAX_ITER and
+    the capped factors are bit-identical to the N=1 schedule (no
+    post-stop drift at the cap — the fence stops the arithmetic)."""
+    from nmfx.solvers.base import StopReason
+
+    a, w0, h0 = jobs
+    # 20 % (2*4) != 0: launches of 4 sub-blocks overshoot the cap, the
+    # budget fence must cut them mid-launch
+    ref = mu_sched(a, w0, h0, _cfg("pallas", 1, max_iter=20), slots=4)
+    got = mu_sched(a, w0, h0, _cfg("pallas", 4, max_iter=20), slots=4)
+    assert np.all(np.asarray(got.iterations) == 20)
+    assert np.all(np.asarray(got.stop_reason) == StopReason.MAX_ITER)
+    np.testing.assert_array_equal(np.asarray(ref.w), np.asarray(got.w))
+    np.testing.assert_array_equal(np.asarray(ref.h), np.asarray(got.h))
+
+
+def test_fixed_batch_drivers_check_block_exact(jobs):
+    """mu_grid / mu_packed honor check_block with exact semantics: the
+    unrolled sub-blocks check at every check_every boundary and converged
+    lanes freeze, so results are bit-identical — only the while-loop trip
+    count changes. max_iter=601 makes (max_iter // check_every) NOT a
+    multiple of check_block: the main loop then hands up to
+    N*check_every-1 trailing iterations to the per-iteration tail loop,
+    whose checks are no-ops off the check_every boundaries
+    (batch_convergence's is_check gate) — so the cadence, and hence the
+    results, stay exact even there."""
+    a, w0, h0 = jobs
+    for max_iter in (600, 601):
+        ref_g = mu_grid(a, w0, h0, _cfg("auto", 1, max_iter=max_iter))
+        got_g = mu_grid(a, w0, h0, _cfg("auto", 3, max_iter=max_iter))
+        np.testing.assert_array_equal(np.asarray(ref_g.iterations),
+                                      np.asarray(got_g.iterations))
+        np.testing.assert_array_equal(np.asarray(ref_g.stop_reason),
+                                      np.asarray(got_g.stop_reason))
+        np.testing.assert_array_equal(np.asarray(ref_g.w),
+                                      np.asarray(got_g.w))
+
+    k = KS[0]
+    w0s, h0s = w0[:R, :, :k], h0[:R, :k, :]
+    ref_p = mu_packed(a, w0s, h0s, _cfg("auto", 1))
+    got_p = mu_packed(a, w0s, h0s, _cfg("auto", 3))
+    np.testing.assert_array_equal(np.asarray(ref_p.iterations),
+                                  np.asarray(got_p.iterations))
+    np.testing.assert_array_equal(np.asarray(ref_p.wp),
+                                  np.asarray(got_p.wp))
+
+
+def test_sweep_level_parity_within_gate_band(jobs):
+    """Full sweep through the pallas grid engine at check_block=4 vs 1:
+    per-restart iterations/stop reasons exact, consensus within the
+    hardware gate's restart-equivalent band (mean|dC|*R <= 0.6 — the
+    same band bench.py --verify holds engines to on real hardware)."""
+    a, _, _ = jobs
+    ks = (2, 3, 4)
+    out = {}
+    for ncheck in (1, 4):
+        scfg = SolverConfig(max_iter=600, backend="pallas",
+                            check_block=ncheck)
+        out[ncheck] = sweep(a, ConsensusConfig(ks=ks, restarts=R,
+                                               grid_exec="grid"),
+                            scfg, InitConfig(), None)
+    for k in ks:
+        np.testing.assert_array_equal(
+            np.asarray(out[1][k].iterations),
+            np.asarray(out[4][k].iterations))
+        np.testing.assert_array_equal(
+            np.asarray(out[1][k].stop_reasons),
+            np.asarray(out[4][k].stop_reasons))
+        dc = np.abs(np.asarray(out[1][k].consensus)
+                    - np.asarray(out[4][k].consensus))
+        assert dc.mean() * R <= 0.6, (k, dc.mean() * R)
+
+
+def test_check_block_validation():
+    with pytest.raises(ValueError, match="check_block"):
+        SolverConfig(check_block=0)
+    with pytest.raises(ValueError, match="check_block"):
+        SolverConfig(check_block="fast")
+    # ragged pool is check-per-trip: explicit batching must be rejected
+    from nmfx.config import ExperimentalConfig
+
+    with pytest.raises(ValueError, match="check_block"):
+        mu_sched(jnp.ones((8, 8)), jnp.ones((2, 8, 2)),
+                 jnp.ones((2, 2, 8)),
+                 SolverConfig(backend="pallas", check_block=2,
+                              max_iter=10,
+                              experimental=ExperimentalConfig(ragged=True)),
+                 slots=2, job_ks=(2, 2))
+
+
+def test_ragged_estimates_helper(jobs):
+    """ragged_estimates_from_iterations turns a previous run's per-job
+    iteration counts into the hashable per-class table
+    ExperimentalConfig.ragged_iters_est takes; the layout consumes it
+    (and the default model WARNs when extrapolating)."""
+    import logging
+
+    from nmfx.ops.sched_mu import (_ragged_layout,
+                                   ragged_estimates_from_iterations)
+
+    job_ks = (4, 4, 3, 2, 2, 2)
+    iters = [800, 600, 500, 400, 500, 600]
+    est = ragged_estimates_from_iterations(job_ks, iters)
+    assert est == ((2, 500.0), (3, 500.0), (4, 700.0))
+    layout = _ragged_layout(job_ks, 16, iters_est=est, max_iter=10000)
+    assert sum(c.slots * c.k for c in layout) <= 16
+    with pytest.raises(ValueError, match="ragged_iters_est"):
+        _ragged_layout(job_ks, 16, iters_est=((2, 500.0),),
+                       max_iter=10000)
+    with pytest.raises(ValueError, match="iterations"):
+        ragged_estimates_from_iterations((2, 3), [1, 2, 3])
+    # default model outside its calibrated profile: loud, not silent
+    logger = logging.getLogger("nmfx")
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger.addHandler(handler)
+    try:
+        _ragged_layout((12, 12, 2), 40, max_iter=10000)
+    finally:
+        logger.removeHandler(handler)
+    assert any("calibrated" in r.getMessage() for r in records)

@@ -38,6 +38,7 @@ def test_outputs_replicated_and_addressable(two_group_data):
         np.asarray(x)  # fully addressable on this (every) host
 
 
+@pytest.mark.slow
 def test_global_mesh_matches_single_device(two_group_data):
     cfg = SolverConfig(algorithm="mu", max_iter=40)
     plain = sweep_one_k(two_group_data, jax.random.key(3), k=3, restarts=16,

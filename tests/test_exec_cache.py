@@ -113,7 +113,8 @@ def test_cacheable_gating():
 
 # --- padded-bucket numerical equivalence ----------------------------------
 
-@pytest.mark.parametrize("mesh_on", [False, True])
+@pytest.mark.parametrize("mesh_on", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_padded_equivalence_to_exact_sweep(serve_data, mesh_on):
     """The acceptance property: a bucketed sweep (padded A, masked
     consensus, rescaled dnorms, threaded flip budget) must reproduce the

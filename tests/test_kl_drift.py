@@ -1,0 +1,69 @@
+"""kl packed-grid vs vmapped-default consensus drift at high k (round 6,
+pinning the round-5 finding).
+
+Round 5 measured (RESULTS.md "kl same-range pair"): at the north-star
+shape the whole-grid kl engine (``backend="packed"``) reproduces the
+vmapped default's consensus exactly at k<=4, while at k=5/6 — ranks
+above the benchmark matrix's 4-group structure — surplus-cluster
+near-ties split differently between the engines' reduction orders and
+max|dC| reached 0.25 at R=20 (rho identical, iteration ratios
+0.95–0.97). This is the over-clustering trajectory-drift class the
+hardware gate bounds, not a corruption: it appears exactly when k
+exceeds the data's structure.
+
+This test pins the band at a gate-scale shape (the north-star-scale
+measurement lives in RESULTS.md round 5; ``SolverConfig.backend``'s
+docstring carries the user-facing guidance). The bound is asserted in
+RESTART-EQUIVALENTS (mean|dC|*R), the normalization that makes one band
+correct at any restart count (see bench.py's ``compare``).
+"""
+
+import numpy as np
+import pytest
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.datasets import grouped_matrix
+from nmfx.sweep import sweep
+
+R = 8
+
+
+@pytest.fixture(scope="module")
+def engines_out():
+    a = grouped_matrix(400, (20, 20, 20, 20), effect=2.0, seed=0)
+    out = {}
+    for name, backend, grid_exec in (("vmap", "auto", "per_k"),
+                                     ("packed", "packed", "grid")):
+        scfg = SolverConfig(algorithm="kl", max_iter=400, backend=backend)
+        out[name] = sweep(a, ConsensusConfig(ks=(4, 5, 6), restarts=R,
+                                             grid_exec=grid_exec),
+                          scfg, InitConfig(), None)
+    return out
+
+
+@pytest.mark.parametrize("k", [5, 6])
+def test_kl_packed_high_k_drift_bounded(engines_out, k):
+    """The k=5/6 over-clustering drift stays inside the hardware gate's
+    bands: mean|dC|*R <= 0.6 restart-equivalents, and iteration counts
+    within the gate's 1.6x ratio."""
+    v, p = engines_out["vmap"][k], engines_out["packed"][k]
+    dc = np.abs(np.asarray(v.consensus) - np.asarray(p.consensus))
+    assert dc.mean() * R <= 0.6, dc.mean() * R
+    # max|dC|*R: a handful of boundary samples may disagree across a few
+    # restarts (round 5 measured max|dC| = 0.25 at R=20 -> 5
+    # restart-equivalents); anything approaching all-R disagreement on
+    # many pairs would be the round-3 corruption class instead
+    assert dc.max() * R <= 6.0, dc.max() * R
+    iv = float(np.asarray(v.iterations).mean())
+    ip = float(np.asarray(p.iterations).mean())
+    assert 1 / 1.6 <= ip / iv <= 1.6, (ip, iv)
+
+
+def test_kl_packed_low_k_agreement(engines_out):
+    """At k within the data's structure (k=4 on 4-group data) the two
+    engines' consensus agrees tightly — the drift is a high-k
+    phenomenon, which is what makes it safe to document rather than
+    fix."""
+    v, p = engines_out["vmap"][4], engines_out["packed"][4]
+    dc = np.abs(np.asarray(v.consensus) - np.asarray(p.consensus))
+    assert dc.mean() * R <= 0.25, dc.mean() * R

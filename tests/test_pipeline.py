@@ -94,6 +94,7 @@ def test_save_results(two_group_result, tmp_path):
                                two_group_result.per_k[2].best_h, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_per_k_results_independent_of_sweep_composition(two_group_data):
     # (seed, k) fully determines a rank's factorizations, no matter which
     # other ranks are swept alongside it. Under per_k execution this is

@@ -2,6 +2,7 @@
 reference's compile-time PROFILE_* macros, libnmf common.h:27-45)."""
 
 import jax.numpy as jnp
+import pytest
 
 from nmfx.api import nmfconsensus
 from nmfx.profiling import NullProfiler, Profiler
@@ -52,6 +53,7 @@ def test_null_profiler_is_transparent(two_group_data):
     assert prof.report() == "profiling disabled"
 
 
+@pytest.mark.slow
 def test_trace_capture(tmp_path):
     trace_dir = str(tmp_path / "trace")
     prof = Profiler(trace_dir=trace_dir)

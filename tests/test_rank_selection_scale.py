@@ -99,6 +99,7 @@ def test_native_tie_breaking_matches_numpy_bitwise():
         np.testing.assert_array_equal(np.asarray(ours.order), ref.order)
 
 
+@pytest.mark.slow
 def test_device_matches_host_at_n800():
     """The on-device path at a scale two orders beyond its round-1 tests
     (n=800 keeps the O(n³) fori_loop tractable on the CPU test platform;

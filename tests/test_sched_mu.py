@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from nmfx.config import InitConfig, SolverConfig
+from nmfx.config import ExperimentalConfig, InitConfig, SolverConfig
 from nmfx.datasets import grouped_matrix
 from nmfx.init import initialize
 from nmfx.ops.grid_mu import mu_grid
@@ -70,7 +70,8 @@ def test_pallas_scheduler_matches_dense(jobs, slots, max_iter):
     cfg = SolverConfig(max_iter=max_iter)
     ref = mu_sched(a, w0, h0, cfg, slots=slots)
     got = mu_sched(a, w0, h0, SolverConfig(max_iter=max_iter,
-                                           backend="pallas"), slots=slots)
+                                           backend="pallas",
+                                           check_block=1), slots=slots)
     np.testing.assert_array_equal(np.asarray(ref.iterations),
                                   np.asarray(got.iterations))
     np.testing.assert_array_equal(np.asarray(ref.stop_reason),
@@ -135,7 +136,8 @@ def test_pallas_pool_clamps_to_vmem_envelope(jobs):
     cfg = SolverConfig(max_iter=100)
     ref = mu_sched(a, w0b, h0b, cfg, slots=48)
     got = mu_sched(a, w0b, h0b, SolverConfig(max_iter=100,
-                                             backend="pallas"), slots=48)
+                                             backend="pallas",
+                                             check_block=1), slots=48)
     np.testing.assert_array_equal(np.asarray(ref.iterations),
                                   np.asarray(got.iterations))
     np.testing.assert_allclose(np.asarray(ref.w), np.asarray(got.w),
@@ -202,8 +204,12 @@ def test_evict_batch_is_schedule_only(jobs, backend):
     cfg = SolverConfig(algorithm="mu", backend=backend, max_iter=600)
     base = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS)
     for eb in (4, 8):
-        r = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS,
-                     evict_batch=eb)
+        r = mu_sched(a, w0, h0,
+                     SolverConfig(algorithm="mu", backend=backend,
+                                  max_iter=600,
+                                  experimental=ExperimentalConfig(
+                                      evict_batch=eb)),
+                     slots=6, job_ks=JOB_KS)
         np.testing.assert_array_equal(np.asarray(base.iterations),
                                       np.asarray(r.iterations))
         np.testing.assert_array_equal(np.asarray(base.stop_reason),
@@ -222,11 +228,16 @@ def test_ragged_pool_matches_uniform(jobs):
     per-class queues with reloads (slots < jobs), the tail handover,
     and composition with evict_batch."""
     a, w0, h0 = jobs
-    cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600)
+    cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600,
+                       check_block=1)
     base = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS)
     for eb in (1, 8):
-        r = mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS, ragged=True,
-                     evict_batch=eb)
+        r = mu_sched(a, w0, h0,
+                     SolverConfig(algorithm="mu", backend="pallas",
+                                  max_iter=600, check_block=1,
+                                  experimental=ExperimentalConfig(
+                                      ragged=True, evict_batch=eb)),
+                     slots=6, job_ks=JOB_KS)
         np.testing.assert_array_equal(np.asarray(base.iterations),
                                       np.asarray(r.iterations))
         np.testing.assert_array_equal(np.asarray(base.stop_reason),
@@ -239,9 +250,12 @@ def test_ragged_pool_matches_uniform(jobs):
     # class-blocked width plus the uniform tail
     assert np.asarray(r.pool_widths).shape[0] == 2
     with pytest.raises(ValueError, match="ragged"):
-        mu_sched(a, w0, h0, SolverConfig(algorithm="mu", backend="auto",
-                                         max_iter=600),
-                 slots=6, job_ks=JOB_KS, ragged=True)
+        mu_sched(a, w0, h0,
+                 SolverConfig(algorithm="mu", backend="auto",
+                              max_iter=600,
+                              experimental=ExperimentalConfig(
+                                  ragged=True)),
+                 slots=6, job_ks=JOB_KS)
 
 
 def test_factor_dtype_bf16_pool(jobs):
@@ -255,8 +269,10 @@ def test_factor_dtype_bf16_pool(jobs):
     from nmfx.solvers.base import StopReason
 
     a, w0, h0 = jobs
-    cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600)
-    r = mu_sched(a, w0, h0, cfg, slots=6, factor_dtype="bfloat16")
+    cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600,
+                       experimental=ExperimentalConfig(
+                           factor_dtype="bfloat16"))
+    r = mu_sched(a, w0, h0, cfg, slots=6)
     assert np.asarray(r.w).dtype == np.float32
     assert np.isfinite(np.asarray(r.w)).all()
     assert np.isfinite(np.asarray(r.dnorm)).all()
@@ -267,14 +283,22 @@ def test_factor_dtype_bf16_pool(jobs):
                                               int(StopReason.MAX_ITER)}
     # preconditions are enforced, not silently ignored
     with pytest.raises(ValueError, match="factor_dtype"):
-        mu_sched(a, w0, h0, cfg, slots=6, factor_dtype="float16")
+        ExperimentalConfig(factor_dtype="float16")
     with pytest.raises(ValueError, match="bfloat16"):
-        mu_sched(a, w0, h0, SolverConfig(algorithm="mu", backend="auto",
-                                         max_iter=600),
-                 slots=6, factor_dtype="bfloat16")
+        mu_sched(a, w0, h0,
+                 SolverConfig(algorithm="mu", backend="auto",
+                              max_iter=600,
+                              experimental=ExperimentalConfig(
+                                  factor_dtype="bfloat16")),
+                 slots=6)
     with pytest.raises(ValueError, match="bfloat16"):
-        mu_sched(a, w0, h0, cfg, slots=6, job_ks=JOB_KS, ragged=True,
-                 factor_dtype="bfloat16")
+        mu_sched(a, w0, h0,
+                 SolverConfig(algorithm="mu", backend="pallas",
+                              max_iter=600,
+                              experimental=ExperimentalConfig(
+                                  ragged=True,
+                                  factor_dtype="bfloat16")),
+                 slots=6, job_ks=JOB_KS)
 
 
 def test_alias_io_schedule_free(jobs):
@@ -287,7 +311,12 @@ def test_alias_io_schedule_free(jobs):
     a, w0, h0 = jobs
     cfg = SolverConfig(algorithm="mu", backend="pallas", max_iter=600)
     base = mu_sched(a, w0, h0, cfg, slots=6)
-    al = mu_sched(a, w0, h0, cfg, slots=6, alias_io=True)
+    al = mu_sched(a, w0, h0,
+                  SolverConfig(algorithm="mu", backend="pallas",
+                               max_iter=600,
+                               experimental=ExperimentalConfig(
+                                   alias_io=True)),
+                  slots=6)
     np.testing.assert_array_equal(np.asarray(base.iterations),
                                   np.asarray(al.iterations))
     np.testing.assert_array_equal(np.asarray(base.stop_reason),

@@ -84,7 +84,10 @@ def test_pg_family_stops_on_projgrad(low_rank_data, algo):
     assert float(res.dnorm) < float(residual_norm(a, w0, h0))
 
 
-@pytest.mark.parametrize("algo", ALGOS)
+@pytest.mark.parametrize("algo", [
+    pytest.param(a, marks=[pytest.mark.slow] if a in ("pg", "alspg")
+                 else [])  # the line-search family costs ~10s per lane
+    for a in ALGOS])
 def test_vmap_over_restarts(low_rank_data, algo):
     a, _, _ = _problem(low_rank_data)
     m, n = a.shape
@@ -120,6 +123,7 @@ def test_f64_parity_mode(low_rank_data):
         assert np.isfinite(float(res.dnorm))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("algo,backend", [("kl", "auto"), ("mu", "vmap")])
 def test_restart_chunking_matches_unchunked(low_rank_data, algo, backend):
     """restart_chunk bounds concurrent lanes without changing results:
@@ -167,6 +171,7 @@ def test_solvers_clean_under_debug_nans(low_rank_data, algo):
         jax.config.update("jax_debug_nans", prev)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape,k", [((7, 31), 2), ((31, 7), 3),
                                      ((129, 5), 4), ((3, 3), 2),
                                      ((64, 2), 2)])

@@ -17,6 +17,10 @@ import textwrap
 
 import pytest
 
+#: f64 lockstep-vs-reference-math comparisons are the heaviest per-test
+#: tier of the pyramid; tier-1 keeps the f32 equivalents
+pytestmark = pytest.mark.slow
+
 _TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 #: (algorithm, transliteration, iterations). Iteration counts are kept small
