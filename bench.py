@@ -45,6 +45,7 @@ measured alongside as a second backend in the same session.
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -459,8 +460,6 @@ def main():
     import jax
 
     if args.compile_cache:
-        import os
-
         # best-effort like the CLI's default-on cache: an unwritable
         # path degrades to benchmarking uncached, never a traceback
         try:
@@ -478,7 +477,7 @@ def main():
 
     from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
     from nmfx.datasets import grouped_matrix
-    from nmfx.sweep import default_mesh, sweep, sweep_one_k
+    from nmfx.sweep import default_mesh, sweep
 
     ks = tuple(range(2, args.kmax + 1))
     if not ks:
@@ -511,6 +510,20 @@ def main():
             p.error("--verify needs --maxiter >= 2000 so every job can "
                     "converge; a lower cap would fail the gate's "
                     "no-MAX_ITER assertion on a healthy solver")
+        # the gate is the ONE sanctioned fault-injection harness: it
+        # translates the probe's env var into the explicit in-process
+        # opt-in HERE, at startup, before the first trace. Library code
+        # ignores the env var entirely (nmfx.ops.sched_mu._fault_state;
+        # lint rule NMFX002), so an inherited variable alone can no
+        # longer alter compiled production reload paths —
+        # probe_fault_gate.py's subprocess protocol still works because
+        # its subprocess IS this entrypoint.
+        frac = float(os.environ.get("NMFX_FAULT_INJECT_STALE_RELOAD",
+                                    "0") or 0)
+        if frac > 0:
+            from nmfx.ops.sched_mu import enable_stale_reload_fault
+
+            enable_stale_reload_fault(frac)
         raise SystemExit(run_verify(args))
     seed = 123
     icfg = InitConfig()

@@ -10,8 +10,11 @@ Missing #2). This probe closes that loop:
 
 1. runs ``bench.py --verify`` clean → must PASS (exit 0);
 2. runs it again in a subprocess with ``NMFX_FAULT_INJECT_STALE_RELOAD``
-   set — ``nmfx.ops.sched_mu`` then drops the factor writes for a
-   deterministic fraction of pallas-path slot reloads while the
+   set — ``bench.py --verify`` translates the var into the explicit
+   ``nmfx.ops.sched_mu.enable_stale_reload_fault()`` opt-in at startup
+   (since round 7 the env var is INERT in library code: trace-time env
+   reads are the lint class NMFX002), which drops the factor writes for
+   a deterministic fraction of pallas-path slot reloads while the
    scheduler's bookkeeping proceeds, reproducing the round-3 failure
    signature exactly — and the gate must FAIL (exit 1).
 

@@ -1,0 +1,90 @@
+"""nmfx-lint: contract-checking static analysis for the solver/serving stack.
+
+Usage::
+
+    python -m nmfx.analysis nmfx/            # lint the package
+    python -m nmfx.analysis nmfx/ --json     # machine-readable findings
+    python -m nmfx.analysis nmfx/ --baseline lint_baseline.json
+
+Rules (each encodes an observed failure class — see docs/analysis.md
+for the incident behind each one):
+
+=========  ==============================================================
+NMFX001    config-fingerprint coverage (registry + exec-cache bucket key)
+NMFX002    trace-time environment reads
+NMFX003    donation/aliasing safety (read-after-donate)
+NMFX004    PRNG discipline (key reuse, host RNG in traced code)
+NMFX005    implicit host syncs in traced/hot-path code
+NMFX101    engine jaxpr stays f32 under x64 parity (jaxpr layer)
+NMFX102    no device_put inside engine loop bodies (jaxpr layer)
+=========  ==============================================================
+
+Suppress a finding inline with a REQUIRED reason::
+
+    read_env()  # nmfx: ignore[NMFX002] -- import-time read, not traced
+
+The jaxpr layer (NMFX101/102) imports jax and traces every registered
+engine abstractly; it runs by default when the analyzed paths contain
+the nmfx package and can be disabled with ``--no-jaxpr`` for fast
+AST-only iteration.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from nmfx.analysis.core import (RULES, Finding, Rule, active,
+                                apply_baseline, load_baseline,
+                                parse_suppressions, register)
+from nmfx.analysis.ast_scan import Project, load_project
+
+# registering imports — each module populates RULES at import time
+from nmfx.analysis import rules_config  # noqa: F401  (NMFX001)
+from nmfx.analysis import rules_traced  # noqa: F401  (NMFX002/004/005)
+from nmfx.analysis import rules_alias   # noqa: F401  (NMFX003)
+from nmfx.analysis import jaxpr_rules   # noqa: F401  (NMFX101/102)
+
+__all__ = ["run", "RULES", "Finding", "Rule", "register", "active",
+           "Project", "load_project"]
+
+
+def run(paths: "Iterable[str]", baseline: "str | None" = None,
+        jaxpr: bool = True,
+        rule_ids: "Iterable[str] | None" = None) -> "list[Finding]":
+    """Lint ``paths`` and return every finding, suppression- and
+    baseline-annotated. ``active(findings)`` is what should gate a
+    build. ``jaxpr=False`` skips the engine-tracing layer (NMFX101/102);
+    ``rule_ids`` restricts to a subset (fixture tests)."""
+    import os as _os
+
+    project = load_project(paths)
+    # the engine-tracing layer runs only when the real package is in
+    # the analyzed set (its findings anchor at the engine registries —
+    # a lint of an unrelated file must not go red for code outside it)
+    project.jaxpr_checks_enabled = jaxpr and any(
+        m.path.replace("\\", "/").endswith("nmfx/ops/grid_mu.py")
+        for m in project.modules)
+    findings: "list[Finding]" = []
+    suppressions = {}
+    for mod in project.modules:
+        by_line, bad = parse_suppressions(mod.path, mod.text)
+        # keyed by abspath so findings anchored via inspect (NMFX001)
+        # or repo-relative constants (jaxpr rules) still match the
+        # inline suppressions in the analyzed sources
+        suppressions[_os.path.abspath(mod.path)] = by_line
+        findings.extend(bad)
+    for rule_id, rule in RULES.items():
+        if rule_ids is not None and rule_id not in set(rule_ids):
+            continue
+        findings.extend(rule.check(project))
+    import dataclasses
+
+    annotated = []
+    for f in findings:
+        ids = suppressions.get(_os.path.abspath(f.file),
+                               {}).get(f.line, set())
+        annotated.append(dataclasses.replace(f, suppressed=True)
+                         if f.rule_id in ids else f)
+    annotated = apply_baseline(annotated, load_baseline(baseline))
+    annotated.sort(key=lambda f: (f.file, f.line, f.rule_id))
+    return annotated

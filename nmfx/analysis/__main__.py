@@ -1,0 +1,88 @@
+"""CLI entrypoint: ``python -m nmfx.analysis [paths] [options]``.
+
+Exit code 0 when no unsuppressed, unbaselined ERROR findings remain;
+1 otherwise; 2 on usage errors. ``--json`` emits one machine-readable
+document (findings + summary) on stdout for CI consumption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nmfx.analysis",
+        description="nmfx-lint: contract-checking static analysis "
+                    "(see docs/analysis.md)")
+    ap.add_argument("paths", nargs="*", default=["nmfx"],
+                    help="files/directories to lint (default: nmfx)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="JSON baseline of tolerated findings "
+                         "(shipped policy: empty)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the engine-tracing layer (NMFX101/102) "
+                         "for fast AST-only runs")
+    ap.add_argument("--rules", metavar="IDS", default=None,
+                    help="comma-separated rule ids to run (default all)")
+    ap.add_argument("--write-baseline", metavar="FILE", default=None,
+                    help="write the current unsuppressed findings as a "
+                         "baseline file and exit 0")
+    args = ap.parse_args(argv)
+
+    from nmfx.analysis import active, run
+
+    rule_ids = (None if args.rules is None
+                else tuple(s.strip() for s in args.rules.split(",")
+                           if s.strip()))
+    try:
+        findings = run(args.paths, baseline=args.baseline,
+                       jaxpr=not args.no_jaxpr, rule_ids=rule_ids)
+    except FileNotFoundError as e:
+        print(f"nmfx-lint: {e}", file=sys.stderr)
+        return 2
+
+    errors = active(findings, "error")
+    warnings = active(findings, "warning")
+
+    if args.write_baseline:
+        # include findings the CURRENT --baseline already tolerates —
+        # refreshing a baseline in place must re-record them, not
+        # truncate the file to [] because they were annotated away
+        records = [{"file": f.file, "rule": f.rule_id, "line": f.line}
+                   for f in findings if not f.suppressed]
+        with open(args.write_baseline, "w") as fh:
+            json.dump(records, fh, indent=2)
+            fh.write("\n")
+        print(f"nmfx-lint: wrote {len(records)} baseline records to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.as_json:
+        doc = {
+            "findings": [f.to_json() for f in findings],
+            "summary": {
+                "errors": len(errors),
+                "warnings": len(warnings),
+                "suppressed": sum(f.suppressed for f in findings),
+                "baselined": sum(f.baselined for f in findings),
+            },
+            "ok": not errors,
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"nmfx-lint: {len(errors)} error(s), {len(warnings)} "
+              f"warning(s), {sum(f.suppressed for f in findings)} "
+              f"suppressed, {sum(f.baselined for f in findings)} "
+              "baselined")
+    return 0 if not errors else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

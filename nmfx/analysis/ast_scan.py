@@ -1,0 +1,386 @@
+"""AST project model for nmfx-lint: modules, functions, traced reachability.
+
+The trace-context rules (NMFX002 env reads, NMFX004 PRNG discipline,
+NMFX005 host syncs) all need the same question answered: *is this code
+reachable from something JAX traces?* Inside traced code the usual
+dynamic defenses do not exist — an env read happens once at trace time
+and is baked into every cached executable, a ``np.random`` draw becomes
+a compile-time constant, a host sync stalls the dispatch pipeline — so
+the lint boundary is "reachable from a traced root", computed here once
+and shared.
+
+Roots are detected syntactically:
+
+* functions decorated with ``jax.jit`` / ``jit`` /
+  ``(functools.)partial(jax.jit, ...)``;
+* functions passed to ``jax.jit(f)`` / ``jax.vmap(f)`` /
+  ``jax.pmap(f)`` / ``shard_map(f, ...)`` as a bare name;
+* kernel/body functions handed to ``pl.pallas_call`` or
+  ``lax.while_loop`` / ``lax.scan`` / ``lax.cond`` / ``lax.fori_loop``
+  / ``lax.switch``.
+
+Reachability then follows an IMPORT-AWARE name-based call graph across
+the analyzed file set. A bare call ``foo(...)`` resolves to the same
+module's ``foo`` if one exists, else through the module's
+``from X import foo`` to module X's ``foo`` (when X is in the analyzed
+set; an import from OUTSIDE the set resolves to nothing — jax/numpy
+calls never alias project helpers). ``base.foo(...)`` resolves inside
+module ``base`` when ``base`` is an imported-module alias, and falls
+back to every analyzed function named ``foo`` when the base is an
+ordinary variable. The fallback over-approximates — a method call can
+alias a same-named helper — which is the right direction for a
+contract linter: a false edge surfaces for human review and gets an
+inline suppression with a reason; a missed edge would hide a real
+trace-time hazard. Nested functions belong to their enclosing function
+(a closure inside a jitted body is traced with it) AND are nodes of
+their own, reachable from the enclosing scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable
+
+#: callables whose function-typed arguments are traced
+_TRACING_CALLS = {
+    "jit", "vmap", "pmap", "pallas_call", "while_loop", "scan", "cond",
+    "fori_loop", "switch", "shard_map", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "grad", "value_and_grad", "make_jaxpr",
+}
+
+
+def _attr_tail(node: ast.AST) -> "str | None":
+    """``a.b.c`` -> "c"; bare name -> itself; else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> "str | None":
+    """``a.b.c`` -> "a.b.c" when every link is a Name/Attribute."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def own_nodes(stmt: ast.stmt) -> "Iterable[ast.AST]":
+    """The statement's OWN subtree — header expressions included, nested
+    statement lists excluded. Statement-ordered rules (NMFX003's
+    donation tracking, NMFX004's key threading) flatten compound
+    statements into source order; walking the full subtree at the
+    compound's position would process nested events OUT of order (a
+    donation deep in the body would precede a read that textually
+    comes before it)."""
+    nested: "set[int]" = set()
+    for field in ("body", "orelse", "finalbody"):
+        for child in getattr(stmt, field, []) or []:
+            nested.update(id(n) for n in ast.walk(child))
+    for handler in getattr(stmt, "handlers", []) or []:
+        nested.update(id(n) for n in ast.walk(handler))
+    for node in ast.walk(stmt):
+        if id(node) not in nested:
+            yield node
+
+
+def stores(stmt: ast.stmt) -> "set[str]":
+    """Names (re)bound at the statement's own level."""
+    return {node.id for node in own_nodes(stmt)
+            if isinstance(node, ast.Name)
+            and isinstance(node.ctx, (ast.Store, ast.Del))}
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@(functools.)partial(jax.jit, ...)``
+    (and the pallas/checkpoint spellings) — a decorator that makes the
+    decorated function a traced root."""
+    if _attr_tail(dec) in ("jit", "pallas_call", "checkpoint", "remat"):
+        return True
+    if isinstance(dec, ast.Call):
+        tail = _attr_tail(dec.func)
+        if tail in ("jit", "pallas_call", "checkpoint", "remat"):
+            return True
+        if tail == "partial" and dec.args:
+            return _attr_tail(dec.args[0]) in ("jit", "pallas_call",
+                                               "checkpoint", "remat")
+    return False
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One (possibly nested) function definition."""
+
+    module: "ModuleInfo"
+    qualname: str  # "outer.<locals>.inner" style, dots only
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    is_root: bool = False  # syntactically traced (decorator/arg position)
+    #: (base, tail) call/reference edges out of this function's body:
+    #: base None = bare name, "" = attribute on a non-name expression,
+    #: else the leading name of a dotted call ("jax" in jax.jit). Bare
+    #: Name arguments passed to any call are recorded too — function
+    #: values travel through partial/callback positions
+    calls: "set[tuple]" = dataclasses.field(default_factory=set)
+    #: names of directly nested function defs
+    nested: "set[str]" = dataclasses.field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str  # as given (project-relative when invoked that way)
+    text: str
+    tree: ast.Module
+    functions: "dict[str, FunctionInfo]" = dataclasses.field(
+        default_factory=dict)
+    #: local name -> (source module dotted path, original name) for
+    #: ``from X import name [as alias]``
+    from_imports: "dict[str, tuple[str, str]]" = dataclasses.field(
+        default_factory=dict)
+    #: local alias -> dotted module for ``import X [as Y]`` and
+    #: ``from pkg import submodule`` (resolved against the analyzed set)
+    module_aliases: "dict[str, str]" = dataclasses.field(
+        default_factory=dict)
+
+
+def _collect_imports(mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    mod.module_aliases[alias.asname] = alias.name
+                else:
+                    # `import a.b` binds the TOP-LEVEL name `a` (to
+                    # module a, not a.b) — recording a->a.b would make
+                    # `import jax.scipy.linalg` shadow `jax` itself and
+                    # break jax.random key-consumption resolution
+                    top = alias.name.split(".")[0]
+                    mod.module_aliases[top] = top
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            src = node.module or ""
+            for alias in node.names:
+                local = alias.asname or alias.name
+                mod.from_imports[local] = (src, alias.name)
+                # `from pkg import submodule` doubles as a module alias
+                mod.module_aliases.setdefault(local,
+                                              f"{src}.{alias.name}")
+
+
+class _FunctionCollector(ast.NodeVisitor):
+    """Collect every function def with qualname, root-ness, and the
+    names it calls. Calls made by a nested def are credited to every
+    enclosing function as well — tracing a jitted outer function traces
+    the closures it builds."""
+
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        self.stack: "list[FunctionInfo]" = []
+
+    def _handle_def(self, node, name: str):
+        qual = (self.stack[-1].qualname + "." + name if self.stack
+                else name)
+        info = FunctionInfo(module=self.module, qualname=qual, node=node)
+        decos = getattr(node, "decorator_list", [])
+        info.is_root = any(is_jit_decorator(d) for d in decos)
+        if self.stack:
+            self.stack[-1].nested.add(name)
+        self.module.functions[qual] = info
+        self.stack.append(info)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._handle_def(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._handle_def(node, f"<lambda@{node.lineno}>")
+
+    def visit_Call(self, node):
+        callee = _attr_tail(node.func)
+        if callee:
+            base = None
+            if isinstance(node.func, ast.Attribute):
+                dotted = _dotted(node.func)
+                base = dotted.split(".")[0] if dotted else ""
+            for fn in self.stack:
+                fn.calls.add((base, callee))
+        # a bare function name passed as an argument is an edge too —
+        # function values travel through partial()/callback positions.
+        # Marked "<ref>": resolved STRICTLY (local defs and explicit
+        # imports, never the global name fallback), because most Name
+        # arguments are data whose names can collide with functions
+        # elsewhere in the project
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in args:
+            if isinstance(arg, ast.Name):
+                for fn in self.stack:
+                    fn.calls.add(("<ref>", arg.id))
+        # function-typed arguments of tracing combinators are roots:
+        # jax.jit(f), lax.while_loop(cond, body, ...), pallas_call(k, ...)
+        if callee in _TRACING_CALLS:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    self._mark_root(arg.id)
+        self.generic_visit(node)
+
+    def _mark_root(self, name: str):
+        """Mark ``name`` as traced: prefer a function visible from the
+        current scope, else any module-level def seen later (second
+        pass resolves by name)."""
+        self.module._pending_roots.add(name)
+
+
+def parse_module(path: str, text: "str | None" = None) -> ModuleInfo:
+    if text is None:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    tree = ast.parse(text, filename=path)
+    mod = ModuleInfo(path=path, text=text, tree=tree)
+    mod._pending_roots = set()  # type: ignore[attr-defined]
+    _collect_imports(mod)
+    _FunctionCollector(mod).visit(tree)
+    for info in mod.functions.values():
+        if info.name in mod._pending_roots:  # type: ignore[attr-defined]
+            info.is_root = True
+    return mod
+
+
+def _dotted_module(path: str) -> "tuple[str, ...]":
+    """Path -> dotted-name segments for import matching:
+    ``a/b/nmfx/ops/grid_mu.py`` -> ("a", "b", "nmfx", "ops", "grid_mu");
+    ``__init__.py`` collapses onto its package."""
+    norm = path.replace("\\", "/").rstrip("/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    parts = tuple(p for p in norm.split("/") if p and p != ".")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return parts
+
+
+class Project:
+    """The analyzed file set plus the shared reachability answer."""
+
+    def __init__(self, modules: "list[ModuleInfo]"):
+        self.modules = modules
+        #: simple name -> functions bearing it, across the project
+        self.by_name: "dict[str, list[FunctionInfo]]" = {}
+        for mod in modules:
+            for fn in mod.functions.values():
+                self.by_name.setdefault(fn.name, []).append(fn)
+        #: dotted-segment tuple -> module, for import resolution
+        self._by_dotted = {_dotted_module(m.path): m for m in modules}
+        self._traced = self._compute_traced()
+
+    def _module_for(self, dotted: str) -> "ModuleInfo | None":
+        """The analyzed module an absolute import refers to — matched by
+        dotted-path suffix, so 'nmfx.ops.grid_mu' finds
+        '/any/prefix/nmfx/ops/grid_mu.py'. None = external (jax, numpy,
+        stdlib): its functions are nobody's in this project."""
+        want = tuple(dotted.split("."))
+        for segs, mod in self._by_dotted.items():
+            if segs[-len(want):] == want:
+                return mod
+        return None
+
+    def _resolve(self, caller: FunctionInfo, base: "str | None",
+                 tail: str) -> "list[FunctionInfo]":
+        mod = caller.module
+        if base is None or base == "<ref>":
+            local = [f for f in mod.functions.values() if f.name == tail]
+            if local:
+                return local
+            if tail in mod.from_imports:
+                src, orig = mod.from_imports[tail]
+                target = self._module_for(src)
+                if target is None:
+                    return []  # imported from outside the analyzed set
+                return [f for f in target.functions.values()
+                        if f.name == orig]
+            # direct calls of an unresolved bare name fall back to every
+            # bearer; a mere reference does not (data names collide with
+            # function names far too often)
+            return [] if base == "<ref>" else self.by_name.get(tail, [])
+        if base and base in mod.module_aliases:
+            target = self._module_for(mod.module_aliases[base])
+            if target is None:
+                return []  # jax.jit, np.sum, os.environ... not ours
+            return [f for f in target.functions.values()
+                    if f.name == tail]
+        # attribute on an ordinary variable (method call): fall back to
+        # every bearer of the name — over-approximate, reviewable
+        return self.by_name.get(tail, [])
+
+    def _compute_traced(self) -> "set[int]":
+        """BFS over the import-aware call graph from the syntactic
+        roots; returns id()s of reachable FunctionInfos (identity —
+        qualnames collide across modules)."""
+        work = [fn for mod in self.modules
+                for fn in mod.functions.values() if fn.is_root]
+        seen = {id(fn) for fn in work}
+        while work:
+            fn = work.pop()
+            # nested defs trace with their parent (a closure built
+            # inside a jitted body); called names resolve via imports
+            edges = [(None, n) for n in fn.nested] + list(fn.calls)
+            for base, tail in edges:
+                for cand in self._resolve(fn, base, tail):
+                    if id(cand) not in seen:
+                        seen.add(id(cand))
+                        work.append(cand)
+        return seen
+
+    def is_traced(self, fn: FunctionInfo) -> bool:
+        """Whether ``fn`` is a traced root or (name-graph) reachable
+        from one."""
+        return id(fn) in self._traced
+
+    def traced_functions(self) -> "Iterable[FunctionInfo]":
+        for mod in self.modules:
+            for fn in mod.functions.values():
+                if self.is_traced(fn):
+                    yield fn
+
+
+def collect_paths(paths: "Iterable[str]") -> "list[str]":
+    """Expand files/directories into a sorted .py file list (skips
+    __pycache__ and hidden directories). A path that exists as neither
+    raises — a typo'd CI lint target must fail the job, not lint
+    nothing and report clean forever."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py") and os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(
+                f"lint target {p!r} is neither a directory nor an "
+                "existing .py file")
+    return out
+
+
+def load_project(paths: "Iterable[str]") -> Project:
+    return Project([parse_module(p) for p in collect_paths(paths)])

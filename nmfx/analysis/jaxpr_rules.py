@@ -1,0 +1,232 @@
+"""NMFX101/NMFX102 — jaxpr-level contract checks on the registered engines.
+
+The AST layer reads what the code SAYS; this layer reads what JAX
+actually traces. Each registered batched engine (one ``mu_grid`` per
+algorithm in ``grid_mu.BLOCKS``, plus the slot scheduler ``mu_sched``)
+is traced to a jaxpr with small abstract inputs and walked recursively
+(while/scan/cond sub-jaxprs included):
+
+* **NMFX101 — f64 leak.** With ``jax_enable_x64`` enabled (the parity
+  configuration ``tests/test_x64_parity.py`` runs under) an engine
+  configured ``dtype="float32"`` must stay f32: any float64-producing
+  equation — a ``convert_element_type`` to f64 or an op with an f64
+  output aval — means a Python/NumPy double leaked into the traced
+  math (a weak-typed scalar, an np.float64 config value). Under the
+  normal f32 session such a leak is INVISIBLE (x64-off silently
+  downcasts it); under the documented parity workflow it silently
+  doubles compute and diverges from the f32 fleet. The suite probes
+  this only dynamically, per release; the lint proves it per engine,
+  statically.
+
+* **NMFX102 — transfer in the loop body.** The transfer-overlap
+  contract (docs/design.md §5b, the exec-cache pipeline) assumes the
+  solve loop is transfer-free: every host↔device movement happens
+  before dispatch or after harvest. A ``device_put`` equation inside a
+  ``while``/``scan`` body re-stages a buffer every iteration — the
+  round-trip-per-trip class the round-5 trace decomposition hunted at
+  microsecond scale. Integer iota/broadcast constants are fine; actual
+  ``device_put`` in a loop body is not.
+
+Engines are traced, never compiled or executed — CPU-cheap (the whole
+layer runs in a few seconds) and shape-independent by design: the tiny
+trace shapes see the same program structure the north-star shapes do,
+because the engines are shape-polymorphic up to padding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from nmfx.analysis.core import Finding, Rule, register
+
+
+def _engine_specs():
+    """(name, thunk) per registered engine; each thunk returns a traced
+    ClosedJaxpr. Imported lazily — the AST rules must not pay the jax
+    import."""
+    import jax
+
+    from nmfx.config import SolverConfig
+    from nmfx.ops.grid_mu import BLOCKS, mu_grid
+    from nmfx.ops.sched_mu import mu_sched
+
+    m, n, k, b = 16, 12, 2, 4
+    specs = []
+
+    def _abstract(shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+
+    def _grid_thunk(algorithm):
+        def thunk():
+            cfg = SolverConfig(algorithm=algorithm, max_iter=4,
+                               backend="packed")
+            # job_ks: the exact per-lane ranks — the direct-driver
+            # idiom (grid_mu.pad_live_mask) the round-5 advisor asked
+            # every caller that knows its lane composition to use
+            return jax.make_jaxpr(
+                lambda a, w0, h0: mu_grid(a, w0, h0, cfg,
+                                          job_ks=(k,) * b))(
+                    _abstract((m, n)), _abstract((b, m, k)),
+                    _abstract((b, k, n)))
+        return thunk
+
+    for algorithm in sorted(BLOCKS):
+        specs.append((f"mu_grid[{algorithm}]", _grid_thunk(algorithm)))
+
+    def _sched_thunk():
+        cfg = SolverConfig(algorithm="mu", max_iter=4, backend="packed")
+        return jax.make_jaxpr(
+            lambda a, w0, h0: mu_sched(a, w0, h0, cfg, slots=2,
+                                       tail_slots=None,
+                                       job_ks=(k,) * b))(
+                _abstract((m, n)), _abstract((b, m, k)),
+                _abstract((b, k, n)))
+
+    specs.append(("mu_sched[mu]", _sched_thunk))
+    return specs
+
+
+def _walk_eqns(jaxpr, in_loop: bool = False):
+    """Yield (eqn, in_loop) over a jaxpr and every sub-jaxpr; in_loop
+    marks equations inside a while/scan body."""
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        looping = in_loop or eqn.primitive.name in ("while", "scan")
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub, looping)
+
+
+def _sub_jaxprs(eqn):
+    for val in eqn.params.values():
+        for item in (val if isinstance(val, (list, tuple)) else [val]):
+            jx = getattr(item, "jaxpr", None)
+            if jx is not None:
+                yield jx
+            elif hasattr(item, "eqns"):
+                yield item
+
+
+def check_engine_jaxpr(name: str, closed_jaxpr) -> "list[str]":
+    """The pure per-jaxpr checks; returns problem strings. Split out so
+    the rule tests can feed deliberately-bad jaxprs."""
+    problems = []
+    f64_lines = set()
+    for eqn, in_loop in _walk_eqns(closed_jaxpr.jaxpr):
+        prim = eqn.primitive.name
+        if prim == "convert_element_type":
+            new = str(eqn.params.get("new_dtype"))
+            if new == "float64":
+                f64_lines.add(
+                    f"{name}: convert_element_type to float64 "
+                    "(a Python/NumPy double leaked into the traced "
+                    "math — under x64 parity runs the f32 engine "
+                    "silently computes in f64)")
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and str(getattr(aval, "dtype", ""
+                                                )) == "float64":
+                f64_lines.add(
+                    f"{name}: op '{prim}' produces a float64 value in "
+                    "an engine configured dtype='float32' — x64-parity "
+                    "contract violation")
+        if prim == "device_put" and in_loop:
+            problems.append(
+                f"{name}: device_put inside a while/scan body — the "
+                "solve loop must be transfer-free (docs/design.md §5b); "
+                "a per-iteration restage defeats the transfer-overlap "
+                "pipeline")
+    problems.extend(sorted(f64_lines))
+    return problems
+
+
+def run_jaxpr_checks() -> "list[tuple[str, str, str]]":
+    """Trace every registered engine under x64 (the parity
+    configuration) and run the checks. Returns (engine, rule_id,
+    message) triples; tracing failures surface as NMFX101 problems
+    rather than crashing the linter."""
+    import jax
+
+    out = []
+    try:
+        ctx_factory = jax.experimental.enable_x64
+    except AttributeError:
+        # without x64 the f64-leak check would be a silent false-clean
+        # (x64-off downcasts the very leaks NMFX101 exists to see) —
+        # report the capability gap as a finding instead of passing
+        out.append((
+            "jaxpr-layer", "NMFX101",
+            "this jax build has no jax.experimental.enable_x64 — the "
+            "engines cannot be traced under the x64 parity "
+            "configuration, so the NMFX101 f64-leak contract is "
+            "UNVERIFIED (not clean). Run the linter on a jax with the "
+            "context manager, or suppress via baseline with that "
+            "reason on record"))
+        return out
+    for name, thunk in _engine_specs():
+        try:
+            with ctx_factory(True):
+                closed = thunk()
+                problems = check_engine_jaxpr(name, closed)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            out.append((name, "NMFX101",
+                        f"{name}: engine failed to trace abstractly "
+                        f"({type(e).__name__}: {e}) — every registered "
+                        "engine must trace with abstract inputs"))
+            continue
+        for msg in problems:
+            rule = "NMFX102" if "device_put" in msg else "NMFX101"
+            out.append((name, rule, msg))
+    return out
+
+
+def _project_jaxpr_results(project) -> "list[tuple[str, str, str]]":
+    """Engine tracing is shared (and memoized on the project) between
+    the two jaxpr rules, so running both costs one trace of each
+    engine — and ``--rules NMFX102`` alone still traces."""
+    cached = getattr(project, "_jaxpr_results", None)
+    if cached is None:
+        cached = run_jaxpr_checks()
+        project._jaxpr_results = cached
+    return cached
+
+
+class _JaxprRule(Rule):
+    """Base for the jaxpr-layer rules: emits only the findings bearing
+    its own rule id from the shared engine-trace results."""
+
+    def check(self, project) -> "Iterable[Finding]":
+        if not getattr(project, "jaxpr_checks_enabled", False):
+            return
+        for _name, rule_id, msg in _project_jaxpr_results(project):
+            if rule_id != self.rule_id:
+                continue
+            # findings anchor at the engine registries rather than a
+            # synthetic location — at the ANALYZED module's path when
+            # present, so inline suppressions/baselines (both matched
+            # by abspath against the analyzed sources) can reach them
+            # from any invocation cwd
+            rel = ("nmfx/ops/sched_mu.py" if "mu_sched" in msg
+                   else "nmfx/ops/grid_mu.py")
+            path = next(
+                (m.path for m in project.modules
+                 if m.path.replace("\\", "/").endswith(rel)), rel)
+            yield Finding(file=path, line=1, rule_id=rule_id,
+                          message=msg, severity="error")
+
+
+@register
+class EngineX64Parity(_JaxprRule):
+    """NMFX101: traced engines stay f32 under x64; no f64 leaks."""
+
+    rule_id = "NMFX101"
+    title = "engine jaxpr x64-parity contract"
+
+
+@register
+class EngineLoopTransferFree(_JaxprRule):
+    """NMFX102: no device_put inside engine while/scan bodies."""
+
+    rule_id = "NMFX102"
+    title = "engine loop bodies transfer-free"

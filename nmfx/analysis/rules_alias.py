@@ -1,0 +1,239 @@
+"""NMFX003 — donation/aliasing safety (read-after-donate).
+
+The round-3 incident (VERDICT.md round 3): the pallas scheduler aliased
+its input factor buffers onto the output VMEM windows and made the
+alias the DATA path — bit-exact standalone, silently stale inside
+``lax.while_loop`` on hardware. The round-5 successor (``alias_io``)
+re-admits donation ONLY as an optimization on top of an explicit copy,
+and the boundary between the two is a buffer-lifetime contract no test
+can see: a buffer named in ``donate_argnums`` / ``input_output_aliases``
+is DEAD after the call that consumes it, and a later read returns
+whatever the executable scribbled there — on backends that honor
+donation, which CPU tests do not (jax warns at most).
+
+The rule tracks, per function body, in statement order:
+
+* ``g = jax.jit(f, donate_argnums=(...))`` (and
+  ``functools.partial``-spelled jit) — ``g`` carries the donated
+  positions;
+* ``pl.pallas_call(..., input_output_aliases={...})`` — the returned
+  callable carries the aliased input positions;
+* direct forms ``jax.jit(f, donate_argnums=...)(x, y)`` and
+  ``pl.pallas_call(..., input_output_aliases=...)(x, y)``;
+
+then at each call through a donating callable records which argument
+NAMES died, and flags any later load of a dead name. A rebind
+(assignment) resurrects the name — ``w = donating(w)`` is the intended
+idiom. Only literal int/dict donation specs are tracked: a computed
+spec (e.g. pallas_mu's conditional ``alias`` dict) marks the call
+donating-with-unknown-positions, which kills nothing — the rule prefers
+missed edges over false kills here because a false read-after-donate
+error on the main kernel path would teach people to suppress the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from nmfx.analysis.ast_scan import _attr_tail, own_nodes, stores
+from nmfx.analysis.core import Finding, Rule, register
+
+
+def _donated_positions(call: ast.Call) -> "tuple[str | None, set[int]]":
+    """(kind, positions) for a jit/pallas_call constructor node.
+
+    kind "callable": the call RESULT takes the buffers directly
+    (``jax.jit(f, donate_argnums=...)``, ``pl.pallas_call(...,
+    input_output_aliases=...)``) — calling it kills the positional args.
+    kind "factory": one more application stands between this node and
+    the buffers (``partial(jax.jit, donate_argnums=...)``) — calling IT
+    produces a donating callable and kills nothing itself (its
+    arguments are functions, not buffers). None: not donating.
+    Positions are argument indices of the eventual buffer call; empty
+    set means donating-with-unknown-positions (computed spec)."""
+    tail = _attr_tail(call.func)
+    kind = None
+    if tail in ("jit", "pallas_call"):
+        kind = "callable"
+    elif tail == "partial" and call.args and \
+            _attr_tail(call.args[0]) in ("jit", "pallas_call"):
+        kind = "factory"
+    if kind is None:
+        return None, set()
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            if isinstance(kw.value, ast.Tuple):
+                vals = kw.value.elts
+            else:
+                vals = [kw.value]
+            # ints = call positions; strs (donate_argnames) = parameter
+            # names, matched at the call site against keyword args and
+            # same-named positional Name args (the common idiom)
+            pos = {v.value for v in vals
+                   if isinstance(v, ast.Constant)
+                   and isinstance(v.value, (int, str))}
+            known = all(isinstance(v, ast.Constant) for v in vals)
+            return kind, (pos if known else set())
+        if kw.arg == "input_output_aliases":
+            if isinstance(kw.value, ast.Dict):
+                pos = {k.value for k in kw.value.keys
+                       if isinstance(k, ast.Constant)
+                       and isinstance(k.value, int)}
+                known = all(isinstance(k, ast.Constant)
+                            for k in kw.value.keys)
+                return kind, (pos if known else set())
+            return kind, set()  # computed spec: donating, unknown args
+    return None, set()
+
+
+def _loads(stmt: ast.stmt) -> "Iterable[ast.Name]":
+    for node in own_nodes(stmt):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            yield node
+
+
+@register
+class ReadAfterDonate(Rule):
+    """NMFX003: a buffer read after being donated/aliased away."""
+
+    rule_id = "NMFX003"
+    title = "donation/aliasing safety"
+
+    def check(self, project) -> "Iterable[Finding]":
+        for mod in project.modules:
+            for fn in mod.functions.values():
+                yield from self._check_function(mod, fn)
+
+    def _check_function(self, mod, fn) -> "Iterable[Finding]":
+        if not isinstance(fn.node, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+            return  # lambdas: single expression, nothing to order
+        yield from self._scan_block(mod, fn.node.body, {}, {})
+
+    def _scan_block(self, mod, body, donating_vars, dead
+                    ) -> "Iterable[Finding]":
+        """One statement block in source order. Child blocks (if/for/
+        try bodies) scan with COPIES of the donation state: kills made
+        on one branch do not escape to statements after the compound —
+        whether the donating call actually ran there is path-sensitive,
+        and a false read-after-donate error would teach people to
+        suppress the rule (missed cross-branch kills are the accepted
+        cost; same philosophy as unknown-position donation specs).
+
+        ``donating_vars``: name -> ("callable"|"factory", positions).
+        ``dead``: buffer name -> (donation line, callee description).
+        """
+        for stmt in body:
+            # reads of dead names first (the statement's loads happen
+            # before its stores rebind anything)
+            for load in _loads(stmt):
+                if load.id in dead:
+                    line, desc = dead[load.id]
+                    yield self.finding(
+                        mod.path, load.lineno,
+                        f"'{load.id}' is read after being donated to "
+                        f"{desc} at line {line}: donated buffers are "
+                        "dead — on backends that honor donation the "
+                        "read returns whatever the executable wrote "
+                        "there (the round-3 alias_io hazard class; "
+                        "CPU tests will NOT catch this). Re-bind the "
+                        "result or copy before donating")
+                    del dead[load.id]  # one report per death
+            for call in self._calls(stmt):
+                self._track(call, donating_vars, dead)
+            for name in stores(stmt):
+                dead.pop(name, None)
+                donating_vars.pop(name, None)
+            self._record_bindings(stmt, donating_vars)
+            for field in ("body", "orelse", "finalbody"):
+                child = getattr(stmt, field, None)
+                if child and not isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._scan_block(
+                        mod, child, dict(donating_vars), dict(dead))
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from self._scan_block(
+                    mod, handler.body, dict(donating_vars), dict(dead))
+
+    @staticmethod
+    def _calls(stmt: ast.stmt) -> "Iterable[ast.Call]":
+        for node in own_nodes(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+    @staticmethod
+    def _callable_kind(call: ast.Call, donating_vars):
+        """What calling this node's FUNC yields: ("callable"|"factory",
+        positions) for donation-relevant calls, else (None, set()).
+        Covers constructors (``jit(f, donate_argnums=...)``,
+        ``partial(jax.jit, ...)``), applied factories
+        (``partial(jax.jit, ...)(f)`` / ``mk(f)``), and bound names."""
+        if isinstance(call.func, ast.Call):
+            inner_kind, pos = _donated_positions(call.func)
+            if inner_kind == "callable":
+                return "callable", pos
+            if inner_kind == "factory":
+                # `partial(jax.jit, ...)(f)` applies the factory: the
+                # RESULT is the donating callable
+                return "applied-factory", pos
+            # `mk(f)` where mk is a bound factory: handled by the Name
+            # branch below when the factory result is itself called —
+            # an inner Call func that is a Name call through a factory
+            inner = call.func
+            if (isinstance(inner.func, ast.Name)
+                    and donating_vars.get(inner.func.id,
+                                          (None,))[0] == "factory"):
+                return "callable", donating_vars[inner.func.id][1]
+            return None, set()
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in donating_vars:
+            kind, pos = donating_vars[call.func.id]
+            return kind, pos
+        return None, set()
+
+    def _record_bindings(self, stmt, donating_vars):
+        """Bind names produced by donation constructors/factories:
+        ``g = jax.jit(f, donate_argnums=...)`` (callable),
+        ``mk = partial(jax.jit, donate_argnums=...)`` (factory),
+        ``g = mk(f)`` / ``g = partial(jax.jit, ...)(f)`` (callable)."""
+        if not isinstance(stmt, ast.Assign):
+            return
+        if not isinstance(stmt.value, ast.Call):
+            return
+        call = stmt.value
+        kind, pos = _donated_positions(call)
+        if kind is None:
+            # applying a factory (inline `partial(jax.jit, ...)(f)` or a
+            # bound `mk(f)`) yields the donating CALLABLE
+            applied, apos = self._callable_kind(call, donating_vars)
+            if applied in ("applied-factory", "factory"):
+                kind, pos = "callable", apos
+        if kind is not None:
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    donating_vars[tgt.id] = (kind, pos)
+
+    def _track(self, call: ast.Call, donating_vars, dead):
+        """Mark arguments killed by this call: only CALLABLE-kind calls
+        take buffers (a factory's arguments are functions)."""
+        kind, positions = self._callable_kind(call, donating_vars)
+        if kind != "callable":
+            return
+        if isinstance(call.func, ast.Name):
+            desc = f"'{call.func.id}'"
+        elif isinstance(call.func, ast.Call):
+            desc = _attr_tail(call.func.func) or "a donating callable"
+        else:
+            desc = "a donating callable"
+        for i, arg in enumerate(call.args):
+            # int entries match by position; str entries
+            # (donate_argnames) match a positional Name whose variable
+            # name equals the donated parameter name
+            if isinstance(arg, ast.Name) and (i in positions
+                                              or arg.id in positions):
+                dead[arg.id] = (call.lineno, desc)
+        for kw in call.keywords:
+            if (kw.arg in positions
+                    and isinstance(kw.value, ast.Name)):
+                dead[kw.value.id] = (call.lineno, desc)

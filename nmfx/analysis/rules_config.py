@@ -1,0 +1,207 @@
+"""NMFX001 — config-fingerprint coverage.
+
+The silent-corruption class this rule kills: a numerics-affecting
+``SolverConfig``/``ExperimentalConfig`` field that never reaches the
+registry fingerprint (``nmfx/registry.py``) lets a checkpoint written
+under one configuration resume under another — plausible factors, wrong
+numbers, no crash (the exact hazard the fingerprint's v3→v6 history in
+``registry.py`` documents release by release). The same field missing
+from the exec-cache bucket key (``nmfx/exec_cache.py``) serves one
+compiled executable to two configurations that should compile
+differently.
+
+The rule cross-references three AUTHORITATIVE declarations (the
+introspection hooks added for it — no hash-body parsing):
+
+* ``dataclasses.fields(SolverConfig/ExperimentalConfig)`` — what exists;
+* ``registry.FINGERPRINT_SOLVER_EXCLUDED`` + ``fingerprint_solver_fields``
+  — what the fingerprint covers;
+* ``SolverConfig.NON_NUMERICS_FIELDS`` — which fields are DECLARED
+  execution-strategy-only (the only legitimate exclusions);
+* ``exec_cache.solver_key_fields()`` — what the bucket key covers.
+
+Every field must be fingerprint-covered or declared non-numerics; every
+exclusion must be declared; the declaration must not go stale; both
+config dataclasses must stay frozen-with-hash (the bucket key and jit
+static-argument machinery depend on it). The check itself is a pure
+function over field sets (``check_config_coverage``) so the per-rule
+tests can inject a mutated universe and watch the rule fire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Iterable
+
+from nmfx.analysis.core import Finding, Rule, register
+
+
+def _decl_site(obj, fallback_file: str) -> "tuple[str, int]":
+    """file:line of a class/module-level declaration, best effort."""
+    try:
+        f = inspect.getsourcefile(obj) or fallback_file
+        _, line = inspect.getsourcelines(obj)
+        return f, line
+    except (OSError, TypeError):
+        return fallback_file, 1
+
+
+def check_config_coverage(
+    solver_fields: "frozenset[str]",
+    experimental_fields: "frozenset[str]",
+    fingerprint_covered: "frozenset[str]",
+    fingerprint_excluded: "tuple[str, ...]",
+    declared_non_numerics: "tuple[str, ...]",
+    exec_key_covered: "frozenset[str]",
+    hashable_configs: "dict[str, bool]",
+    fingerprint_resolved: "tuple[str, ...]" = (),
+    noncompare_fields: "dict[str, tuple[str, ...]]" = {},
+) -> "list[str]":
+    """The pure contract check; returns human-readable problems.
+
+    Parameters default to nothing — the Rule wrapper reads the live
+    modules; tests inject mutated universes (a field dropped from
+    ``fingerprint_covered``, an exclusion not declared) and assert the
+    corresponding message appears.
+    """
+    problems: "list[str]" = []
+    # 1. declarations must not go stale
+    for name in declared_non_numerics:
+        if name not in solver_fields:
+            problems.append(
+                f"SolverConfig.NON_NUMERICS_FIELDS names {name!r}, which "
+                "is not a SolverConfig field — stale declaration")
+    for name in fingerprint_resolved:
+        if name not in solver_fields:
+            problems.append(
+                f"registry.FINGERPRINT_SOLVER_RESOLVED names {name!r}, "
+                "which is not a SolverConfig field — stale declaration")
+    # 2. every fingerprint exclusion must be a declared non-numerics
+    #    field (numerics-affecting fields may NEVER be excluded)
+    for name in fingerprint_excluded:
+        if name not in declared_non_numerics:
+            problems.append(
+                f"SolverConfig.{name} is excluded from the registry "
+                "fingerprint (registry.FINGERPRINT_SOLVER_EXCLUDED) but "
+                "not declared execution-strategy-only in "
+                "SolverConfig.NON_NUMERICS_FIELDS — a numerics-affecting "
+                "field excluded from the fingerprint resumes stale "
+                "checkpoints silently")
+    # 3. every field must reach the fingerprint unless declared
+    for name in sorted(solver_fields - fingerprint_covered):
+        if name not in declared_non_numerics:
+            problems.append(
+                f"SolverConfig.{name} does not reach the registry "
+                "fingerprint and is not declared in NON_NUMERICS_FIELDS "
+                "— checkpoints written under different values of it "
+                "would be served interchangeably")
+    # 4. the exec-cache bucket key must cover every field that can
+    #    change the compiled program (everything; even declared
+    #    non-numerics fields like restart_chunk change program
+    #    STRUCTURE, so nothing may be missing here)
+    for name in sorted(solver_fields - exec_key_covered):
+        problems.append(
+            f"SolverConfig.{name} is not covered by the exec-cache "
+            "bucket key (exec_cache.solver_key_fields) — two configs "
+            "differing in it would share one compiled executable")
+    # 5. the nested experimental knobs ride along via the
+    #    'experimental' field; it must itself be covered on both sides
+    if experimental_fields and "experimental" not in fingerprint_covered:
+        problems.append(
+            "SolverConfig.experimental (the ExperimentalConfig knobs) "
+            "does not reach the registry fingerprint — every "
+            f"experimental field ({', '.join(sorted(experimental_fields))}) "
+            "is numerics-affecting by definition")
+    # 6. both config dataclasses must stay frozen-with-hash: the bucket
+    #    key and jit static-argnames hash the VALUES
+    for cls_name, ok in hashable_configs.items():
+        if not ok:
+            problems.append(
+                f"{cls_name} is not a frozen/hashable dataclass — the "
+                "exec-cache bucket key and jit static-argument caching "
+                "hash config values; an unhashable config breaks both")
+    # 7. no field anywhere in the config tree may opt out of comparison:
+    #    dataclass __eq__/__hash__ skip compare=False fields, so two
+    #    configs differing there would hash equal and share one cached
+    #    executable — including fields of the NESTED ExperimentalConfig,
+    #    which ride into the bucket key through SolverConfig's hash
+    for cls_name, names in noncompare_fields.items():
+        for name in names:
+            problems.append(
+                f"{cls_name}.{name} is declared compare=False — it is "
+                "invisible to dataclass __eq__/__hash__ and therefore "
+                "to the exec-cache bucket key and jit static-argument "
+                "caching; two configs differing in it would share one "
+                "compiled executable")
+    return problems
+
+
+def _live_universe():
+    from nmfx import exec_cache, registry
+    from nmfx.config import ExperimentalConfig, SolverConfig
+
+    def _hashable(cls) -> bool:
+        return (dataclasses.is_dataclass(cls)
+                and cls.__hash__ is not None
+                and cls.__dataclass_params__.frozen)
+
+    return dict(
+        solver_fields=frozenset(
+            f.name for f in dataclasses.fields(SolverConfig)),
+        experimental_fields=frozenset(
+            f.name for f in dataclasses.fields(ExperimentalConfig)),
+        fingerprint_covered=registry.fingerprint_solver_fields(),
+        fingerprint_excluded=tuple(registry.FINGERPRINT_SOLVER_EXCLUDED),
+        fingerprint_resolved=tuple(registry.FINGERPRINT_SOLVER_RESOLVED),
+        declared_non_numerics=tuple(SolverConfig.NON_NUMERICS_FIELDS),
+        exec_key_covered=exec_cache.solver_key_fields(),
+        hashable_configs={"SolverConfig": _hashable(SolverConfig),
+                          "ExperimentalConfig": _hashable(
+                              ExperimentalConfig)},
+        noncompare_fields={
+            cls.__name__: tuple(f.name
+                                for f in dataclasses.fields(cls)
+                                if not f.compare)
+            for cls in (SolverConfig, ExperimentalConfig)},
+    )
+
+
+@register
+class ConfigFingerprintCoverage(Rule):
+    """NMFX001: every numerics-affecting config field must reach the
+    registry fingerprint and the exec-cache bucket key."""
+
+    rule_id = "NMFX001"
+    title = "config-fingerprint coverage"
+
+    def check(self, project) -> "Iterable[Finding]":
+        # this is a semantic whole-package rule: it runs only when the
+        # real package is in the analyzed set (fixture runs over test
+        # snippets call check_config_coverage directly)
+        import os
+
+        analyzed_cfg = next(
+            (m.path for m in project.modules
+             if m.path.replace("\\", "/").endswith("nmfx/config.py")),
+            None)
+        if analyzed_cfg is None:
+            return []
+        from nmfx.config import SolverConfig
+
+        cfg_file, cfg_line = _decl_site(SolverConfig, "nmfx/config.py")
+        # this rule (and the jaxpr layer) checks the IMPORTED package;
+        # if the import resolves outside the analyzed checkout (a stale
+        # site-packages install shadowing a worktree), the results
+        # would describe the wrong tree — fail loudly instead
+        if os.path.abspath(cfg_file) != os.path.abspath(analyzed_cfg):
+            return [self.finding(
+                analyzed_cfg, 1,
+                f"the importable nmfx package resolves to {cfg_file!r}, "
+                f"not the analyzed {analyzed_cfg!r} — NMFX001 and the "
+                "jaxpr layer would check the WRONG tree. Run the "
+                "linter with the analyzed checkout first on sys.path "
+                "(e.g. `PYTHONPATH=<checkout> python -m nmfx.analysis "
+                "<checkout>/nmfx`)")]
+        return [self.finding(cfg_file, cfg_line, msg)
+                for msg in check_config_coverage(**_live_universe())]

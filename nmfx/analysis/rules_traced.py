@@ -1,0 +1,373 @@
+"""NMFX002/NMFX004/NMFX005 — hazards inside traced code.
+
+All three rules share the traced-reachability answer from
+``ast_scan.Project`` (functions jitted, handed to pallas/lax
+combinators, or name-graph reachable from one): inside traced code the
+hazards below leave no runtime trace.
+
+* **NMFX002 — trace-time environment reads.** ``os.environ`` /
+  ``os.getenv`` inside traced code executes ONCE at trace time and is
+  baked into every cached executable: toggling the variable mid-process
+  silently serves the stale program, and a process that merely
+  *inherits* the variable (a test harness spawning a service) changes
+  production numerics with no record. This repo shipped exactly this
+  class: ``NMFX_FAULT_INJECT_STALE_RELOAD`` was read at trace time in
+  the production reload path (ADVICE.md round 5) until the explicit
+  ``enable_stale_reload_fault()`` opt-in replaced it.
+
+* **NMFX004 — PRNG discipline.** ``np.random``/stdlib ``random`` inside
+  traced code freezes one host draw into the executable (every call of
+  the compiled program replays the same "random" numbers — the
+  reference's irreproducibility bug, inverted). And a JAX key consumed
+  by two sampling calls without an intervening ``split``/``fold_in``
+  correlates draws that the consensus math assumes independent —
+  restarts collapse toward each other with no numerical signature
+  (PAPER.md's whole premise is independent restarts).
+
+* **NMFX005 — implicit host syncs.** ``.item()`` / ``float()`` /
+  ``bool()`` / ``int()`` / ``np.asarray`` on a traced array either
+  aborts tracing (good case) or — in host-side dispatch loops — blocks
+  the dispatch pipeline on a device round trip per call (the transfer
+  discipline docs/design.md §5b exists to protect). The rule is
+  dataflow-gated to stay quiet on the pervasive legitimate host math on
+  STATIC config values: only conversions of names bound from
+  ``jnp.``/``jax.``/``lax.`` results or of the traced function's own
+  array parameters are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from nmfx.analysis.ast_scan import (FunctionInfo, _attr_tail,
+                                    _dotted, own_nodes, stores)
+from nmfx.analysis.core import Finding, Rule, register
+
+#: jax.random functions that DERIVE new keys rather than consuming one
+#: for sampling (calling these repeatedly on one key is the intended
+#: idiom); constructors take seeds, not keys
+_KEY_DERIVERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data"}
+_KEY_CONSTRUCTORS = {"key", "PRNGKey"}
+
+
+def _function_body_calls(fn: FunctionInfo) -> "Iterable[ast.Call]":
+    """Call nodes lexically inside ``fn`` but NOT inside a nested def
+    (nested defs are their own FunctionInfo and get visited there)."""
+    skip: "set[int]" = set()
+    for node in ast.walk(fn.node):
+        if node is not fn.node and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            skip.update(id(sub) for sub in ast.walk(node))
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call) and id(node) not in skip:
+            yield node
+
+
+@register
+class TraceTimeEnvRead(Rule):
+    """NMFX002: os.environ/os.getenv reachable from jitted/pallas code."""
+
+    rule_id = "NMFX002"
+    title = "trace-time environment read"
+
+    @staticmethod
+    def _is_env_read(fn: FunctionInfo, dotted: str) -> bool:
+        """Whether a dotted name chain reaches os.environ/os.getenv,
+        resolving the leading name through the module's imports — so
+        ``import os as _os``, ``from os import getenv`` and
+        ``from os import environ`` spellings are all caught, while a
+        user-defined ``environ`` object from elsewhere is not."""
+        parts = dotted.split(".")
+        aliases = fn.module.module_aliases
+        from_imports = fn.module.from_imports
+        head = parts[0]
+        # module alias chain: <os-alias>.environ... / <os-alias>.getenv
+        if (len(parts) >= 2 and aliases.get(head) == "os"
+                and parts[1] in ("environ", "getenv")):
+            return True
+        # from os import getenv/environ (any local alias)
+        origin = from_imports.get(head)
+        if origin is not None:
+            src, orig = origin
+            return src == "os" and orig in ("getenv", "environ")
+        return False
+
+    def check(self, project) -> "Iterable[Finding]":
+        for fn in project.traced_functions():
+            for node in ast.walk(fn.node):
+                dotted = None
+                if isinstance(node, ast.Call):
+                    dotted = _dotted(node.func)
+                elif isinstance(node, (ast.Attribute, ast.Name)):
+                    dotted = _dotted(node)
+                if dotted is None or not self._is_env_read(fn, dotted):
+                    continue
+                yield self.finding(
+                    fn.module.path, node.lineno,
+                    f"environment read ({dotted}) inside "
+                    f"'{fn.qualname}', which is traced or reachable "
+                    "from traced code: the value is read ONCE at "
+                    "trace time and baked into every cached "
+                    "executable — changing the variable later "
+                    "silently serves the stale program. Read env "
+                    "vars at import/call-site setup and pass the "
+                    "value in explicitly")
+                break  # one finding per function per rule keeps
+                # output actionable; re-lint after the fix
+
+
+@register
+class PRNGDiscipline(Rule):
+    """NMFX004: host RNG in traced code; JAX key reuse without split."""
+
+    rule_id = "NMFX004"
+    title = "PRNG discipline"
+
+    def check(self, project) -> "Iterable[Finding]":
+        for fn in project.traced_functions():
+            yield from self._host_rng(fn)
+        # key reuse is a per-function property of ANY function (a host
+        # driver reusing a key across two traced calls is just as
+        # correlated), so scan them all
+        for mod in project.modules:
+            for fn in mod.functions.values():
+                yield from self._key_reuse(fn)
+
+    def _host_rng(self, fn: FunctionInfo) -> "Iterable[Finding]":
+        aliases = fn.module.module_aliases
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            parts = dotted.split(".")
+            head_res = aliases.get(parts[0], parts[0])
+            # numpy resolved through the module's imports (import numpy
+            # as onp; from numpy import random as nprand) — and
+            # "random." only for the STDLIB module: a module that did
+            # `from jax import random` is consuming keys, not host RNG
+            np_random = ((len(parts) >= 3 and parts[1] == "random"
+                          and head_res in ("numpy", "np"))
+                         or (len(parts) >= 2
+                             and head_res == "numpy.random"))
+            stdlib_random = (len(parts) >= 2 and head_res == "random")
+            if np_random or stdlib_random:
+                yield self.finding(
+                    fn.module.path, node.lineno,
+                    f"host RNG call ({dotted}) inside traced "
+                    f"'{fn.qualname}': the draw happens once at trace "
+                    "time and becomes a compile-time constant — every "
+                    "execution replays the same numbers. Use jax.random "
+                    "with an explicit key")
+
+    def _consumption(self, fn: FunctionInfo,
+                     node: ast.Call) -> "str | None":
+        """The key Name this call consumes for sampling, or None.
+
+        Only jax.random consumption counts as KEY use: the call's base
+        resolves through the module's imports, so stdlib
+        ``random.shuffle(data)`` (base resolves to "random", not
+        "jax.random") never flags a data argument as a reused key."""
+        aliases = fn.module.module_aliases
+        dotted = _dotted(node.func) or ""
+        parts = dotted.split(".")
+        if (len(parts) >= 3 and parts[1] == "random"
+                and aliases.get(parts[0], parts[0]) == "jax"):
+            pass
+        elif len(parts) == 2 and aliases.get(parts[0]) == "jax.random":
+            pass  # `from jax import random` / `import jax.random as X`
+        else:
+            return None
+        leaf = parts[-1]
+        if leaf in _KEY_DERIVERS or leaf in _KEY_CONSTRUCTORS:
+            return None
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            return None
+        return node.args[0].id
+
+    def _reuse_finding(self, fn: FunctionInfo, node: ast.Call,
+                       key_name: str, first_line: int) -> Finding:
+        leaf = (_dotted(node.func) or "?").split(".")[-1]
+        return self.finding(
+            fn.module.path, node.lineno,
+            f"PRNG key '{key_name}' is consumed by jax.random.{leaf} "
+            f"at line {node.lineno} after already being consumed at "
+            f"line {first_line} in '{fn.qualname}' — reused keys "
+            "correlate draws that downstream consensus math assumes "
+            "independent; split the key (jax.random.split) so each "
+            "sampling call owns a fresh one")
+
+    def _key_reuse(self, fn: FunctionInfo) -> "Iterable[Finding]":
+        """Same Name consumed by 2+ jax.random sampling calls without
+        an intervening rebind — the canonical threading idiom
+        ``key = jax.random.fold_in(key, i)`` RESURRECTS the name (the
+        statement-ordered scan clears it on store), and branch bodies
+        scan with copies so sibling branches never see each other's
+        consumptions."""
+        if not isinstance(fn.node, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+            # lambda: one expression, no rebinds possible — flat scan
+            consumed: "dict[str, int]" = {}
+            for node in _function_body_calls(fn):
+                key = self._consumption(fn, node)
+                if key is None:
+                    continue
+                if key in consumed:
+                    yield self._reuse_finding(fn, node, key,
+                                              consumed[key])
+                else:
+                    consumed[key] = node.lineno
+            return
+        yield from self._scan_keys(fn, fn.node.body, {})
+
+    def _scan_keys(self, fn: FunctionInfo, body,
+                   consumed: "dict[str, int]") -> "Iterable[Finding]":
+        for stmt in body:
+            for node in own_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                key = self._consumption(fn, node)
+                if key is None:
+                    continue
+                if key in consumed:
+                    yield self._reuse_finding(fn, node, key,
+                                              consumed[key])
+                else:
+                    consumed[key] = node.lineno
+            for name in stores(stmt):
+                consumed.pop(name, None)
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # loop-carried reuse: ONE textual consumption inside
+                # the body runs once per iteration — identical draws
+                # every trip unless the body rebinds the key (the
+                # `k = fold_in(key, i)` idiom stores a fresh name and
+                # stays quiet)
+                yield from self._loop_carried(fn, stmt)
+            for field in ("body", "orelse", "finalbody"):
+                child = getattr(stmt, field, None)
+                if child and not isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._scan_keys(fn, child,
+                                               dict(consumed))
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from self._scan_keys(fn, handler.body,
+                                           dict(consumed))
+
+    def _loop_carried(self, fn: FunctionInfo,
+                      loop) -> "Iterable[Finding]":
+        # inner loops run their own _loop_carried pass (from
+        # _scan_keys's recursion) — excluding their subtrees here keeps
+        # one finding per defect instead of one per enclosing loop
+        inner: "set[int]" = set()
+        for node in ast.walk(loop):
+            if node is not loop and isinstance(
+                    node, (ast.For, ast.AsyncFor, ast.While,
+                           ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner.update(id(sub) for sub in ast.walk(node))
+        body_stores: "set[str]" = set()
+        for stmt in ast.walk(loop):
+            if isinstance(stmt, ast.stmt) and id(stmt) not in inner:
+                body_stores.update(stores(stmt))
+        # the loop target itself rebinds each iteration
+        target = getattr(loop, "target", None)
+        if target is not None:
+            body_stores.update(n.id for n in ast.walk(target)
+                               if isinstance(n, ast.Name))
+        seen: "set[str]" = set()
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or id(node) in inner:
+                continue
+            key = self._consumption(fn, node)
+            if key is None or key in body_stores or key in seen:
+                continue
+            seen.add(key)
+            yield self.finding(
+                fn.module.path, node.lineno,
+                f"PRNG key '{key}' is consumed inside a loop body in "
+                f"'{fn.qualname}' without being rebound per iteration "
+                "— every iteration replays the identical draw "
+                "(restarts collapse together); derive a fresh key per "
+                "iteration (jax.random.fold_in(key, i) or a "
+                "pre-split key array)")
+
+
+#: conversion calls that force a device->host sync on a traced array
+#: (int() stays off the list: the codebase's int() sites coerce static
+#: config/shape values, and ISSUE-class incidents were float/bool/item)
+_SYNC_CALLS = {"float", "bool"}
+_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _array_tainted(fn: FunctionInfo) -> "set[str]":
+    """Names plausibly bound to device arrays in ``fn``: its parameters
+    plus anything assigned from a ``jnp.``/``jax.``/``lax.`` call.
+    Config objects and static shape math arrive as attributes/ints and
+    never enter this set — that is what keeps NMFX005 quiet on the
+    pervasive legitimate host math inside jitted builders."""
+    tainted: "set[str]" = set()
+    args = getattr(fn.node, "args", None)
+    if args is not None:
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            tainted.add(a.arg)
+        if args.vararg:
+            tainted.add(args.vararg.arg)
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            dotted = _dotted(node.value.func) or ""
+            if dotted.split(".")[0] in ("jnp", "jax", "lax"):
+                for tgt in node.targets:
+                    for leaf in ast.walk(tgt):
+                        if isinstance(leaf, ast.Name):
+                            tainted.add(leaf.id)
+    return tainted
+
+
+@register
+class ImplicitHostSync(Rule):
+    """NMFX005: .item()/float()/bool()/np.asarray on traced arrays."""
+
+    rule_id = "NMFX005"
+    title = "implicit host sync"
+
+    def check(self, project) -> "Iterable[Finding]":
+        for fn in project.traced_functions():
+            tainted = _array_tainted(fn)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._classify(node, tainted)
+                if hit:
+                    yield self.finding(
+                        fn.module.path, node.lineno,
+                        f"{hit} inside traced '{fn.qualname}': on a "
+                        "traced array this either aborts tracing or — "
+                        "in the dispatch hot path — blocks the pipeline "
+                        "on a device round trip per call (see "
+                        "docs/design.md §5b). Keep reductions on device "
+                        "(jnp) and convert once, after the batch")
+
+    @staticmethod
+    def _classify(node: ast.Call, tainted: "set[str]") -> "str | None":
+        # x.item() where x is array-tainted (or a jnp/lax call result)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"):
+            recv = node.func.value
+            if ((isinstance(recv, ast.Name) and recv.id in tainted)
+                    or (isinstance(recv, ast.Call)
+                        and (_dotted(recv.func) or "").split(".")[0]
+                        in ("jnp", "jax", "lax"))):
+                return ".item() call"
+            return None
+        dotted = _dotted(node.func) or ""
+        name = _attr_tail(node.func)
+        is_sync = (dotted in _SYNC_NP
+                   or (name in _SYNC_CALLS
+                       and isinstance(node.func, ast.Name)))
+        if not is_sync or not node.args:
+            return None
+        arg = node.args[0]
+        if isinstance(arg, ast.Name) and arg.id in tainted:
+            return f"{dotted or name}() on traced array '{arg.id}'"
+        return None

@@ -9,7 +9,7 @@ R-level function arguments (reference ``nmf.r:106``).
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import ClassVar, Sequence
 
 ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf", "hals")
 #: algorithms with a dense-batched block (nmfx.ops.grid_mu.BLOCKS) that
@@ -136,6 +136,21 @@ class SolverConfig:
       never fire for TolFun < 1; we test against the previous iteration's
       residual instead.
     """
+
+    #: AUTHORITATIVE declaration of the fields that are EXECUTION
+    #: STRATEGY only — they change how the solve is scheduled or
+    #: batched, never the numbers it produces — and are therefore the
+    #: ONLY fields the registry fingerprint may exclude
+    #: (``registry.FINGERPRINT_SOLVER_EXCLUDED``). The static analyzer
+    #: (``nmfx.analysis`` rule NMFX001) cross-references the two lists
+    #: and errors on any fingerprint exclusion not declared here, so
+    #: adding a numerics-affecting field while forgetting the
+    #: fingerprint fails lint instead of silently resuming stale
+    #: checkpoints. A new field earns a place here only with a
+    #: bit-identity argument on record (restart_chunk: prefix-stable
+    #: PRNG keys make chunked and unchunked sweeps bit-identical —
+    #: tests/test_solvers.py).
+    NON_NUMERICS_FIELDS: ClassVar[tuple] = ("restart_chunk",)
 
     algorithm: str = "mu"
     max_iter: int = 10000

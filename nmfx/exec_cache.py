@@ -69,7 +69,26 @@ from nmfx.sweep import (KSweepOutput, _pad_count,
                         _build_bucketed_sweep_fn, bucketed_lane_init_fn,
                         grid_axes_active, grid_exec_ok)
 
-__all__ = ["ExecCache", "PlacedMatrix", "start_host_fetch", "bucket_dim"]
+__all__ = ["ExecCache", "PlacedMatrix", "start_host_fetch", "bucket_dim",
+           "solver_key_fields"]
+
+
+def solver_key_fields() -> frozenset:
+    """The SolverConfig fields the bucket key covers — the introspection
+    hook NMFX001 reads instead of parsing ``ExecCache._key``.
+
+    The key embeds the SolverConfig dataclass VALUE itself (frozen
+    dataclass ``__eq__``/``__hash__``, which compare every field
+    including the nested ExperimentalConfig), so coverage is total by
+    construction — as long as every field participates in comparison.
+    Reading ``field.compare`` keeps this hook honest: a field added with
+    ``compare=False`` would silently alias two different-numerics
+    configs onto one cached executable, and shows up here (and in
+    NMFX001) as uncovered."""
+    import dataclasses
+
+    return frozenset(f.name for f in dataclasses.fields(SolverConfig)
+                     if f.compare)
 
 
 def bucket_dim(x: int, quantum: int, growth_steps: int = 8) -> int:

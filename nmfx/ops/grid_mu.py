@@ -423,7 +423,8 @@ def tolfun_update(a, state_w, state_h, it, cfg: SolverConfig, *,
            & (dnorm - new_dnorm <= cfg.tol_fun * dnorm) & ~done)
     dnorm = jnp.where(is_check & ~done_in, new_dnorm, dnorm)
     done = done | hit
-    stop_reason = jnp.where(hit, base.StopReason.TOL_FUN, stop_reason)
+    stop_reason = jnp.where(hit, jnp.int32(base.StopReason.TOL_FUN),
+                            stop_reason)
     return dnorm, done, stop_reason
 
 

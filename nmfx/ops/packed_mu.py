@@ -266,12 +266,13 @@ def batch_convergence(cfg: SolverConfig, it, *, new_classes, delta, n_glob,
         classes = jnp.where(reset[:, None], new_classes, classes)
         hit = active & (stable >= cfg.stable_checks)
         done = done | hit
-        reason = jnp.where(hit, base.StopReason.CLASS_STABLE, reason)
+        reason = jnp.where(hit, jnp.int32(base.StopReason.CLASS_STABLE),
+                           reason)
 
     if cfg.use_tol_checks:
         hit = active & (delta < cfg.tol_x) & ~done
         done = done | hit
-        reason = jnp.where(hit, base.StopReason.TOL_X, reason)
+        reason = jnp.where(hit, jnp.int32(base.StopReason.TOL_X), reason)
 
     newly = done & ~done_in
     done_iter = jnp.where(newly, it, done_iter)

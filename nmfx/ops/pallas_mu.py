@@ -25,7 +25,8 @@ caveats); as of round 4 the FIXED fused-kernel scheduler wins it —
 and ``backend="pallas"`` is the documented fast path on TPU. The
 library default remains the packed/dense family for stability (one
 engine family across platforms and shapes; the pallas pool's VMEM
-envelope is shape-dependent), not for speed. The whole-grid slot scheduler (``nmfx.ops.sched_mu``)
+envelope is shape-dependent), not for speed. The whole-grid slot
+scheduler (``nmfx.ops.sched_mu``)
 also runs on these kernels under ``backend="pallas"`` (packed-column
 slot state; one ``fused_block_iterations`` launch per check block).
 History: round 3's block kernel used input/output-aliased VMEM windows

@@ -39,6 +39,36 @@ _META_NAME = "registry.json"
 #: SolverConfig.experimental, changing the hashed field map
 _FORMAT_VERSION = 6
 
+#: AUTHORITATIVE list of SolverConfig fields excluded from the
+#: fingerprint payload. Every entry must be declared execution-strategy
+#: -only in ``SolverConfig.NON_NUMERICS_FIELDS`` — the static analyzer
+#: (``nmfx.analysis`` rule NMFX001) cross-references the two lists, so a
+#: numerics-affecting field can no longer be dropped from the
+#: fingerprint silently (the silent-stale-resume class this module's
+#: guard exists for). ``restart_chunk``: chunked and unchunked sweeps
+#: are bit-identical by construction (prefix-stable PRNG keys; see
+#: tests/test_solvers.py).
+FINGERPRINT_SOLVER_EXCLUDED = ("restart_chunk",)
+
+#: SolverConfig fields hashed by a RESOLVED value instead of their raw
+#: one (still covered — two configs differing here hash differently
+#: whenever the numbers can differ): ``backend`` hashes as its resolved
+#: engine family, so "auto" and the explicit equivalent choice share
+#: checkpoints while different engine families never do.
+FINGERPRINT_SOLVER_RESOLVED = ("backend",)
+
+
+def fingerprint_solver_fields() -> frozenset:
+    """The SolverConfig fields the fingerprint covers (raw or resolved)
+    — the introspection hook NMFX001 reads instead of parsing
+    ``_fingerprint``'s body."""
+    import dataclasses as _dc
+
+    from nmfx.config import SolverConfig
+
+    return (frozenset(f.name for f in _dc.fields(SolverConfig))
+            - set(FINGERPRINT_SOLVER_EXCLUDED))
+
 
 def _all_fields(cfg) -> dict:
     """Every config field by value — including default-valued ones.
@@ -85,8 +115,15 @@ def _fingerprint(a: np.ndarray, solver_cfg, init_cfg, restarts: int,
     h.update(str(arr.dtype).encode())
     h.update(arr.tobytes())
     solver = _all_fields(solver_cfg)
-    solver.pop("restart_chunk", None)
-    solver["backend"] = resolve_engine_family(solver_cfg, mesh)
+    for name in FINGERPRINT_SOLVER_EXCLUDED:
+        solver.pop(name, None)
+    # every field declared resolved MUST have a resolver here — a
+    # KeyError on a stale declaration is the loud failure NMFX001's
+    # cross-reference expects, never a silently-raw hash
+    resolvers = {"backend": lambda: resolve_engine_family(solver_cfg,
+                                                          mesh)}
+    for name in FINGERPRINT_SOLVER_RESOLVED:
+        solver[name] = resolvers[name]()
     payload = {
         "solver": solver,
         "init": _all_fields(init_cfg),

@@ -246,7 +246,12 @@ def check_convergence(
         classes = jnp.where(is_check & ~same, new_classes, state.classes)
         hit = is_check & (stable >= cfg.stable_checks)
         done = done | hit
-        reason = jnp.where(hit, StopReason.CLASS_STABLE, reason)
+        # jnp.int32(enum): an IntEnum is NOT weak-typed, so under
+        # jax_enable_x64 (the parity configuration) a bare enum constant
+        # canonicalizes to int64 and poisons the i32 stop_reason carry —
+        # a while-carry type error the lint jaxpr layer (NMFX101) traces
+        # for on every registered engine
+        reason = jnp.where(hit, jnp.int32(StopReason.CLASS_STABLE), reason)
 
     if use_tolx and cfg.use_tol_checks:
         # W is row-sharded over the feature axis (replicated over samples),
@@ -255,7 +260,7 @@ def check_convergence(
                             maxchange(state.h, state.h_prev, s_ax))
         hit = is_check & (delta < cfg.tol_x) & ~done
         done = done | hit
-        reason = jnp.where(hit, StopReason.TOL_X, reason)
+        reason = jnp.where(hit, jnp.int32(StopReason.TOL_X), reason)
 
     dnorm = state.dnorm
     if use_tolfun and cfg.use_tol_checks:
@@ -266,7 +271,7 @@ def check_convergence(
                & (state.dnorm - new_dnorm <= cfg.tol_fun * state.dnorm) & ~done)
         dnorm = jnp.where(is_check, new_dnorm, state.dnorm)
         done = done | hit
-        reason = jnp.where(hit, StopReason.TOL_FUN, reason)
+        reason = jnp.where(hit, jnp.int32(StopReason.TOL_FUN), reason)
 
     return state._replace(classes=classes, stable=stable, done=done,
                           stop_reason=reason, dnorm=dnorm)

@@ -141,9 +141,12 @@ def test_fixed_batch_drivers_check_block_exact(jobs):
     (batch_convergence's is_check gate) — so the cadence, and hence the
     results, stay exact even there."""
     a, w0, h0 = jobs
+    job_ks = tuple(k for k in KS for _ in range(R))
     for max_iter in (600, 601):
-        ref_g = mu_grid(a, w0, h0, _cfg("auto", 1, max_iter=max_iter))
-        got_g = mu_grid(a, w0, h0, _cfg("auto", 3, max_iter=max_iter))
+        ref_g = mu_grid(a, w0, h0, _cfg("auto", 1, max_iter=max_iter),
+                        job_ks=job_ks)
+        got_g = mu_grid(a, w0, h0, _cfg("auto", 3, max_iter=max_iter),
+                        job_ks=job_ks)
         np.testing.assert_array_equal(np.asarray(ref_g.iterations),
                                       np.asarray(got_g.iterations))
         np.testing.assert_array_equal(np.asarray(ref_g.stop_reason),

@@ -199,7 +199,6 @@ def test_sharded_check_counts_global_mismatches():
     crafted so each shard's local count is within tolerance while the global
     sum exceeds it — a bug comparing local counts would pass."""
     import jax
-    from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
     n_glob = 16

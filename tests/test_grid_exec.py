@@ -49,7 +49,9 @@ def test_mu_grid_matches_per_rank_packed(data):
     root = jax.random.key(123)
     k_max = max(KS)
     w0, h0 = _dense_init(a, root, KS, R, k_max)
-    res = mu_grid(a, w0, h0, cfg)
+    # exact per-lane ranks — the direct-driver idiom (pad_live_mask)
+    res = mu_grid(a, w0, h0, cfg,
+                  job_ks=tuple(k for k in KS for _ in range(R)))
     for g, k in enumerate(KS):
         keys = jax.random.split(jax.random.fold_in(root, k), R)
         w0s, h0s = jax.vmap(

@@ -1,0 +1,59 @@
+"""Tier-1 gate: the full analyzer over ``nmfx/`` reports ZERO
+unsuppressed findings with an EMPTY baseline (ISSUE 3 acceptance).
+
+This is the enforcement point for every contract class the linter
+encodes: adding a SolverConfig field that misses the fingerprint, an
+env read reachable from jitted code, a key reuse, a read-after-donate,
+or an engine that stops tracing f32-clean under x64 turns this test
+red — at lint time, not in a hardware sweep three rounds later.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "nmfx")
+
+
+def test_nmfx_tree_lint_clean():
+    from nmfx.analysis import active, run
+
+    findings = run([PKG], jaxpr=True)
+    errors = active(findings, "error")
+    warnings = active(findings, "warning")
+    assert not errors, "\n".join(f.render() for f in errors)
+    assert not warnings, "\n".join(f.render() for f in warnings)
+    # the shipped-baseline policy IS the empty baseline: nothing above
+    # relied on one (no baseline was passed), and no finding survived
+    # as suppressed without the required reason (parse_suppressions
+    # rejects reasonless ignores as NMFX000, which `active` would carry)
+
+
+def test_cli_entrypoint_exits_zero():
+    """``python -m nmfx.analysis nmfx/`` (the documented invocation)
+    exits 0 on the shipped tree. AST layer only: the jaxpr layer runs
+    in-process above; a second trace of every engine in a subprocess
+    would double the cost for no added coverage."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "nmfx.analysis", PKG, "--no-jaxpr"],
+        capture_output=True, text=True, timeout=240, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout
+
+
+def test_ruff_clean_if_available():
+    """Generic lint stays delegated to ruff (pyproject [tool.ruff]) so
+    nmfx-lint rules stay domain-focused; the container image may not
+    ship ruff, in which case this gate runs wherever it is installed."""
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        pytest.skip("ruff not installed in this environment")
+    proc = subprocess.run([ruff, "check", "nmfx", "tests", "bench.py"],
+                          capture_output=True, text=True, timeout=240,
+                          cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

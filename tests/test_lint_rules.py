@@ -1,0 +1,698 @@
+"""Per-rule nmfx-lint tests: every rule must flag its known-bad fixture
+and stay quiet on a minimal clean twin (ISSUE 3 acceptance: mutating a
+SolverConfig field out of the fingerprint, or adding an unsplit key
+reuse, turns the corresponding test red).
+
+The AST rules run over tmp-file fixtures through the real ``run()``
+driver (suppression machinery included); NMFX001 tests drive the pure
+``check_config_coverage`` with mutated field universes; the jaxpr-layer
+tests feed deliberately-bad traced functions to ``check_engine_jaxpr``.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from nmfx.analysis import active, run
+from nmfx.analysis.rules_config import check_config_coverage
+
+
+def _lint(tmp_path, source, rules, jaxpr=False, name="fixture.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return run([str(path)], jaxpr=jaxpr, rule_ids=rules)
+
+
+def _ids(findings):
+    return [f.rule_id for f in active(findings)]
+
+
+# ---------------------------------------------------------------- NMFX001
+
+def _universe(**overrides):
+    """A minimal healthy config universe; overrides inject the defect."""
+    base = dict(
+        solver_fields=frozenset({"algorithm", "tol_x", "restart_chunk",
+                                 "experimental"}),
+        experimental_fields=frozenset({"ragged"}),
+        fingerprint_covered=frozenset({"algorithm", "tol_x",
+                                       "experimental"}),
+        fingerprint_excluded=("restart_chunk",),
+        declared_non_numerics=("restart_chunk",),
+        exec_key_covered=frozenset({"algorithm", "tol_x", "restart_chunk",
+                                    "experimental"}),
+        hashable_configs={"SolverConfig": True, "ExperimentalConfig": True},
+    )
+    base.update(overrides)
+    return base
+
+
+def test_nmfx001_clean_universe_quiet():
+    assert check_config_coverage(**_universe()) == []
+
+
+def test_nmfx001_live_tree_clean():
+    """The REAL config/registry/exec_cache triple passes — the
+    introspection hooks agree with the dataclasses."""
+    from nmfx.analysis.rules_config import _live_universe
+
+    assert check_config_coverage(**_live_universe()) == []
+
+
+def test_nmfx001_field_dropped_from_fingerprint_fires():
+    """The acceptance-criteria mutation: a numerics-affecting field
+    (tol_x) that stops reaching the fingerprint is an error."""
+    problems = check_config_coverage(**_universe(
+        fingerprint_covered=frozenset({"algorithm", "experimental"})))
+    assert any("tol_x" in p and "fingerprint" in p for p in problems)
+
+
+def test_nmfx001_undeclared_exclusion_fires():
+    """Excluding a field without declaring it non-numerics is an error
+    even if someone ALSO forgot it in NON_NUMERICS_FIELDS."""
+    problems = check_config_coverage(**_universe(
+        fingerprint_excluded=("restart_chunk", "tol_x"),
+        fingerprint_covered=frozenset({"algorithm", "experimental"})))
+    assert any("tol_x" in p and "NON_NUMERICS_FIELDS" in p
+               for p in problems)
+
+
+def test_nmfx001_stale_declaration_fires():
+    problems = check_config_coverage(**_universe(
+        declared_non_numerics=("restart_chunk", "gone_field")))
+    assert any("gone_field" in p and "stale" in p for p in problems)
+
+
+def test_nmfx001_stale_resolved_declaration_fires():
+    """FINGERPRINT_SOLVER_RESOLVED naming a non-field is an error (the
+    constant is load-bearing: _fingerprint iterates it)."""
+    problems = check_config_coverage(**_universe(
+        fingerprint_resolved=("gone_field",)))
+    assert any("gone_field" in p and "RESOLVED" in p for p in problems)
+
+
+def test_nmfx001_exec_key_gap_fires():
+    """A field invisible to the exec-cache bucket key (e.g. added with
+    compare=False) shares one executable across different configs."""
+    problems = check_config_coverage(**_universe(
+        exec_key_covered=frozenset({"algorithm", "restart_chunk",
+                                    "experimental"})))
+    assert any("tol_x" in p and "bucket key" in p for p in problems)
+
+
+def test_nmfx001_unhashable_config_fires():
+    problems = check_config_coverage(**_universe(
+        hashable_configs={"SolverConfig": False,
+                          "ExperimentalConfig": True}))
+    assert any("SolverConfig" in p and "hashable" in p for p in problems)
+
+
+def test_nmfx001_noncompare_field_fires():
+    """A compare=False field — even on the NESTED ExperimentalConfig —
+    is invisible to dataclass hash/eq and so to the bucket key."""
+    problems = check_config_coverage(**_universe(
+        noncompare_fields={"ExperimentalConfig": ("sneaky",)}))
+    assert any("ExperimentalConfig.sneaky" in p
+               and "compare=False" in p for p in problems)
+
+
+# ---------------------------------------------------------------- NMFX002
+
+_ENV_BAD = """
+    import os
+    import jax
+
+    @jax.jit
+    def solve(x):
+        return x * _scale()
+
+    def _scale():
+        return float(os.environ.get("NMFX_SCALE", "1"))
+"""
+
+_ENV_CLEAN = """
+    import os
+    import jax
+
+    _SCALE = float(os.environ.get("NMFX_SCALE", "1"))  # import time: fine
+
+    @jax.jit
+    def solve(x):
+        return x * _SCALE
+"""
+
+
+def test_nmfx002_env_read_reachable_from_jit(tmp_path):
+    assert _ids(_lint(tmp_path, _ENV_BAD, ["NMFX002"])) == ["NMFX002"]
+
+
+def test_nmfx002_import_time_read_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _ENV_CLEAN, ["NMFX002"])) == []
+
+
+def test_nmfx002_aliased_spellings(tmp_path):
+    """`import os as _os` / `from os import getenv` / `from os import
+    environ` are the same hazard — resolution goes through the
+    module's imports, not literal text."""
+    for body in (
+        "import os as _os\n\n@jax.jit\ndef f(x):\n"
+        "    return x * float(_os.environ.get('S', '1'))\n",
+        "from os import getenv\n\n@jax.jit\ndef f(x):\n"
+        "    return x * float(getenv('S', '1'))\n",
+        "from os import environ\n\n@jax.jit\ndef f(x):\n"
+        "    return x * float(environ['S'])\n",
+    ):
+        src = "import jax\n" + body
+        assert _ids(_lint(tmp_path, src, ["NMFX002"])) == ["NMFX002"], src
+
+
+def test_suppression_in_string_literal_inert(tmp_path):
+    """Suppression syntax quoted inside a string literal neither
+    suppresses nor trips NMFX000 — only real comments count."""
+    src = _ENV_BAD + (
+        '    _DOC = "example:  # nmfx: ignore[NMFX002]"\n')
+    findings = _lint(tmp_path, src, ["NMFX002"])
+    ids = _ids(findings)
+    assert ids == ["NMFX002"]  # the env read; NO NMFX000 for the string
+
+
+def test_nmfx002_suppression_with_reason(tmp_path):
+    src = _ENV_BAD.replace(
+        'return float(os.environ.get("NMFX_SCALE", "1"))',
+        'return float(os.environ.get("NMFX_SCALE", "1"))'
+        '  # nmfx: ignore[NMFX002] -- fixture exercising suppressions')
+    findings = _lint(tmp_path, src, ["NMFX002"])
+    assert _ids(findings) == []
+    assert any(f.suppressed for f in findings)
+
+
+def test_nmfx000_suppression_without_reason_is_a_finding(tmp_path):
+    src = _ENV_BAD.replace(
+        'return float(os.environ.get("NMFX_SCALE", "1"))',
+        'return float(os.environ.get("NMFX_SCALE", "1"))'
+        '  # nmfx: ignore[NMFX002]')
+    findings = _lint(tmp_path, src, ["NMFX002"])
+    ids = _ids(findings)
+    assert "NMFX000" in ids  # the malformed comment itself
+    assert "NMFX002" in ids  # and it suppressed nothing
+
+
+# ---------------------------------------------------------------- NMFX003
+
+_DONATE_BAD = """
+    import jax
+
+    def serve(w, h):
+        step = jax.jit(_update, donate_argnums=(0,))
+        w2 = step(w)
+        return w + w2  # read of donated w
+"""
+
+_DONATE_CLEAN = """
+    import jax
+
+    def serve(w, h):
+        step = jax.jit(_update, donate_argnums=(0,))
+        w = step(w)  # rebind: the donated name dies with the old binding
+        return w + h
+"""
+
+_ALIAS_BAD = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def launch(kernel, wbuf, out_shape):
+        run = pl.pallas_call(kernel, out_shape=out_shape,
+                             input_output_aliases={0: 0})
+        result = run(wbuf)
+        checksum = wbuf.sum()  # wbuf is dead
+        return result, checksum
+"""
+
+
+def test_nmfx003_read_after_donate(tmp_path):
+    findings = _lint(tmp_path, _DONATE_BAD, ["NMFX003"])
+    assert _ids(findings) == ["NMFX003"]
+    assert "donated" in findings[0].message
+
+
+def test_nmfx003_rebind_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _DONATE_CLEAN, ["NMFX003"])) == []
+
+
+def test_nmfx003_pallas_alias(tmp_path):
+    findings = _lint(tmp_path, _ALIAS_BAD, ["NMFX003"])
+    assert _ids(findings) == ["NMFX003"]
+    assert "wbuf" in findings[0].message
+
+
+def test_nmfx003_donate_argnames(tmp_path):
+    """String donate_argnames track too: keyword args by name, and the
+    common positional idiom where the variable carries the parameter
+    name."""
+    src = """
+        import jax
+
+        def serve(w, h):
+            step = jax.jit(_update, donate_argnames=("w",))
+            w2 = step(w)
+            return w + w2  # read of donated w
+    """
+    findings = _lint(tmp_path, src, ["NMFX003"])
+    assert _ids(findings) == ["NMFX003"]
+    assert "'w'" in findings[0].message
+    kw = src.replace("step(w)", "step(w=w)")
+    assert _ids(_lint(tmp_path, kw, ["NMFX003"])) == ["NMFX003"]
+
+
+def test_nmfx003_compound_statement_order(tmp_path):
+    """Inside an if/for body, a read that textually PRECEDES the
+    donation is legal; a read after it still flags. The compound
+    statement's own subtree must not pre-process its children."""
+    clean = """
+        import jax
+
+        def serve(w, cond):
+            g = jax.jit(_update, donate_argnums=(0,))
+            if cond:
+                u = w + 1  # read BEFORE the donation: fine
+                r = g(w)
+                return r + u
+            return w
+    """
+    assert _ids(_lint(tmp_path, clean, ["NMFX003"])) == []
+
+    bad = """
+        import jax
+
+        def serve(w, cond):
+            g = jax.jit(_update, donate_argnums=(0,))
+            if cond:
+                r = g(w)
+                u = w + 1  # read AFTER the donation
+                return r + u
+            return w
+    """
+    findings = _lint(tmp_path, bad, ["NMFX003"])
+    assert _ids(findings) == ["NMFX003"]
+    assert "'w'" in findings[0].message
+
+
+def test_nmfx003_partial_factory(tmp_path):
+    """partial-spelled jit: the factory's function argument is NOT a
+    donated buffer, but a buffer passed through the factory-built
+    callable IS tracked (the real round-3 hazard shape)."""
+    src = """
+        import functools
+        import jax
+
+        def serve(w, h):
+            mk = functools.partial(jax.jit, donate_argnums=(0,))
+            step = mk(_update)
+            w2 = step(w)
+            return w + w2  # read of donated w
+    """
+    findings = _lint(tmp_path, src, ["NMFX003"])
+    assert len(_ids(findings)) == 1
+    assert "'w'" in findings[0].message  # w, not _update
+
+    clean = src.replace("w2 = step(w)\n            return w + w2"
+                        "  # read of donated w",
+                        "w = step(w)\n            return w + h")
+    assert _ids(_lint(tmp_path, clean, ["NMFX003"])) == []
+
+
+# ---------------------------------------------------------------- NMFX004
+
+_KEY_REUSE_BAD = """
+    import jax
+
+    def init_factors(key, m, n, k):
+        w0 = jax.random.uniform(key, (m, k))
+        h0 = jax.random.uniform(key, (k, n))  # same key: correlated
+        return w0, h0
+"""
+
+_KEY_REUSE_CLEAN = """
+    import jax
+
+    def init_factors(key, m, n, k):
+        kw, kh = jax.random.split(key)
+        w0 = jax.random.uniform(kw, (m, k))
+        h0 = jax.random.uniform(kh, (k, n))
+        return w0, h0
+"""
+
+_HOST_RNG_BAD = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def noisy_step(x):
+        return x + np.random.normal()  # frozen at trace time
+"""
+
+
+def test_nmfx004_key_reuse(tmp_path):
+    findings = _lint(tmp_path, _KEY_REUSE_BAD, ["NMFX004"])
+    assert _ids(findings) == ["NMFX004"]
+    assert "key" in findings[0].message
+
+
+def test_nmfx004_split_idiom_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _KEY_REUSE_CLEAN, ["NMFX004"])) == []
+
+
+def test_nmfx004_fold_in_threading_quiet(tmp_path):
+    """The canonical key-threading idiom rebinds the name between
+    consumptions — a store resurrects the key, so this is NOT reuse."""
+    src = """
+        import jax
+
+        def chain(key, m):
+            x = jax.random.uniform(key, (m,))
+            key = jax.random.fold_in(key, 1)
+            y = jax.random.normal(key, (m,))
+            return x + y
+    """
+    assert _ids(_lint(tmp_path, src, ["NMFX004"])) == []
+
+
+def test_nmfx004_loop_carried_reuse(tmp_path):
+    """A key consumed inside a loop without per-iteration rebinding
+    replays the identical draw every trip; the fold_in-per-iteration
+    idiom stays quiet."""
+    bad = """
+        import jax
+
+        def restarts(key, m, k, r):
+            out = []
+            for i in range(r):
+                out.append(jax.random.uniform(key, (m, k)))
+            return out
+    """
+    findings = _lint(tmp_path, bad, ["NMFX004"])
+    assert _ids(findings) == ["NMFX004"]
+    assert "loop" in findings[0].message
+
+    clean = """
+        import jax
+
+        def restarts(key, m, k, r):
+            out = []
+            for i in range(r):
+                ki = jax.random.fold_in(key, i)
+                out.append(jax.random.uniform(ki, (m, k)))
+            return out
+    """
+    assert _ids(_lint(tmp_path, clean, ["NMFX004"])) == []
+
+
+def test_nmfx004_nested_loop_single_finding(tmp_path):
+    """One defect, one finding: the inner loop's own pass owns a
+    consumption nested two loops deep."""
+    src = """
+        import jax
+
+        def grid(key, r):
+            for i in range(r):
+                for j in range(2):
+                    x = jax.random.uniform(key, (3,))
+            return x
+    """
+    findings = _lint(tmp_path, src, ["NMFX004"])
+    assert len(_ids(findings)) == 1
+
+
+def test_nmfx004_branchlocal_consumption_quiet(tmp_path):
+    """Sibling branches each consume the key once — no path consumes
+    it twice, so nothing flags."""
+    src = """
+        import jax
+
+        def pick(key, m, flip):
+            if flip:
+                return jax.random.uniform(key, (m,))
+            else:
+                return jax.random.normal(key, (m,))
+    """
+    assert _ids(_lint(tmp_path, src, ["NMFX004"])) == []
+
+
+def test_nmfx004_host_rng_in_traced(tmp_path):
+    findings = _lint(tmp_path, _HOST_RNG_BAD, ["NMFX004"])
+    assert _ids(findings) == ["NMFX004"]
+    assert "trace" in findings[0].message
+
+
+def test_nmfx004_host_rng_aliased_numpy(tmp_path):
+    """`import numpy as onp` / `from numpy import random as nprand`
+    are the same host-RNG hazard — resolved through the module's
+    imports like NMFX002 does for os."""
+    onp = _HOST_RNG_BAD.replace("import numpy as np",
+                                "import numpy as onp"
+                                ).replace("np.random.normal()",
+                                          "onp.random.normal()")
+    assert _ids(_lint(tmp_path, onp, ["NMFX004"])) == ["NMFX004"]
+    nprand = _HOST_RNG_BAD.replace(
+        "import numpy as np", "from numpy import random as nprand"
+    ).replace("np.random.normal()", "nprand.normal()")
+    assert _ids(_lint(tmp_path, nprand, ["NMFX004"])) == ["NMFX004"]
+
+
+def test_nmfx004_stdlib_random_not_a_key(tmp_path):
+    """stdlib `random.shuffle(data)` twice on one sequence is NOT key
+    reuse — only jax.random consumption counts (base resolved through
+    the module's imports)."""
+    src = """
+        import random
+
+        def shuffle_twice(data):
+            random.shuffle(data)
+            picked = random.sample(data, 3)
+            return picked
+    """
+    assert _ids(_lint(tmp_path, src, ["NMFX004"])) == []
+
+
+def test_nmfx004_from_jax_import_random_is_keys(tmp_path):
+    """`from jax import random; random.uniform(key...)` twice IS key
+    reuse — and is not misflagged as host RNG."""
+    src = """
+        from jax import random
+
+        def init(key, m, k):
+            w = random.uniform(key, (m, k))
+            h = random.uniform(key, (k, m))
+            return w, h
+    """
+    findings = _lint(tmp_path, src, ["NMFX004"])
+    assert _ids(findings) == ["NMFX004"]
+    assert "key" in findings[0].message and "consumed" in findings[0].message
+
+
+# ---------------------------------------------------------------- NMFX005
+
+_SYNC_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def residual(a, w, h):
+        r = jnp.linalg.norm(a - w @ h)
+        return float(r)  # host sync on a traced value
+"""
+
+_SYNC_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def residual(a, w, h):
+        n_scale = float(a.shape[0] * a.shape[1])  # static host math: fine
+        return jnp.linalg.norm(a - w @ h) / n_scale
+"""
+
+
+def test_nmfx005_host_sync_in_traced(tmp_path):
+    findings = _lint(tmp_path, _SYNC_BAD, ["NMFX005"])
+    assert _ids(findings) == ["NMFX005"]
+
+
+def test_nmfx005_static_shape_math_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _SYNC_CLEAN, ["NMFX005"])) == []
+
+
+def test_nmfx005_item_call(tmp_path):
+    src = _SYNC_BAD.replace("return float(r)", "return r.item()")
+    findings = _lint(tmp_path, src, ["NMFX005"])
+    assert _ids(findings) == ["NMFX005"]
+    assert ".item()" in findings[0].message
+
+
+# ----------------------------------------------------------- jaxpr layer
+
+def test_jaxpr_f64_leak_detected():
+    """An np.float64 constant leaking into f32 math is invisible under
+    the normal session but explodes to f64 under the x64 parity config —
+    NMFX101's check sees the convert/aval in the jaxpr."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nmfx.analysis.jaxpr_rules import check_engine_jaxpr
+
+    try:
+        ctx = jax.experimental.enable_x64(True)
+    except AttributeError:
+        pytest.skip("jax.experimental.enable_x64 unavailable")
+    with ctx:
+        bad = jax.make_jaxpr(
+            lambda x: x * np.float64(2.0))(
+                jax.ShapeDtypeStruct((4,), jnp.float32))
+        clean = jax.make_jaxpr(
+            lambda x: x * 2.0)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert any("float64" in p for p in check_engine_jaxpr("bad", bad))
+    assert check_engine_jaxpr("clean", clean) == []
+
+
+def test_jaxpr_device_put_in_loop_detected():
+    import jax
+    import jax.numpy as jnp
+
+    from nmfx.analysis.jaxpr_rules import check_engine_jaxpr
+
+    def bad(x):
+        def body(c):
+            return jax.device_put(c) + 1.0
+
+        return jax.lax.while_loop(lambda c: c[0] < 3.0, body, x)
+
+    def clean(x):
+        return jax.lax.while_loop(lambda c: c[0] < 3.0,
+                                  lambda c: c + 1.0, x)
+
+    jx_bad = jax.make_jaxpr(bad)(jax.ShapeDtypeStruct((2,), jnp.float32))
+    jx_clean = jax.make_jaxpr(clean)(
+        jax.ShapeDtypeStruct((2,), jnp.float32))
+    assert any("device_put" in p
+               for p in check_engine_jaxpr("bad", jx_bad))
+    assert check_engine_jaxpr("clean", jx_clean) == []
+
+
+def test_jaxpr_registered_engines_trace_clean():
+    """Every registered engine traces abstractly under the x64 parity
+    config with no f64 leak and no loop-body device_put — the static
+    form of the x64-parity/transfer-overlap contracts (this is the test
+    that caught the StopReason-IntEnum int64 carry poisoning)."""
+    from nmfx.analysis.jaxpr_rules import run_jaxpr_checks
+
+    assert run_jaxpr_checks() == []
+
+
+# ----------------------------------------------------------------- CLI
+
+def test_cli_json_output(tmp_path):
+    path = tmp_path / "bad.py"
+    path.write_text(textwrap.dedent(_ENV_BAD))
+    proc = subprocess.run(
+        [sys.executable, "-m", "nmfx.analysis", str(path), "--json",
+         "--no-jaxpr", "--rules", "NMFX002"],
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    assert doc["summary"]["errors"] == 1
+    assert doc["findings"][0]["rule_id"] == "NMFX002"
+
+
+def test_nmfx102_rule_selectable():
+    """``--rules NMFX102`` must run the device_put check on its own (the
+    jaxpr results are shared between NMFX101/NMFX102 but each rule is
+    registered and filterable separately)."""
+    from nmfx.analysis import RULES
+
+    assert "NMFX101" in RULES and "NMFX102" in RULES
+    from nmfx.analysis.ast_scan import Project
+    from nmfx.analysis.jaxpr_rules import _project_jaxpr_results
+
+    project = Project([])
+    project.jaxpr_checks_enabled = True
+    project._jaxpr_results = [
+        ("fake", "NMFX102", "fake: device_put inside a while body"),
+        ("fake", "NMFX101", "fake: f64 leak"),
+    ]
+    f102 = list(RULES["NMFX102"].check(project))
+    f101 = list(RULES["NMFX101"].check(project))
+    assert [f.rule_id for f in f102] == ["NMFX102"]
+    assert [f.rule_id for f in f101] == ["NMFX101"]
+    assert _project_jaxpr_results(project) is project._jaxpr_results
+
+
+def test_cli_nonexistent_path_fails(tmp_path):
+    """A typo'd lint target must fail the run (exit 2), never report
+    '0 errors' while linting nothing."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "nmfx.analysis",
+         str(tmp_path / "no_such_dir"), "--no-jaxpr"],
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 2
+    assert "no_such_dir" in proc.stderr
+
+
+def test_baseline_path_normalization(tmp_path):
+    """A baseline recorded with one path spelling applies to a run
+    invoked with another (relative vs absolute), same cwd."""
+    import os
+
+    path = tmp_path / "bad.py"
+    path.write_text(textwrap.dedent(_ENV_BAD))
+    findings = run([str(path)], jaxpr=False, rule_ids=["NMFX002"])
+    baseline = tmp_path / "baseline.json"
+    rel = os.path.relpath(str(path))
+    baseline.write_text(json.dumps(
+        [{"file": rel, "rule": f.rule_id, "line": f.line}
+         for f in active(findings)]))
+    rebaselined = run([str(path)], baseline=str(baseline), jaxpr=False,
+                      rule_ids=["NMFX002"])
+    assert _ids(rebaselined) == []
+
+
+def test_cli_baseline_tolerates(tmp_path):
+    path = tmp_path / "bad.py"
+    path.write_text(textwrap.dedent(_ENV_BAD))
+    findings = run([str(path)], jaxpr=False, rule_ids=["NMFX002"])
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        [{"file": f.file, "rule": f.rule_id, "line": f.line}
+         for f in active(findings)]))
+    rebaselined = run([str(path)], baseline=str(baseline), jaxpr=False,
+                      rule_ids=["NMFX002"])
+    assert _ids(rebaselined) == []
+    assert any(f.baselined for f in rebaselined)
+
+
+def test_cli_write_baseline_refresh_keeps_records(tmp_path):
+    """--write-baseline together with --baseline (the refresh idiom)
+    must re-record tolerated findings, not truncate to []."""
+    path = tmp_path / "bad.py"
+    path.write_text(textwrap.dedent(_ENV_BAD))
+    baseline = tmp_path / "baseline.json"
+    env = {**__import__("os").environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "nmfx.analysis", str(path), "--no-jaxpr",
+         "--rules", "NMFX002", "--write-baseline", str(baseline)],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0
+    first = json.loads(baseline.read_text())
+    assert len(first) == 1
+    proc = subprocess.run(
+        [sys.executable, "-m", "nmfx.analysis", str(path), "--no-jaxpr",
+         "--rules", "NMFX002", "--baseline", str(baseline),
+         "--write-baseline", str(baseline)],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0
+    assert json.loads(baseline.read_text()) == first

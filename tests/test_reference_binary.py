@@ -155,12 +155,15 @@ def test_reference_binary_through_grid_engines(engine):
     cfg = SolverConfig(algorithm="mu", max_iter=maxiter, dtype="float64",
                        use_tol_checks=False, class_flip_tol=0.0,
                        backend=backend)
+    job_ks = tuple(k for k, _r in jobs)
     if engine == "grid":
         from nmfx.ops.grid_mu import mu_grid
-        res = mu_grid(a, jnp.asarray(w0), jnp.asarray(h0), cfg)
+        res = mu_grid(a, jnp.asarray(w0), jnp.asarray(h0), cfg,
+                      job_ks=job_ks)
     else:
         from nmfx.ops.sched_mu import mu_sched
-        res = mu_sched(a, jnp.asarray(w0), jnp.asarray(h0), cfg, slots=7)
+        res = mu_sched(a, jnp.asarray(w0), jnp.asarray(h0), cfg, slots=7,
+                       job_ks=job_ks)
     assert np.all(np.asarray(res.iterations) == maxiter)
 
     h = np.asarray(res.h)

@@ -45,8 +45,11 @@ def test_schedule_free_results(jobs, slots):
     to the fixed batch), and pools forcing multi-generation slot reuse."""
     a, w0, h0 = jobs
     cfg = SolverConfig(max_iter=600)
-    ref = mu_grid(a, w0, h0, cfg)
-    got = mu_sched(a, w0, h0, cfg, slots=slots)
+    # job_ks: every caller that knows its lane composition passes the
+    # exact per-lane ranks (ADVICE.md round 5 / ISSUE 3 — the inferred
+    # mask is the fallback for callers that genuinely don't)
+    ref = mu_grid(a, w0, h0, cfg, job_ks=JOB_KS)
+    got = mu_sched(a, w0, h0, cfg, slots=slots, job_ks=JOB_KS)
     np.testing.assert_array_equal(np.asarray(ref.iterations),
                                   np.asarray(got.iterations))
     np.testing.assert_array_equal(np.asarray(ref.stop_reason),
@@ -340,23 +343,47 @@ def test_job_ks_length_validation(jobs):
         pad_live_mask(w0, h0, JOB_KS[:3])
 
 
-def test_fault_inject_env_banner(jobs, monkeypatch, capsys):
-    """An inherited NMFX_FAULT_INJECT_STALE_RELOAD must announce itself
-    loudly — the hook corrupts results by design, and a silent inherited
-    env var would poison a production run (ADVICE.md round 5)."""
+def test_fault_inject_requires_explicit_optin(jobs, monkeypatch, capsys):
+    """The stale-reload fault injection arms ONLY through the explicit
+    ``enable_stale_reload_fault()`` call: an inherited
+    NMFX_FAULT_INJECT_STALE_RELOAD env var alone is inert in library
+    code (but announces its inertness at import), so a test-harness
+    environment can no longer corrupt a production run silently
+    (ADVICE.md round 5; ISSUE 3 satellite; lint rule NMFX002)."""
     from nmfx.ops import sched_mu
 
+    # env var alone: inert — the library never reads it at trace time
     monkeypatch.setenv("NMFX_FAULT_INJECT_STALE_RELOAD", "0.5")
-    monkeypatch.setattr(sched_mu, "_stale_reload_warned", False)
+    monkeypatch.setitem(sched_mu._fault_state, "fraction", 0.0)
+    monkeypatch.setitem(sched_mu._fault_state, "announced", False)
+    assert sched_mu._stale_reload_fraction() == 0.0
+    # the import-time notice names the explicit opt-in it now requires
+    sched_mu._warn_inert_env_hook()
+    err = capsys.readouterr().err
+    assert "IGNORED" in err
+    assert "enable_stale_reload_fault" in err
+    # explicit opt-in: arms, and announces loudly exactly once
+    sched_mu.enable_stale_reload_fault(0.5)
     assert sched_mu._stale_reload_fraction() == 0.5
     err = capsys.readouterr().err
-    assert "NMFX_FAULT_INJECT_STALE_RELOAD" in err
+    assert "ARMED" in err
     assert "INVALID" in err
-    # once per process, not once per trace
-    sched_mu._stale_reload_fraction()
-    assert "NMFX_FAULT_INJECT_STALE_RELOAD" not in capsys.readouterr().err
-    # unset: no banner, identity behavior
+    sched_mu.enable_stale_reload_fault(0.5)
+    assert "ARMED" not in capsys.readouterr().err
+    # and the armed state is what the reload path consumes: the mask
+    # now drops factor writes (identity when disarmed)
+    load = jnp.ones((8,), bool)
+    gather = jnp.arange(8, dtype=jnp.int32)
+    masked = np.asarray(sched_mu._stale_load_mask(load, gather))
+    assert masked.sum() < 8  # some reloads deliberately dropped
+    monkeypatch.setitem(sched_mu._fault_state, "fraction", 0.0)
+    np.testing.assert_array_equal(
+        np.asarray(sched_mu._stale_load_mask(load, gather)),
+        np.asarray(load))
+    # out-of-range fractions are rejected
+    with pytest.raises(ValueError, match="fraction"):
+        sched_mu.enable_stale_reload_fault(1.5)
+    # unset env: the import-time notice stays silent
     monkeypatch.delenv("NMFX_FAULT_INJECT_STALE_RELOAD")
-    monkeypatch.setattr(sched_mu, "_stale_reload_warned", False)
-    assert sched_mu._stale_reload_fraction() == 0.0
+    sched_mu._warn_inert_env_hook()
     assert "NMFX_FAULT_INJECT" not in capsys.readouterr().err
