@@ -31,7 +31,8 @@ import os
 import jax
 from jax import lax
 
-__all__ = ["shard_map", "pcast", "force_cpu_devices"]
+__all__ = ["shard_map", "pcast", "force_cpu_devices",
+           "serialize_compiled", "deserialize_compiled"]
 
 
 # The sweep's key-chain contracts — restart r's key is independent of mesh
@@ -79,6 +80,43 @@ def distributed_is_initialized() -> bool:
         from jax._src import distributed as _dist
 
         return _dist.global_state.client is not None
+
+
+def serialize_compiled(compiled) -> bytes:
+    """One opaque blob for a ``jax.stages.Compiled`` — the PJRT-serialized
+    executable plus the pickled arg/result pytree structure
+    (``jax.experimental.serialize_executable`` returns the trees separately
+    because pytrees aren't self-serializing; bundling them here keeps the
+    on-disk format a single atomic artifact). Raises ``RuntimeError`` when
+    this jax/backend cannot serialize executables — callers degrade to
+    plain recompilation."""
+    import pickle
+
+    try:
+        from jax.experimental.serialize_executable import serialize
+    except ImportError as e:  # pragma: no cover - every supported jax has it
+        raise RuntimeError(
+            "this jax has no jax.experimental.serialize_executable") from e
+    try:
+        payload, in_tree, out_tree = serialize(compiled)
+    except (ValueError, RuntimeError) as e:
+        # e.g. "Compilation does not support serialization" on backends
+        # whose PJRT client lacks executable serialization
+        raise RuntimeError(
+            f"executable serialization unsupported here: {e}") from e
+    return pickle.dumps((payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_compiled(blob: bytes):
+    """Inverse of :func:`serialize_compiled` — a loaded, callable
+    ``jax.stages.Compiled`` on the current default backend."""
+    import pickle
+
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    payload, in_tree, out_tree = pickle.loads(blob)
+    return deserialize_and_load(payload, in_tree, out_tree)
 
 
 def force_cpu_devices(n: int) -> None:

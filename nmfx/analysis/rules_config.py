@@ -18,14 +18,21 @@ introspection hooks added for it — no hash-body parsing):
   — what the fingerprint covers;
 * ``SolverConfig.NON_NUMERICS_FIELDS`` — which fields are DECLARED
   execution-strategy-only (the only legitimate exclusions);
-* ``exec_cache.solver_key_fields()`` — what the bucket key covers.
+* ``exec_cache.solver_key_fields()`` — what the in-memory bucket key
+  covers (dataclass hash/eq → ``field.compare``);
+* ``exec_cache.persist_key_fields()`` — what the PERSISTENT disk key
+  covers (dataclass repr → ``field.repr``): a field added with
+  ``repr=False`` stays in the in-memory key but vanishes from the disk
+  key, so two configs differing only in it would share one on-disk
+  entry and a fresh process would deserialize the wrong executable.
 
 Every field must be fingerprint-covered or declared non-numerics; every
 exclusion must be declared; the declaration must not go stale; both
 config dataclasses must stay frozen-with-hash (the bucket key and jit
-static-argument machinery depend on it). The check itself is a pure
-function over field sets (``check_config_coverage``) so the per-rule
-tests can inject a mutated universe and watch the rule fire.
+static-argument machinery depend on it); nothing may be missing from
+either exec-cache key. The check itself is a pure function over field
+sets (``check_config_coverage``) so the per-rule tests can inject a
+mutated universe and watch the rule fire.
 """
 
 from __future__ import annotations
@@ -57,6 +64,8 @@ def check_config_coverage(
     hashable_configs: "dict[str, bool]",
     fingerprint_resolved: "tuple[str, ...]" = (),
     noncompare_fields: "dict[str, tuple[str, ...]]" = {},
+    persist_key_covered: "frozenset[str] | None" = None,
+    nonrepr_fields: "dict[str, tuple[str, ...]]" = {},
 ) -> "list[str]":
     """The pure contract check; returns human-readable problems.
 
@@ -105,6 +114,17 @@ def check_config_coverage(
             f"SolverConfig.{name} is not covered by the exec-cache "
             "bucket key (exec_cache.solver_key_fields) — two configs "
             "differing in it would share one compiled executable")
+    # 4b. the PERSISTENT disk key must cover the same universe: it is
+    #     derived from the key's repr (field.repr), so a repr=False
+    #     field survives the in-memory key but drops out of the disk
+    #     key — a fresh process would deserialize the wrong executable
+    if persist_key_covered is not None:
+        for name in sorted(solver_fields - persist_key_covered):
+            problems.append(
+                f"SolverConfig.{name} is not covered by the persistent "
+                "exec-cache disk key (exec_cache.persist_key_fields) — "
+                "disk entries written under different values of it would "
+                "be served interchangeably across processes")
     # 5. the nested experimental knobs ride along via the
     #    'experimental' field; it must itself be covered on both sides
     if experimental_fields and "experimental" not in fingerprint_covered:
@@ -134,6 +154,21 @@ def check_config_coverage(
                 "to the exec-cache bucket key and jit static-argument "
                 "caching; two configs differing in it would share one "
                 "compiled executable")
+    # 8. ...and none may opt out of REPR either: the persistent disk key
+    #    is the key's repr, and dataclass __repr__ elides repr=False
+    #    fields — including fields of the NESTED ExperimentalConfig,
+    #    which the SolverConfig-level persist_key_fields hook cannot
+    #    see. Such a field would stay in the in-memory key (hash/eq)
+    #    but vanish from the disk key, so a fresh process would
+    #    deserialize the wrong executable.
+    for cls_name, names in nonrepr_fields.items():
+        for name in names:
+            problems.append(
+                f"{cls_name}.{name} is declared repr=False — it is "
+                "invisible to the repr-derived persistent exec-cache "
+                "disk key (exec_cache.persist_key_fields); disk entries "
+                "written under different values of it would be served "
+                "interchangeably across processes")
     return problems
 
 
@@ -156,6 +191,7 @@ def _live_universe():
         fingerprint_resolved=tuple(registry.FINGERPRINT_SOLVER_RESOLVED),
         declared_non_numerics=tuple(SolverConfig.NON_NUMERICS_FIELDS),
         exec_key_covered=exec_cache.solver_key_fields(),
+        persist_key_covered=exec_cache.persist_key_fields(),
         hashable_configs={"SolverConfig": _hashable(SolverConfig),
                           "ExperimentalConfig": _hashable(
                               ExperimentalConfig)},
@@ -163,6 +199,11 @@ def _live_universe():
             cls.__name__: tuple(f.name
                                 for f in dataclasses.fields(cls)
                                 if not f.compare)
+            for cls in (SolverConfig, ExperimentalConfig)},
+        nonrepr_fields={
+            cls.__name__: tuple(f.name
+                                for f in dataclasses.fields(cls)
+                                if not f.repr)
             for cls in (SolverConfig, ExperimentalConfig)},
     )
 

@@ -338,8 +338,14 @@ def nmfconsensus(
     ``exec_cache``: an ``nmfx.exec_cache.ExecCache`` serving this and
     future calls — repeat requests whose dataset shapes land in an
     already-compiled bucket skip the sweep's trace+compile entirely
-    (results are shape-exact: the bucket only pads the execution).
-    Ignored for non-cacheable configurations and checkpointed runs; see
+    (results are shape-exact: the bucket only pads the execution). With
+    ``ExecCacheConfig(cache_dir=...)`` the compiled executables persist
+    on disk, so a FRESH process deserializes instead of recompiling
+    (cold start becomes deserialize-and-dispatch), and
+    ``ExecCache.warm(shapes, ..., background=True)`` pre-compiles
+    buckets off-thread — a request arriving mid-warm waits on the
+    in-flight compile rather than duplicating it. Ignored for
+    non-cacheable configurations and checkpointed runs; see
     ``docs/serving.md``.
     """
     if rank_selection not in ("host", "device"):

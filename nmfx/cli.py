@@ -216,6 +216,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "and batchable at startup instead of paying the "
                         "20-odd-second sweep compile on first traffic; "
                         "implies --exec-cache")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="persistent EXECUTABLE cache for the serving "
+                        "layer: compiled sweep executables are serialized "
+                        "here and a fresh process DESERIALIZES instead of "
+                        "re-tracing and re-compiling — cold start becomes "
+                        "deserialize-and-dispatch (docs/serving.md 'Cold "
+                        "start'). Implies --exec-cache; independent of "
+                        "--compile-cache (which caches XLA's intermediate "
+                        "compilation products, not loaded executables)")
+    p.add_argument("--warm-cache", action="store_true",
+                   help="run the --warm-shapes warmup in the BACKGROUND "
+                        "(compiles overlap dataset loading and run setup; "
+                        "the sweep waits only for its own bucket's "
+                        "executable, de-duplicated against the in-flight "
+                        "warm). Requires --warm-shapes; pairs with "
+                        "--cache-dir so warmed executables persist for "
+                        "future processes")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "
@@ -333,8 +350,12 @@ def main(argv: list[str] | None = None) -> int:
                             restart_chunk=args.restart_chunk,
                             check_block=args.check_block)
     exec_cache = None
-    if args.exec_cache or args.warm_shapes:
-        from nmfx.config import ConsensusConfig, InitConfig
+    warm_task = None
+    if args.warm_cache and not args.warm_shapes:
+        parser.error("--warm-cache backgrounds the --warm-shapes warmup; "
+                     "pass --warm-shapes with the shapes to pre-compile")
+    if args.exec_cache or args.warm_shapes or args.cache_dir:
+        from nmfx.config import ConsensusConfig, ExecCacheConfig, InitConfig
         from nmfx.exec_cache import ExecCache
         from nmfx.sweep import default_mesh
 
@@ -349,7 +370,9 @@ def main(argv: list[str] | None = None) -> int:
                          "--checkpoint-dir (checkpointed sweeps resume "
                          "through the registry path, which bypasses the "
                          "executable cache)")
-        exec_cache = ExecCache()
+        ecfg = (ExecCacheConfig(cache_dir=args.cache_dir)
+                if args.cache_dir else ExecCacheConfig())
+        exec_cache = ExecCache(ecfg)
         if args.warm_shapes:
             cache_mesh = None if args.no_mesh else default_mesh()
             # must mirror nmfconsensus' own ConsensusConfig construction
@@ -366,14 +389,21 @@ def main(argv: list[str] | None = None) -> int:
                     "--warm-shapes needs an exec-cacheable configuration "
                     "(an algorithm/backend the whole-grid scheduler runs "
                     "— see ExecCache.cacheable)")
-            for rec in exec_cache.warm(args.warm_shapes, warm_ccfg,
-                                       run_scfg,
-                                       InitConfig(method=args.init),
-                                       cache_mesh):
-                print(f"nmfx: warmed bucket {rec['bucket']} for shape "
-                      f"{rec['shape']} in {rec['compile_s']}s"
-                      + (" (already warm)" if rec["cache_hit"] else ""),
-                      file=sys.stderr)
+            if args.warm_cache:
+                # background: compiles overlap dataset loading; the run's
+                # own bucket de-duplicates against the in-flight warm
+                warm_task = exec_cache.warm(
+                    args.warm_shapes, warm_ccfg, run_scfg,
+                    InitConfig(method=args.init), cache_mesh,
+                    background=True)
+                print(f"nmfx: warming {len(args.warm_shapes)} shape(s) "
+                      "in the background", file=sys.stderr)
+            else:
+                for rec in exec_cache.warm(args.warm_shapes, warm_ccfg,
+                                           run_scfg,
+                                           InitConfig(method=args.init),
+                                           cache_mesh):
+                    print(_warm_line(rec), file=sys.stderr)
     with profiler:
         result = nmfconsensus(
             args.dataset,
@@ -396,12 +426,36 @@ def main(argv: list[str] | None = None) -> int:
             profiler=profiler,
             exec_cache=exec_cache,
         )
+    if warm_task is not None and args.cache_dir:
+        # with a persistent cache dir, joining is worth the wait: every
+        # warmed bucket lands on disk for FUTURE processes. Without one
+        # the daemon warm dies with the process (nothing to keep). The
+        # warm is best-effort — a failure must not discard the completed
+        # run's results below
+        try:
+            for rec in warm_task.result():
+                print(_warm_line(rec), file=sys.stderr)
+        except Exception as e:
+            print(f"nmfx: background warmup failed ({e}); the run "
+                  "itself is unaffected", file=sys.stderr)
     if args.save_result:
         result.save(args.save_result)
     print(result.summary())
     if args.profile:
         print(profiler.report())
     return 0
+
+
+def _warm_line(rec: dict) -> str:
+    # for disk-served entries report the seconds THIS process paid
+    # (deserialize), not the original compile cost stored in the record
+    if rec["cache_hit"] and rec.get("source") == "disk":
+        return (f"nmfx: warmed bucket {rec['bucket']} for shape "
+                f"{rec['shape']} in {rec['deserialize_s']}s "
+                "(deserialized from disk cache)")
+    note = " (already warm)" if rec["cache_hit"] else ""
+    return (f"nmfx: warmed bucket {rec['bucket']} for shape "
+            f"{rec['shape']} in {rec['compile_s']}s{note}")
 
 
 if __name__ == "__main__":

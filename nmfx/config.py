@@ -468,7 +468,11 @@ class ExecCacheConfig:
     growth_steps: int = 8
     #: LRU bound on LIVE compiled executables (each holds device buffers
     #: for its constants and its compiled program — evicting drops the
-    #: reference so a re-request recompiles). The NNDSVD route's small
+    #: reference so a re-request recompiles, or re-deserializes under
+    #: ``cache_dir``). The ``pipeline_ranks`` mode raises the EFFECTIVE
+    #: bound to the largest request's rank count, so one sweep's
+    #: per-rank executables can never thrash the LRU against themselves
+    #: (ks=2..10 is 9 co-resident entries). The NNDSVD route's small
     #: per-true-shape lane-init jits live in a separate module-level
     #: pool (``sweep.bucketed_lane_init_fn``, lru_cache(128)) outside
     #: this bound — orders of magnitude smaller than a sweep executable
@@ -478,6 +482,36 @@ class ExecCacheConfig:
     #: (they are rebuilt per request, so aliasing them away is safe;
     #: applied only on backends where XLA honors donation)
     donate_inits: bool = True
+    #: persistent executable cache directory (None = in-memory only).
+    #: Compiled executables are SERIALIZED here (atomic tmp+rename
+    #: writes), keyed by the bucket key extended with the device kind and
+    #: jax/jaxlib/platform versions, so a FRESH process deserializes and
+    #: dispatches instead of re-tracing and re-compiling — the cold-start
+    #: path collapses to a disk read. Corrupt or version-mismatched
+    #: entries fall back to a clean recompile with one warning. See
+    #: docs/serving.md "Cold start".
+    cache_dir: "str | None" = None
+    #: byte cap on the disk cache: once the directory's entries exceed
+    #: it, oldest-mtime entries are evicted (every disk hit touches its
+    #: entry's mtime — an mtime-LRU). Independent of the in-memory LRU:
+    #: evicting a live executable from memory NEVER deletes its disk
+    #: entry, and re-admission from disk is a (persist) hit, not a
+    #: recompile.
+    max_disk_bytes: int = 2 << 30  # 2 GiB
+    #: serve each rank through its OWN bucketed executable: on a cold
+    #: start the per-rank executables compile concurrently in a thread
+    #: pool (XLA compilation releases the GIL) and dispatch
+    #: lowest-rank-first, so the k=2 solve is already running on device
+    #: while higher ranks are still compiling. Each rank's results are
+    #: exactly those of a single-rank grid sweep (ks=(k,)); the grid
+    #: COMPOSITION differs from the whole-grid default, so cross-mode
+    #: results agree only to float tolerance — which is why this is an
+    #: opt-in rather than the default cold path.
+    pipeline_ranks: bool = False
+    #: thread-pool width for parallel compilation (ExecCache.warm and the
+    #: pipeline_ranks cold path); 0 = auto (one thread per pending
+    #: executable, capped at the CPU count)
+    compile_workers: int = 0
 
     def __post_init__(self):
         if self.m_quantum < 1 or self.n_quantum < 1:
@@ -486,6 +520,10 @@ class ExecCacheConfig:
             raise ValueError("growth_steps must be >= 1")
         if self.max_entries < 1:
             raise ValueError("max_entries must be >= 1")
+        if self.max_disk_bytes < 1:
+            raise ValueError("max_disk_bytes must be >= 1")
+        if self.compile_workers < 0:
+            raise ValueError("compile_workers must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)

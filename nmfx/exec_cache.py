@@ -1,4 +1,5 @@
-"""Executable-reuse serving layer: shape-bucketed AOT sweep cache plus a
+"""Executable-reuse serving layer: shape-bucketed AOT sweep cache, a
+persistent on-disk executable store, a pipelined compile pool, and a
 double-buffered host↔device pipeline.
 
 The reference amortizes nothing — every run re-spawns its R workers and
@@ -7,10 +8,11 @@ inherited an analogous cold-start tax at a worse exchange rate: XLA keys
 compiled executables by EXACT input shape, so a service sweeping datasets
 of nearby-but-different shapes pays the full trace+compile — measured
 22.3 s against a 1.85 s warm solve at the north star (BENCH_r05) — on
-*every* new shape. Both MPI-FAUN (arxiv 1609.09154) and the distributed
-out-of-memory NMF line (arxiv 2202.09518) identify data movement, not
-FLOPs, as the binding constraint for alternating-update NMF at scale;
-this module attacks both ends:
+*every* new shape, and every FRESH PROCESS pays it again even for shapes
+it has served before. Both MPI-FAUN (arxiv 1609.09154) and the
+distributed out-of-memory NMF line (arxiv 2202.09518) treat setup
+amortization across many factorizations as a first-class cost at scale;
+this module attacks shape churn, process churn, and compile serialism:
 
 * **Shape buckets** (``ExecCacheConfig``): incoming ``(m, n)`` rounds up
   to a coarse lattice (quantum-aligned steps that double as the
@@ -28,6 +30,27 @@ this module attacks both ends:
   (``compile.cache_miss`` phase; hits mark ``compile.cache_hit``).
   Entries are LRU-bounded (``max_entries``) — each live executable pins
   device memory for its program.
+* **Disk persistence** (``ExecCacheConfig.cache_dir``): compiled
+  executables are serialized (``nmfx._compat.serialize_compiled``) into
+  a cache directory keyed by the bucket key extended with the device
+  kind and jax/jaxlib/platform versions, with atomic tmp+rename writes
+  (concurrent writers race safely — readers never observe a partial
+  file) and a byte-capped mtime-LRU eviction INDEPENDENT of the
+  in-memory LRU (a memory eviction never deletes the disk entry;
+  re-admission from disk is a hit). A fresh process's cold start
+  becomes deserialize-and-dispatch instead of trace-and-compile
+  (``compile.persist_hit``/``compile.deserialize`` phases); corrupt or
+  environment-mismatched entries fall back to a clean recompile with
+  ONE warning, never a crash.
+* **Pipelined compilation**: :meth:`ExecCache.warm` compiles multiple
+  pending executables concurrently in a thread pool (XLA compilation
+  releases the GIL) and, with ``background=True``, off the caller's
+  thread entirely — a request that arrives mid-warm WAITS on the
+  in-flight compile instead of duplicating it (the in-flight future
+  registry). Under ``ExecCacheConfig.pipeline_ranks`` a cold
+  :meth:`run_sweep` builds per-rank executables the same way and
+  dispatches lowest-rank-first, so the k=2 solve runs on device while
+  higher ranks are still compiling (per-rank ``compile.k=<k>`` spans).
 * **Transfer overlap**: :meth:`ExecCache.prefetch` starts the next
   request's host→device transfer while the current sweep runs (the
   transfer also overlaps the request's own lane-init compute, which for
@@ -46,7 +69,9 @@ hash — the solver-config fingerprint, which since round 6 includes the
 the bucket key versions on the new cadence/experimental fields
 automatically — two configs differing only in cadence compile and cache
 separately), label rule, keep_factors, the scheduler knobs, the mesh,
-and the jax version + backend platform.
+and the jax version + backend platform. The DISK key additionally
+covers the device kind and the jaxlib/PJRT platform versions (a cache
+directory shared across an upgrade simply misses cleanly and re-fills).
 InitConfig is deliberately NOT in the key: initialization runs outside
 the executable, which is what makes one bucket executable serve every
 init scheme and true shape.
@@ -54,8 +79,18 @@ init scheme and true shape.
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+import hashlib
+import os
+import pickle
+import queue
+import tempfile
+import threading
 import time
+import warnings
 from collections import OrderedDict
+from concurrent.futures import Future
 from typing import NamedTuple, Sequence
 
 import jax
@@ -69,8 +104,42 @@ from nmfx.sweep import (KSweepOutput, _pad_count,
                         _build_bucketed_sweep_fn, bucketed_lane_init_fn,
                         grid_axes_active, grid_exec_ok)
 
-__all__ = ["ExecCache", "PlacedMatrix", "start_host_fetch", "bucket_dim",
-           "solver_key_fields"]
+__all__ = ["ExecCache", "PlacedMatrix", "WarmTask", "start_host_fetch",
+           "bucket_dim", "solver_key_fields", "persist_key_fields",
+           "compile_count"]
+
+#: on-disk record format version; bumped on any layout change so old
+#: entries fail the format check (one warning, clean recompile) instead
+#: of deserializing garbage
+_DISK_FORMAT = 1
+#: suffix of persisted executable entries (the eviction scan and the
+#: tests key on it; atomic-write temp files use a different suffix so a
+#: crashed writer's leftovers are never mistaken for entries)
+_DISK_SUFFIX = ".nmfxexec"
+#: age after which an orphaned atomic-write temp file (a writer killed
+#: between mkstemp and the rename) is swept by the eviction scan — far
+#: beyond any real compile+serialize, so a live writer is never raced
+_PART_MAX_AGE_S = 3600.0
+
+# module-wide count of actual .lower().compile() calls — the honesty
+# counter behind the zero-compile cold-start contract: a fresh process
+# serving from a warm disk cache must leave it at ZERO
+# (tests/test_exec_cache.py, bench.py cold_persist stage)
+_compile_count = 0
+_compile_count_lock = threading.Lock()
+
+
+def compile_count() -> int:
+    """How many executables this process has ACTUALLY compiled through
+    the serving layer (``.lower().compile()`` calls; deserialized disk
+    hits do not count)."""
+    return _compile_count
+
+
+def _note_compile() -> None:
+    global _compile_count
+    with _compile_count_lock:
+        _compile_count += 1
 
 
 def solver_key_fields() -> frozenset:
@@ -85,10 +154,43 @@ def solver_key_fields() -> frozenset:
     ``compare=False`` would silently alias two different-numerics
     configs onto one cached executable, and shows up here (and in
     NMFX001) as uncovered."""
-    import dataclasses
-
     return frozenset(f.name for f in dataclasses.fields(SolverConfig)
                      if f.compare)
+
+
+def persist_key_fields() -> frozenset:
+    """The SolverConfig fields the PERSISTENT disk key covers — the
+    second NMFX001 introspection hook.
+
+    The disk key is the ``repr`` of the in-memory key (plus the
+    device/jax environment), and dataclass ``__repr__`` renders exactly
+    the fields declared with ``repr=True`` — so this hook reads
+    ``field.repr``. The honesty argument mirrors
+    :func:`solver_key_fields`: a field added with ``repr=False`` would
+    be present in the in-memory key (hash/eq) but INVISIBLE in the disk
+    key, so two configs differing only in it would map to one disk
+    entry and a fresh process would deserialize the wrong executable —
+    that gap shows up here (and fails lint) instead of shipping."""
+    return frozenset(f.name for f in dataclasses.fields(SolverConfig)
+                     if f.repr)
+
+
+@functools.lru_cache(maxsize=1)
+def _env_fingerprint() -> tuple:
+    """Everything about the runtime that can invalidate a serialized
+    executable beyond the bucket key itself: jax/jaxlib versions, the
+    backend platform, the device kind, and the PJRT platform version
+    (XLA build). Part of the hashed disk-entry name AND stored inside
+    each entry, so a mismatched entry is detected even on a hash
+    collision or a hand-moved file. Constant for the process lifetime
+    (the backend cannot change once initialized) — cached."""
+    import jaxlib
+
+    dev = jax.devices()[0]
+    client = getattr(dev, "client", None)
+    return (jax.__version__, jaxlib.__version__, jax.default_backend(),
+            str(getattr(dev, "device_kind", "?")),
+            str(getattr(client, "platform_version", "?")))
 
 
 def bucket_dim(x: int, quantum: int, growth_steps: int = 8) -> int:
@@ -135,28 +237,79 @@ class PlacedMatrix(NamedTuple):
 
 
 class _Entry(NamedTuple):
-    fn: "jax.stages.Wrapped"  # the jitted builder output (traceable)
+    #: the jitted builder output (traceable); None for entries
+    #: deserialized from disk, which never re-trace
+    fn: object | None
     compiled: "jax.stages.Compiled"  # the AOT executable actually called
     bucket: tuple[int, int]
+    #: seconds this entry's compile took — for disk-loaded entries, the
+    #: ORIGINAL compile cost recorded by whichever process paid it
     compile_s: float
+    #: seconds spent deserializing (0.0 for freshly-compiled entries)
+    deserialize_s: float = 0.0
+    #: where this entry came from: "compile" or "disk"
+    source: str = "compile"
+    #: this entry's persisted file (None when not on disk) — memory hits
+    #: touch its mtime so the disk mtime-LRU sees hot buckets as hot
+    #: even when they are served from memory for days
+    path: "str | None" = None
+
+
+class WarmTask:
+    """Handle to a background :meth:`ExecCache.warm` — ``done()`` polls,
+    ``result()`` joins and returns (or raises) the warm report. The
+    worker is a daemon thread: a process that exits mid-warm abandons
+    the remaining compiles (persisted entries written so far survive)."""
+
+    def __init__(self, thread: threading.Thread, box: dict):
+        self._thread = thread
+        self._box = box
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: "float | None" = None) -> "list[dict]":
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("background warmup still compiling")
+        err = self._box.get("error")
+        if err is not None:
+            raise err
+        return self._box["report"]
 
 
 class ExecCache:
-    """LRU of AOT-compiled, shape-bucketed sweep executables.
+    """LRU of AOT-compiled, shape-bucketed sweep executables, optionally
+    backed by a persistent on-disk store (``ExecCacheConfig.cache_dir``).
 
     One instance is meant to live for a serving process's lifetime and be
     passed to ``nmfconsensus(exec_cache=...)`` / ``sweep(exec_cache=...)``
     on every request; repeat requests whose shapes fall in a warm bucket
-    skip compilation entirely. Thread-hostile by design (like jit's own
-    caches): serialize requests or shard caches per worker.
+    skip compilation entirely, and with a cache directory a FRESH process
+    deserializes instead of recompiling. Request serving is meant to stay
+    single-threaded (like jit's own caches), but compilation is
+    internally thread-safe: background/parallel warms and a foreground
+    request de-duplicate through an in-flight future registry, so no
+    executable is ever built twice concurrently.
     """
 
     def __init__(self, cfg: ExecCacheConfig = ExecCacheConfig()):
         self.cfg = cfg
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        #: effective in-memory LRU bound — cfg.max_entries, raised by the
+        #: per-rank mode to the largest request's rank count so that one
+        #: sweep's per-rank executables never thrash the LRU (ks=2..10 is
+        #: 9 entries against the default cap of 8)
+        self._entries_cap = cfg.max_entries
+        self._inflight: "dict[tuple, Future]" = {}
+        self._lock = threading.RLock()
+        self._warned: set[str] = set()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.persist_hits = 0
+        self.persist_misses = 0
+        self.disk_evictions = 0
 
     # -- policy ------------------------------------------------------------
     def bucket_shape(self, m: int, n: int) -> tuple[int, int]:
@@ -198,31 +351,301 @@ class ExecCache:
         return (self.cfg.donate_inits
                 and jax.default_backend() in ("tpu", "gpu"))
 
+    def _workers(self, pending: int) -> int:
+        if self.cfg.compile_workers > 0:
+            return self.cfg.compile_workers
+        return max(1, min(pending, os.cpu_count() or 2))
+
+    def _compile_concurrently(self, keys, run_one) -> "dict[object, Future]":
+        """Run ``run_one(key)`` for every key on DAEMON worker threads —
+        not a ThreadPoolExecutor, whose non-daemon workers are joined at
+        interpreter exit: a process quitting mid-background-warm must
+        abandon in-flight compiles (as :class:`WarmTask` documents)
+        instead of hanging until XLA finishes work whose results are
+        discarded. Returns one Future per key; workers drain the keys in
+        the given order. Shared by :meth:`warm` and the per-rank
+        pipeline so the two call sites cannot drift apart."""
+        keys = list(keys)
+        futs = {k: Future() for k in keys}
+        pending: "queue.SimpleQueue" = queue.SimpleQueue()
+        for k in keys:
+            pending.put(k)
+
+        def drain():
+            while True:
+                try:
+                    k = pending.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    futs[k].set_result(run_one(k))
+                except BaseException as e:
+                    futs[k].set_exception(e)
+
+        for _ in range(self._workers(len(keys))):
+            threading.Thread(target=drain, daemon=True,
+                             name="nmfx-exec-compile").start()
+        return futs
+
+    # -- the persistent store ----------------------------------------------
+    def _persist_repr(self, key: tuple) -> str:
+        """The canonical disk-key string: the in-memory key's repr (every
+        SolverConfig field with ``repr=True`` renders into it — the
+        coverage :func:`persist_key_fields` declares) extended with the
+        device/jax environment. Deterministic across processes: dataclass
+        reprs are field-ordered and Mesh reprs are device-ordered."""
+        return repr((key, _env_fingerprint()))
+
+    def _disk_path(self, key: tuple) -> str:
+        digest = hashlib.sha256(
+            self._persist_repr(key).encode()).hexdigest()[:40]
+        return os.path.join(self.cfg.cache_dir, digest + _DISK_SUFFIX)
+
+    def _warn_once(self, category: str, msg: str) -> None:
+        """One warning per failure category per cache instance — a
+        serving process logs the first corrupt/mismatched/unwritable
+        event and then degrades silently (the fallback is always a
+        clean recompile, never a crash)."""
+        with self._lock:
+            if category in self._warned:
+                return
+            self._warned.add(category)
+        warnings.warn(f"nmfx exec cache: {msg}", RuntimeWarning,
+                      stacklevel=4)
+
+    def _disk_load(self, path: str, key: tuple,
+                   bucket: tuple[int, int], prof) -> "_Entry | None":
+        from nmfx._compat import deserialize_compiled
+
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            # a TRANSIENT read problem (fd pressure, a network
+            # filesystem hiccup) — recompile here, but leave the entry
+            # alone: it may be perfectly valid for the other processes
+            # sharing this cache directory
+            self._warn_once(
+                "disk-read",
+                f"could not read cache entry ({e}); recompiling")
+            return None
+        try:
+            rec = pickle.loads(data)
+            if not (isinstance(rec, dict)
+                    and rec.get("format") == _DISK_FORMAT):
+                raise ValueError(f"unrecognized record format in {path}")
+            if rec.get("key") != self._persist_repr(key):
+                raise ValueError(
+                    f"stored key mismatch in {path} (written under a "
+                    "different jax/jaxlib/device environment or config)")
+            t0 = time.perf_counter()
+            with prof.phase("compile.deserialize"):
+                compiled = deserialize_compiled(rec["blob"])
+            dt = time.perf_counter() - t0
+            try:
+                os.utime(path)  # mtime-LRU: a hit refreshes the entry
+            except OSError:
+                pass
+            return _Entry(None, compiled, bucket,
+                          float(rec.get("compile_s", 0.0)), dt, "disk",
+                          path)
+        except Exception as e:
+            # a CONTENT failure — truncated pickle, stale environment,
+            # a PJRT that can't deserialize this blob: the entry itself
+            # is unusable, so drop it, warn once, recompile
+            self._warn_once(
+                "disk-read",
+                f"discarding unusable cache entry and recompiling ({e})")
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+
+    def _disk_store(self, path: str, key: tuple, entry: _Entry) -> bool:
+        from nmfx._compat import serialize_compiled
+
+        try:
+            blob = serialize_compiled(entry.compiled)
+            rec = pickle.dumps(
+                {"format": _DISK_FORMAT, "key": self._persist_repr(key),
+                 "blob": blob, "compile_s": entry.compile_s},
+                protocol=pickle.HIGHEST_PROTOCOL)
+            d = os.path.dirname(path) or "."
+            os.makedirs(d, exist_ok=True)
+            # atomic publish: concurrent writers (two serving processes
+            # cold-starting the same bucket) each rename a complete temp
+            # file onto the entry path — last wins, readers never see a
+            # partial file (tests/test_multiprocess.py)
+            fd, tmp = tempfile.mkstemp(dir=d, prefix="write-",
+                                       suffix=".part")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(rec)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+            self._evict_disk(keep=path)
+            return True
+        except Exception as e:
+            self._warn_once(
+                "disk-write",
+                f"could not persist executable ({e}); this process "
+                "serves from memory only")
+            return False
+
+    def _evict_disk(self, keep: "str | None" = None) -> None:
+        """Byte-capped mtime-LRU over the cache directory: evict
+        oldest-touched entries until the directory fits
+        ``max_disk_bytes``. The just-written entry (``keep``) survives
+        even when it alone exceeds the cap. Independent of the
+        in-memory LRU by design — memory evictions never call this."""
+        d = self.cfg.cache_dir
+        try:
+            stats = []
+            now = time.time()
+            for name in os.listdir(d):
+                p = os.path.join(d, name)
+                if name.endswith(".part"):
+                    # a writer killed between mkstemp and the rename
+                    # leaves an entry-sized orphan the byte cap can't
+                    # see; sweep any old enough that no live writer can
+                    # still own it
+                    try:
+                        if now - os.stat(p).st_mtime > _PART_MAX_AGE_S:
+                            os.remove(p)
+                    except OSError:
+                        pass
+                    continue
+                if not name.endswith(_DISK_SUFFIX):
+                    continue
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue  # concurrently evicted by another process
+                stats.append((st.st_mtime, st.st_size, p))
+            total = sum(size for _, size, _ in stats)
+            keep_abs = os.path.abspath(keep) if keep is not None else None
+            for _, size, p in sorted(stats):
+                if total <= self.cfg.max_disk_bytes:
+                    break
+                if os.path.abspath(p) == keep_abs:
+                    continue
+                try:
+                    os.remove(p)
+                except OSError:
+                    continue
+                total -= size
+                with self._lock:
+                    self.disk_evictions += 1
+        except OSError as e:
+            self._warn_once("disk-evict",
+                            f"disk eviction scan failed ({e})")
+
     # -- compilation -------------------------------------------------------
     def executable(self, shape: tuple[int, int], ccfg: ConsensusConfig,
                    scfg: SolverConfig = SolverConfig(),
                    icfg: InitConfig = InitConfig(), mesh=None,
                    profiler=None) -> tuple[_Entry, bool]:
-        """The (entry, was_hit) for a request shape — compiling AOT on
-        miss, LRU-touching on hit. ``shape`` is the TRUE (m, n); the
-        entry is keyed by its bucket, so any same-bucket shape returns
-        the same executable."""
+        """The (entry, was_hit) for a request shape — served from memory,
+        the in-flight compile registry, or the disk store, compiling AOT
+        only when all three miss. ``shape`` is the TRUE (m, n); the entry
+        is keyed by its bucket, so any same-bucket shape returns the same
+        executable. ``was_hit`` means "no compile was paid for this
+        call" (memory hit, a wait on another thread's in-flight compile,
+        or a disk deserialize)."""
         prof = profiler if profiler is not None else _null()
         bucket = self.bucket_shape(*shape)
-        inside_init = icfg.method == "random"
         key = self._key(bucket, ccfg, scfg, icfg, mesh)
-        entry = self._entries.get(key)
+        wait = None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            else:
+                wait = self._inflight.get(key)
+                if wait is None:
+                    fut: Future = Future()
+                    self._inflight[key] = fut
         if entry is not None:
-            self._entries.move_to_end(key)
-            self.hits += 1
+            if entry.path is not None:
+                # keep the disk mtime-LRU honest: a bucket served from
+                # memory for days is the HOTTEST entry, not the coldest
+                try:
+                    os.utime(entry.path)
+                except OSError:
+                    pass
             prof.mark("compile.cache_hit")
             return entry, True
-        self.misses += 1
-        with prof.phase("compile.cache_miss"):
+        if wait is not None:
+            # another thread (a background warm, a parallel compile) is
+            # already building this exact executable — wait for it
+            # instead of compiling twice
+            with prof.phase("compile.inflight_wait"):
+                entry = wait.result()
+            with self._lock:
+                self.hits += 1
+            prof.mark("compile.cache_hit")
+            return entry, True
+        try:
+            entry, served = self._load_or_compile(bucket, key, ccfg, scfg,
+                                                  icfg, mesh, prof)
+            with self._lock:
+                self._entries[key] = entry
+                # in-memory LRU only: an evicted entry's DISK record (if
+                # any) stays — a later request re-admits it as a persist
+                # hit instead of recompiling
+                while len(self._entries) > self._entries_cap:
+                    self._entries.popitem(last=False)
+                    self.evictions += 1
+                self._inflight.pop(key, None)
+            fut.set_result(entry)
+            return entry, served
+        except BaseException as e:
+            with self._lock:
+                self._inflight.pop(key, None)
+            fut.set_exception(e)
+            raise
+
+    def _load_or_compile(self, bucket, key, ccfg, scfg, icfg, mesh,
+                         prof) -> tuple[_Entry, bool]:
+        path = (self._disk_path(key) if self.cfg.cache_dir is not None
+                else None)
+        if path is not None:
+            entry = self._disk_load(path, key, bucket, prof)
+            if entry is not None:
+                with self._lock:
+                    self.persist_hits += 1
+                prof.mark("compile.persist_hit")
+                return entry, True
+            with self._lock:
+                self.persist_misses += 1
+            prof.mark("compile.persist_miss")
+        entry = self._compile(bucket, ccfg, scfg, icfg, mesh, prof)
+        if path is not None and self._disk_store(path, key, entry):
+            entry = entry._replace(path=path)
+        return entry, False
+
+    def _compile(self, bucket, ccfg, scfg, icfg, mesh, prof) -> _Entry:
+        with self._lock:
+            self.misses += 1
+        _note_compile()
+        ks = tuple(sorted(ccfg.ks))
+        span = (f"compile.k={ks[0]}" if len(ks) == 1
+                else f"compile.ks={ks[0]}-{ks[-1]}")
+        with prof.phase("compile.cache_miss"), prof.phase(span):
             t0 = time.perf_counter()
             tail = (tuple(ccfg.grid_tail_slots)
                     if isinstance(ccfg.grid_tail_slots, list)
                     else ccfg.grid_tail_slots)
+            inside_init = icfg.method == "random"
             fn = _build_bucketed_sweep_fn(
                 tuple(ccfg.ks), ccfg.restarts, scfg, ccfg.label_rule,
                 mesh, ccfg.keep_factors, ccfg.grid_slots, tail, bucket,
@@ -255,39 +678,95 @@ class ExecCache:
                     struct((b, m_pad, k_max), dtype),
                     struct((b, k_max, n_pad), dtype), *i32).compile()
             compile_s = time.perf_counter() - t0
-        entry = _Entry(fn, compiled, bucket, compile_s)
-        self._entries[key] = entry
-        while len(self._entries) > self.cfg.max_entries:
-            # the compiled program's memory is held by entry.compiled;
-            # dropping the dict reference releases it (entry.fn is the
-            # lru_cached builder, whose own jit cache was never
-            # populated — this layer only calls .lower().compile())
-            self._entries.popitem(last=False)
-            self.evictions += 1
-        return entry, False
+        return _Entry(fn, compiled, bucket, compile_s)
 
     def warm(self, shapes: Sequence[tuple[int, int]],
              ccfg: ConsensusConfig, scfg: SolverConfig = SolverConfig(),
              icfg: InitConfig = InitConfig(), mesh=None,
-             profiler=None) -> list[dict]:
-        """Batch-compile the executables for each shape's bucket at
-        startup (the CLI's ``--warm-shapes``). Returns one record per
-        shape: its bucket, whether it was already warm, and the compile
-        seconds paid."""
-        report = []
+             profiler=None, parallel: bool = True,
+             background: bool = False):
+        """Batch-compile the executables for each shape's bucket (the
+        CLI's ``--warm-shapes``) — CONCURRENTLY in a thread pool when
+        more than one is pending (XLA compilation releases the GIL), and
+        per rank when ``pipeline_ranks`` is on. With ``background=True``
+        the warm runs on a daemon thread and a :class:`WarmTask` handle
+        returns immediately (the CLI's ``--warm-cache``): a request
+        arriving mid-warm waits on the matching in-flight compile
+        instead of duplicating it. Returns one record per executable:
+        its shape, bucket, rank set, whether it was already warm
+        (``cache_hit`` — no compile paid now), the compile seconds, and
+        the entry's origin (``source``: "compile"/"disk")."""
+        if background:
+            box: dict = {}
+
+            def work():
+                try:
+                    box["report"] = self.warm(
+                        shapes, ccfg, scfg, icfg, mesh, profiler=None,
+                        parallel=parallel, background=False)
+                except BaseException as e:  # surfaced by WarmTask.result
+                    box["error"] = e
+
+            thread = threading.Thread(target=work, daemon=True,
+                                      name="nmfx-exec-warm")
+            thread.start()
+            return WarmTask(thread, box)
+        prof = profiler if profiler is not None else _null()
+        specs: list[tuple[tuple[int, int], ConsensusConfig]] = []
         for m, n in shapes:
-            entry, hit = self.executable((m, n), ccfg, scfg, icfg, mesh,
-                                         profiler)
-            report.append({"shape": (m, n), "bucket": entry.bucket,
-                           "cache_hit": hit,
-                           "compile_s": round(entry.compile_s, 3)})
+            if self.cfg.pipeline_ranks and len(ccfg.ks) > 1:
+                specs.extend(((m, n), dataclasses.replace(ccfg, ks=(k,)))
+                             for k in sorted(ccfg.ks))
+            else:
+                specs.append(((m, n), ccfg))
+        if self.cfg.pipeline_ranks:
+            # one request needs its per-rank entries co-resident, so the
+            # effective LRU bound rises to the RANK count — never to
+            # shapes×ranks, which would silently void the max_entries
+            # device-memory bound. Warming more shapes than max_entries
+            # keeps only the most recent in memory; pair with cache_dir
+            # so the rest stay disk-warm (deserialize, not recompile).
+            with self._lock:
+                self._entries_cap = max(self._entries_cap, len(ccfg.ks))
+        pooled = parallel and len(specs) > 1
+        if pooled:
+            # workers get a NullProfiler (Profiler phase bookkeeping is
+            # single-threaded); compile walls land in the report and are
+            # credited to the profiler below. result() re-raises the
+            # first failed spec's exception.
+            futs = self._compile_concurrently(
+                range(len(specs)),
+                lambda i: self.executable(specs[i][0], specs[i][1],
+                                          scfg, icfg, mesh))
+            results = [futs[i].result() for i in range(len(specs))]
+        else:
+            # sequential: executable() records its own compile spans on
+            # the caller's profiler directly
+            results = [self.executable(s, c, scfg, icfg, mesh, prof)
+                       for s, c in specs]
+        report = []
+        for (shape, c), (entry, hit) in zip(specs, results):
+            if pooled and not hit and entry.source == "compile":
+                prof.add_seconds(
+                    f"compile.k={c.ks[0]}" if len(c.ks) == 1
+                    else f"compile.ks={min(c.ks)}-{max(c.ks)}",
+                    entry.compile_s)
+            report.append({"shape": tuple(shape), "bucket": entry.bucket,
+                           "ks": tuple(c.ks), "cache_hit": hit,
+                           "source": entry.source,
+                           "compile_s": round(entry.compile_s, 3),
+                           "deserialize_s": round(entry.deserialize_s, 3)})
         return report
 
     @property
     def stats(self) -> dict:
         return {"entries": len(self._entries), "hits": self.hits,
                 "misses": self.misses, "evictions": self.evictions,
-                "max_entries": self.cfg.max_entries}
+                "persist_hits": self.persist_hits,
+                "persist_misses": self.persist_misses,
+                "disk_evictions": self.disk_evictions,
+                "max_entries": self._entries_cap,
+                "cache_dir": self.cfg.cache_dir}
 
     # -- the host<->device pipeline ---------------------------------------
     def prefetch(self, a, scfg: SolverConfig = SolverConfig(),
@@ -319,6 +798,47 @@ class ExecCache:
                 a_pad = jax.device_put(a_pad)
         return PlacedMatrix(a_pad, (m, n), bucket)
 
+    def _solve_args(self, placed: PlacedMatrix, ccfg: ConsensusConfig,
+                    scfg: SolverConfig, icfg: InitConfig, mesh,
+                    prof) -> tuple:
+        """The executable's runtime arguments for one request: the padded
+        matrix, the init route's inputs, and the dynamic true-dimension
+        scalars (shared by the whole-grid and per-rank dispatch paths)."""
+        m_true, n_true = placed.true_shape
+        # host-side (the executable's static n is the bucket width, so
+        # it cannot compute floor(tol·n_true) itself), via the SAME
+        # helper batch_convergence uses — decision parity by sharing
+        from nmfx.ops.packed_mu import flip_budget
+
+        flip = flip_budget(scfg.class_flip_tol, n_true)
+        dev_args = (jnp.asarray(m_true, jnp.int32),
+                    jnp.asarray(n_true, jnp.int32),
+                    jnp.asarray(flip, jnp.int32))
+        rep = NamedSharding(mesh, P()) if mesh is not None else None
+        if rep is not None:
+            dev_args = tuple(jax.device_put(x, rep) for x in dev_args)
+        if icfg.method == "random":
+            # init happens INSIDE the executable with dynamic true dims
+            # (sweep._dyn_lane_init): a new shape in a warm bucket costs
+            # zero compilation
+            root = jax.random.key(ccfg.seed)
+            if rep is not None:
+                root = jax.device_put(root, rep)
+            return (placed.a_pad, root, *dev_args)
+        with prof.phase("exec_cache.init") as sync:
+            # NNDSVD factors the true matrix: its lane batch is a
+            # small per-true-shape jit outside the executable
+            init_fn = bucketed_lane_init_fn(
+                placed.true_shape, tuple(ccfg.ks),
+                _pad_count(ccfg.restarts, mesh), icfg, scfg.dtype,
+                placed.bucket)
+            a_true = placed.a_pad[:m_true, :n_true]
+            w0, h0 = sync(init_fn(a_true, jax.random.key(ccfg.seed)))
+        if rep is not None:
+            w0 = jax.device_put(w0, rep)
+            h0 = jax.device_put(h0, rep)
+        return (placed.a_pad, w0, h0, *dev_args)
+
     def run_sweep(self, a, ccfg: ConsensusConfig,
                   scfg: SolverConfig = SolverConfig(),
                   icfg: InitConfig = InitConfig(), mesh=None, *,
@@ -341,49 +861,79 @@ class ExecCache:
                 " — route it through nmfx.sweep.sweep instead")
         placed = (a if isinstance(a, PlacedMatrix)
                   else self.prefetch(a, scfg, mesh, profiler=prof))
+        if self.cfg.pipeline_ranks and len(ccfg.ks) > 1:
+            return self._run_sweep_ranks(placed, ccfg, scfg, icfg, mesh,
+                                         prof)
         m_true, n_true = placed.true_shape
         entry, _ = self.executable(placed.true_shape, ccfg, scfg, icfg,
                                    mesh, prof)
-        # host-side (the executable's static n is the bucket width, so
-        # it cannot compute floor(tol·n_true) itself), via the SAME
-        # helper batch_convergence uses — decision parity by sharing
-        from nmfx.ops.packed_mu import flip_budget
-
-        flip = flip_budget(scfg.class_flip_tol, n_true)
-        dev_args = (jnp.asarray(m_true, jnp.int32),
-                    jnp.asarray(n_true, jnp.int32),
-                    jnp.asarray(flip, jnp.int32))
-        rep = NamedSharding(mesh, P()) if mesh is not None else None
-        if rep is not None:
-            dev_args = tuple(jax.device_put(x, rep) for x in dev_args)
-        if icfg.method == "random":
-            # init happens INSIDE the executable with dynamic true dims
-            # (sweep._dyn_lane_init): a new shape in a warm bucket costs
-            # zero compilation
-            root = jax.random.key(ccfg.seed)
-            if rep is not None:
-                root = jax.device_put(root, rep)
-            solve_args = (placed.a_pad, root, *dev_args)
-        else:
-            with prof.phase("exec_cache.init") as sync:
-                # NNDSVD factors the true matrix: its lane batch is a
-                # small per-true-shape jit outside the executable
-                init_fn = bucketed_lane_init_fn(
-                    placed.true_shape, tuple(ccfg.ks),
-                    _pad_count(ccfg.restarts, mesh), icfg, scfg.dtype,
-                    placed.bucket)
-                a_true = placed.a_pad[:m_true, :n_true]
-                w0, h0 = sync(init_fn(a_true, jax.random.key(ccfg.seed)))
-            if rep is not None:
-                w0 = jax.device_put(w0, rep)
-                h0 = jax.device_put(h0, rep)
-            solve_args = (placed.a_pad, w0, h0, *dev_args)
+        solve_args = self._solve_args(placed, ccfg, scfg, icfg, mesh, prof)
         with prof.phase("solve.grid") as sync:
             raw = sync(entry.compiled(*solve_args))
         out = {k: _unpad(v, m_true, n_true) for k, v in raw.items()}
         with prof.phase("xfer.overlap"):
             start_host_fetch(out)
         return out
+
+    def _run_sweep_ranks(self, placed: PlacedMatrix, ccfg: ConsensusConfig,
+                         scfg: SolverConfig, icfg: InitConfig, mesh,
+                         prof) -> dict[int, KSweepOutput]:
+        """Pipelined per-rank serving (``ExecCacheConfig.pipeline_ranks``):
+        one bucketed executable per rank, compiled concurrently on cold
+        start, dispatched ascending-k as each compile lands — the lowest
+        rank is already solving on device while higher ranks still
+        compile, and under a NullProfiler each rank's async dispatch
+        overlaps the next rank's compile wait. Each rank's results are
+        exactly a single-rank grid sweep's (``ks=(k,)``); they differ
+        from the whole-grid default only by float-tolerance GEMM-batching
+        drift, which is why the mode is an opt-in."""
+        ks = tuple(sorted(ccfg.ks))
+        m_true, n_true = placed.true_shape
+        rank_cfgs = {k: dataclasses.replace(ccfg, ks=(k,)) for k in ks}
+        # one request needs all its per-rank entries co-resident: raise
+        # the effective LRU bound so the flagship ks=2..10 (9 entries vs
+        # the default cap of 8) cannot thrash itself into a perpetual
+        # one-recompile-per-request tax
+        with self._lock:
+            self._entries_cap = max(self._entries_cap, len(ks))
+            # the hot path stays thread-free: only ranks actually
+            # missing from memory get compile workers (a fully-warm
+            # request spawns no threads at all)
+            missing = [k for k in ks
+                       if self._key(placed.bucket, rank_cfgs[k], scfg,
+                                    icfg, mesh) not in self._entries]
+        futs: "dict[object, Future]" = {}
+        if missing:
+            # the coordinator consumes ranks ascending while later
+            # compiles continue in flight on the daemon workers
+            futs = self._compile_concurrently(
+                missing,
+                lambda k: self.executable(placed.true_shape,
+                                          rank_cfgs[k], scfg, icfg,
+                                          mesh))
+        out: dict[int, KSweepOutput] = {}
+        for k in ks:
+            ck = rank_cfgs[k]
+            if k in futs:
+                with prof.phase("compile.pipeline_wait"):
+                    entry, hit = futs[k].result()
+                if hit:
+                    prof.mark("compile.cache_hit")
+                elif entry.source == "compile":
+                    # the per-rank compile span, measured in the worker
+                    # thread, credited here on the coordinating thread
+                    prof.add_seconds(f"compile.k={k}", entry.compile_s)
+            else:
+                entry, _ = self.executable(placed.true_shape, ck, scfg,
+                                           icfg, mesh, prof)
+            solve_args = self._solve_args(placed, ck, scfg, icfg, mesh,
+                                          prof)
+            with prof.phase(f"solve.k={k}") as sync:
+                raw = sync(entry.compiled(*solve_args))
+            out[k] = _unpad(raw[k], m_true, n_true)
+            with prof.phase("xfer.overlap"):
+                start_host_fetch(out[k])
+        return {k: out[k] for k in ccfg.ks}
 
 
 def _unpad(out_k: KSweepOutput, m: int, n: int) -> KSweepOutput:

@@ -81,9 +81,21 @@ class Profiler:
     def mark(self, name: str) -> None:
         """Record an instantaneous event as a zero-duration phase
         occurrence — the count column is the payload (e.g. the serving
-        layer's ``compile.cache_hit`` marks, where the whole point is
-        that no time was spent)."""
+        layer's ``compile.cache_hit``/``compile.persist_hit``/
+        ``compile.persist_miss`` marks, where the whole point is that no
+        — or only deserialization — time was spent)."""
         self.phases.setdefault(name, PhaseRecord(name)).count += 1
+
+    def add_seconds(self, name: str, seconds: float, count: int = 1) -> None:
+        """Credit externally-measured wall time to a phase. For work timed
+        off-thread — the serving layer's per-rank compile spans
+        (``compile.k=<k>``) run inside pool threads, where this
+        profiler's single-threaded ``phase`` bookkeeping must not be
+        touched — the coordinating thread records the measured seconds
+        here after the fact."""
+        rec = self.phases.setdefault(name, PhaseRecord(name))
+        rec.seconds += seconds
+        rec.count += count
 
     # -- reporting ---------------------------------------------------------
     def total_seconds(self) -> float:
@@ -119,6 +131,9 @@ class NullProfiler(Profiler):
         yield lambda x: x
 
     def mark(self, name: str) -> None:
+        pass
+
+    def add_seconds(self, name: str, seconds: float, count: int = 1) -> None:
         pass
 
     def report(self) -> str:

@@ -1168,8 +1168,10 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     the shape-bucketed executable-reuse layer when the configuration is
     cacheable (:meth:`ExecCache.cacheable`) — repeat requests whose
     shapes land in an already-compiled bucket skip the trace+compile
-    entirely. Falls back to the normal path for non-cacheable configs
-    and for checkpointed (``registry``) runs."""
+    entirely, and with a persistent ``cache_dir`` a fresh process
+    deserializes the bucket's executable from disk instead of
+    recompiling it. Falls back to the normal path for non-cacheable
+    configs and for checkpointed (``registry``) runs."""
     if profiler is None:
         from nmfx.profiling import NullProfiler
 

@@ -1,6 +1,8 @@
 """CLI surface tests (reference entry semantics: runNMFinJobs args,
 nmf.r:106) — run in-process on the 8-device virtual CPU platform."""
 
+import os
+
 import pytest
 
 from nmfx.cli import main, parse_ks
@@ -171,16 +173,24 @@ def test_cli_version(capsys):
     assert nmfx.__version__ in capsys.readouterr().out
 
 
-def test_cli_exec_cache_and_warm_shapes(gct_path, capsys):
+def test_cli_exec_cache_and_warm_shapes(gct_path, tmp_path, capsys):
     # warmup shares the run's bucket: the sweep itself must HIT the
-    # warmed executable (demo.gct is 60x16; warm a nearby shape)
+    # warmed executable (demo.gct is 60x16; warm a nearby shape).
+    # --warm-cache backgrounds the warmup and --cache-dir persists the
+    # warmed executable to disk — one run exercises all three flags.
+    cache_dir = tmp_path / "exec-cache"
     rc = main([gct_path, "--ks", "2-3", "--restarts", "4",
                "--maxiter", "150", "--no-files",
-               "--warm-shapes", "64x16"])
+               "--warm-shapes", "64x16", "--warm-cache",
+               "--cache-dir", str(cache_dir)])
     assert rc == 0
     cap = capsys.readouterr()
     assert "best k = 2" in cap.out
-    assert "warmed bucket" in cap.err
+    assert "in the background" in cap.err
+    assert "warmed bucket" in cap.err  # report printed after the join
+    # the warmed executable persisted for future processes
+    assert any(name.endswith(".nmfxexec")
+               for name in os.listdir(cache_dir))
 
 
 def test_cli_warm_shapes_validation(gct_path):
@@ -196,6 +206,10 @@ def test_cli_warm_shapes_validation(gct_path):
         # pg can't run through the whole-grid scheduler
         main([gct_path, "--warm-shapes", "64x16", "--algorithm", "pg",
               "--no-files"])
+    with pytest.raises(SystemExit):
+        # --warm-cache backgrounds the --warm-shapes warmup; alone it
+        # has nothing to warm
+        main([gct_path, "--warm-cache", "--no-files"])
 
 
 def test_cli_exec_cache_rejects_checkpoint_dir(gct_path, tmp_path):

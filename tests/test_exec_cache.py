@@ -1,8 +1,17 @@
 """Executable-reuse serving layer (nmfx/exec_cache.py): bucket policy,
-hit/miss keying, LRU eviction, and — the load-bearing property — exact
-numerical equivalence of padded-bucket sweeps to exact-shape sweeps."""
+hit/miss keying, LRU eviction, disk persistence (fresh-process
+zero-compile cold start, corruption/mismatch fallback, byte-capped
+mtime-LRU), the pipelined parallel-compile paths, and — the load-bearing
+property — exact numerical equivalence of padded-bucket sweeps to
+exact-shape sweeps."""
 
 import dataclasses
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
 
 import jax
 import numpy as np
@@ -10,7 +19,8 @@ import pytest
 
 from nmfx.config import ConsensusConfig, ExecCacheConfig, InitConfig, \
     SolverConfig
-from nmfx.exec_cache import ExecCache, bucket_dim, start_host_fetch
+from nmfx.exec_cache import (ExecCache, bucket_dim, persist_key_fields,
+                             start_host_fetch)
 from nmfx.sweep import sweep
 
 CCFG = ConsensusConfig(ks=(2, 3), restarts=6, seed=3, grid_exec="grid",
@@ -199,6 +209,272 @@ def test_threefry_flat_index_properties():
     j = jnp.arange(197)[None, :]
     np.testing.assert_array_equal(np.asarray(hu[i * 197 + j]),
                                   np.asarray(ht))
+
+
+# --- disk persistence -----------------------------------------------------
+# Tier-1 budget note: every persistence test compiles (at most) the
+# smallest viable executables — one rank, two restarts, max_iter<=30 on a
+# 60x20 matrix — so the whole section stays within seconds per compile on
+# the CPU-only container.
+
+_A_SMALL = np.random.default_rng(0).uniform(0.1, 1.0, (60, 20))
+
+
+def _disk_cache(tmp_path, **kw):
+    return ExecCache(ExecCacheConfig(cache_dir=str(tmp_path / "exec"),
+                                     **kw))
+
+
+def _entry_files(tmp_path):
+    d = tmp_path / "exec"
+    return sorted(p for p in os.listdir(d) if p.endswith(".nmfxexec"))
+
+
+def test_persist_fresh_instance_serves_from_disk(tmp_path):
+    """A second cache instance (standing in for a fresh process — the
+    real cross-process contract is pinned by the subprocess test below)
+    deserializes the persisted executable instead of recompiling, and
+    the served results are identical."""
+    c1 = _disk_cache(tmp_path)
+    o1 = c1.run_sweep(_A_SMALL, _CCFG_TINY, _SCFG_TINY, InitConfig())
+    assert c1.stats["persist_misses"] == 1 and c1.misses == 1
+    assert len(_entry_files(tmp_path)) == 1
+    c2 = _disk_cache(tmp_path)
+    o2 = c2.run_sweep(_A_SMALL, _CCFG_TINY, _SCFG_TINY, InitConfig())
+    assert c2.stats["persist_hits"] == 1
+    assert c2.misses == 0  # deserialize-and-dispatch, no compile
+    np.testing.assert_array_equal(np.asarray(o1[2].labels),
+                                  np.asarray(o2[2].labels))
+    np.testing.assert_array_equal(np.asarray(o1[2].dnorms),
+                                  np.asarray(o2[2].dnorms))
+
+
+def test_memory_eviction_keeps_disk_entry_readmission_is_hit(tmp_path):
+    """The two LRUs are independent: evicting an executable from the
+    in-memory LRU must NOT delete its disk entry, and re-admitting it
+    from disk is a (persist) hit, not a recompile."""
+    cache = _disk_cache(tmp_path, max_entries=1)
+    cfg_a = _SCFG_TINY
+    cfg_b = dataclasses.replace(_SCFG_TINY, max_iter=22)
+    cache.executable((60, 20), _CCFG_TINY, cfg_a)
+    cache.executable((60, 20), _CCFG_TINY, cfg_b)  # evicts A from memory
+    assert cache.stats["evictions"] == 1 and cache.misses == 2
+    assert len(_entry_files(tmp_path)) == 2  # both disk entries survive
+    _, hit = cache.executable((60, 20), _CCFG_TINY, cfg_a)
+    assert hit  # re-admission from disk IS a hit
+    assert cache.stats["persist_hits"] == 1
+    assert cache.misses == 2  # no recompile happened
+    assert cache.stats["disk_evictions"] == 0
+
+
+def test_corrupt_entry_falls_back_with_one_warning(tmp_path):
+    """A truncated/corrupt cache file must degrade to a clean recompile
+    — one warning per instance, never a crash — and the recompile
+    re-publishes a valid entry."""
+    c1 = _disk_cache(tmp_path)
+    cfg_b = dataclasses.replace(_SCFG_TINY, max_iter=24)
+    c1.executable((60, 20), _CCFG_TINY, _SCFG_TINY)
+    c1.executable((60, 20), _CCFG_TINY, cfg_b)
+    for name in _entry_files(tmp_path):
+        path = tmp_path / "exec" / name
+        path.write_bytes(path.read_bytes()[:10])  # truncate both
+    c2 = _disk_cache(tmp_path)
+    with pytest.warns(RuntimeWarning, match="recompiling"):
+        _, hit = c2.executable((60, 20), _CCFG_TINY, _SCFG_TINY)
+    assert not hit and c2.misses == 1
+    # second corrupt entry in the SAME instance: silent fallback (the
+    # warning fired once), still a clean recompile
+    import warnings as _warnings
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        _, hit = c2.executable((60, 20), _CCFG_TINY, cfg_b)
+    assert not hit and c2.misses == 2
+    assert not [w for w in caught
+                if issubclass(w.category, RuntimeWarning)]
+    # the fallback republished a valid entry: a third instance hits disk
+    c3 = _disk_cache(tmp_path)
+    _, hit = c3.executable((60, 20), _CCFG_TINY, _SCFG_TINY)
+    assert hit and c3.stats["persist_hits"] == 1
+
+
+def test_env_mismatched_entry_falls_back_with_warning(tmp_path):
+    """An entry whose stored key disagrees with this process's (a stale
+    jax/jaxlib/device environment — simulated by editing the stored key,
+    since the live environment can't be swapped mid-test) recompiles
+    with one warning instead of deserializing the wrong executable."""
+    c1 = _disk_cache(tmp_path)
+    c1.executable((60, 20), _CCFG_TINY, _SCFG_TINY)
+    (name,) = _entry_files(tmp_path)
+    path = tmp_path / "exec" / name
+    rec = pickle.loads(path.read_bytes())
+    rec["key"] += "-written-under-different-jax"
+    path.write_bytes(pickle.dumps(rec))
+    c2 = _disk_cache(tmp_path)
+    with pytest.warns(RuntimeWarning, match="recompiling"):
+        _, hit = c2.executable((60, 20), _CCFG_TINY, _SCFG_TINY)
+    assert not hit and c2.misses == 1
+    # the mismatched entry was replaced by a valid one
+    c3 = _disk_cache(tmp_path)
+    _, hit = c3.executable((60, 20), _CCFG_TINY, _SCFG_TINY)
+    assert hit
+
+
+def test_disk_byte_cap_evicts_mtime_lru(tmp_path):
+    """Byte-capped disk eviction drops oldest-mtime entries first and
+    never the just-written one — exercised directly on crafted files so
+    the test pays zero compiles."""
+    cache = _disk_cache(tmp_path, max_disk_bytes=3000)
+    d = tmp_path / "exec"
+    d.mkdir()
+    paths = []
+    for i, name in enumerate(("old", "mid", "new")):
+        p = d / f"{name}.nmfxexec"
+        p.write_bytes(b"x" * 1500)
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))
+        paths.append(p)
+    cache._evict_disk(keep=str(paths[2]))
+    assert not paths[0].exists()  # oldest evicted
+    assert paths[1].exists() and paths[2].exists()
+    assert cache.stats["disk_evictions"] == 1
+    # the protected entry survives even when it alone exceeds the cap
+    tight = _disk_cache(tmp_path, max_disk_bytes=100)
+    tight._evict_disk(keep=str(paths[2]))
+    assert paths[2].exists()
+    assert not paths[1].exists()
+
+
+def test_persist_key_fields_cover_all_solver_fields():
+    """The NMFX001 persistent-key hook: today every SolverConfig field
+    renders into the disk key's repr. A field added with repr=False
+    shrinks this set and fails lint (tests/test_lint_rules.py)."""
+    assert persist_key_fields() == frozenset(
+        f.name for f in dataclasses.fields(SolverConfig))
+
+
+# --- pipelined / background compilation -----------------------------------
+
+def test_background_warm_dedupes_with_foreground_request():
+    """A request arriving while a background warm is compiling the same
+    executable WAITS on the in-flight compile instead of duplicating it:
+    exactly one compile total."""
+    cache = ExecCache()
+    task = cache.warm([_A_SMALL.shape], _CCFG_TINY, _SCFG_TINY,
+                      background=True)
+    out = cache.run_sweep(_A_SMALL, _CCFG_TINY, _SCFG_TINY, InitConfig())
+    report = task.result()
+    assert len(report) == 1
+    assert cache.misses == 1  # one compile despite the concurrency
+    assert cache.stats["entries"] == 1
+    assert out[2].labels.shape == (_CCFG_TINY.restarts, _A_SMALL.shape[1])
+
+
+def test_warm_parallel_compiles_multiple_buckets():
+    """warm() builds multiple pending buckets concurrently in the thread
+    pool — both land, each reported once."""
+    cache = ExecCache(ExecCacheConfig(compile_workers=2))
+    report = cache.warm([(60, 20), (40, 100)], _CCFG_TINY, _SCFG_TINY)
+    assert len(report) == 2
+    assert {tuple(r["bucket"]) for r in report} == {(256, 64), (256, 128)}
+    assert all(not r["cache_hit"] and r["source"] == "compile"
+               for r in report)
+    assert cache.misses == 2 and cache.stats["entries"] == 2
+
+
+def test_pipeline_ranks_matches_single_rank_grid_sweeps():
+    """ExecCacheConfig.pipeline_ranks: each rank is served by its own
+    concurrently-compiled bucketed executable, and each rank's results
+    are EXACTLY a single-rank grid sweep's (the mode's documented
+    contract; it matches the whole-grid default only to float
+    tolerance, which is why it is opt-in)."""
+    ccfg = ConsensusConfig(ks=(2, 3), restarts=2, seed=3,
+                           grid_exec="grid", grid_slots=2)
+    scfg = SolverConfig(max_iter=30)
+    cache = ExecCache(ExecCacheConfig(pipeline_ranks=True,
+                                      compile_workers=2))
+    out = cache.run_sweep(_A_SMALL, ccfg, scfg, InitConfig())
+    assert cache.misses == 2  # one executable per rank
+    for k in ccfg.ks:
+        ref = sweep(_A_SMALL,
+                    dataclasses.replace(ccfg, ks=(k,)), scfg,
+                    InitConfig(), None)
+        np.testing.assert_array_equal(np.asarray(out[k].labels),
+                                      np.asarray(ref[k].labels))
+        np.testing.assert_array_equal(np.asarray(out[k].iterations),
+                                      np.asarray(ref[k].iterations))
+        np.testing.assert_allclose(np.asarray(out[k].consensus),
+                                   np.asarray(ref[k].consensus),
+                                   atol=1e-6)
+        assert out[k].consensus.shape == (20, 20)
+    # a repeat request is fully compile-free through the per-rank entries
+    cache.run_sweep(_A_SMALL, ccfg, scfg, InitConfig())
+    assert cache.misses == 2 and cache.hits == 2
+
+
+def test_pipeline_ranks_raises_lru_floor_no_self_thrash():
+    """A per-rank request whose rank count exceeds max_entries must raise
+    the effective LRU bound instead of evicting its own entries — else a
+    ks=2..10 sweep against the default cap of 8 would pay one recompile
+    on EVERY warm request, forever."""
+    ccfg = ConsensusConfig(ks=(2, 3), restarts=2, seed=3,
+                           grid_exec="grid", grid_slots=2)
+    cache = ExecCache(ExecCacheConfig(pipeline_ranks=True, max_entries=1))
+    cache.run_sweep(_A_SMALL, ccfg, _SCFG_TINY, InitConfig())
+    assert cache.stats["entries"] == 2  # both ranks stayed resident
+    assert cache.evictions == 0
+    cache.run_sweep(_A_SMALL, ccfg, _SCFG_TINY, InitConfig())
+    assert cache.misses == 2  # the repeat request was fully compile-free
+
+
+# --- fresh-process cold start (the acceptance contract) -------------------
+
+_FRESH_CHILD = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    from nmfx.config import (ConsensusConfig, ExecCacheConfig, InitConfig,
+                             SolverConfig)
+    from nmfx import exec_cache as ec
+
+    a = np.random.default_rng(0).uniform(0.1, 1.0, (60, 20))
+    cache = ec.ExecCache(ExecCacheConfig(cache_dir=sys.argv[1]))
+    ccfg = ConsensusConfig(ks=(2,), restarts=2, seed=3, grid_exec="grid",
+                           grid_slots=2)
+    out = cache.run_sweep(a, ccfg, SolverConfig(max_iter=20), InitConfig())
+    print(json.dumps({
+        "compiles": ec.compile_count(),
+        "persist_hits": cache.stats["persist_hits"],
+        "labels": np.asarray(out[2].labels).tolist(),
+        "dnorms": np.asarray(out[2].dnorms).tolist()}))
+""")
+
+
+def _run_fresh_child(tmp_path, cache_dir):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "fresh_child.py"
+    script.write_text(_FRESH_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, str(script), str(cache_dir)],
+                          capture_output=True, text=True, env=env,
+                          timeout=240)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_fresh_process_zero_compile_with_warm_disk_cache(tmp_path):
+    """THE cold-start acceptance contract: with a warm disk cache a
+    fresh process's sweep performs ZERO .lower().compile() calls — the
+    exec-layer compile counter stays at 0 — and serves results identical
+    to the process that compiled."""
+    cache_dir = tmp_path / "exec"
+    first = _run_fresh_child(tmp_path, cache_dir)
+    assert first["compiles"] >= 1 and first["persist_hits"] == 0
+    second = _run_fresh_child(tmp_path, cache_dir)
+    assert second["compiles"] == 0  # deserialize-and-dispatch only
+    assert second["persist_hits"] == 1
+    assert second["labels"] == first["labels"]
+    assert second["dnorms"] == first["dnorms"]
 
 
 # --- flip-floor threading -------------------------------------------------

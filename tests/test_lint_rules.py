@@ -44,6 +44,8 @@ def _universe(**overrides):
         declared_non_numerics=("restart_chunk",),
         exec_key_covered=frozenset({"algorithm", "tol_x", "restart_chunk",
                                     "experimental"}),
+        persist_key_covered=frozenset({"algorithm", "tol_x",
+                                       "restart_chunk", "experimental"}),
         hashable_configs={"SolverConfig": True, "ExperimentalConfig": True},
     )
     base.update(overrides)
@@ -101,6 +103,39 @@ def test_nmfx001_exec_key_gap_fires():
         exec_key_covered=frozenset({"algorithm", "restart_chunk",
                                     "experimental"})))
     assert any("tol_x" in p and "bucket key" in p for p in problems)
+
+
+def test_nmfx001_persist_key_gap_fires():
+    """A field missing from the PERSISTENT disk key (e.g. declared
+    repr=False — present in the in-memory key's hash but invisible in
+    its repr) would serve one on-disk executable to configs that should
+    persist separately."""
+    problems = check_config_coverage(**_universe(
+        persist_key_covered=frozenset({"algorithm", "restart_chunk",
+                                       "experimental"})))
+    assert any("tol_x" in p and "persistent" in p for p in problems)
+    # the in-memory key is intact, so only the persistent check fires
+    assert not any("solver_key_fields" in p for p in problems)
+
+
+def test_nmfx001_nested_nonrepr_field_fires():
+    """A repr=False field — even on the NESTED ExperimentalConfig, which
+    the SolverConfig-level persist hook cannot see — vanishes from the
+    repr-derived disk key while staying in the in-memory hash/eq key: a
+    fresh process would deserialize the wrong executable."""
+    problems = check_config_coverage(**_universe(
+        nonrepr_fields={"ExperimentalConfig": ("hidden",)}))
+    assert any("ExperimentalConfig.hidden" in p and "repr=False" in p
+               for p in problems)
+
+
+def test_nmfx001_persist_key_check_skipped_when_not_provided():
+    """Callers without a persist hook (pre-persistence universes) are
+    not retroactively flagged — the check activates only when the
+    universe declares persistent coverage."""
+    u = _universe()
+    u.pop("persist_key_covered")
+    assert check_config_coverage(**u) == []
 
 
 def test_nmfx001_unhashable_config_fires():

@@ -126,6 +126,74 @@ def test_two_process_distributed_consensus(tmp_path):
     assert not (tmp_path / "files1").exists()
 
 
+_EXEC_WRITER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    from nmfx.config import (ConsensusConfig, ExecCacheConfig, InitConfig,
+                             SolverConfig)
+    from nmfx import exec_cache as ec
+
+    cache_dir, out_path = sys.argv[1], sys.argv[2]
+    a = np.random.default_rng(0).uniform(0.1, 1.0, (60, 20))
+    cache = ec.ExecCache(ExecCacheConfig(cache_dir=cache_dir))
+    ccfg = ConsensusConfig(ks=(2,), restarts=2, seed=3, grid_exec="grid",
+                           grid_slots=2)
+    res = cache.run_sweep(a, ccfg, SolverConfig(max_iter=20), InitConfig())
+    with open(out_path, "w") as f:
+        json.dump({"labels": np.asarray(res[2].labels).tolist(),
+                   "compiles": ec.compile_count()}, f)
+""")
+
+
+def test_exec_cache_concurrent_writers_leave_valid_cache(tmp_path):
+    """Two OS processes cold-starting the SAME exec-cache entry
+    concurrently both publish via atomic tmp+rename: exactly one valid
+    entry file survives (last wins), no partial temp files leak, and a
+    subsequent reader deserializes it compile-free."""
+    cache_dir = tmp_path / "exec"
+    cache_dir.mkdir()
+    script = tmp_path / "exec_writer.py"
+    script.write_text(_EXEC_WRITER)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(cache_dir),
+         str(tmp_path / f"writer{i}.json")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    errs = []
+    for p in procs:
+        try:
+            _, e = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            _, e = p.communicate()
+        if p.returncode != 0:
+            errs.append(e[-3000:])
+    assert not errs, errs
+    payloads = [json.loads((tmp_path / f"writer{i}.json").read_text())
+                for i in range(2)]
+    # both raced through a cold compile and produced identical results
+    assert all(pl["compiles"] >= 1 for pl in payloads)
+    assert payloads[0]["labels"] == payloads[1]["labels"]
+    names = os.listdir(cache_dir)
+    assert len([n for n in names if n.endswith(".nmfxexec")]) == 1
+    assert not [n for n in names if n.endswith(".part")]
+    # the surviving entry is a valid, complete record this process can
+    # deserialize and serve from — no recompile
+    from nmfx import exec_cache as ec
+    from nmfx.config import (ConsensusConfig, ExecCacheConfig, InitConfig,
+                             SolverConfig)
+
+    cache = ec.ExecCache(ExecCacheConfig(cache_dir=str(cache_dir)))
+    ccfg = ConsensusConfig(ks=(2,), restarts=2, seed=3, grid_exec="grid",
+                           grid_slots=2)
+    _, hit = cache.executable((60, 20), ccfg, SolverConfig(max_iter=20),
+                              InitConfig())
+    assert hit and cache.stats["persist_hits"] == 1 and cache.misses == 0
+
+
 def test_two_process_grid_axes(tmp_path):
     """Feature-axis collectives spanning the process boundary: a (1, 2, 2)
     grid mesh over two OS processes running the kl grid driver — every
