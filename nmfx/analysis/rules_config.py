@@ -25,6 +25,11 @@ introspection hooks added for it — no hash-body parsing):
   ``repr=False`` stays in the in-memory key but vanishes from the disk
   key, so two configs differing only in it would share one on-disk
   entry and a fresh process would deserialize the wrong executable.
+* ``data_cache.data_key_fields()`` — what the device-resident input
+  cache's content-fingerprint key (``data_cache.DataKey``) compares: a
+  key field added with ``compare=False`` would serve ONE resident
+  device buffer to two (matrix, placement) pairs that must differ —
+  the data-plane twin of the executable-key hazard above.
 
 Every field must be fingerprint-covered or declared non-numerics; every
 exclusion must be declared; the declaration must not go stale; both
@@ -66,6 +71,8 @@ def check_config_coverage(
     noncompare_fields: "dict[str, tuple[str, ...]]" = {},
     persist_key_covered: "frozenset[str] | None" = None,
     nonrepr_fields: "dict[str, tuple[str, ...]]" = {},
+    data_fields: "frozenset[str] | None" = None,
+    data_key_covered: "frozenset[str] | None" = None,
 ) -> "list[str]":
     """The pure contract check; returns human-readable problems.
 
@@ -169,11 +176,23 @@ def check_config_coverage(
                 "disk key (exec_cache.persist_key_fields); disk entries "
                 "written under different values of it would be served "
                 "interchangeably across processes")
+    # 9. the device-resident input cache's DataKey must compare on
+    #    every field it declares: the cache looks entries up by the
+    #    key's dataclass hash/eq, so a compare=False field would alias
+    #    two (matrix, placement) pairs onto one cached device buffer —
+    #    the data-plane twin of the executable-key hazards above
+    if data_fields is not None and data_key_covered is not None:
+        for name in sorted(data_fields - data_key_covered):
+            problems.append(
+                f"DataKey.{name} is not covered by the device-resident "
+                "input-cache key (data_cache.data_key_fields) — two "
+                "placements differing in it would share one cached "
+                "device buffer")
     return problems
 
 
 def _live_universe():
-    from nmfx import exec_cache, registry
+    from nmfx import data_cache, exec_cache, registry
     from nmfx.config import ExperimentalConfig, SolverConfig
 
     def _hashable(cls) -> bool:
@@ -192,9 +211,13 @@ def _live_universe():
         declared_non_numerics=tuple(SolverConfig.NON_NUMERICS_FIELDS),
         exec_key_covered=exec_cache.solver_key_fields(),
         persist_key_covered=exec_cache.persist_key_fields(),
+        data_fields=frozenset(
+            f.name for f in dataclasses.fields(data_cache.DataKey)),
+        data_key_covered=data_cache.data_key_fields(),
         hashable_configs={"SolverConfig": _hashable(SolverConfig),
                           "ExperimentalConfig": _hashable(
-                              ExperimentalConfig)},
+                              ExperimentalConfig),
+                          "DataKey": _hashable(data_cache.DataKey)},
         noncompare_fields={
             cls.__name__: tuple(f.name
                                 for f in dataclasses.fields(cls)

@@ -148,6 +148,39 @@ class ConsensusResult:
                                                    for c in z["col_names"]))
 
 
+def _build_k_result(k: int, out, linkage: str,
+                    selection=None) -> KResult:
+    """One rank's host-side assembly — the SINGLE implementation both
+    the sequential loop and the streaming harvest workers
+    (``nmfx/harvest.py``) call, so the two paths are bit-identical by
+    construction. ``out`` is a host-materialized ``KSweepOutput``;
+    ``selection`` injects a precomputed (rho, membership, order) (the
+    device rank-selection path), else the host
+    hclust/cophenetic/cutree runs here."""
+    cons = np.asarray(out.consensus, dtype=np.float64)
+    if selection is not None:
+        rho, membership, order = selection
+        rho = float(rho)
+        membership = np.asarray(membership)
+        order = np.asarray(order)
+    else:
+        rho, membership, order = coph.rank_selection(cons, k, linkage)
+    rho = float(np.format_float_positional(
+        rho, precision=4, fractional=False))  # signif(rho,4) nmf.r:172
+    return KResult(
+        k=k, consensus=cons, rho=rho,
+        dispersion=float(np.mean((2.0 * cons - 1.0) ** 2)),
+        membership=membership, order=order,
+        iterations=out.iterations,
+        dnorms=out.dnorms,
+        stop_reasons=out.stop_reasons,
+        best_w=out.best_w,
+        best_h=out.best_h,
+        all_w=out.all_w,
+        all_h=out.all_h,
+    )
+
+
 def run_example(outdir: str | None = "./nmfx_out", **kwargs):
     """The reference's ``runExample`` entry (nmf.r:6-14) on equivalent
     synthetic data: a 1000x40 two-group expression matrix (the bundled
@@ -293,6 +326,7 @@ def nmfconsensus(
     mesh=None,
     use_mesh: bool = True,
     rank_selection: str = "host",
+    harvest: str = "streamed",
     keep_factors: bool = False,
     grid_exec: str = "auto",
     grid_slots: int = 48,
@@ -318,6 +352,20 @@ def nmfconsensus(
     clustering itself on the accelerator (``nmfx/ops/hclust_jax.py``) —
     the consensus matrix still comes to host once, for the returned
     ``KResult``, overlapped with the device clustering.
+
+    ``harvest``: how per-rank results cross to host under host rank
+    selection — "streamed" (default) pipelines each rank's
+    device→host copy AND its hclust/cophenetic/cutree through worker
+    threads the moment that rank's device output exists, so the host
+    tail overlaps the remaining ranks' device solve
+    (``nmfx/harvest.py``; results are bit-identical to the sequential
+    path — same transfers, same host math, pinned by
+    tests/test_harvest.py); "sequential" restores the strictly
+    phase-ordered path (one end-of-sweep batched transfer, then rank
+    selection) — the reference's shape (nmf.r:146-253) and the
+    measurement baseline the streamed path is audited against.
+    ``rank_selection="device"`` implies the sequential assembly (the
+    clustering already overlaps the transfer on-device).
 
     ``keep_factors``: retain every restart's (W, H) in each ``KResult``
     (``all_w``/``all_h``) — the reference registry's per-job retention
@@ -351,6 +399,9 @@ def nmfconsensus(
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
                          f"{rank_selection!r}")
+    if harvest not in ("streamed", "sequential"):
+        raise ValueError("harvest must be 'streamed' or 'sequential', got "
+                         f"{harvest!r}")
     arr, col_names = _as_matrix(data)
     if not np.isfinite(arr).all():
         raise ValueError("input matrix contains non-finite values")
@@ -387,59 +438,65 @@ def nmfconsensus(
 
         profiler = NullProfiler()
 
-    raw = sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
-                profiler=profiler, exec_cache=exec_cache)
+    streamed = harvest == "streamed" and rank_selection == "host"
+    if streamed:
+        # streaming harvest: the sweep layer hands each rank's device
+        # output to the pipeline the moment it EXISTS (async dispatch —
+        # arrays are futures), so its device→host copy and its host
+        # rank selection run in worker threads while later ranks still
+        # solve on device. results() joins; per-rank host math is the
+        # shared _build_k_result, so this path is bit-identical to the
+        # sequential one below.
+        from nmfx.harvest import HarvestPipeline
 
-    # Device-path rank selection is dispatched for every k BEFORE anything
-    # is pulled to host, so the clustering overlaps the transfer below.
-    dev_sel = None
-    if rank_selection == "device":
-        import jax.numpy as jnp
+        pipeline = HarvestPipeline(linkage=ccfg.linkage, profiler=profiler)
+        try:
+            sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
+                  profiler=profiler, exec_cache=exec_cache,
+                  on_rank=pipeline.submit)
+            per_k = pipeline.results()
+        finally:
+            pipeline.close()
+        # results() yields submission order (checkpoint-loaded ranks
+        # stream first); normalize to ks order like the sequential path
+        per_k = {k: per_k[k] for k in ccfg.ks}
+    else:
+        raw = sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
+                    profiler=profiler, exec_cache=exec_cache)
 
-        from nmfx.ops.hclust_jax import rank_selection_jax
+        # Device-path rank selection is dispatched for every k BEFORE
+        # anything is pulled to host, so the clustering overlaps the
+        # transfer below.
+        dev_sel = None
+        if rank_selection == "device":
+            import jax.numpy as jnp
 
-        # its own phase so per-k trace/compile cost (synchronous, host-side)
-        # isn't silently charged to device_to_host or to no phase at all
-        with profiler.phase("rank_selection_dispatch"):
-            dev_sel = {k: rank_selection_jax(jnp.asarray(out.consensus), k,
-                                             ccfg.linkage)
-                       for k, out in raw.items()}
-    # ONE batched device→host transfer for every rank's outputs (labels are
-    # never read here — keep them out of the transfer): a per-field
-    # np.asarray pays one round trip per array, ~50–150 ms each through a
-    # remote-attached chip — 0.4–1.4 s of pure latency measured on the
-    # 9-rank north star (same reasoning as registry.save)
-    with profiler.phase("device_to_host"):
-        host, dev_sel = jax.device_get(
-            ({k: out._replace(labels=None) for k, out in raw.items()},
-             dev_sel))
+            from nmfx.ops.hclust_jax import rank_selection_jax
 
-    per_k: dict[int, KResult] = {}
-    for k, out in host.items():
-        with profiler.phase("rank_selection"):
-            cons = np.asarray(out.consensus, dtype=np.float64)
-            if dev_sel is not None:
-                rho, membership, order = dev_sel[k]
-                rho = float(rho)
-                membership = np.asarray(membership)
-                order = np.asarray(order)
-            else:
-                rho, membership, order = coph.rank_selection(
-                    cons, k, ccfg.linkage)
-            rho = float(np.format_float_positional(
-                rho, precision=4, fractional=False))  # signif(rho,4) nmf.r:172
-        per_k[k] = KResult(
-            k=k, consensus=cons, rho=rho,
-            dispersion=float(np.mean((2.0 * cons - 1.0) ** 2)),
-            membership=membership, order=order,
-            iterations=out.iterations,
-            dnorms=out.dnorms,
-            stop_reasons=out.stop_reasons,
-            best_w=out.best_w,
-            best_h=out.best_h,
-            all_w=out.all_w,
-            all_h=out.all_h,
-        )
+            # its own phase so per-k trace/compile cost (synchronous,
+            # host-side) isn't silently charged to device_to_host or to
+            # no phase at all
+            with profiler.phase("rank_selection_dispatch"):
+                dev_sel = {k: rank_selection_jax(
+                    jnp.asarray(out.consensus), k, ccfg.linkage)
+                    for k, out in raw.items()}
+        # ONE batched device→host transfer for every rank's outputs
+        # (labels are never read here — keep them out of the transfer):
+        # a per-field np.asarray pays one round trip per array,
+        # ~50–150 ms each through a remote-attached chip — 0.4–1.4 s of
+        # pure latency measured on the 9-rank north star (same
+        # reasoning as registry.save)
+        with profiler.phase("device_to_host"):
+            host, dev_sel = jax.device_get(
+                ({k: out._replace(labels=None) for k, out in raw.items()},
+                 dev_sel))
+
+        per_k = {}
+        for k, out in host.items():
+            with profiler.phase("rank_selection"):
+                per_k[k] = _build_k_result(
+                    k, out, ccfg.linkage,
+                    selection=None if dev_sel is None else dev_sel[k])
 
     result = ConsensusResult(ks=ccfg.ks, per_k=per_k,
                              col_names=tuple(col_names))

@@ -225,6 +225,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "start'). Implies --exec-cache; independent of "
                         "--compile-cache (which caches XLA's intermediate "
                         "compilation products, not loaded executables)")
+    p.add_argument("--pipeline-ranks", action="store_true",
+                   help="serve each rank through its OWN bucketed "
+                        "executable (ExecCacheConfig.pipeline_ranks): "
+                        "cold compiles run concurrently and dispatch is "
+                        "lowest-k-first, so k=2 solves while k=10 still "
+                        "compiles, and the streamed harvest consumes "
+                        "each rank as it lands. Implies --exec-cache. "
+                        "Exactness caveat (docs/serving.md): each "
+                        "rank's results are exactly a single-rank grid "
+                        "sweep's, but the grid COMPOSITION differs from "
+                        "the whole-grid default, so cross-mode results "
+                        "agree only to float tolerance")
+    p.add_argument("--input-cache-bytes", type=int, default=None,
+                   metavar="N",
+                   help="byte cap for the device-resident input cache "
+                        "(repeat sweeps over the same matrix transfer "
+                        "zero bytes; default 2 GiB of live device "
+                        "buffers). 0 disables retention — every request "
+                        "transfers — for accelerators where resident "
+                        "inputs would crowd solver working memory")
     p.add_argument("--warm-cache", action="store_true",
                    help="run the --warm-shapes warmup in the BACKGROUND "
                         "(compiles overlap dataset loading and run setup; "
@@ -351,10 +371,18 @@ def main(argv: list[str] | None = None) -> int:
                             check_block=args.check_block)
     exec_cache = None
     warm_task = None
+    if args.input_cache_bytes is not None:
+        if args.input_cache_bytes < 0:
+            parser.error("--input-cache-bytes must be >= 0 "
+                         "(0 disables retention)")
+        from nmfx.data_cache import default_cache
+
+        default_cache().resize(max_bytes=args.input_cache_bytes)
     if args.warm_cache and not args.warm_shapes:
         parser.error("--warm-cache backgrounds the --warm-shapes warmup; "
                      "pass --warm-shapes with the shapes to pre-compile")
-    if args.exec_cache or args.warm_shapes or args.cache_dir:
+    if (args.exec_cache or args.warm_shapes or args.cache_dir
+            or args.pipeline_ranks):
         from nmfx.config import ConsensusConfig, ExecCacheConfig, InitConfig
         from nmfx.exec_cache import ExecCache
         from nmfx.sweep import default_mesh
@@ -370,8 +398,8 @@ def main(argv: list[str] | None = None) -> int:
                          "--checkpoint-dir (checkpointed sweeps resume "
                          "through the registry path, which bypasses the "
                          "executable cache)")
-        ecfg = (ExecCacheConfig(cache_dir=args.cache_dir)
-                if args.cache_dir else ExecCacheConfig())
+        ecfg = ExecCacheConfig(cache_dir=args.cache_dir,
+                               pipeline_ranks=args.pipeline_ranks)
         exec_cache = ExecCache(ecfg)
         if args.warm_shapes:
             cache_mesh = None if args.no_mesh else default_mesh()

@@ -1,0 +1,140 @@
+"""Streaming per-rank harvest: device→host transfer and host rank
+selection pipelined behind the device solve.
+
+The reference pipeline is strictly phase-sequential — load → solve grid
+→ gather → hclust/cophenetic (``nmf.r:106-119, 146-253``) — and the
+warm path here used to be too: every rank's results crossed to host in
+one end-of-sweep barrier, and the hclust/cophenetic/cutree rank
+selection ran after that, entirely outside the phase accounting
+(BENCH_r05: 0.278 s of device→host plus an untracked host tail against
+a 1.21 s solve). The batch-streaming NMF line (arxiv 2202.09518) gets
+its throughput from exactly this overlap; this module brings it to the
+DEFAULT warm path:
+
+The sweep layer (``sweep()``, ``ExecCache.run_sweep``) starts each
+rank's non-blocking ``copy_to_host_async`` (``start_host_fetch``) and
+invokes an ``on_rank(k, KSweepOutput)`` callback the moment rank k's
+device output EXISTS — dispatched, not completed: JAX arrays are
+futures. :meth:`HarvestPipeline.submit` is that callback. It hands the
+rank to a worker thread, which blocks on exactly that rank's arrays
+(ranks k+1… keep solving on device underneath), then runs the host rank
+selection (linkage/cophenetic/cutree from ``nmfx/cophenetic.py``) and
+assembles the rank's ``KResult``. :meth:`HarvestPipeline.results` joins
+the workers and returns ``{k: KResult}``.
+
+Bit-identity: the workers consume the same device outputs through the
+same ``device_get`` and the same ``api._build_k_result`` host math as
+the sequential path — per-rank results are bit-identical by
+construction, and tests/test_harvest.py pins streamed-vs-sequential
+equality across runs on every engine family reachable on CPU.
+
+Accounting: worker walls are credited to the OVERLAP phases
+``xfer.d2h_overlap`` (the blocking host fetch, which overlaps device
+compute of later ranks) and ``post.rank_selection`` (the host
+clustering) via the thread-safe ``Profiler.add_seconds`` — see
+``Profiler.audit`` for how they reconcile against the wall.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+
+__all__ = ["HarvestPipeline"]
+
+
+class HarvestPipeline:
+    """Producer/consumer pipeline from per-rank device outputs to
+    per-rank ``KResult``\\ s.
+
+    ``workers`` bounds the harvest threads (default: half the CPUs,
+    capped at 4 — rank selection is host-CPU-bound and must not starve
+    the main thread's dispatch). Threads are daemons and spawn lazily on
+    the first submit; :meth:`results` (or :meth:`close`) shuts them
+    down, re-raising the first worker failure.
+    """
+
+    def __init__(self, linkage: str = "average", profiler=None,
+                 workers: "int | None" = None):
+        from nmfx.profiling import NullProfiler
+
+        self._linkage = linkage
+        self._prof = profiler if profiler is not None else NullProfiler()
+        self._max_workers = (workers if workers is not None
+                             else max(1, min(4, (os.cpu_count() or 2) // 2)))
+        if self._max_workers < 1:
+            raise ValueError("workers must be >= 1")
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._futures: "dict[int, Future]" = {}
+        self._threads: "list[threading.Thread]" = []
+        self._closed = False
+
+    # -- producer side ----------------------------------------------------
+    def submit(self, k: int, out) -> None:
+        """Accept rank ``k``'s (possibly still-computing) device output.
+
+        Called by the sweep layer the moment the rank's arrays exist.
+        The sweep layer owns starting the non-blocking device→host
+        copies (``start_host_fetch`` runs at every ``on_rank`` call
+        site before the callback fires), so this only enqueues the
+        host-side harvest; it never blocks on device work.
+        """
+        if self._closed:
+            raise RuntimeError("harvest pipeline already closed")
+        if k in self._futures:
+            raise ValueError(f"rank {k} submitted twice")
+        fut: Future = Future()
+        self._futures[k] = fut
+        self._queue.put((k, out, fut))
+        if len(self._threads) < min(self._max_workers,
+                                    len(self._futures)):
+            t = threading.Thread(target=self._work, daemon=True,
+                                 name="nmfx-harvest")
+            t.start()
+            self._threads.append(t)
+
+    # -- consumer side ----------------------------------------------------
+    def _work(self) -> None:
+        from nmfx.api import _build_k_result
+
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            k, out, fut = item
+            try:
+                t0 = time.perf_counter()
+                # block on THIS rank only; labels feed the on-device
+                # consensus reduction and are never read host-side, so
+                # they stay out of the transfer (design.md §5b)
+                host = jax.device_get(out._replace(labels=None))
+                t1 = time.perf_counter()
+                self._prof.add_seconds("xfer.d2h_overlap", t1 - t0)
+                res = _build_k_result(k, host, self._linkage)
+                self._prof.add_seconds("post.rank_selection",
+                                       time.perf_counter() - t1)
+                fut.set_result(res)
+            except BaseException as e:  # re-raised by results()
+                fut.set_exception(e)
+
+    def results(self) -> dict:
+        """Join every submitted rank and return ``{k: KResult}`` in
+        submission order; the first worker failure re-raises here."""
+        try:
+            return {k: fut.result() for k, fut in self._futures.items()}
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Shut the worker threads down (idempotent). Ranks already
+        submitted still finish; their futures stay retrievable."""
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._threads:
+            self._queue.put(None)

@@ -14,15 +14,39 @@ routine with ``gettimeofday`` and print µs via ``outputTiming`` (reference
   wrapped region.
 
 Enabled from the CLI with ``--profile [--trace-dir D]``.
+
+Thread-safety: ``phase``/``mark``/``add_seconds`` may be called
+concurrently — the streaming harvest workers (``nmfx/harvest.py``)
+record their device→host and rank-selection walls from worker threads
+while the main thread times solve phases. All ``phases`` mutation is
+lock-guarded, so concurrent recording neither drops nor double-counts
+time (tests/test_profiling.py pins this).
+
+Overlap accounting: phases whose names start with an
+``OVERLAP_PREFIXES`` prefix (``xfer.``, ``post.``) record work that runs
+CONCURRENTLY with the main-thread pipeline — async transfer dispatch,
+worker-thread harvests. :meth:`Profiler.audit` therefore splits the
+books in two: the sequential phase sum (which must track the wall — the
+phase-sum-vs-wall audit that keeps hidden async time from silently
+migrating between phases) and the overlapped seconds, reported as an
+overlap ratio against the wall.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any
 
 import jax
+
+#: phase-name prefixes recorded as OVERLAPPED work: async-transfer
+#: bookkeeping (``xfer.``) and post-solve host work streamed through
+#: harvest worker threads (``post.``). These run concurrently with the
+#: sequential pipeline phases, so the audit keeps them out of the
+#: phase-sum-vs-wall reconciliation and reports them as overlap instead
+OVERLAP_PREFIXES = ("xfer.", "post.")
 
 
 class PhaseRecord:
@@ -33,6 +57,10 @@ class PhaseRecord:
         self.seconds = 0.0
         self.count = 0
 
+    @property
+    def overlapped(self) -> bool:
+        return self.name.startswith(OVERLAP_PREFIXES)
+
 
 class Profiler:
     """Accumulates per-phase wall-clock; optionally wraps a device trace."""
@@ -40,6 +68,7 @@ class Profiler:
     def __init__(self, trace_dir: str | None = None):
         self.trace_dir = trace_dir
         self.phases: dict[str, PhaseRecord] = {}
+        self._lock = threading.Lock()
         self._t0: float | None = None
         self._t_total: float | None = None
 
@@ -62,7 +91,6 @@ class Profiler:
         (or any array pytree) to block on device completion before the
         timer stops — otherwise JAX's async dispatch attributes device time
         to whichever later phase first touches the values."""
-        rec = self.phases.setdefault(name, PhaseRecord(name))
         sync_target: list[Any] = []
 
         def sync(x):
@@ -75,8 +103,7 @@ class Profiler:
         finally:
             for x in sync_target:
                 jax.block_until_ready(x)
-            rec.seconds += time.perf_counter() - t0
-            rec.count += 1
+            self.add_seconds(name, time.perf_counter() - t0)
 
     def mark(self, name: str) -> None:
         """Record an instantaneous event as a zero-duration phase
@@ -84,33 +111,84 @@ class Profiler:
         layer's ``compile.cache_hit``/``compile.persist_hit``/
         ``compile.persist_miss`` marks, where the whole point is that no
         — or only deserialization — time was spent)."""
-        self.phases.setdefault(name, PhaseRecord(name)).count += 1
+        self.add_seconds(name, 0.0)
 
     def add_seconds(self, name: str, seconds: float, count: int = 1) -> None:
-        """Credit externally-measured wall time to a phase. For work timed
-        off-thread — the serving layer's per-rank compile spans
-        (``compile.k=<k>``) run inside pool threads, where this
-        profiler's single-threaded ``phase`` bookkeeping must not be
-        touched — the coordinating thread records the measured seconds
-        here after the fact."""
-        rec = self.phases.setdefault(name, PhaseRecord(name))
-        rec.seconds += seconds
-        rec.count += count
+        """Credit measured wall time to a phase — the one mutation point
+        every recording entry (``phase``/``mark``/this) funnels through,
+        and it is lock-guarded: harvest workers and compile pools record
+        from their own threads concurrently with the main thread's
+        phases, and the accumulation must neither drop nor double-count
+        a contribution."""
+        with self._lock:
+            rec = self.phases.setdefault(name, PhaseRecord(name))
+            rec.seconds += seconds
+            rec.count += count
 
     # -- reporting ---------------------------------------------------------
     def total_seconds(self) -> float:
         if self._t_total is not None:
             return self._t_total
-        return sum(r.seconds for r in self.phases.values())
+        with self._lock:  # workers may be inserting phases concurrently
+            return sum(r.seconds for r in self.phases.values()
+                       if not r.overlapped)
+
+    def audit(self, wall_s: "float | None" = None) -> dict:
+        """Phase-sum-vs-wall reconciliation + overlap summary.
+
+        ``phase_sum_s`` is the SEQUENTIAL phases only (overlap-classed
+        phases run concurrently with them, so including them would make
+        the sum exceed the wall by design); ``coverage`` is how much of
+        the wall those phases explain — the audit that keeps hidden
+        async time from migrating between phases unaccounted (the
+        round-5/r05 failure mode: host rank selection ran entirely
+        outside the phase books). ``overlap_s``/``overlap_ratio`` report
+        the work that ran behind the sequential pipeline — transfer
+        dispatch and streamed harvests; a ratio near the non-solve share
+        of the wall means the pipelining is actually hiding that work.
+
+        Meaningful when the sequential phases are flat (non-nested) —
+        true of the sweep/serving pipeline; compile-miss paths nest
+        spans and are not audited.
+        """
+        if wall_s is None:
+            wall_s = (self._t_total if self._t_total is not None
+                      else self.total_seconds())
+        with self._lock:
+            seq = sum(r.seconds for r in self.phases.values()
+                      if not r.overlapped)
+            over = sum(r.seconds for r in self.phases.values()
+                       if r.overlapped)
+        cov = seq / wall_s if wall_s > 0 else 0.0
+        return {"wall_s": round(wall_s, 3),
+                "phase_sum_s": round(seq, 3),
+                "unattributed_s": round(max(wall_s - seq, 0.0), 3),
+                "coverage": round(cov, 3),
+                "overlap_s": round(over, 3),
+                "overlap_ratio": round(over / wall_s, 3)
+                if wall_s > 0 else 0.0}
 
     def report(self) -> str:
         total = self.total_seconds()
         lines = [f"{'phase':<28}{'calls':>6}{'seconds':>10}{'share':>8}"]
-        for rec in sorted(self.phases.values(), key=lambda r: -r.seconds):
-            share = rec.seconds / total if total > 0 else 0.0
-            lines.append(f"{rec.name:<28}{rec.count:>6}{rec.seconds:>10.3f}"
-                         f"{share:>7.1%}")
+        with self._lock:  # snapshot: workers may still insert phases
+            recs = list(self.phases.values())
+        for rec in sorted(recs, key=lambda r: -r.seconds):
+            if rec.overlapped:
+                # the denominator is the SEQUENTIAL sum: a share here
+                # would be against a total this row is not part of
+                # (and could exceed 100% with several workers)
+                tag, share_txt = "~", f"{'-':>7}"
+            else:
+                share = rec.seconds / total if total > 0 else 0.0
+                tag, share_txt = "", f"{share:>7.1%}"
+            lines.append(f"{tag + rec.name:<28}{rec.count:>6}"
+                         f"{rec.seconds:>10.3f}{share_txt}")
         lines.append(f"{'total':<28}{'':>6}{total:>10.3f}{'':>8}")
+        a = self.audit()
+        lines.append(f"(~ = overlapped with the phases above; "
+                     f"{a['overlap_s']:.3f}s overlapped, ratio "
+                     f"{a['overlap_ratio']:.0%} of wall)")
         if self.trace_dir is not None:
             lines.append(f"device trace written to {self.trace_dir} "
                          "(tensorboard --logdir, or load in Perfetto)")

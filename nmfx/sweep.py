@@ -1147,7 +1147,7 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
           init_cfg: InitConfig = InitConfig(),
           mesh: Mesh | None = None,
           registry=None, profiler=None,
-          exec_cache=None) -> dict[int, KSweepOutput]:
+          exec_cache=None, on_rank=None) -> dict[int, KSweepOutput]:
     """Full (k × restart) grid — by default as ONE whole-grid solve.
 
     Under ``cfg.grid_exec`` "grid"/"auto" (and an eligible config, see
@@ -1171,15 +1171,25 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     entirely, and with a persistent ``cache_dir`` a fresh process
     deserializes the bucket's executable from disk instead of
     recompiling it. Falls back to the normal path for non-cacheable
-    configs and for checkpointed (``registry``) runs."""
+    configs and for checkpointed (``registry``) runs.
+
+    ``on_rank(k, KSweepOutput)``: streaming hook, invoked the moment
+    rank k's device output EXISTS (dispatched, not completed — the
+    arrays are async futures). The harvest pipeline
+    (``nmfx/harvest.py``) uses it to overlap per-rank device→host
+    copies and host rank selection with the remaining ranks' device
+    solve; checkpoint-loaded ranks are streamed too. The callback must
+    not block (it runs on the dispatching thread)."""
     if profiler is None:
         from nmfx.profiling import NullProfiler
 
         profiler = NullProfiler()
+    if on_rank is None:
+        on_rank = _noop_rank
     if (exec_cache is not None and registry is None
             and exec_cache.cacheable(cfg, solver_cfg, mesh)):
         return exec_cache.run_sweep(a, cfg, solver_cfg, init_cfg, mesh,
-                                    profiler=profiler)
+                                    profiler=profiler, on_rank=on_rank)
     # Multi-host discipline: every process must take the same compute-vs-skip
     # branch for each k, or the skippers never join the collectives compiled
     # into the sharded sweep and the job deadlocks. The coordinator (the only
@@ -1206,15 +1216,22 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
                     None if x is None else np.asarray(x) for x in
                     multihost_utils.broadcast_one_to_all(tuple(loaded))))
             out[k] = loaded
+            on_rank(k, loaded)
         else:
             needed.append(k)
     if not needed:  # fully-checkpointed re-run: A never transfers
         return out
-    # place A on device once, replicated over the mesh — re-transferring
-    # the matrix for every rank costs more than a rank's whole solve at
-    # small sizes (~0.14 s/call through the TPU tunnel for a 10 MB matrix)
-    with profiler.phase("host_to_device") as sync:
-        a_dev = sync(place_input(a, solver_cfg, mesh))
+    # place A on device once, replicated over the mesh, THROUGH the
+    # device-resident input cache: a repeat sweep over the same matrix
+    # (serving traffic, re-runs at new ks) transfers ZERO bytes, and a
+    # first touch dispatches a chunked async copy that overlaps the
+    # first rank's trace/compile instead of blocking here —
+    # re-transferring the matrix for every rank costs more than a
+    # rank's whole solve at small sizes (~0.14 s/call through the TPU
+    # tunnel for a 10 MB matrix)
+    from nmfx.data_cache import default_cache
+
+    a_dev = default_cache().place(a, solver_cfg, mesh, profiler=profiler)
 
     eligible = grid_exec_ok(solver_cfg, mesh)
     if cfg.grid_exec == "grid" and not eligible:
@@ -1246,6 +1263,10 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             # dispatch), the results are already streaming/resident
             start_host_fetch(solved)
         out.update(solved)
+        for k in needed:
+            # stream: one executable produced every rank, but each
+            # rank's arrays complete (and harvest) independently
+            on_rank(k, solved[k])
         if 0 < _log.level <= logging.INFO and coord:
             iters = {k: float(np.asarray(v.iterations).mean())
                      for k, v in solved.items()}
@@ -1275,6 +1296,7 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             # k+1 compiles/solves, instead of all ranks paying one end
             # barrier at the pipeline's device_get
             start_host_fetch(out[k])
+        on_rank(k, out[k])
         if 0 < _log.level <= logging.INFO and coord:
             # reading the stats forces a device sync, trading the k-grid's
             # async dispatch pipelining for live progress. Gated on a level
@@ -1289,6 +1311,10 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             with profiler.phase("checkpoint"):
                 registry.save(k, out[k])
     return {k: out[k] for k in cfg.ks}
+
+
+def _noop_rank(k: int, out: KSweepOutput) -> None:
+    """Default ``on_rank`` hook: no streaming consumer attached."""
 
 
 def place_input(a, solver_cfg: SolverConfig, mesh: Mesh | None) -> jax.Array:

@@ -216,3 +216,41 @@ def test_cli_exec_cache_rejects_checkpoint_dir(gct_path, tmp_path):
     with pytest.raises(SystemExit):
         main([gct_path, "--exec-cache", "--checkpoint-dir",
               str(tmp_path / "ckpt"), "--no-files"])
+
+
+def test_cli_pipeline_ranks(gct_path, capsys):
+    """ISSUE 5 satellite: --pipeline-ranks (per-rank executables,
+    lowest-k-first dispatch feeding the streamed harvest) gets a CLI
+    surface; it implies --exec-cache."""
+    rc = main([gct_path, "--ks", "2-3", "--restarts", "4",
+               "--maxiter", "150", "--no-files", "--pipeline-ranks"])
+    assert rc == 0
+    assert "best k = 2" in capsys.readouterr().out
+
+
+def test_cli_pipeline_ranks_rejects_checkpoint_dir(gct_path, tmp_path):
+    # implies --exec-cache, so it inherits its incompatibilities
+    with pytest.raises(SystemExit):
+        main([gct_path, "--pipeline-ranks", "--checkpoint-dir",
+              str(tmp_path / "ckpt"), "--no-files"])
+
+
+def test_cli_input_cache_bytes(gct_path, capsys):
+    """--input-cache-bytes 0 disables input-buffer retention (the run
+    still works, nothing stays resident); negatives are a clean usage
+    error."""
+    from nmfx.data_cache import default_cache
+
+    old = default_cache().max_bytes
+    try:
+        rc = main([gct_path, "--ks", "2", "--restarts", "3",
+                   "--maxiter", "100", "--no-files",
+                   "--input-cache-bytes", "0"])
+        assert rc == 0
+        assert "best k = 2" in capsys.readouterr().out
+        assert default_cache().max_bytes == 0
+        assert default_cache().stats["entries"] == 0
+    finally:
+        default_cache().resize(max_bytes=old)
+    with pytest.raises(SystemExit):
+        main([gct_path, "--input-cache-bytes", "-1", "--no-files"])

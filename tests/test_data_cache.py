@@ -1,0 +1,221 @@
+"""Device-resident input cache (ISSUE 5): repeat sweeps over the same
+matrix transfer zero bytes, gated by the module transfer counters (the
+honesty-counter discipline of ``exec_cache.compile_count()``); the
+content-fingerprint key discriminates everything that changes the
+device buffer; the LRU bounds live-buffer memory."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx import data_cache
+from nmfx.config import SolverConfig
+from nmfx.data_cache import DataCache, DataKey, data_key_fields
+
+SCFG = SolverConfig()
+
+
+def _matrix(seed=0, shape=(40, 12)):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0.1, 1.0, size=shape)
+
+
+def test_repeat_place_is_zero_transfer():
+    """THE contract: the second placement of the same content serves the
+    resident buffer — counters unchanged, same device array back."""
+    cache = DataCache()
+    a = _matrix(0)
+    t0, b0 = data_cache.transfer_count(), data_cache.h2d_bytes()
+    x1 = cache.place(a, SCFG)
+    t1, b1 = data_cache.transfer_count(), data_cache.h2d_bytes()
+    assert t1 == t0 + 1 and b1 > b0
+    x2 = cache.place(a, SCFG)
+    assert x2 is x1
+    assert data_cache.transfer_count() == t1
+    assert data_cache.h2d_bytes() == b1
+    assert cache.stats["hits"] == 1 and cache.stats["misses"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(x1), np.asarray(a, np.asarray(x1).dtype))
+
+
+def test_content_fingerprint_not_identity():
+    """An equal-content COPY hits; an in-place mutation misses — the
+    honesty discipline: the key is the bytes, not the object."""
+    cache = DataCache()
+    a = _matrix(1)
+    x1 = cache.place(a, SCFG)
+    t = data_cache.transfer_count()
+    assert cache.place(a.copy(), SCFG) is x1  # same bytes, zero transfer
+    assert data_cache.transfer_count() == t
+    a[0, 0] += 1.0  # caller mutates: must NOT see the stale buffer
+    x3 = cache.place(a, SCFG)
+    assert x3 is not x1
+    assert data_cache.transfer_count() == t + 1
+    assert float(np.asarray(x3)[0, 0]) == pytest.approx(float(a[0, 0]))
+
+
+def test_key_discriminates_placement():
+    """Same content under a different dtype or pad shape is a different
+    buffer — every DataKey field separates entries."""
+    cache = DataCache()
+    a = _matrix(2)
+    base = cache.place(a, SCFG)
+    padded = cache.place(a, SCFG, pad_shape=(64, 16))
+    assert padded.shape == (64, 16)
+    assert padded is not base
+    m, n = a.shape
+    np.testing.assert_array_equal(np.asarray(padded)[:m, :n],
+                                  np.asarray(base))
+    assert np.asarray(padded)[m:, :].sum() == 0
+    # a different placement dtype is a different key (even where the
+    # backend canonicalizes the buffer dtype, e.g. x64 disabled)
+    other_dtype = cache.place(a, SolverConfig(dtype="float64"))
+    assert other_dtype is not base
+    assert cache.stats["misses"] == 3
+    # and each repeat is a hit
+    assert cache.place(a, SCFG, pad_shape=(64, 16)) is padded
+    assert cache.stats["hits"] == 1
+
+
+def test_device_array_passthrough_not_cached():
+    """A jax.Array input is already resident: no fingerprint round trip,
+    no counter movement, no cache entry."""
+    cache = DataCache()
+    a_dev = jnp.asarray(_matrix(3), jnp.float32)
+    t = data_cache.transfer_count()
+    out = cache.place(a_dev, SCFG)
+    assert data_cache.transfer_count() == t
+    assert cache.stats["entries"] == 0
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(a_dev))
+    padded = cache.place(a_dev, SCFG, pad_shape=(64, 16))
+    assert padded.shape == (64, 16)
+    assert cache.stats["entries"] == 0
+
+
+def test_lru_entry_bound():
+    cache = DataCache(max_entries=2)
+    first = _matrix(10)
+    cache.place(first, SCFG)
+    cache.place(_matrix(11), SCFG)
+    cache.place(_matrix(12), SCFG)  # evicts the LRU (first)
+    assert cache.stats["entries"] == 2
+    assert cache.stats["evictions"] == 1
+    t = data_cache.transfer_count()
+    cache.place(first, SCFG)  # evicted: a fresh transfer
+    assert data_cache.transfer_count() == t + 1
+
+
+def test_byte_bound_and_oversized_not_retained():
+    a = _matrix(13)
+    nbytes = a.shape[0] * a.shape[1] * 4  # float32 placement
+    cache = DataCache(max_bytes=nbytes - 1)
+    out = cache.place(a, SCFG)  # transferred but too big to retain
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(a, np.float32))
+    assert cache.stats["entries"] == 0
+
+
+def test_chunked_put_bitwise_equal():
+    """The double-buffered first touch (row-chunked async device_put)
+    reassembles the exact array."""
+    rows = 2200  # 2200 x 1024 f32 ~ 9 MB > _CHUNK_MIN_BYTES
+    host = np.arange(rows * 1024, dtype=np.float32).reshape(rows, 1024)
+    out = DataCache._chunked_put(host)
+    assert out.shape == host.shape
+    np.testing.assert_array_equal(np.asarray(out), host)
+
+
+def test_data_key_fields_cover_every_field():
+    """The NMFX001 hook: every DataKey field participates in the cache
+    key (compare=True). A compare=False field would alias two
+    placements onto one buffer — lint fails before this test does."""
+    assert data_key_fields() == frozenset(
+        f.name for f in dataclasses.fields(DataKey))
+    assert {"fingerprint", "src_dtype", "shape", "dtype", "pad_shape",
+            "mesh", "device"} <= data_key_fields()
+
+
+def test_byte_view_aliasing_rejected():
+    """Same raw bytes under a different source dtype are different
+    VALUES: a float32 matrix and its int32 byte-view must not share a
+    buffer (the key carries src_dtype, not just the content hash)."""
+    cache = DataCache()
+    a = np.asarray([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    b = a.view(np.int32).copy()  # identical bytes, different values
+    x = cache.place(a, SCFG)
+    y = cache.place(b, SCFG)
+    assert y is not x
+    np.testing.assert_array_equal(np.asarray(y),
+                                  b.astype(np.float32))
+
+
+def test_second_sweep_zero_h2d():
+    """End to end through the DEFAULT path (the acceptance gate): the
+    second ``sweep()`` over the same array records zero h2d transfers
+    and zero bytes."""
+    from nmfx.config import ConsensusConfig
+    from nmfx.sweep import sweep
+
+    a = _matrix(20, shape=(60, 20))
+    ccfg = ConsensusConfig(ks=(2,), restarts=2, seed=5)
+    scfg = SolverConfig(max_iter=20)
+    out1 = sweep(a, ccfg, scfg)
+    jax.block_until_ready(out1[2].consensus)
+    t, b = data_cache.transfer_count(), data_cache.h2d_bytes()
+    out2 = sweep(a, ccfg, scfg)
+    jax.block_until_ready(out2[2].consensus)
+    assert data_cache.transfer_count() == t, "second sweep paid a transfer"
+    assert data_cache.h2d_bytes() == b, "second sweep paid h2d bytes"
+    np.testing.assert_array_equal(np.asarray(out1[2].consensus),
+                                  np.asarray(out2[2].consensus))
+
+
+def test_profiler_sees_hit_and_miss_phases():
+    from nmfx.profiling import Profiler
+
+    cache = DataCache()
+    a = _matrix(30)
+    prof = Profiler()
+    cache.place(a, SCFG, profiler=prof)
+    assert prof.phases["xfer.h2d_overlap"].count == 1
+    cache.place(a, SCFG, profiler=prof)
+    assert prof.phases["xfer.h2d_cache_hit"].count == 1
+    # both are overlap-classed: they never inflate the sequential
+    # phase-sum the audit reconciles against the wall
+    assert all(prof.phases[n].overlapped for n in prof.phases)
+
+
+def test_resize_evicts_and_disables():
+    """The runtime sizing surface (CLI --input-cache-bytes): shrinking
+    evicts LRU-first; max_bytes=0 retains nothing but still places
+    correctly."""
+    cache = DataCache(max_entries=4)
+    a, b = _matrix(40), _matrix(41)
+    cache.place(a, SCFG)
+    cache.place(b, SCFG)
+    assert cache.stats["entries"] == 2
+    nbytes_one = a.size * 4  # float32 placement
+    cache.resize(max_bytes=nbytes_one)  # room for ONE entry: a evicted
+    assert cache.stats["entries"] == 1
+    assert cache.place(b, SCFG) is not None
+    assert cache.stats["hits"] == 1  # b survived as the MRU entry
+    cache.resize(max_bytes=0)
+    assert cache.stats["entries"] == 0
+    out = cache.place(a, SCFG)  # transfers, retains nothing
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(a, np.float32))
+    assert cache.stats["entries"] == 0
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        DataCache(max_entries=0)
+    with pytest.raises(ValueError):
+        DataCache(max_bytes=-1)
+    with pytest.raises(ValueError):
+        DataCache().resize(max_entries=0)
+    with pytest.raises(ValueError):
+        DataCache().resize(max_bytes=-1)

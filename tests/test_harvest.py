@@ -1,0 +1,184 @@
+"""Streamed-harvest determinism (ISSUE 5 tentpole): the pipelined
+warm path — per-rank device→host copies and host rank selection running
+in worker threads while later ranks still solve — must be BIT-IDENTICAL
+to the strictly phase-sequential path on every engine family reachable
+on CPU. Overlap buys wall time, never drift: both paths consume the
+same device outputs through the same ``device_get`` and the same
+``api._build_k_result`` host math, and these tests pin that equality
+field by field. Plus the pipeline's own mechanics (double-submit,
+error propagation, close idempotence, overlap-phase accounting)."""
+
+import numpy as np
+import pytest
+
+from nmfx.api import nmfconsensus
+from nmfx.harvest import HarvestPipeline
+from nmfx.profiling import Profiler
+
+KS = (2, 3)
+RESTARTS = 2
+MAX_ITER = 30
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    from nmfx.datasets import two_group_matrix
+
+    # <= 60x20: the smallest shape with two planted groups (tier-1
+    # budget discipline, ISSUE 5 satellite)
+    return two_group_matrix(n_genes=60, n_per_group=10, seed=3)
+
+
+def _run(data, harvest, *, algorithm="mu", backend="auto",
+         grid_exec="auto", **kw):
+    from nmfx.config import SolverConfig
+
+    scfg = SolverConfig(algorithm=algorithm, backend=backend,
+                        max_iter=MAX_ITER)
+    return nmfconsensus(data, ks=KS, restarts=RESTARTS, seed=11,
+                        solver_cfg=scfg, grid_exec=grid_exec,
+                        use_mesh=False, harvest=harvest, **kw)
+
+
+def assert_results_bit_equal(streamed, sequential):
+    """Every per-rank field the KResult carries, bitwise."""
+    assert set(streamed.per_k) == set(sequential.per_k)
+    for k in sequential.per_k:
+        s, q = streamed.per_k[k], sequential.per_k[k]
+        assert s.consensus.dtype == q.consensus.dtype
+        assert np.array_equal(s.consensus, q.consensus), f"consensus k={k}"
+        assert s.rho == q.rho, f"rho k={k}"
+        assert np.array_equal(s.membership, q.membership), f"membership k={k}"
+        assert np.array_equal(s.order, q.order), f"order k={k}"
+        assert np.array_equal(s.iterations, q.iterations), f"iterations k={k}"
+        assert np.array_equal(s.stop_reasons, q.stop_reasons), (
+            f"stop_reasons k={k}")
+        assert np.array_equal(s.dnorms, q.dnorms), f"dnorms k={k}"
+        assert s.dispersion == q.dispersion, f"dispersion k={k}"
+        assert np.array_equal(s.best_w, q.best_w), f"best_w k={k}"
+        assert np.array_equal(s.best_h, q.best_h), f"best_h k={k}"
+
+
+# one representative per engine family reachable on CPU: the whole-grid
+# engine (mu routes through the packed/scheduled machinery under
+# grid_exec auto), the vmapped per-k loop, and the packed per-k family
+# on a second algorithm
+@pytest.mark.parametrize("algorithm,backend,grid_exec", [
+    ("mu", "auto", "auto"),      # whole-grid engine
+    ("mu", "vmap", "per_k"),     # vmapped per-k loop
+    ("hals", "packed", "auto"),  # packed family, non-mu block
+])
+def test_streamed_equals_sequential(small_data, algorithm, backend,
+                                    grid_exec):
+    streamed = _run(small_data, "streamed", algorithm=algorithm,
+                    backend=backend, grid_exec=grid_exec)
+    sequential = _run(small_data, "sequential", algorithm=algorithm,
+                      backend=backend, grid_exec=grid_exec)
+    assert_results_bit_equal(streamed, sequential)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("algorithm,backend,grid_exec", [
+    ("als", "auto", "per_k"),
+    ("kl", "packed", "auto"),
+])
+def test_streamed_equals_sequential_more_engines(small_data, algorithm,
+                                                 backend, grid_exec):
+    streamed = _run(small_data, "streamed", algorithm=algorithm,
+                    backend=backend, grid_exec=grid_exec)
+    sequential = _run(small_data, "sequential", algorithm=algorithm,
+                      backend=backend, grid_exec=grid_exec)
+    assert_results_bit_equal(streamed, sequential)
+
+
+def test_streamed_run_to_run_deterministic(small_data):
+    """Threaded harvest twice over the same inputs: no ordering or
+    float-reassociation effect may leak into the results."""
+    a = _run(small_data, "streamed")
+    b = _run(small_data, "streamed")
+    assert_results_bit_equal(a, b)
+
+
+def test_streamed_through_exec_cache_pipeline_ranks(small_data):
+    """The fully-streamed serving shape: per-rank executables
+    (``pipeline_ranks``) feeding the harvest pipeline — still exactly
+    the sequential assembly of the SAME per-rank engine."""
+    from nmfx.config import ExecCacheConfig
+    from nmfx.exec_cache import ExecCache
+
+    cache = ExecCache(ExecCacheConfig(pipeline_ranks=True))
+    streamed = _run(small_data, "streamed", exec_cache=cache)
+    sequential = _run(small_data, "sequential", exec_cache=cache)
+    assert_results_bit_equal(streamed, sequential)
+
+
+def test_streamed_overlap_phases_recorded(small_data):
+    """The harvest workers credit their walls to the overlap phases the
+    e2e accounting audits (xfer.d2h_overlap, post.rank_selection) —
+    the r05 failure was exactly this work running outside every phase."""
+    prof = Profiler()
+    with prof:
+        _run(small_data, "streamed", profiler=prof)
+    assert prof.phases["xfer.d2h_overlap"].count >= len(KS)
+    assert prof.phases["post.rank_selection"].count >= len(KS)
+    assert prof.phases["post.rank_selection"].seconds > 0
+    # and they are classed as overlapped, so the sequential phase sum
+    # (the audit's phase-sum-vs-wall book) does not double-count them
+    assert prof.phases["post.rank_selection"].overlapped
+    audit = prof.audit()
+    assert audit["overlap_s"] > 0
+
+
+def test_device_rank_selection_implies_sequential(small_data):
+    """harvest='streamed' + rank_selection='device' falls back to the
+    sequential assembly (the clustering already overlaps on-device);
+    results must match the host path to float tolerance as before."""
+    r = _run(small_data, "streamed", rank_selection="device")
+    assert set(r.per_k) == set(KS)
+    for k in KS:
+        assert r.per_k[k].consensus.shape[0] == small_data.shape[1]
+
+
+def test_harvest_rejects_bad_mode(small_data):
+    with pytest.raises(ValueError, match="harvest"):
+        _run(small_data, "overlapped")
+
+
+# ---------------------------------------------------------------- pipeline
+# mechanics, no solver involved
+
+def test_pipeline_double_submit_rejected():
+    from nmfx.sweep import KSweepOutput
+
+    pipe = HarvestPipeline()
+    # perfect two-cluster consensus: rank selection is well-defined
+    cons = np.kron(np.eye(2), np.ones((2, 2))).astype(np.float32)
+    out = KSweepOutput(
+        consensus=cons, labels=None,
+        iterations=np.array([1]), dnorms=np.array([0.0]),
+        stop_reasons=np.array([0]), best_w=None, best_h=None,
+        all_w=None, all_h=None)
+    pipe.submit(2, out)
+    with pytest.raises(ValueError, match="submitted twice"):
+        pipe.submit(2, out)
+    pipe.results()
+
+
+def test_pipeline_worker_error_propagates():
+    pipe = HarvestPipeline()
+    pipe.submit(2, None)  # no ._replace -> worker raises
+    with pytest.raises(AttributeError):
+        pipe.results()
+
+
+def test_pipeline_close_idempotent_and_rejects_late_submit():
+    pipe = HarvestPipeline()
+    pipe.close()
+    pipe.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        pipe.submit(2, object())
+
+
+def test_pipeline_workers_validation():
+    with pytest.raises(ValueError, match="workers"):
+        HarvestPipeline(workers=0)

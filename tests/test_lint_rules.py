@@ -154,6 +154,30 @@ def test_nmfx001_noncompare_field_fires():
                and "compare=False" in p for p in problems)
 
 
+def test_nmfx001_data_key_gap_fires():
+    """A DataKey field dropped from the input-cache key (compare=False)
+    would serve ONE resident device buffer to two placements that must
+    differ — the data-plane twin of the executable-key hazards."""
+    problems = check_config_coverage(**_universe(
+        data_fields=frozenset({"fingerprint", "shape", "dtype"}),
+        data_key_covered=frozenset({"fingerprint", "shape"})))
+    assert any("DataKey.dtype" in p and "input-cache" in p
+               for p in problems)
+
+
+def test_nmfx001_data_key_covered_quiet():
+    problems = check_config_coverage(**_universe(
+        data_fields=frozenset({"fingerprint", "shape"}),
+        data_key_covered=frozenset({"fingerprint", "shape"})))
+    assert problems == []
+
+
+def test_nmfx001_data_key_check_skipped_when_not_provided():
+    """Pre-data-cache universes are not retroactively flagged."""
+    assert check_config_coverage(**_universe(
+        data_fields=frozenset({"fingerprint"}))) == []
+
+
 # ---------------------------------------------------------------- NMFX002
 
 _ENV_BAD = """
