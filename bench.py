@@ -185,6 +185,33 @@ def _pipeline_parity_problems(per_k, host, ks, restarts,
     return problems
 
 
+def _serve_parity_problems(got, ref, label: str) -> list[str]:
+    """A served request's ConsensusResult must be BIT-IDENTICAL to a
+    solo ``nmfconsensus`` run of the same request through the same
+    serving layer — the serving front-end's exactness contract
+    (docs/serving.md "Serving front-end"). Gated per served request the
+    same way streamed-vs-sequential harvest parity is gated per rep:
+    any mismatch fails the bench with exit 2."""
+    import numpy as np
+
+    if set(got.per_k) != set(ref.per_k):
+        return [f"{label}: served rank set {sorted(got.per_k)} != solo "
+                f"{sorted(ref.per_k)}"]
+    problems = []
+    for k in ref.per_k:
+        s, q = got.per_k[k], ref.per_k[k]
+        for field in ("consensus", "membership", "order", "iterations",
+                      "dnorms", "stop_reasons", "best_w", "best_h"):
+            if not np.array_equal(np.asarray(getattr(s, field)),
+                                  np.asarray(getattr(q, field))):
+                problems.append(f"{label} k={k}: served {field} differs "
+                                "from the solo run (bitwise)")
+        if s.rho != q.rho:
+            problems.append(f"{label} k={k}: served rho {s.rho} != solo "
+                            f"{q.rho}")
+    return problems
+
+
 def _best_prior_record(metric: str) -> "dict | None":
     """Best (lowest-wall) prior BENCH_r*.json record of this metric —
     regression tracking: the warm metric drifted 1.384 s (r03) →
@@ -1082,6 +1109,138 @@ def main():
               f"{cold_wall[args.backend]:.2f}s", file=sys.stderr)
         return out
 
+    # --- serve traffic stage (nmfx.serve) ------------------------------
+    # Multi-tenant serving under load: Poisson arrivals over an
+    # offered-load ladder into ONE NMFXServer (async request queue +
+    # continuous cross-request restart batching). Per rung: p50/p99
+    # latency, goodput vs offered load, and the packing-efficiency
+    # counter. EVERY served request is parity-gated bit-identical
+    # against a solo run of the same request (exit 2 on mismatch) — the
+    # per-rep parity discipline extended to served requests.
+    def run_traffic_stage():
+        from nmfx import serve as serve_mod
+        from nmfx.api import nmfconsensus
+        from nmfx.exec_cache import ExecCache
+        from nmfx.serve import NMFXServer, ServeConfig
+
+        scfg_t = cfgs[args.backend]
+        # the serving unit is a SLICE of the bench sweep (2 ranks,
+        # <= 10 restarts): the stage measures serving dynamics — queue
+        # wait, packing, tail latency — and the ladder multiplies
+        # request count, so the per-request unit must stay small
+        ks_t = ks[:2]
+        restarts_t = min(args.restarts, 10)
+        ccfg_t = ConsensusConfig(ks=ks_t, restarts=restarts_t, seed=seed,
+                                 grid_exec=args.grid_exec)
+        cache = ExecCache()
+        if not cache.cacheable(ccfg_t, scfg_t, None):
+            return {"skipped": "configuration not exec-cacheable "
+                               "(see ExecCache.cacheable)"}
+        # distinct tenants = distinct seeds over the shared matrix (the
+        # packable case: one resident buffer, one bucket, one config)
+        seeds_t = (123, 456, 789, 1012)
+        warm_cfg = ServeConfig(max_batch_requests=4)
+
+        def gate(probs):
+            if probs:
+                for prob in probs:
+                    print(f"bench SERVE PARITY FAILURE: {prob}",
+                          file=sys.stderr)
+                raise SystemExit(2)
+
+        # warm request: pays the bucket compile once, outside the
+        # ladder's books
+        with NMFXServer(warm_cfg, exec_cache=cache) as srv:
+            warm_res = srv.submit(
+                a, ks=ks_t, restarts=restarts_t, seed=seeds_t[0],
+                solver_cfg=scfg_t).result()
+        # solo-latency floor on the WARM path -> capacity estimate the
+        # ladder's offered loads are multiples of
+        with NMFXServer(warm_cfg, exec_cache=cache) as srv:
+            fut = srv.submit(a, ks=ks_t, restarts=restarts_t,
+                             seed=seeds_t[0], solver_cfg=scfg_t)
+            fut.result()
+        solo_latency_s = fut.stats.latency_s
+        capacity = 1.0 / max(solo_latency_s, 1e-6)
+        # the ladder serves with a linger of a quarter solo-latency —
+        # the continuous-batching knob sized to the workload: near-
+        # simultaneous arrivals pack, an isolated request pays at most
+        # 25% extra latency (recorded, so the tradeoff is in the books)
+        serve_cfg = ServeConfig(
+            max_batch_requests=4,
+            batch_linger_s=round(0.25 * solo_latency_s, 4))
+
+        # solo references for the parity gate: one per tenant seed,
+        # through the SAME serving layer (exec cache, no mesh)
+        refs = {sd: nmfconsensus(a, ks=ks_t, restarts=restarts_t,
+                                 seed=sd, solver_cfg=scfg_t,
+                                 use_mesh=False, exec_cache=cache)
+                for sd in seeds_t}
+        gate(_serve_parity_problems(warm_res, refs[seeds_t[0]],
+                                    "warmup"))
+
+        n_req = 6
+        rng = np.random.default_rng(seed)
+        ladder = []
+        # three Poisson rungs spanning under- to over-load, then a
+        # closed-loop burst (every request submitted at once — the
+        # regime continuous batching exists for: the queue is deep, so
+        # dispatches pack)
+        for load_frac in (0.5, 1.0, 2.0, "burst"):
+            rate = None if load_frac == "burst" \
+                else capacity * load_frac
+            with NMFXServer(serve_cfg, exec_cache=cache) as srv:
+                t0 = time.perf_counter()
+                futs = []
+                for i in range(n_req):
+                    sd = seeds_t[i % len(seeds_t)]
+                    futs.append((sd, srv.submit(
+                        a, ks=ks_t, restarts=restarts_t, seed=sd,
+                        solver_cfg=scfg_t)))
+                    if rate is not None and i < n_req - 1:
+                        time.sleep(rng.exponential(1.0 / rate))
+                results = [(sd, f, f.result()) for sd, f in futs]
+                wall = time.perf_counter() - t0
+            for sd, f, res in results:
+                gate(_serve_parity_problems(
+                    res, refs[sd], f"load={load_frac} seed={sd}"))
+            lat = np.asarray(sorted(f.stats.latency_s
+                                    for _, f in futs))
+            s = srv.stats()
+            ladder.append({
+                "offered_load": load_frac,
+                "offered_req_per_s": (None if rate is None
+                                      else round(rate, 4)),
+                "goodput_req_per_s": round(len(results) / wall, 4),
+                "p50_latency_s": round(float(np.percentile(lat, 50)), 3),
+                "p99_latency_s": round(float(np.percentile(lat, 99)), 3),
+                "mean_queue_wait_s": round(float(np.mean(
+                    [f.stats.queue_wait_s for _, f in futs])), 3),
+                "dispatches": s["dispatches"],
+                "packed_dispatches": s["packed_dispatches"],
+                "packing_efficiency": s["packing_efficiency"],
+            })
+            print(f"bench: serve traffic load={load_frac}: "
+                  f"p50={ladder[-1]['p50_latency_s']}s "
+                  f"p99={ladder[-1]['p99_latency_s']}s "
+                  f"goodput={ladder[-1]['goodput_req_per_s']} req/s "
+                  f"packing={ladder[-1]['packing_efficiency']}",
+                  file=sys.stderr)
+        return {
+            "unit": f"ks={list(ks_t)} x {restarts_t} restarts over the "
+                    f"{args.genes}x{args.samples} bench matrix",
+            "tenants": len(seeds_t),
+            "requests_per_rung": n_req,
+            "solo_latency_s": round(solo_latency_s, 3),
+            "capacity_req_per_s_est": round(capacity, 4),
+            "ladder": ladder,
+            "parity": "ok",
+            "module_counters": {
+                "dispatches": serve_mod.dispatch_count(),
+                "packed_dispatches": serve_mod.packed_dispatch_count(),
+                "packing_efficiency": serve_mod.packing_efficiency()},
+        }
+
     # headline = the requested backend's same-session minimum; per-backend
     # min/median/all-reps in detail
     primary = args.backend
@@ -1160,6 +1319,10 @@ def main():
     finally:
         shutil.rmtree(exec_dir, ignore_errors=True)
 
+    traffic = run_traffic_stage()
+    print(f"bench: serve traffic stage: {json.dumps(traffic)}",
+          file=sys.stderr)
+
     # regression tracking: compare against the best prior round's record
     # (the warm metric drifted 1.384 s → 2.041/1.848 s across r03-r05
     # with nothing in the record to flag it) and stamp this run's
@@ -1209,6 +1372,7 @@ def main():
             "commit": commit,
             "best_prior": best_prior,
             "exec_cache": serving,
+            "serve": traffic,
             # cold_wall_s/compile_wall_s are first-session numbers; with
             # a persistent cache dir a second session's cold run re-loads
             # these programs from disk instead of recompiling

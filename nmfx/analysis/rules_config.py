@@ -30,6 +30,12 @@ introspection hooks added for it — no hash-body parsing):
   key field added with ``compare=False`` would serve ONE resident
   device buffer to two (matrix, placement) pairs that must differ —
   the data-plane twin of the executable-key hazard above.
+* ``serve.serve_key_fields()`` — what the serving front-end's
+  :class:`ServeConfig` comparison covers (``field.compare``): the
+  bench traffic stage and tests compare serving policies by dataclass
+  eq/hash, so a field added with ``compare=False`` would alias two
+  different admission/packing policies onto one — the control-plane
+  twin of the key hazards above.
 
 Every field must be fingerprint-covered or declared non-numerics; every
 exclusion must be declared; the declaration must not go stale; both
@@ -73,6 +79,8 @@ def check_config_coverage(
     nonrepr_fields: "dict[str, tuple[str, ...]]" = {},
     data_fields: "frozenset[str] | None" = None,
     data_key_covered: "frozenset[str] | None" = None,
+    serve_fields: "frozenset[str] | None" = None,
+    serve_key_covered: "frozenset[str] | None" = None,
 ) -> "list[str]":
     """The pure contract check; returns human-readable problems.
 
@@ -188,11 +196,22 @@ def check_config_coverage(
                 "input-cache key (data_cache.data_key_fields) — two "
                 "placements differing in it would share one cached "
                 "device buffer")
+    # 10. the serving front-end's ServeConfig must compare on every
+    #     field: serving policies are compared/keyed by dataclass
+    #     eq/hash (bench traffic stage, comparable-server tests), so a
+    #     compare=False field would alias two different admission/
+    #     packing/deadline policies onto one
+    if serve_fields is not None and serve_key_covered is not None:
+        for name in sorted(serve_fields - serve_key_covered):
+            problems.append(
+                f"ServeConfig.{name} is not covered by the serving-"
+                "policy fingerprint (serve.serve_key_fields) — two "
+                "serving policies differing in it would compare equal")
     return problems
 
 
 def _live_universe():
-    from nmfx import data_cache, exec_cache, registry
+    from nmfx import data_cache, exec_cache, registry, serve
     from nmfx.config import ExperimentalConfig, SolverConfig
 
     def _hashable(cls) -> bool:
@@ -214,10 +233,14 @@ def _live_universe():
         data_fields=frozenset(
             f.name for f in dataclasses.fields(data_cache.DataKey)),
         data_key_covered=data_cache.data_key_fields(),
+        serve_fields=frozenset(
+            f.name for f in dataclasses.fields(serve.ServeConfig)),
+        serve_key_covered=serve.serve_key_fields(),
         hashable_configs={"SolverConfig": _hashable(SolverConfig),
                           "ExperimentalConfig": _hashable(
                               ExperimentalConfig),
-                          "DataKey": _hashable(data_cache.DataKey)},
+                          "DataKey": _hashable(data_cache.DataKey),
+                          "ServeConfig": _hashable(serve.ServeConfig)},
         noncompare_fields={
             cls.__name__: tuple(f.name
                                 for f in dataclasses.fields(cls)

@@ -253,6 +253,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "warm). Requires --warm-shapes; pairs with "
                         "--cache-dir so warmed executables persist for "
                         "future processes")
+    p.add_argument("--serve-smoke", action="store_true",
+                   help="route the run through the multi-tenant serving "
+                        "engine (nmfx.serve.NMFXServer): submit this "
+                        "request to the async queue, await its future, "
+                        "and report the serve counters and per-request "
+                        "spans (queue-wait, pack, solve, harvest) to "
+                        "stderr. Results are bit-identical to the "
+                        "direct path — the serving exactness contract "
+                        "(docs/serving.md 'Serving front-end'). Implies "
+                        "--exec-cache; single-device (no shard flags)")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "
@@ -381,8 +391,32 @@ def main(argv: list[str] | None = None) -> int:
     if args.warm_cache and not args.warm_shapes:
         parser.error("--warm-cache backgrounds the --warm-shapes warmup; "
                      "pass --warm-shapes with the shapes to pre-compile")
+    if args.serve_smoke:
+        if mesh is not None:
+            parser.error("--serve-smoke owns ONE device (the serving "
+                         "scheduler's contract); drop "
+                         "--feature-shards/--sample-shards")
+        if args.checkpoint_dir is not None:
+            parser.error("--serve-smoke does not compose with "
+                         "--checkpoint-dir (served requests dispatch "
+                         "through the executable cache, which bypasses "
+                         "the registry resume path)")
+        if args.keep_factors:
+            parser.error("--serve-smoke does not compose with "
+                         "--keep-factors (served results carry the best "
+                         "restart's factors only)")
+        if args.rank_selection == "device":
+            parser.error("--serve-smoke harvests on the host (the "
+                         "completion workers run hclust/cophenetic "
+                         "there); drop --rank-selection device")
+        if args.grid_exec == "per_k":
+            parser.error("--serve-smoke does not compose with "
+                         "--grid-exec per_k (served requests dispatch "
+                         "through the whole-grid scheduler; per-k "
+                         "outputs differ by float tolerance, which "
+                         "would break the serve exactness contract)")
     if (args.exec_cache or args.warm_shapes or args.cache_dir
-            or args.pipeline_ranks):
+            or args.pipeline_ranks or args.serve_smoke):
         from nmfx.config import ConsensusConfig, ExecCacheConfig, InitConfig
         from nmfx.exec_cache import ExecCache
         from nmfx.sweep import default_mesh
@@ -433,27 +467,31 @@ def main(argv: list[str] | None = None) -> int:
                                            cache_mesh):
                     print(_warm_line(rec), file=sys.stderr)
     with profiler:
-        result = nmfconsensus(
-            args.dataset,
-            ks=args.ks,
-            restarts=args.restarts,
-            seed=args.seed,
-            solver_cfg=run_scfg,
-            init=args.init,
-            label_rule=args.label_rule,
-            linkage=args.linkage,
-            mesh=mesh,
-            use_mesh=not args.no_mesh,
-            rank_selection=args.rank_selection,
-            keep_factors=args.keep_factors,
-            grid_exec=args.grid_exec,
-            grid_slots=args.grid_slots,
-            grid_tail_slots=args.grid_tail_slots,
-            output=output,
-            checkpoint_dir=args.checkpoint_dir,
-            profiler=profiler,
-            exec_cache=exec_cache,
-        )
+        if args.serve_smoke:
+            result = _serve_smoke(args, run_scfg, exec_cache, output,
+                                  profiler)
+        else:
+            result = nmfconsensus(
+                args.dataset,
+                ks=args.ks,
+                restarts=args.restarts,
+                seed=args.seed,
+                solver_cfg=run_scfg,
+                init=args.init,
+                label_rule=args.label_rule,
+                linkage=args.linkage,
+                mesh=mesh,
+                use_mesh=not args.no_mesh,
+                rank_selection=args.rank_selection,
+                keep_factors=args.keep_factors,
+                grid_exec=args.grid_exec,
+                grid_slots=args.grid_slots,
+                grid_tail_slots=args.grid_tail_slots,
+                output=output,
+                checkpoint_dir=args.checkpoint_dir,
+                profiler=profiler,
+                exec_cache=exec_cache,
+            )
     if warm_task is not None and args.cache_dir:
         # with a persistent cache dir, joining is worth the wait: every
         # warmed bucket lands on disk for FUTURE processes. Without one
@@ -472,6 +510,51 @@ def main(argv: list[str] | None = None) -> int:
     if args.profile:
         print(profiler.report())
     return 0
+
+
+def _serve_smoke(args, run_scfg, exec_cache, output, profiler):
+    """Route the run through the multi-tenant serving engine: ONE
+    request down the same queue → pack → dispatch → harvest path
+    concurrent tenants share (nmfx/serve.py), then report the serve
+    counters and this request's spans. Results are bit-identical to the
+    direct path — the serving exactness contract (docs/serving.md
+    "Serving front-end") — which is exactly what makes this a smoke
+    test: same output, with the serving machinery in the loop."""
+    from nmfx.api import save_results
+    from nmfx.config import InitConfig
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    with NMFXServer(ServeConfig(), exec_cache=exec_cache,
+                    profiler=profiler) as srv:
+        fut = srv.submit(args.dataset, ks=args.ks,
+                         restarts=args.restarts, seed=args.seed,
+                         solver_cfg=run_scfg,
+                         init_cfg=InitConfig(method=args.init),
+                         label_rule=args.label_rule,
+                         linkage=args.linkage,
+                         grid_slots=args.grid_slots,
+                         grid_tail_slots=args.grid_tail_slots)
+        result = fut.result()
+    s = srv.stats()
+    st = fut.stats
+
+    def fmt(v):
+        return "n/a" if v is None else f"{v:.3f}s"
+
+    print("nmfx: serve-smoke: submitted="
+          f"{s['submitted']} completed={s['completed']} "
+          f"dispatches={s['dispatches']} "
+          f"packed_dispatches={s['packed_dispatches']} "
+          f"packing_efficiency={s['packing_efficiency']}",
+          file=sys.stderr)
+    print("nmfx: serve-smoke spans: "
+          f"queue-wait={fmt(st.queue_wait_s)} pack={fmt(st.pack_s)} "
+          f"solve={fmt(st.solve_s)} harvest={fmt(st.harvest_s)} "
+          f"latency={fmt(st.latency_s)}", file=sys.stderr)
+    if output is not None:
+        with profiler.phase("write_outputs"):
+            save_results(result, output)
+    return result
 
 
 def _warm_line(rec: dict) -> str:

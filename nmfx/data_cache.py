@@ -267,16 +267,26 @@ class DataCache:
                     if mesh is not None else a_pad)
         a = np.asarray(a)
         key = self.key_for(a, solver_cfg.dtype, pad_shape, mesh)
+        # Concurrency audit (the serve front-end's submit threads and
+        # scheduler share this instance —
+        # tests/test_data_cache.py::test_concurrent_place_access): the
+        # lookup-or-miss decision and its counter land in ONE lock
+        # acquisition, so hits+misses always equals host-path calls;
+        # the transfer itself runs outside the lock by design (it must
+        # overlap other threads' hits), which means two threads racing
+        # the SAME cold key may both transfer — the second insert
+        # overwrites the first (same key, same bytes), counters record
+        # two honest misses, and no entry or byte total is corrupted.
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
+            else:
+                self.misses += 1
         if entry is not None:
             prof.mark("xfer.h2d_cache_hit")
             return entry.array
-        with self._lock:
-            self.misses += 1
         host = np.asarray(a, dtype)
         if pad_shape is not None:
             m, n = a.shape

@@ -303,6 +303,20 @@ class ExecCache:
         self._inflight: "dict[tuple, Future]" = {}
         self._lock = threading.RLock()
         self._warned: set[str] = set()
+        #: background-warm failures by cache key: a compile that died on
+        #: a warm worker thread is recorded here and surfaced (one
+        #: warning + a clean recompile) on the NEXT executable()/
+        #: run_sweep touching that bucket — a corrupt warm must never
+        #: silently strand or silently vanish (tests/test_exec_cache.py)
+        self._warm_failures: "dict[tuple, BaseException]" = {}
+        # Concurrency audit (the serve front-end hits one instance from
+        # request + warm + scheduler threads — tests/test_exec_cache.py
+        # ::test_concurrent_executable_access): every mutation of
+        # _entries / _inflight / _entries_cap / _warned / the counters
+        # below goes through _lock; _Entry values are immutable
+        # NamedTuples; the only check-then-act across a lock release is
+        # the in-flight future registry, which is exactly the dedup
+        # that makes concurrent same-key compiles single-flight.
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -562,6 +576,17 @@ class ExecCache:
         prof = profiler if profiler is not None else _null()
         bucket = self.bucket_shape(*shape)
         key = self._key(bucket, ccfg, scfg, icfg, mesh)
+        with self._lock:
+            stale = self._warm_failures.pop(key, None)
+        if stale is not None:
+            # a background warm died building THIS bucket's executable
+            # after its waiters (if any) were already failed — surface
+            # it on the next request instead of swallowing it until
+            # WarmTask.result(), then recompile cleanly below
+            self._warn_once(
+                "warm-failed",
+                f"background warmup failed for this bucket ({stale!r}); "
+                "recompiling in the foreground")
         wait = None
         with self._lock:
             entry = self._entries.get(key)
@@ -683,7 +708,7 @@ class ExecCache:
              ccfg: ConsensusConfig, scfg: SolverConfig = SolverConfig(),
              icfg: InitConfig = InitConfig(), mesh=None,
              profiler=None, parallel: bool = True,
-             background: bool = False):
+             background: bool = False, _record_failures: bool = False):
         """Batch-compile the executables for each shape's bucket (the
         CLI's ``--warm-shapes``) — CONCURRENTLY in a thread pool when
         more than one is pending (XLA compilation releases the GIL), and
@@ -702,7 +727,8 @@ class ExecCache:
                 try:
                     box["report"] = self.warm(
                         shapes, ccfg, scfg, icfg, mesh, profiler=None,
-                        parallel=parallel, background=False)
+                        parallel=parallel, background=False,
+                        _record_failures=True)
                 except BaseException as e:  # surfaced by WarmTask.result
                     box["error"] = e
 
@@ -727,22 +753,55 @@ class ExecCache:
             # so the rest stay disk-warm (deserialize, not recompile).
             with self._lock:
                 self._entries_cap = max(self._entries_cap, len(ccfg.ks))
+        def note_failure(spec, exc) -> None:
+            # remember which BUCKET the dead compile belonged to, so
+            # the next foreground request touching it warns-and-
+            # recompiles instead of the failure staying invisible
+            # until (a possibly never-called) WarmTask.result().
+            # Background warms only: a foreground warm raises straight
+            # to its caller, and recording it too would double-report
+            # (and mislabel) an already-surfaced failure on the next
+            # request touching the bucket
+            if not _record_failures:
+                return
+            shape, c = spec
+            key = self._key(self.bucket_shape(*shape), c, scfg, icfg,
+                            mesh)
+            with self._lock:
+                self._warm_failures[key] = exc
+
         pooled = parallel and len(specs) > 1
         if pooled:
             # workers get a NullProfiler (Profiler phase bookkeeping is
             # single-threaded); compile walls land in the report and are
-            # credited to the profiler below. result() re-raises the
-            # first failed spec's exception.
+            # credited to the profiler below. The first failed spec's
+            # exception re-raises (the WarmTask.result contract) AFTER
+            # every spec is drained and every failure recorded.
             futs = self._compile_concurrently(
                 range(len(specs)),
                 lambda i: self.executable(specs[i][0], specs[i][1],
                                           scfg, icfg, mesh))
-            results = [futs[i].result() for i in range(len(specs))]
+            results, first_err = [], None
+            for i in range(len(specs)):
+                try:
+                    results.append(futs[i].result())
+                except BaseException as e:
+                    note_failure(specs[i], e)
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
         else:
             # sequential: executable() records its own compile spans on
             # the caller's profiler directly
-            results = [self.executable(s, c, scfg, icfg, mesh, prof)
-                       for s, c in specs]
+            results = []
+            for s, c in specs:
+                try:
+                    results.append(self.executable(s, c, scfg, icfg,
+                                                   mesh, prof))
+                except BaseException as e:
+                    note_failure((s, c), e)
+                    raise
         report = []
         for (shape, c), (entry, hit) in zip(specs, results):
             if pooled and not hit and entry.source == "compile":
@@ -759,13 +818,15 @@ class ExecCache:
 
     @property
     def stats(self) -> dict:
-        return {"entries": len(self._entries), "hits": self.hits,
-                "misses": self.misses, "evictions": self.evictions,
-                "persist_hits": self.persist_hits,
-                "persist_misses": self.persist_misses,
-                "disk_evictions": self.disk_evictions,
-                "max_entries": self._entries_cap,
-                "cache_dir": self.cfg.cache_dir}
+        with self._lock:  # a consistent snapshot under concurrent serving
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions,
+                    "persist_hits": self.persist_hits,
+                    "persist_misses": self.persist_misses,
+                    "disk_evictions": self.disk_evictions,
+                    "warm_failures": len(self._warm_failures),
+                    "max_entries": self._entries_cap,
+                    "cache_dir": self.cfg.cache_dir}
 
     # -- the host<->device pipeline ---------------------------------------
     def prefetch(self, a, scfg: SolverConfig = SolverConfig(),

@@ -45,7 +45,36 @@ from concurrent.futures import Future
 
 import jax
 
-__all__ = ["HarvestPipeline"]
+__all__ = ["HarvestPipeline", "harvest_rank"]
+
+
+def harvest_rank(k: int, out, linkage: str,
+                 profiler) -> "tuple[object, float, float]":
+    """The per-rank harvest body: blocking device→host fetch of rank
+    ``k``'s output, then the host rank selection, through the SAME
+    ``api._build_k_result`` as the sequential path — the single
+    implementation shared by the :class:`HarvestPipeline` workers and
+    the serving engine's completion workers (``nmfx/serve.py``), so
+    every consumer is bit-identical by construction.
+
+    Returns ``(KResult, fetch_seconds, select_seconds)``; the walls are
+    also credited to the overlap phases ``xfer.d2h_overlap`` /
+    ``post.rank_selection`` on ``profiler`` (thread-safe
+    ``add_seconds``)."""
+    from nmfx.api import _build_k_result
+
+    t0 = time.perf_counter()
+    # block on THIS rank only; labels feed the on-device consensus
+    # reduction and are never read host-side, so they stay out of the
+    # transfer (design.md §5b)
+    host = jax.device_get(out._replace(labels=None))
+    t1 = time.perf_counter()
+    fetch_s = t1 - t0
+    profiler.add_seconds("xfer.d2h_overlap", fetch_s)
+    res = _build_k_result(k, host, linkage)
+    select_s = time.perf_counter() - t1
+    profiler.add_seconds("post.rank_selection", select_s)
+    return res, fetch_s, select_s
 
 
 class HarvestPipeline:
@@ -100,24 +129,14 @@ class HarvestPipeline:
 
     # -- consumer side ----------------------------------------------------
     def _work(self) -> None:
-        from nmfx.api import _build_k_result
-
         while True:
             item = self._queue.get()
             if item is None:
                 return
             k, out, fut = item
             try:
-                t0 = time.perf_counter()
-                # block on THIS rank only; labels feed the on-device
-                # consensus reduction and are never read host-side, so
-                # they stay out of the transfer (design.md §5b)
-                host = jax.device_get(out._replace(labels=None))
-                t1 = time.perf_counter()
-                self._prof.add_seconds("xfer.d2h_overlap", t1 - t0)
-                res = _build_k_result(k, host, self._linkage)
-                self._prof.add_seconds("post.rank_selection",
-                                       time.perf_counter() - t1)
+                res, _, _ = harvest_rank(k, out, self._linkage,
+                                         self._prof)
                 fut.set_result(res)
             except BaseException as e:  # re-raised by results()
                 fut.set_exception(e)

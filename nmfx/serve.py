@@ -1,0 +1,920 @@
+"""Multi-tenant serving engine: async request queue + continuous
+cross-request restart batching.
+
+Everything below the request level was already built — the persistent
+AOT executable cache (``nmfx/exec_cache.py``), the device-resident
+input cache (``nmfx/data_cache.py``), the streamed per-rank harvest
+(``nmfx/harvest.py``), and lane-batched grid solvers with per-lane
+masks and in-kernel budgets (``nmfx/ops/sched_mu.py``) — yet the repo
+still served one sweep per process at a time. This module is the
+missing front-end: many concurrent consensus jobs share one device
+through an async request queue and a single scheduler thread that owns
+dispatch.
+
+The scheduler does **continuous restart batching** — the
+token-level-batching analogue for consensus NMF: restarts from
+*different* requests are packed into the same padded executable lanes
+of one slot-scheduled dispatch (``sweep._build_packed_serve_fn``).
+Each request's rank-k restart block becomes one lane group; the slot
+scheduler solves every lane independently (per-lane masks, per-lane
+in-kernel budgets), so a request's results are **bit-identical to its
+solo run** — pinned by tests/test_serve.py the same way
+streamed-vs-sequential harvest parity already is. Requests that cannot
+share lanes (different matrices, NNDSVD init, non-cacheable configs,
+deadline-budget-clamped solves) degrade gracefully to solo dispatch
+through the same engine.
+
+Layering::
+
+    submit(A, ks, ...) ──► admission control ──► priority queue
+                                                     │  scheduler thread
+                                                     ▼
+                                  compatibility grouping + lane packing
+                                                     │
+                     ┌───────────────────────────────┴─────────────┐
+                     ▼ (≥2 compatible requests)                    ▼ (solo)
+          _build_packed_serve_fn dispatch            ExecCache.run_sweep /
+          (one executable, lanes from                sweep.sweep
+           several requests)                                       │
+                     └───────────────────────────────┬─────────────┘
+                                                     ▼
+                            completion workers: per-rank harvest
+                            (``harvest.harvest_rank`` — the SAME body
+                            the streamed pipeline runs) ──► Future
+
+Admission control bounds the queue by depth AND by pending input bytes
+(the matrices waiting to be placed); the priority queue orders by
+(priority desc, deadline asc, arrival); a request whose deadline
+expires while queued resolves to a typed :class:`DeadlineExceeded`
+without ever dispatching, and one that would expire mid-solve is
+dispatched solo with its per-lane iteration budget clamped from the
+remaining deadline (``ServeConfig.iter_rate_estimate``) — eviction via
+the in-kernel per-lane budget mechanism the grid solvers already
+enforce, since a launched XLA dispatch cannot be interrupted.
+
+Exactness contract: a packed request's lanes draw the canonical
+per-(seed, k, restart) key chain and traverse the slot scheduler
+independently of their dispatch-mates (batched GEMMs evaluate each lane
+independently; zero-padding to a larger ``k_max`` adds exact-zero terms
+only — the ``grid_mu`` invariant), so per-request results equal the
+solo path bit-for-bit on the XLA engines. A deadline-clamped request is
+exact against a solo run of the same clamped ``max_iter`` (recorded in
+its :class:`RequestStats`). See docs/serving.md "Serving front-end".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import TYPE_CHECKING, Mapping, Protocol, Sequence
+
+import numpy as np
+
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+
+if TYPE_CHECKING:
+    from nmfx.api import ConsensusResult
+    from nmfx.sweep import KSweepOutput
+
+__all__ = ["DeadlineExceeded", "Engine", "ExecCacheEngine", "NMFXServer",
+           "QueueFull", "RequestStats", "ServeConfig", "ServeError",
+           "ServerClosed", "dispatch_count", "packed_dispatch_count",
+           "packing_efficiency", "serve_key_fields"]
+
+
+# --------------------------------------------------------------------------
+# module counters — the honesty-counter discipline of
+# exec_cache.compile_count() / data_cache.transfer_count(): the
+# cross-request-packing contract is gated on these, not on log lines
+# (tests/test_serve.py, bench.py traffic stage)
+_dispatches = 0
+_packed_dispatches = 0  # dispatches whose lanes span >= 2 requests
+_total_lanes = 0
+_packed_lanes = 0  # lanes that rode a packed dispatch
+_counter_lock = threading.Lock()
+
+
+def dispatch_count() -> int:
+    """Executable dispatches issued by serve schedulers in this process
+    (packed and solo)."""
+    return _dispatches
+
+
+def packed_dispatch_count() -> int:
+    """Dispatches that ACTUALLY contained lanes from >= 2 distinct
+    requests — the counter the cross-request packing contract is gated
+    on (a test asserting packing must watch this, not wall clocks)."""
+    return _packed_dispatches
+
+
+def packing_efficiency() -> "float | None":
+    """Fraction of all dispatched lanes that rode a packed (multi-
+    request) dispatch; None before the first dispatch."""
+    with _counter_lock:
+        if _total_lanes == 0:
+            return None
+        return _packed_lanes / _total_lanes
+
+
+def _note_dispatch(n_requests: int, lanes: int) -> None:
+    global _dispatches, _packed_dispatches, _total_lanes, _packed_lanes
+    with _counter_lock:
+        _dispatches += 1
+        _total_lanes += lanes
+        if n_requests >= 2:
+            _packed_dispatches += 1
+            _packed_lanes += lanes
+
+
+# --------------------------------------------------------------------------
+class ServeError(RuntimeError):
+    """Base class of the serving engine's typed failures."""
+
+
+class QueueFull(ServeError):
+    """Admission control rejected the request (queue depth or pending
+    input bytes over bound) — back off and resubmit."""
+
+
+class ServerClosed(ServeError):
+    """The server no longer accepts (or will not complete) requests."""
+
+
+class DeadlineExceeded(ServeError, TimeoutError):
+    """The request's deadline expired — while queued (never dispatched)
+    or mid-solve (its lanes were stopped by the per-lane iteration
+    budget; the computed results are discarded)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-engine policy (``nmfx/serve.py``).
+
+    Every field participates in ``__eq__``/``__hash__`` (frozen
+    dataclass, no ``compare=False``) — the coverage
+    :func:`serve_key_fields` declares and lint rule NMFX001 enforces,
+    exactly like ``DataKey``/``SolverConfig``: the server's behavior
+    contract is keyed by this config (tests and the bench traffic stage
+    construct comparable servers from equal configs), so a field
+    invisible to comparison would alias two different serving policies.
+    """
+
+    #: admission bound on requests queued but not yet dispatched;
+    #: submit raises :class:`QueueFull` beyond it
+    max_queue_depth: int = 64
+    #: admission bound on the total host bytes of queued input matrices
+    #: (they become device-resident at dispatch through the input
+    #: cache); protects the placement path from unbounded buffering
+    max_pending_bytes: int = 1 << 30
+    #: pack lanes from at most this many requests into one dispatch
+    max_batch_requests: int = 4
+    #: cap on total lanes (Σ |ks|·restarts over the batch) per dispatch
+    #: — bounds the packed executable's job batch the way grid_slots
+    #: bounds its concurrent lanes
+    max_batch_lanes: int = 1024
+    #: enable cross-request lane packing (False = every request solo —
+    #: the A/B baseline the packing-efficiency counter is read against)
+    pack: bool = True
+    #: after popping a packable request, linger this long for more
+    #: compatible arrivals before dispatching — the classic continuous-
+    #: batching knob (0 = dispatch immediately with whatever is queued)
+    batch_linger_s: float = 0.0
+    #: deadline applied to requests submitted without one (None = no
+    #: implicit deadline)
+    default_timeout_s: "float | None" = None
+    #: estimated per-lane solver iterations per second, used to clamp a
+    #: deadline request's per-lane iteration budget
+    #: (``max_iter' = remaining_s * rate``, rounded up to a power-of-two
+    #: multiple of check_every to bound executable churn). None = no
+    #: mid-solve budget clamping; deadlines are then enforced at queue
+    #: and completion boundaries only
+    iter_rate_estimate: "float | None" = None
+    #: completion worker threads (device→host fetch + host rank
+    #: selection per finished request)
+    harvest_workers: int = 2
+
+    def __post_init__(self):
+        if self.max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        if self.max_pending_bytes < 0:
+            raise ValueError("max_pending_bytes must be >= 0")
+        if self.max_batch_requests < 1:
+            raise ValueError("max_batch_requests must be >= 1")
+        if self.max_batch_lanes < 1:
+            raise ValueError("max_batch_lanes must be >= 1")
+        if self.batch_linger_s < 0:
+            raise ValueError("batch_linger_s must be >= 0")
+        if (self.default_timeout_s is not None
+                and self.default_timeout_s <= 0):
+            raise ValueError("default_timeout_s must be positive or None")
+        if (self.iter_rate_estimate is not None
+                and self.iter_rate_estimate <= 0):
+            raise ValueError("iter_rate_estimate must be positive or None")
+        if self.harvest_workers < 1:
+            raise ValueError("harvest_workers must be >= 1")
+
+
+def serve_key_fields() -> frozenset:
+    """The :class:`ServeConfig` fields that participate in comparison —
+    the introspection hook lint rule NMFX001 cross-references (the
+    ``DataKey``/``SolverConfig`` discipline). Reading ``field.compare``
+    keeps it honest: a field added with ``compare=False`` would be
+    invisible to the dataclass hash/eq two policies are compared by,
+    and shows up here (and fails lint) as uncovered."""
+    return frozenset(f.name for f in dataclasses.fields(ServeConfig)
+                     if f.compare)
+
+
+@dataclasses.dataclass
+class RequestStats:
+    """Per-request serving spans, readable on the returned future
+    (``future.stats``) once the request resolves; partial values are
+    visible earlier (queue_wait_s lands at dispatch)."""
+
+    #: seconds between submit and dispatch (queue residency)
+    queue_wait_s: "float | None" = None
+    #: seconds of the dispatch step itself: placement, lane packing,
+    #: executable lookup/compile and the async dispatch call
+    pack_s: "float | None" = None
+    #: seconds the completion worker blocked on the device for this
+    #: request's arrays (device solve + device queueing behind
+    #: dispatch-mates)
+    solve_s: "float | None" = None
+    #: seconds of host-side harvest (hclust/cophenetic/cutree + result
+    #: assembly)
+    harvest_s: "float | None" = None
+    #: submit → future-resolved wall
+    latency_s: "float | None" = None
+    #: how many requests shared this request's dispatch (1 = solo)
+    packed_requests: "int | None" = None
+    #: this request's lane count (Σ restarts over its ranks)
+    lanes: "int | None" = None
+    #: the deadline-clamped per-lane iteration budget, when the
+    #: scheduler clamped one (None = dispatched at the configured
+    #: max_iter); the exactness contract is then against a solo run at
+    #: this max_iter
+    budget_iters: "int | None" = None
+
+
+class _ServeFuture(Future):
+    """Future[ConsensusResult] with the request's serving spans."""
+
+    def __init__(self, stats: RequestStats):
+        super().__init__()
+        self.stats = stats
+
+
+@dataclasses.dataclass
+class _Request:
+    seq: int
+    a: np.ndarray
+    col_names: tuple
+    ks: tuple
+    restarts: int
+    seed: int
+    scfg: SolverConfig
+    icfg: InitConfig
+    label_rule: str
+    linkage: str
+    grid_slots: int
+    grid_tail_slots: object
+    priority: int
+    deadline: "float | None"  # absolute time.monotonic seconds
+    future: _ServeFuture
+    stats: RequestStats
+    compat: "tuple | None"  # packing-compatibility key; None = solo only
+    submitted: float = 0.0
+
+    @property
+    def lanes(self) -> int:
+        return len(self.ks) * self.restarts
+
+    def order_key(self) -> tuple:
+        dl = self.deadline if self.deadline is not None else float("inf")
+        return (-self.priority, dl, self.seq)
+
+
+class Engine(Protocol):
+    """What the scheduler needs from the execution stack — the ONE
+    interface ``sweep``/``exec_cache``/``data_cache``/``harvest`` unify
+    behind (tests drive the scheduler against fakes of this; the
+    MPI-FAUN-style multi-device sharding lands behind it as a psum in
+    ``dispatch_*`` without touching the queue/packing logic above)."""
+
+    def compatibility_key(self, req: _Request) -> "tuple | None":
+        """Hashable key under which requests may share one dispatch's
+        lanes; None when the request can only dispatch solo."""
+        ...
+
+    def place(self, req: _Request) -> object:
+        """Start the request's host→device placement (asynchronous);
+        the returned handle feeds ``dispatch_*``. May return None when
+        the solo path does its own placement."""
+        ...
+
+    def dispatch_solo(self, req: _Request, placed: object,
+                      scfg: SolverConfig) -> "Mapping[int, KSweepOutput]":
+        """Dispatch one request (async) and return its per-rank device
+        outputs. ``scfg`` may be the request's config with a deadline-
+        clamped ``max_iter``."""
+        ...
+
+    def dispatch_packed(self, reqs: "Sequence[_Request]", placed: object
+                        ) -> "list[Mapping[int, KSweepOutput]]":
+        """Dispatch one packed executable whose lanes span every request
+        (all sharing one compatibility key); returns per-request
+        per-rank device outputs, in request order."""
+        ...
+
+
+class ExecCacheEngine:
+    """The production :class:`Engine`: requests serve through the
+    shape-bucketed executable cache (solo), the packed multi-request
+    builder (``sweep._build_packed_serve_fn``), and the device-resident
+    input cache; non-cacheable configurations fall back to the plain
+    sweep path so every algorithm stays servable."""
+
+    def __init__(self, exec_cache=None, profiler=None):
+        from nmfx.exec_cache import ExecCache
+        from nmfx.profiling import NullProfiler
+
+        self.exec_cache = exec_cache if exec_cache is not None \
+            else ExecCache()
+        self._prof = profiler if profiler is not None else NullProfiler()
+
+    # -- request shaping ---------------------------------------------------
+    @staticmethod
+    def _ccfg(req: _Request) -> ConsensusConfig:
+        return ConsensusConfig(ks=req.ks, restarts=req.restarts,
+                               seed=req.seed, label_rule=req.label_rule,
+                               linkage=req.linkage,
+                               grid_slots=req.grid_slots,
+                               grid_tail_slots=req.grid_tail_slots)
+
+    def compatibility_key(self, req: _Request) -> "tuple | None":
+        from nmfx.data_cache import default_cache
+
+        if req.icfg.method != "random":
+            # NNDSVD lane batches are built outside the executable per
+            # true shape — solo only
+            return None
+        ccfg = self._ccfg(req)
+        if not self.exec_cache.cacheable(ccfg, req.scfg, None):
+            return None
+        bucket = self.exec_cache.bucket_shape(*req.a.shape)
+        # the DataKey IS the data half of the compatibility contract:
+        # same content fingerprint + placement = the same resident
+        # padded device buffer the packed executable reads
+        dkey = default_cache().key_for(req.a, req.scfg.dtype,
+                                       pad_shape=bucket, mesh=None)
+        tail = req.grid_tail_slots
+        if isinstance(tail, list):
+            tail = tuple(tail)
+        return (dkey, bucket, req.scfg, req.icfg, req.label_rule,
+                req.grid_slots, tail)
+
+    def place(self, req: _Request):
+        ccfg = self._ccfg(req)
+        if not self.exec_cache.cacheable(ccfg, req.scfg, None):
+            return None  # the plain sweep path places through the cache
+        return self.exec_cache.prefetch(req.a, req.scfg, None,
+                                        profiler=self._prof)
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch_solo(self, req: _Request, placed, scfg: SolverConfig):
+        ccfg = self._ccfg(req)
+        if placed is not None and self.exec_cache.cacheable(ccfg, scfg,
+                                                            None):
+            return self.exec_cache.run_sweep(placed, ccfg, scfg,
+                                             req.icfg, None,
+                                             profiler=self._prof)
+        from nmfx.sweep import sweep
+
+        return sweep(req.a, ccfg, scfg, req.icfg, None,
+                     profiler=self._prof)
+
+    def dispatch_packed(self, reqs, placed):
+        import jax
+        import jax.numpy as jnp
+
+        from nmfx.exec_cache import _unpad, start_host_fetch
+        from nmfx.ops.packed_mu import flip_budget
+        from nmfx.sweep import _build_packed_serve_fn
+
+        req0 = reqs[0]
+        # one lane group per (request, rank); LPT order (rank
+        # descending), deadline/priority/arrival-aware within equal
+        # ranks — urgent requests' lanes load into slots first
+        groups = sorted(
+            ((k, r) for r in reqs for k in r.ks),
+            key=lambda g: (-g[0],) + g[1].order_key())
+        layout = tuple((k, r.restarts) for k, r in groups)
+        tail = req0.grid_tail_slots
+        if isinstance(tail, list):
+            tail = tuple(tail)
+        fn = _build_packed_serve_fn(layout, req0.scfg, req0.label_rule,
+                                    req0.grid_slots, tail, placed.bucket,
+                                    req0.icfg)
+        # canonical chain: fold_in(key(seed), k) per group, split over
+        # the restart axis inside the executable — identical draws to
+        # each request's solo path
+        roots = jnp.stack([
+            jax.random.fold_in(jax.random.key(r.seed), k)
+            for k, r in groups])
+        m_true, n_true = placed.true_shape
+        flip = flip_budget(req0.scfg.class_flip_tol, n_true)
+        outs = fn(placed.a_pad, roots,
+                  jnp.asarray(m_true, jnp.int32),
+                  jnp.asarray(n_true, jnp.int32),
+                  jnp.asarray(flip, jnp.int32))
+        per_req: "dict[int, dict]" = {r.seq: {} for r in reqs}
+        for (k, r), out in zip(groups, outs):
+            per_req[r.seq][k] = _unpad(out, m_true, n_true)
+        with self._prof.phase("xfer.overlap"):
+            start_host_fetch(per_req)
+        return [per_req[r.seq] for r in reqs]
+
+
+class NMFXServer:
+    """Async multi-tenant consensus-NMF server over one device.
+
+    ``submit(...)`` enqueues a request and returns a
+    ``Future[ConsensusResult]`` immediately; a single scheduler thread
+    owns the device and continuously packs compatible requests'
+    restarts into shared executable lanes (see the module docstring);
+    completion workers harvest each request the moment its arrays
+    exist, so the device never waits on host rank selection.
+
+    Lifecycle: workers spawn lazily on the first submit; ``close()``
+    (or the context manager) drains in-flight requests and joins the
+    threads. One server instance per process/device is the intended
+    shape — it owns the exec-cache LRU and the dispatch order.
+    """
+
+    def __init__(self, serve_cfg: ServeConfig = ServeConfig(), *,
+                 engine: "Engine | None" = None, exec_cache=None,
+                 profiler=None, start: bool = True):
+        from nmfx.profiling import NullProfiler
+
+        if engine is not None and exec_cache is not None:
+            raise ValueError("pass either engine or exec_cache, not both")
+        self.cfg = serve_cfg
+        self._prof = profiler if profiler is not None else NullProfiler()
+        self.engine: Engine = engine if engine is not None else \
+            ExecCacheEngine(exec_cache, profiler=self._prof)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: "list[tuple[tuple, _Request]]" = []  # heap
+        self._queued = 0
+        self._pending_bytes = 0
+        self._seq = itertools.count()
+        self._closed = False
+        self._paused = not start
+        self._scheduler: "threading.Thread | None" = None
+        self._harvest_q: "list[tuple[_Request, object, float] | None]" = []
+        self._harvest_cond = threading.Condition()
+        self._harvesters: "list[threading.Thread]" = []
+        self._inflight = 0  # dispatched, not yet resolved
+        self.counters = {"submitted": 0, "completed": 0, "failed": 0,
+                         "cancelled": 0, "deadline_expired": 0,
+                         "rejected": 0, "dispatches": 0,
+                         "packed_dispatches": 0, "packed_requests": 0,
+                         "total_lanes": 0, "packed_lanes": 0,
+                         "budget_clamped": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self) -> "NMFXServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def pause(self) -> None:
+        """Hold dispatch (requests keep queueing) — deterministic batch
+        construction for tests and maintenance windows."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def close(self, cancel_pending: bool = False) -> None:
+        """Stop accepting requests; drain the queue and in-flight work,
+        then join the worker threads. ``cancel_pending=True`` instead
+        fails queued (not yet dispatched) requests with
+        :class:`ServerClosed`."""
+        with self._cond:
+            if not self._closed:
+                self._closed = True
+                if cancel_pending:
+                    for _, req in self._queue:
+                        if req.future.set_running_or_notify_cancel():
+                            req.future.set_exception(ServerClosed(
+                                "server closed before dispatch"))
+                            self.counters["failed"] += 1
+                    self._queue.clear()
+                    self._queued = 0
+                    self._pending_bytes = 0
+                self._paused = False  # a paused close must still drain
+                self._cond.notify_all()
+            scheduler = self._scheduler
+        if scheduler is not None:
+            scheduler.join()
+        with self._harvest_cond:
+            for _ in self._harvesters:
+                self._harvest_q.append(None)
+            self._harvest_cond.notify_all()
+        for t in self._harvesters:
+            t.join()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, data, ks: Sequence[int] = (2, 3, 4, 5),
+               restarts: int = 10, *, seed: int = 123,
+               solver_cfg: "SolverConfig | None" = None,
+               init_cfg: "InitConfig | None" = None,
+               label_rule: str = "argmax", linkage: str = "average",
+               grid_slots: int = 48, grid_tail_slots="auto",
+               priority: int = 0, deadline: "float | None" = None,
+               timeout: "float | None" = None) -> _ServeFuture:
+        """Enqueue one consensus request; returns a
+        ``Future[ConsensusResult]`` immediately.
+
+        Arguments mirror ``nmfconsensus`` (the result is bit-identical
+        to calling it with the same arguments — the exactness
+        contract), plus the serving controls: ``priority`` (higher
+        dispatches first), ``timeout`` (seconds from now) or
+        ``deadline`` (absolute ``time.monotonic()`` seconds) — expiry
+        while queued resolves the future to :class:`DeadlineExceeded`
+        without dispatching. ``future.cancel()`` works until dispatch;
+        ``future.stats`` carries the per-request serving spans.
+        """
+        from nmfx.api import _as_matrix
+
+        arr, col_names = _as_matrix(data)
+        arr = np.asarray(arr)
+        if not np.isfinite(arr).all():
+            raise ValueError("input matrix contains non-finite values")
+        if (arr < 0).any():
+            raise ValueError("input matrix must be non-negative")
+        ks = tuple(dict.fromkeys(int(k) for k in ks))
+        if not ks:
+            raise ValueError("ks must be non-empty")
+        if min(ks) < 2:
+            raise ValueError("all k must be >= 2")
+        if max(ks) > arr.shape[1]:
+            raise ValueError(f"k={max(ks)} exceeds the number of samples "
+                             f"({arr.shape[1]})")
+        if restarts < 1:
+            raise ValueError("restarts must be >= 1")
+        if deadline is not None and timeout is not None:
+            raise ValueError("pass either deadline or timeout, not both")
+        if timeout is None and deadline is None \
+                and self.cfg.default_timeout_s is not None:
+            timeout = self.cfg.default_timeout_s
+        if timeout is not None:
+            deadline = time.monotonic() + timeout
+        scfg = solver_cfg if solver_cfg is not None else SolverConfig()
+        icfg = init_cfg if init_cfg is not None else InitConfig()
+        stats = RequestStats(lanes=len(ks) * restarts)
+        req = _Request(seq=next(self._seq), a=arr,
+                       col_names=tuple(col_names), ks=ks,
+                       restarts=restarts, seed=seed, scfg=scfg,
+                       icfg=icfg, label_rule=label_rule, linkage=linkage,
+                       grid_slots=grid_slots,
+                       grid_tail_slots=grid_tail_slots,
+                       priority=priority, deadline=deadline,
+                       future=_ServeFuture(stats), stats=stats,
+                       compat=None, submitted=time.monotonic())
+        # admission pre-check BEFORE the O(bytes) fingerprint: under
+        # overload QueueFull is the hot path, and rejecting must stay
+        # cheap; the authoritative (race-free) check re-runs at enqueue
+        with self._cond:
+            self._admit_locked(arr.nbytes)
+        # the compatibility fingerprint (one sha256 pass over the host
+        # bytes) is computed HERE on the caller's thread, keeping the
+        # scheduler thread's pop-to-dispatch path hash-free
+        req.compat = self.engine.compatibility_key(req)
+        with self._cond:
+            self._admit_locked(arr.nbytes)
+            heapq.heappush(self._queue, (req.order_key(), req))
+            self._queued += 1
+            self._pending_bytes += arr.nbytes
+            self.counters["submitted"] += 1
+            self._ensure_workers()
+            self._cond.notify_all()
+        return req.future
+
+    def _admit_locked(self, nbytes: int) -> None:
+        """Admission control (caller holds the lock): typed rejection
+        when the queue is over its depth or pending-byte bound."""
+        if self._closed:
+            raise ServerClosed("server is closed")
+        if self._queued >= self.cfg.max_queue_depth:
+            self.counters["rejected"] += 1
+            raise QueueFull(
+                f"queue depth {self._queued} at the configured bound "
+                f"({self.cfg.max_queue_depth})")
+        if self._pending_bytes + nbytes > self.cfg.max_pending_bytes:
+            self.counters["rejected"] += 1
+            raise QueueFull(
+                f"pending input bytes would exceed the "
+                f"{self.cfg.max_pending_bytes}-byte admission bound")
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            c = dict(self.counters)
+            c.update(queued=self._queued, inflight=self._inflight,
+                     pending_bytes=self._pending_bytes,
+                     packing_efficiency=(
+                         c["packed_lanes"] / c["total_lanes"]
+                         if c["total_lanes"] else None))
+            return c
+
+    # -- scheduler ---------------------------------------------------------
+    def _ensure_workers(self) -> None:
+        # caller holds the lock
+        if self._scheduler is None:
+            self._scheduler = threading.Thread(
+                target=self._run_scheduler, daemon=True,
+                name="nmfx-serve-sched")
+            self._scheduler.start()
+        while len(self._harvesters) < self.cfg.harvest_workers:
+            t = threading.Thread(target=self._run_harvester, daemon=True,
+                                 name="nmfx-serve-harvest")
+            t.start()
+            self._harvesters.append(t)
+
+    def _expire_locked(self, now: float) -> None:
+        """Resolve queued requests whose deadline passed — typed
+        DeadlineExceeded, never dispatched. Caller holds the lock."""
+        keep = []
+        for entry in self._queue:
+            req = entry[1]
+            if req.future.cancelled():
+                self._drop_locked(req, "cancelled")
+            elif req.deadline is not None and now >= req.deadline:
+                self._drop_locked(req, "deadline")
+                if req.future.set_running_or_notify_cancel():
+                    req.stats.queue_wait_s = now - req.submitted
+                    req.stats.latency_s = now - req.submitted
+                    req.future.set_exception(DeadlineExceeded(
+                        "deadline expired after "
+                        f"{now - req.submitted:.3f}s in queue; the "
+                        "request was never dispatched"))
+            else:
+                keep.append(entry)
+        if len(keep) != len(self._queue):
+            self._queue[:] = keep
+            heapq.heapify(self._queue)
+
+    def _drop_locked(self, req: _Request, why: str) -> None:
+        self._queued -= 1
+        self._pending_bytes -= req.a.nbytes
+        self.counters["cancelled" if why == "cancelled"
+                      else "deadline_expired"] += 1
+
+    def _next_deadline_locked(self) -> "float | None":
+        dls = [r.deadline for _, r in self._queue
+               if r.deadline is not None]
+        return min(dls) if dls else None
+
+    def _pop_locked(self) -> "_Request | None":
+        while self._queue:
+            _, req = heapq.heappop(self._queue)
+            if req.future.cancelled():
+                self._drop_locked(req, "cancelled")
+                continue
+            self._queued -= 1
+            self._pending_bytes -= req.a.nbytes
+            return req
+        return None
+
+    def _take_compatible_locked(self, head: _Request, lanes: int,
+                                taken: int) -> "list[_Request]":
+        """Pull queued requests sharing ``head``'s compatibility key, in
+        priority order, within the batch bounds. Caller holds the
+        lock."""
+        mates: "list[_Request]" = []
+        keep = []
+        for entry in sorted(self._queue):
+            req = entry[1]
+            if (taken + len(mates) < self.cfg.max_batch_requests
+                    and req.compat == head.compat
+                    and not req.future.cancelled()
+                    and (req.deadline is None
+                         or time.monotonic() < req.deadline)
+                    # a request whose deadline clamps its iteration
+                    # budget must dispatch SOLO (the contract above):
+                    # packed lanes run at the shared max_iter, and a
+                    # mate expiring mid-solve would have its computed
+                    # results discarded — left queued, it pops as head
+                    # and dispatches clamped
+                    and not self._budget_clamps(req)
+                    and lanes + req.lanes <= self.cfg.max_batch_lanes):
+                mates.append(req)
+                lanes += req.lanes
+                self._queued -= 1
+                self._pending_bytes -= req.a.nbytes
+            else:
+                keep.append(entry)
+        if mates:
+            self._queue[:] = keep
+            heapq.heapify(self._queue)
+        return mates
+
+    def _run_scheduler(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    self._expire_locked(now)
+                    if self._queue and not self._paused:
+                        break
+                    if self._closed:
+                        return
+                    dl = self._next_deadline_locked()
+                    self._cond.wait(timeout=None if dl is None
+                                    else max(dl - now, 0.0))
+                head = self._pop_locked()
+                if head is None:
+                    continue
+                batch = [head]
+                packable = (self.cfg.pack and head.compat is not None
+                            and not self._budget_clamps(head))
+                if packable:
+                    batch += self._take_compatible_locked(
+                        head, head.lanes, 1)
+            if (packable and len(batch) < self.cfg.max_batch_requests
+                    and self.cfg.batch_linger_s > 0):
+                batch = self._linger(head, batch)
+            if head.deadline is not None \
+                    and time.monotonic() >= head.deadline:
+                # expired between queue and dispatch: resolve typed,
+                # return its mates to the queue unharmed
+                self._resolve_expired(head)
+                with self._cond:
+                    for req in batch[1:]:
+                        heapq.heappush(self._queue,
+                                       (req.order_key(), req))
+                        self._queued += 1
+                        self._pending_bytes += req.a.nbytes
+                continue
+            self._dispatch(batch)
+
+    def _linger(self, head: _Request,
+                batch: "list[_Request]") -> "list[_Request]":
+        """Continuous-batching linger: hold ``head``'s dispatch briefly
+        so near-simultaneous compatible arrivals share its lanes."""
+        until = time.monotonic() + self.cfg.batch_linger_s
+        lanes = sum(r.lanes for r in batch)
+        with self._cond:
+            while (len(batch) < self.cfg.max_batch_requests
+                   and not self._closed):
+                remaining = until - time.monotonic()
+                if remaining <= 0:
+                    break
+                batch += self._take_compatible_locked(head, lanes,
+                                                      len(batch))
+                lanes = sum(r.lanes for r in batch)
+                if len(batch) >= self.cfg.max_batch_requests:
+                    break
+                self._cond.wait(timeout=remaining)
+            batch += self._take_compatible_locked(head, lanes, len(batch))
+        return batch
+
+    def _budget_clamps(self, req: _Request) -> bool:
+        return (req.deadline is not None
+                and self.cfg.iter_rate_estimate is not None)
+
+    def _budget_iters(self, req: _Request) -> "int | None":
+        """Deadline → per-lane iteration budget: the remaining wall at
+        the estimated iteration rate, rounded UP to a power-of-two
+        multiple of check_every (bounding executable churn to
+        log(max_iter) distinct budgets), clamped to the configured
+        max_iter. The lanes then stop via the per-lane in-kernel budget
+        — the only eviction a launched dispatch admits."""
+        if not self._budget_clamps(req):
+            return None
+        remaining = req.deadline - time.monotonic()
+        want = int(remaining * self.cfg.iter_rate_estimate)
+        ce = req.scfg.check_every
+        step = ce
+        while step < max(want, 1):
+            step *= 2
+        return min(step, req.scfg.max_iter)
+
+    def _resolve_expired(self, req: _Request,
+                         mid_solve: bool = False) -> None:
+        now = time.monotonic()
+        req.stats.latency_s = now - req.submitted
+        with self._lock:
+            self.counters["deadline_expired"] += 1
+        if req.future.cancelled() or req.future.done():
+            return
+        if not mid_solve and not req.future.set_running_or_notify_cancel():
+            return
+        msg = ("deadline expired mid-solve; the request's lanes were "
+               "stopped by the per-lane iteration budget and its "
+               "results discarded" if mid_solve else
+               "deadline expired before dispatch")
+        req.future.set_exception(DeadlineExceeded(msg))
+
+    def _dispatch(self, batch: "list[_Request]") -> None:
+        t0 = time.monotonic()
+        live = [r for r in batch
+                if r.future.set_running_or_notify_cancel()]
+        with self._lock:
+            self.counters["cancelled"] += len(batch) - len(live)
+        if not live:
+            return
+        for req in live:
+            req.stats.queue_wait_s = t0 - req.submitted
+        lanes = sum(r.lanes for r in live)
+        try:
+            with self._prof.phase("serve.pack"):
+                if len(live) >= 2:
+                    placed = self.engine.place(live[0])
+                    raws = self.engine.dispatch_packed(live, placed)
+                else:
+                    req = live[0]
+                    scfg = req.scfg
+                    budget = self._budget_iters(req)
+                    if budget is not None and budget < scfg.max_iter:
+                        scfg = dataclasses.replace(scfg, max_iter=budget)
+                        req.stats.budget_iters = budget
+                        with self._lock:
+                            self.counters["budget_clamped"] += 1
+                    placed = self.engine.place(req)
+                    raws = [self.engine.dispatch_solo(req, placed, scfg)]
+        except BaseException as e:
+            with self._lock:
+                self.counters["failed"] += len(live)
+            for req in live:
+                req.future.set_exception(e)
+            return
+        t1 = time.monotonic()
+        _note_dispatch(len(live), lanes)
+        with self._lock:
+            self.counters["dispatches"] += 1
+            self.counters["total_lanes"] += lanes
+            if len(live) >= 2:
+                self.counters["packed_dispatches"] += 1
+                self.counters["packed_requests"] += len(live)
+                self.counters["packed_lanes"] += lanes
+            self._inflight += len(live)
+        for req, raw in zip(live, raws):
+            req.stats.pack_s = t1 - t0
+            req.stats.packed_requests = len(live)
+            with self._harvest_cond:
+                self._harvest_q.append((req, raw, t1))
+                self._harvest_cond.notify()
+
+    # -- completion --------------------------------------------------------
+    def _run_harvester(self) -> None:
+        from nmfx.api import ConsensusResult
+        from nmfx.harvest import harvest_rank
+
+        while True:
+            with self._harvest_cond:
+                while not self._harvest_q:
+                    self._harvest_cond.wait()
+                item = self._harvest_q.pop(0)
+            if item is None:
+                return
+            req, raw, t_disp = item
+            try:
+                fetch_s = select_s = 0.0
+                per_k = {}
+                for k in req.ks:
+                    kres, f_s, s_s = harvest_rank(k, raw[k], req.linkage,
+                                                  self._prof)
+                    per_k[k] = kres
+                    fetch_s += f_s
+                    select_s += s_s
+                req.stats.solve_s = fetch_s
+                req.stats.harvest_s = select_s
+                now = time.monotonic()
+                req.stats.latency_s = now - req.submitted
+                if req.deadline is not None and now >= req.deadline:
+                    self._resolve_expired(req, mid_solve=True)
+                else:
+                    result = ConsensusResult(ks=req.ks, per_k=per_k,
+                                             col_names=req.col_names)
+                    req.future.set_result(result)
+                    with self._lock:
+                        self.counters["completed"] += 1
+            except BaseException as e:
+                with self._lock:
+                    self.counters["failed"] += 1
+                if not req.future.done():
+                    req.future.set_exception(e)
+            finally:
+                with self._lock:
+                    self._inflight -= 1

@@ -1059,6 +1059,114 @@ def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
     return jax.jit(impl, donate_argnums=donate)
 
 
+@lru_cache(maxsize=32)
+def _build_packed_serve_fn(layout: tuple, solver_cfg: SolverConfig,
+                           label_rule: str, grid_slots: int,
+                           grid_tail_slots,
+                           bucket_shape: tuple[int, int],
+                           init_cfg: InitConfig):
+    """Sweep builder for CROSS-REQUEST lane packing (``nmfx/serve.py``):
+    one slot-scheduled dispatch whose lanes come from SEVERAL serve
+    requests — the token-level-batching analogue for consensus NMF.
+
+    ``layout`` is the static pack shape, a tuple of ``(k, restarts)``
+    groups sorted rank-descending (LPT dispatch order, request-arrival
+    ties preserved by the caller); each group is one request's rank-k
+    restart block. The built function is
+
+        fn(a_pad, group_roots, m_true, n_true, flip_floor)
+            -> tuple[KSweepOutput, ...]   # one per group, layout order
+
+    ``group_roots`` is a stacked ``(G,)`` key array: group g's root is
+    ``fold_in(key(seed_g), k_g)`` computed host-side by the serve
+    scheduler, so each group draws EXACTLY the canonical per-(seed, k,
+    restart) key chain of the solo paths — a request's lanes are
+    initialized identically whether it solves alone or packed.
+
+    Exactness contract (the load-bearing property, pinned by
+    tests/test_serve.py): each lane's trajectory through ``mu_sched``
+    is independent of the dispatch composition — batched GEMMs evaluate
+    each lane independently, padding a lane's factors to a larger
+    ``k_max`` only adds exact-zero terms to its contractions (the
+    ``grid_mu`` invariant), and per-lane budgets/stop decisions are
+    per-lane state — so a request's packed results are bit-identical to
+    its solo bucketed sweep on the XLA engines, the same class as the
+    whole-grid/per-k and streamed/sequential parities. The epilogue
+    below mirrors ``_build_bucketed_sweep_fn``'s per-rank block
+    field-for-field for the same reason.
+
+    Packing therefore REQUIRES (enforced by the serve scheduler's
+    compatibility key, never here): one shared padded matrix, one true
+    shape (the masks/dnorm rescale/flip budget are shared scalars), one
+    SolverConfig/InitConfig(random)/label-rule/slot-pool setting, and
+    no mesh (the serve scheduler owns a single device).
+
+    Compile cost (a known, documented tradeoff — docs/serving.md): the
+    executable is keyed by the exact pack ``layout``, so the FIRST
+    occurrence of a novel batch composition pays a synchronous compile
+    on the scheduler thread, cached only in this in-process
+    ``lru_cache`` (no ``ExecCacheConfig.cache_dir`` persistence, no
+    ``compile_count`` accounting). Steady-state serving with stable
+    request shapes converges to a handful of layouts; deployments with
+    highly variable compositions should bound them via
+    ``ServeConfig.max_batch_requests``/``batch_linger_s`` or disable
+    packing.
+    """
+    from nmfx.ops.sched_mu import mu_sched
+
+    if init_cfg.method != "random":
+        raise ValueError(
+            "cross-request packing draws lanes inside the executable "
+            "(the random-init fast path); NNDSVD requests must dispatch "
+            "solo")
+    if any(layout[i][0] < layout[i + 1][0] for i in range(len(layout) - 1)):
+        raise ValueError(
+            f"layout must be sorted rank-descending (LPT), got {layout}")
+    k_max = max(k for k, _ in layout)
+    m_pad, n_pad = bucket_shape
+    dtype = jnp.dtype(solver_cfg.dtype)
+    dyn_init = _dyn_lane_init(init_cfg, dtype, n_pad, m_pad, k_max)
+    job_ks = tuple(k for k, r in layout for _ in range(r))
+
+    def impl(a_pad, group_roots, m_true, n_true,
+             flip_floor) -> tuple[KSweepOutput, ...]:
+        a_pad = jnp.asarray(a_pad, dtype)
+        rank_keys = [(k, jax.random.split(group_roots[g], r))
+                     for g, (k, r) in enumerate(layout)]
+        w0, h0 = dyn_init(rank_keys, m_true, n_true)
+        res = mu_sched(a_pad, w0, h0, solver_cfg, slots=grid_slots,
+                       tail_slots=grid_tail_slots, job_ks=job_ks,
+                       flip_floor=flip_floor)
+        # pad-masking epilogue: identical math to the solo bucketed
+        # executable's per-rank block (labels -> -1 pad columns ->
+        # one-hot consensus; dnorm rescaled from the padded to the true
+        # normalizer) so packed == solo is slicing, not re-derivation
+        true_mn = (m_true.astype(jnp.float32)
+                   * n_true.astype(jnp.float32))
+        scale = jnp.sqrt(float(m_pad * n_pad) / true_mn).astype(
+            res.dnorm.dtype)
+        valid = jnp.arange(n_pad) < n_true
+        out: list[KSweepOutput] = []
+        start = 0
+        for k, r in layout:
+            sl = slice(start, start + r)
+            start += r
+            hk = res.h[sl, :k, :]
+            wk = res.w[sl, :, :k]
+            labels = jax.vmap(partial(labels_from_h,
+                                      rule=label_rule))(hk)
+            labels = jnp.where(valid[None, :], labels, -1)
+            cons = consensus_matrix(labels, k)
+            dnorm = res.dnorm[sl] * scale
+            best = jnp.argmin(dnorm)
+            out.append(KSweepOutput(cons, res.iterations[sl], dnorm,
+                                    res.stop_reason[sl], labels,
+                                    wk[best], hk[best]))
+        return tuple(out)
+
+    return jax.jit(impl)
+
+
 def grid_mesh(restart_shards: int | None = None,
               feature_shards: int = 1,
               sample_shards: int = 1,

@@ -254,3 +254,44 @@ def test_cli_input_cache_bytes(gct_path, capsys):
         default_cache().resize(max_bytes=old)
     with pytest.raises(SystemExit):
         main([gct_path, "--input-cache-bytes", "-1", "--no-files"])
+
+
+def test_cli_serve_smoke(gct_path, tmp_path, capsys):
+    """ISSUE 6: --serve-smoke routes the run through the multi-tenant
+    serving engine — same summary and output files as the direct path
+    (the exactness contract), plus the serve counters and per-request
+    spans on stderr."""
+    outdir = tmp_path / "served"
+    rc = main([gct_path, "--ks", "2", "--restarts", "3",
+               "--maxiter", "100", "--outdir", str(outdir),
+               "--no-plots", "--serve-smoke"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "serve-smoke: submitted=1 completed=1" in cap.err
+    assert "queue-wait=" in cap.err and "latency=" in cap.err
+    names = {p.name for p in outdir.iterdir()}
+    assert "cophenetic.txt" in names
+    assert "consensus.k.2.gct" in names
+
+
+def test_cli_serve_smoke_rejects_bad_combos(gct_path, tmp_path):
+    for argv in (
+        # one device: no shard flags
+        [gct_path, "--serve-smoke", "--feature-shards", "2",
+         "--no-files"],
+        # the exec-cache path bypasses the registry resume
+        [gct_path, "--serve-smoke", "--checkpoint-dir",
+         str(tmp_path / "ckpt"), "--no-files"],
+        # served results carry the best restart's factors only
+        [gct_path, "--serve-smoke", "--keep-factors", "--no-files"],
+        # completion workers harvest on the host
+        [gct_path, "--serve-smoke", "--rank-selection", "device",
+         "--no-files"],
+        # per-k outputs differ from the whole-grid path by float
+        # tolerance, which would break the serve exactness contract
+        [gct_path, "--serve-smoke", "--grid-exec", "per_k",
+         "--no-files"],
+    ):
+        with pytest.raises(SystemExit):
+            main(argv)

@@ -12,6 +12,7 @@ import pickle
 import subprocess
 import sys
 import textwrap
+import warnings
 
 import jax
 import numpy as np
@@ -475,6 +476,115 @@ def test_fresh_process_zero_compile_with_warm_disk_cache(tmp_path):
     assert second["persist_hits"] == 1
     assert second["labels"] == first["labels"]
     assert second["dnorms"] == first["dnorms"]
+
+
+# --- concurrent serving (ISSUE 6 satellites) ------------------------------
+
+def test_concurrent_executable_access():
+    """ISSUE 6 satellite: the serve front-end hits one ExecCache from
+    request threads, the scheduler, and background warms at once. Under
+    concurrent hammering over two distinct keys, every counter mutation
+    must be lock-guarded (hits + misses == calls exactly) and the
+    in-flight future registry must keep same-key compiles single-flight:
+    exactly ONE compile per distinct key no matter how many threads race
+    it, gated on the module compile counter."""
+    import threading
+
+    from nmfx import exec_cache as ec
+
+    cache = ExecCache()
+    cfgs = [_SCFG_TINY, dataclasses.replace(_SCFG_TINY, max_iter=22)]
+    compiles_before = ec.compile_count()
+    n_threads, calls = 8, 3
+    errors = []
+
+    def worker(tid):
+        try:
+            for i in range(calls):
+                entry, _ = cache.executable((60, 20), _CCFG_TINY,
+                                            cfgs[(tid + i) % len(cfgs)])
+                assert entry.bucket == cache.bucket_shape(60, 20)
+        except Exception as e:  # surfaced after join
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    s = cache.stats
+    # single-flight: one compile per distinct key despite 8 racing
+    # threads — a second compile would mean the in-flight dedup tore
+    assert s["misses"] == len(cfgs)
+    assert ec.compile_count() - compiles_before == len(cfgs)
+    assert s["hits"] + s["misses"] == n_threads * calls
+    assert s["entries"] == len(cfgs)
+    assert s["evictions"] == 0
+
+
+def test_background_warm_failure_surfaces_on_next_request():
+    """ISSUE 6 satellite: WarmTask must not swallow a dead worker's
+    exception until a join that may never come — a corrupt warm must
+    never strand a serve request forever. The failure is recorded
+    against its bucket and the NEXT executable()/run_sweep touching that
+    bucket warns once and recompiles cleanly in the foreground."""
+    cache = ExecCache()
+    orig = ExecCache._compile
+
+    def boom(self, *a, **kw):
+        raise RuntimeError("injected warm-compile failure")
+
+    ExecCache._compile = boom
+    try:
+        task = cache.warm([_A_SMALL.shape], _CCFG_TINY, _SCFG_TINY,
+                          background=True)
+        # the WarmTask join contract still re-raises
+        with pytest.raises(RuntimeError, match="injected warm-compile"):
+            task.result(timeout=120)
+    finally:
+        ExecCache._compile = orig
+    assert cache.stats["warm_failures"] == 1
+    # the next request touching the poisoned bucket: ONE warning, then a
+    # clean foreground recompile serving real results
+    with pytest.warns(RuntimeWarning, match="background warmup failed"):
+        out = cache.run_sweep(_A_SMALL, _CCFG_TINY, _SCFG_TINY,
+                              InitConfig())
+    assert out[2].labels.shape == (_CCFG_TINY.restarts, _A_SMALL.shape[1])
+    assert cache.stats["warm_failures"] == 0  # consumed, not sticky
+    assert cache.stats["entries"] == 1
+    # the failure does not poison OTHER buckets' requests, and the
+    # recompiled bucket serves hits again
+    _, hit = cache.executable(_A_SMALL.shape, _CCFG_TINY, _SCFG_TINY)
+    assert hit
+
+
+def test_foreground_warm_failure_raises_without_recording():
+    """A synchronous warm() failure surfaces to its caller directly —
+    it must NOT also land in the background-failure ledger, or the next
+    request touching the bucket would double-report it with a
+    misleading 'background warmup failed' warning."""
+    cache = ExecCache()
+    orig = ExecCache._compile
+
+    def boom(self, *a, **kw):
+        raise RuntimeError("injected warm-compile failure")
+
+    ExecCache._compile = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected warm-compile"):
+            cache.warm([_A_SMALL.shape], _CCFG_TINY, _SCFG_TINY,
+                       background=False)
+    finally:
+        ExecCache._compile = orig
+    assert cache.stats["warm_failures"] == 0
+    # and the recovery path emits no stale-warm warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = cache.run_sweep(_A_SMALL, _CCFG_TINY, _SCFG_TINY,
+                              InitConfig())
+    assert out[2].labels.shape == (_CCFG_TINY.restarts, _A_SMALL.shape[1])
 
 
 # --- flip-floor threading -------------------------------------------------

@@ -178,6 +178,43 @@ def test_nmfx001_data_key_check_skipped_when_not_provided():
         data_fields=frozenset({"fingerprint"}))) == []
 
 
+def test_nmfx001_serve_key_gap_fires():
+    """The acceptance mutation for the serving front-end: a ServeConfig
+    field dropped from the policy fingerprint (added compare=False)
+    would alias two different admission/packing policies."""
+    problems = check_config_coverage(**_universe(
+        serve_fields=frozenset({"max_queue_depth", "pack",
+                                "batch_linger_s"}),
+        serve_key_covered=frozenset({"max_queue_depth", "pack"})))
+    assert any("ServeConfig.batch_linger_s" in p
+               and "serve_key_fields" in p for p in problems)
+
+
+def test_nmfx001_serve_key_covered_quiet():
+    problems = check_config_coverage(**_universe(
+        serve_fields=frozenset({"max_queue_depth", "pack"}),
+        serve_key_covered=frozenset({"max_queue_depth", "pack"})))
+    assert problems == []
+
+
+def test_nmfx001_serve_key_check_skipped_when_not_provided():
+    """Pre-serve universes are not retroactively flagged."""
+    assert check_config_coverage(**_universe(
+        serve_fields=frozenset({"max_queue_depth"}))) == []
+
+
+def test_nmfx001_live_serve_config_covered():
+    """The REAL ServeConfig: every field participates in comparison
+    (serve_key_fields == the full field set), so the live tree stays
+    lint-clean."""
+    import dataclasses
+
+    from nmfx import serve
+
+    assert serve.serve_key_fields() == frozenset(
+        f.name for f in dataclasses.fields(serve.ServeConfig))
+
+
 # ---------------------------------------------------------------- NMFX002
 
 _ENV_BAD = """

@@ -1,0 +1,526 @@
+"""Multi-tenant serving engine (ISSUE 6 tentpole): async request queue
++ continuous cross-request restart batching.
+
+The acceptance property is counter-gated, not wall-clock-gated: with
+>= 2 concurrent compatible requests, at least one executable dispatch
+must contain lanes from >= 2 distinct requests
+(``serve.packed_dispatch_count()``), while each request's
+ConsensusResult stays BIT-IDENTICAL to its solo ``nmfconsensus`` run of
+the same request through the same serving layer — the same exactness
+discipline the streamed-vs-sequential harvest parity pins.
+
+Queue mechanics (admission control, priority order, deadlines,
+cancellation, close semantics) are driven against a fake
+:class:`nmfx.serve.Engine` so they run in milliseconds with no device
+dispatch; the real ``ExecCacheEngine`` is exercised by the parity and
+degradation tests on the smallest shapes (tier-1 budget discipline)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nmfx.serve as serve
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.serve import (DeadlineExceeded, NMFXServer, QueueFull,
+                        ServeConfig, ServerClosed, serve_key_fields)
+
+KS = (2, 3)
+RESTARTS = 2
+MAX_ITER = 30
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    from nmfx.datasets import two_group_matrix
+
+    return two_group_matrix(n_genes=60, n_per_group=10, seed=3)
+
+
+@pytest.fixture(scope="module")
+def scfg():
+    return SolverConfig(max_iter=MAX_ITER)
+
+
+def _solo(data, exec_cache, *, ks=KS, restarts=RESTARTS, seed=11,
+          scfg=None, **kw):
+    """The solo reference: the SAME request through nmfconsensus on the
+    same serving layer (exec cache, mesh=None) — the exactness
+    contract's right-hand side."""
+    from nmfx.api import nmfconsensus
+
+    return nmfconsensus(data, ks=ks, restarts=restarts, seed=seed,
+                        solver_cfg=scfg, use_mesh=False,
+                        exec_cache=exec_cache, **kw)
+
+
+def assert_result_bit_equal(got, ref):
+    assert set(got.per_k) == set(ref.per_k)
+    for k in ref.per_k:
+        s, q = got.per_k[k], ref.per_k[k]
+        assert np.array_equal(np.asarray(s.consensus),
+                              np.asarray(q.consensus)), f"consensus k={k}"
+        assert s.rho == q.rho, f"rho k={k}"
+        assert np.array_equal(np.asarray(s.membership),
+                              np.asarray(q.membership)), f"membership k={k}"
+        assert np.array_equal(np.asarray(s.order),
+                              np.asarray(q.order)), f"order k={k}"
+        assert np.array_equal(np.asarray(s.iterations),
+                              np.asarray(q.iterations)), f"iterations k={k}"
+        assert np.array_equal(np.asarray(s.dnorms),
+                              np.asarray(q.dnorms)), f"dnorms k={k}"
+        assert np.array_equal(np.asarray(s.stop_reasons),
+                              np.asarray(q.stop_reasons)), \
+            f"stop_reasons k={k}"
+        assert np.array_equal(np.asarray(s.best_w),
+                              np.asarray(q.best_w)), f"best_w k={k}"
+        assert np.array_equal(np.asarray(s.best_h),
+                              np.asarray(q.best_h)), f"best_h k={k}"
+
+
+# ---------------------------------------------------------------------
+# the acceptance criterion: cross-request lane packing, counter-gated,
+# bit-identical per request
+# ---------------------------------------------------------------------
+
+def test_cross_request_packing_bit_identical(small_data, scfg):
+    from nmfx.exec_cache import ExecCache
+
+    cache = ExecCache()
+    before = serve.packed_dispatch_count()
+    with NMFXServer(ServeConfig(), exec_cache=cache,
+                    start=False) as srv:
+        # paused submit: both requests are queued before the scheduler
+        # runs, so batch construction is deterministic
+        f1 = srv.submit(small_data, ks=KS, restarts=RESTARTS, seed=11,
+                        solver_cfg=scfg)
+        f2 = srv.submit(small_data, ks=(2,), restarts=RESTARTS, seed=29,
+                        solver_cfg=scfg)
+        srv.resume()
+        r1 = f1.result(timeout=600)
+        r2 = f2.result(timeout=600)
+    # the packing contract is gated on the module counter, not timing
+    assert serve.packed_dispatch_count() == before + 1
+    assert srv.stats()["packed_requests"] == 2
+    assert f1.stats.packed_requests == 2
+    assert f1.stats.lanes == len(KS) * RESTARTS
+    assert f1.stats.queue_wait_s is not None
+    assert f1.stats.pack_s is not None
+    assert f1.stats.latency_s is not None
+    # each request's result == its solo run through the same layer
+    assert_result_bit_equal(r1, _solo(small_data, cache, seed=11,
+                                      scfg=scfg))
+    assert_result_bit_equal(r2, _solo(small_data, cache, ks=(2,),
+                                      seed=29, scfg=scfg))
+
+
+def test_incompatible_matrices_degrade_to_solo(small_data, scfg):
+    """Different input matrices share no resident device buffer: they
+    must NOT pack (the DataKey is part of the compatibility key), each
+    dispatches solo, and both results stay exact."""
+    from nmfx.exec_cache import ExecCache
+
+    other = np.asarray(small_data)[:, :18].copy()
+    cache = ExecCache()
+    packed_before = serve.packed_dispatch_count()
+    disp_before = serve.dispatch_count()
+    with NMFXServer(ServeConfig(), exec_cache=cache,
+                    start=False) as srv:
+        f1 = srv.submit(small_data, ks=(2,), restarts=RESTARTS, seed=11,
+                        solver_cfg=scfg)
+        f2 = srv.submit(other, ks=(2,), restarts=RESTARTS, seed=11,
+                        solver_cfg=scfg)
+        srv.resume()
+        r1 = f1.result(timeout=600)
+        r2 = f2.result(timeout=600)
+    assert serve.packed_dispatch_count() == packed_before
+    assert serve.dispatch_count() == disp_before + 2
+    assert_result_bit_equal(r1, _solo(small_data, cache, ks=(2,),
+                                      seed=11, scfg=scfg))
+    assert_result_bit_equal(r2, _solo(other, cache, ks=(2,), seed=11,
+                                      scfg=scfg))
+
+
+def test_deadline_budget_clamp_matches_clamped_solo(small_data):
+    """A deadline request under ``iter_rate_estimate`` dispatches solo
+    with its per-lane iteration budget clamped (the in-kernel budget
+    mechanism is the only eviction a launched dispatch admits); its
+    results are exact against a solo run at the SAME clamped
+    max_iter — the documented contract for deadline-degraded output."""
+    from nmfx.exec_cache import ExecCache
+
+    scfg = SolverConfig(max_iter=10_000)
+    cache = ExecCache()
+    cfg = ServeConfig(iter_rate_estimate=4.0)
+    with NMFXServer(cfg, exec_cache=cache, start=False) as srv:
+        f = srv.submit(small_data, ks=(2,), restarts=RESTARTS, seed=11,
+                       solver_cfg=scfg, timeout=600.0)
+        srv.resume()
+        r = f.result(timeout=600)
+    budget = f.stats.budget_iters
+    assert budget is not None and budget < scfg.max_iter
+    # power-of-two multiple of check_every: bounded executable churn
+    step = budget // scfg.check_every
+    assert budget % scfg.check_every == 0
+    assert step & (step - 1) == 0
+    assert srv.stats()["budget_clamped"] == 1
+    clamped = SolverConfig(max_iter=budget)
+    assert_result_bit_equal(r, _solo(small_data, cache, ks=(2,),
+                                     seed=11, scfg=clamped))
+
+
+# ---------------------------------------------------------------------
+# queue mechanics against a fake Engine (no device dispatch)
+# ---------------------------------------------------------------------
+
+def _fake_raw(req):
+    """A host-side KSweepOutput per rank, shaped like a real sweep's
+    output (block-diagonal consensus so host rank selection is
+    well-posed) — lets the real harvest workers run end to end."""
+    from nmfx.sweep import KSweepOutput
+
+    n = req.a.shape[1]
+    m = req.a.shape[0]
+    out = {}
+    for k in req.ks:
+        labels = np.arange(n) * k // n
+        cons = (labels[:, None] == labels[None, :]).astype(np.float32)
+        out[k] = KSweepOutput(
+            consensus=cons,
+            iterations=np.full(req.restarts, 7, np.int32),
+            dnorms=np.linspace(0.5, 0.6, req.restarts).astype(np.float32),
+            stop_reasons=np.zeros(req.restarts, np.int32),
+            labels=np.tile(labels, (req.restarts, 1)).astype(np.int32),
+            best_w=np.ones((m, k), np.float32),
+            best_h=np.ones((k, n), np.float32))
+    return out
+
+
+class FakeEngine:
+    """Scriptable :class:`nmfx.serve.Engine`: records dispatch order and
+    the SolverConfig each solo dispatch received."""
+
+    def __init__(self, compat="shared", delay=0.0):
+        self.compat = compat
+        self.delay = delay
+        self.solo = []  # (seq, scfg)
+        self.packed = []  # tuple of seqs per packed dispatch
+        self.placed = 0
+
+    def compatibility_key(self, req):
+        return self.compat
+
+    def place(self, req):
+        self.placed += 1
+        return None
+
+    def dispatch_solo(self, req, placed, scfg):
+        if self.delay:
+            time.sleep(self.delay)
+        self.solo.append((req.seq, scfg))
+        return _fake_raw(req)
+
+    def dispatch_packed(self, reqs, placed):
+        if self.delay:
+            time.sleep(self.delay)
+        self.packed.append(tuple(r.seq for r in reqs))
+        return [_fake_raw(r) for r in reqs]
+
+
+def _mat(n=6, m=8):
+    rng = np.random.default_rng(0)
+    return rng.random((m, n)).astype(np.float32)
+
+
+def test_queued_deadline_expires_typed_without_dispatch():
+    eng = FakeEngine()
+    with NMFXServer(ServeConfig(), engine=eng, start=False) as srv:
+        f = srv.submit(_mat(), ks=(2,), restarts=2, timeout=0.02)
+        time.sleep(0.08)
+        srv.resume()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+    assert eng.solo == [] and eng.packed == []  # never dispatched
+    assert srv.stats()["deadline_expired"] == 1
+    assert f.stats.latency_s is not None
+
+
+def test_mid_solve_deadline_resolves_typed():
+    """A deadline that expires while the dispatch is in flight resolves
+    to DeadlineExceeded at completion — the computed results are
+    discarded, never returned silently-late."""
+    eng = FakeEngine(compat=None, delay=0.5)
+    with NMFXServer(ServeConfig(), engine=eng, start=False) as srv:
+        f = srv.submit(_mat(), ks=(2,), restarts=2, timeout=0.25)
+        srv.resume()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+    assert len(eng.solo) == 1  # it DID dispatch; expiry was mid-solve
+
+
+def test_admission_queue_depth_bound():
+    eng = FakeEngine()
+    srv = NMFXServer(ServeConfig(max_queue_depth=1), engine=eng,
+                     start=False)
+    f1 = srv.submit(_mat(), ks=(2,), restarts=2)
+    with pytest.raises(QueueFull):
+        srv.submit(_mat(), ks=(2,), restarts=2)
+    assert srv.stats()["rejected"] == 1
+    srv.resume()
+    f1.result(timeout=30)
+    srv.close()
+
+
+def test_admission_pending_bytes_bound():
+    eng = FakeEngine()
+    a = _mat()
+    srv = NMFXServer(ServeConfig(max_pending_bytes=a.nbytes + 1),
+                     engine=eng, start=False)
+    f1 = srv.submit(a, ks=(2,), restarts=2)
+    with pytest.raises(QueueFull):
+        srv.submit(a, ks=(2,), restarts=2)
+    srv.resume()
+    f1.result(timeout=30)
+    # dispatch released the pending bytes: admission reopens
+    f3 = srv.submit(a, ks=(2,), restarts=2)
+    f3.result(timeout=30)
+    srv.close()
+
+
+def test_priority_and_deadline_order():
+    """Dispatch order is (priority desc, deadline asc, arrival): an
+    urgent late arrival overtakes the queue."""
+    eng = FakeEngine(compat=None)  # solo: one dispatch per request
+    with NMFXServer(ServeConfig(), engine=eng, start=False) as srv:
+        f_low = srv.submit(_mat(), ks=(2,), restarts=2, priority=0)
+        f_dl = srv.submit(_mat(), ks=(2,), restarts=2, priority=0,
+                          timeout=120.0)
+        f_hi = srv.submit(_mat(), ks=(2,), restarts=2, priority=5)
+        srv.resume()
+        for f in (f_low, f_dl, f_hi):
+            f.result(timeout=30)
+    # priority 5 first; among equal priorities the deadline-bearing
+    # request precedes the open-ended earlier arrival (seq = submit
+    # order: f_low=0, f_dl=1, f_hi=2)
+    assert [s for s, _ in eng.solo] == [2, 1, 0]
+
+
+def test_packing_respects_max_batch_requests():
+    eng = FakeEngine(compat="shared")
+    with NMFXServer(ServeConfig(max_batch_requests=2), engine=eng,
+                    start=False) as srv:
+        futs = [srv.submit(_mat(), ks=(2,), restarts=2)
+                for _ in range(4)]
+        srv.resume()
+        for f in futs:
+            f.result(timeout=30)
+    assert all(len(p) <= 2 for p in eng.packed)
+    assert sum(len(p) for p in eng.packed) + len(eng.solo) == 4
+
+
+def test_budget_clamped_mate_is_not_packed():
+    """A deadline request whose budget would be clamped
+    (iter_rate_estimate set) must never ride a packed dispatch as a
+    MATE: packed lanes run at the shared max_iter, so a mid-solve
+    expiry would discard its computed results. It stays queued, pops as
+    head, and dispatches solo with the clamped config."""
+    eng = FakeEngine(compat="shared")
+    cfg = ServeConfig(max_batch_requests=4, iter_rate_estimate=10.0)
+    with NMFXServer(cfg, engine=eng, start=False) as srv:
+        # two open-ended requests at high priority become the packed
+        # head+mate; the deadline request (lower priority, so never the
+        # first head) is the candidate mate the clamp must exclude
+        f1 = srv.submit(_mat(), ks=(2,), restarts=2, priority=5)
+        f2 = srv.submit(_mat(), ks=(2,), restarts=2, priority=5)
+        f_dl = srv.submit(_mat(), ks=(2,), restarts=2, priority=0,
+                          timeout=5.0)
+        srv.resume()
+        for f in (f1, f2, f_dl):
+            f.result(timeout=30)
+    assert eng.packed == [(0, 1)]  # the open-ended pair packed
+    assert [s for s, _ in eng.solo] == [2]  # the deadline req: solo
+    clamped = eng.solo[0][1]
+    assert clamped.max_iter < SolverConfig().max_iter  # and clamped
+    assert f_dl.stats.budget_iters == clamped.max_iter
+    assert f_dl.stats.packed_requests == 1
+
+
+def test_incompatible_engine_key_means_solo():
+    """compat=None (NNDSVD-style requests) must never pack."""
+    eng = FakeEngine(compat=None)
+    with NMFXServer(ServeConfig(), engine=eng, start=False) as srv:
+        futs = [srv.submit(_mat(), ks=(2,), restarts=2)
+                for _ in range(3)]
+        srv.resume()
+        for f in futs:
+            f.result(timeout=30)
+    assert eng.packed == []
+    assert len(eng.solo) == 3
+
+
+def test_pack_disabled_is_the_ab_baseline():
+    eng = FakeEngine(compat="shared")
+    with NMFXServer(ServeConfig(pack=False), engine=eng,
+                    start=False) as srv:
+        futs = [srv.submit(_mat(), ks=(2,), restarts=2)
+                for _ in range(3)]
+        srv.resume()
+        for f in futs:
+            f.result(timeout=30)
+    assert eng.packed == []
+    assert len(eng.solo) == 3
+
+
+def test_batch_linger_packs_near_simultaneous_arrivals():
+    """The continuous-batching knob: a compatible request arriving
+    within the linger window rides the held dispatch's lanes."""
+    eng = FakeEngine(compat="shared")
+    with NMFXServer(ServeConfig(batch_linger_s=1.0), engine=eng) as srv:
+        f1 = srv.submit(_mat(), ks=(2,), restarts=2)
+        time.sleep(0.1)  # scheduler pops f1 and lingers
+        f2 = srv.submit(_mat(), ks=(2,), restarts=2)
+        f1.result(timeout=30)
+        f2.result(timeout=30)
+    assert eng.packed == [(0, 1)]
+
+
+def test_cancellation_before_dispatch():
+    eng = FakeEngine()
+    with NMFXServer(ServeConfig(), engine=eng, start=False) as srv:
+        f = srv.submit(_mat(), ks=(2,), restarts=2)
+        assert f.cancel()
+        srv.resume()
+        time.sleep(0.05)
+    assert f.cancelled()
+    assert eng.solo == [] and eng.packed == []
+    assert srv.stats()["cancelled"] == 1
+
+
+def test_submit_after_close_raises():
+    srv = NMFXServer(ServeConfig(), engine=FakeEngine())
+    srv.close()
+    with pytest.raises(ServerClosed):
+        srv.submit(_mat(), ks=(2,), restarts=2)
+
+
+def test_close_drains_inflight_requests():
+    eng = FakeEngine(delay=0.05)
+    srv = NMFXServer(ServeConfig(), engine=eng, start=False)
+    futs = [srv.submit(_mat(), ks=(2,), restarts=2) for _ in range(3)]
+    srv.resume()
+    srv.close()  # must drain, not abandon
+    for f in futs:
+        assert f.result(timeout=1) is not None
+
+
+def test_close_cancel_pending_fails_queued():
+    eng = FakeEngine()
+    srv = NMFXServer(ServeConfig(), engine=eng, start=False)
+    f = srv.submit(_mat(), ks=(2,), restarts=2)
+    srv.close(cancel_pending=True)
+    with pytest.raises(ServerClosed):
+        f.result(timeout=5)
+    assert eng.solo == []
+
+
+def test_engine_failure_propagates_to_futures():
+    class Boom(FakeEngine):
+        def dispatch_solo(self, req, placed, scfg):
+            raise RuntimeError("device on fire")
+
+    with NMFXServer(ServeConfig(), engine=Boom(compat=None)) as srv:
+        f = srv.submit(_mat(), ks=(2,), restarts=2)
+        with pytest.raises(RuntimeError, match="device on fire"):
+            f.result(timeout=30)
+    assert srv.stats()["failed"] == 1
+
+
+def test_concurrent_submitters():
+    """Many threads submitting at once: every future resolves, counters
+    balance — the submit path's lock discipline under contention."""
+    eng = FakeEngine(compat="shared")
+    results = []
+    with NMFXServer(ServeConfig(max_queue_depth=64), engine=eng) as srv:
+        def worker():
+            f = srv.submit(_mat(), ks=(2,), restarts=2)
+            results.append(f.result(timeout=60))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert len(results) == 8
+    s = srv.stats()
+    assert s["submitted"] == 8 and s["completed"] == 8
+    assert sum(len(p) for p in eng.packed) + len(eng.solo) == 8
+
+
+# ---------------------------------------------------------------------
+# config + module surface
+# ---------------------------------------------------------------------
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(max_queue_depth=0)
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch_requests=0)
+    with pytest.raises(ValueError):
+        ServeConfig(batch_linger_s=-1.0)
+    with pytest.raises(ValueError):
+        ServeConfig(default_timeout_s=0.0)
+    with pytest.raises(ValueError):
+        ServeConfig(iter_rate_estimate=-2.0)
+    with pytest.raises(ValueError):
+        ServeConfig(harvest_workers=0)
+
+
+def test_serve_key_fields_covers_every_field():
+    import dataclasses
+
+    assert serve_key_fields() == frozenset(
+        f.name for f in dataclasses.fields(ServeConfig))
+
+
+def test_submit_validation():
+    srv = NMFXServer(ServeConfig(), engine=FakeEngine(), start=False)
+    with pytest.raises(ValueError):
+        srv.submit(-_mat(), ks=(2,), restarts=2)  # negative entries
+    with pytest.raises(ValueError):
+        srv.submit(_mat(), ks=(), restarts=2)
+    with pytest.raises(ValueError):
+        srv.submit(_mat(), ks=(1,), restarts=2)
+    with pytest.raises(ValueError):
+        srv.submit(_mat(), ks=(2,), restarts=0)
+    with pytest.raises(ValueError):
+        srv.submit(_mat(), ks=(2,), restarts=2, timeout=1.0,
+                   deadline=time.monotonic() + 1.0)
+    srv.close()
+
+
+def test_default_timeout_applies():
+    eng = FakeEngine()
+    with NMFXServer(ServeConfig(default_timeout_s=0.02), engine=eng,
+                    start=False) as srv:
+        f = srv.submit(_mat(), ks=(2,), restarts=2)
+        time.sleep(0.08)
+        srv.resume()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+
+
+def test_packing_efficiency_counter():
+    eng = FakeEngine(compat="shared")
+    with NMFXServer(ServeConfig(), engine=eng, start=False) as srv:
+        f1 = srv.submit(_mat(), ks=(2,), restarts=3)
+        f2 = srv.submit(_mat(), ks=(2,), restarts=3)
+        srv.resume()
+        f1.result(timeout=30)
+        f2.result(timeout=30)
+    s = srv.stats()
+    assert s["total_lanes"] == 6
+    assert s["packed_lanes"] == 6
+    assert s["packing_efficiency"] == 1.0
+    assert serve.packing_efficiency() is None \
+        or 0.0 <= serve.packing_efficiency() <= 1.0
