@@ -202,8 +202,13 @@ def _serve_parity_problems(got, ref, label: str) -> list[str]:
         s, q = got.per_k[k], ref.per_k[k]
         for field in ("consensus", "membership", "order", "iterations",
                       "dnorms", "stop_reasons", "best_w", "best_h"):
-            if not np.array_equal(np.asarray(getattr(s, field)),
-                                  np.asarray(getattr(q, field))):
+            # BYTE comparison, not array_equal: literally bit-identical,
+            # and a quarantined lane's NaN dnorm (chaos rung) equals the
+            # reference's identical NaN instead of failing NaN != NaN
+            sv = np.ascontiguousarray(np.asarray(getattr(s, field)))
+            qv = np.ascontiguousarray(np.asarray(getattr(q, field)))
+            if (sv.shape != qv.shape or sv.dtype != qv.dtype
+                    or sv.tobytes() != qv.tobytes()):
                 problems.append(f"{label} k={k}: served {field} differs "
                                 "from the solo run (bitwise)")
         if s.rho != q.rho:
@@ -683,17 +688,24 @@ def main():
         # the gate is the ONE sanctioned fault-injection harness: it
         # translates the probe's env var into the explicit in-process
         # opt-in HERE, at startup, before the first trace. Library code
-        # ignores the env var entirely (nmfx.ops.sched_mu._fault_state;
-        # lint rule NMFX002), so an inherited variable alone can no
-        # longer alter compiled production reload paths —
+        # ignores the env var entirely (the nmfx.faults registry; lint
+        # rule NMFX002), so an inherited variable alone can no longer
+        # alter compiled production reload paths —
         # probe_fault_gate.py's subprocess protocol still works because
-        # its subprocess IS this entrypoint.
+        # its subprocess IS this entrypoint. Since ISSUE 7 the canonical
+        # arming is the faults registry (sched_mu's
+        # enable_stale_reload_fault remains as a deprecation shim for
+        # external probe harnesses).
         frac = float(os.environ.get("NMFX_FAULT_INJECT_STALE_RELOAD",
                                     "0") or 0)
         if frac > 0:
-            from nmfx.ops.sched_mu import enable_stale_reload_fault
+            from nmfx import faults
 
-            enable_stale_reload_fault(frac)
+            faults.arm("sched.stale_reload", rate=frac)
+            print("bench: stale-reload fault injection ARMED "
+                  f"(fraction={frac}) — results from this process are "
+                  "INVALID by design (fault-gate probe)",
+                  file=sys.stderr)
         raise SystemExit(run_verify(args))
     seed = 123
     icfg = InitConfig()
@@ -1226,6 +1238,106 @@ def main():
                   f"goodput={ladder[-1]['goodput_req_per_s']} req/s "
                   f"packing={ladder[-1]['packing_efficiency']}",
                   file=sys.stderr)
+        # --- chaos rung (ISSUE 7, detail.serve.chaos): the 1.0x
+        # offered load again, with faults injected — harvest.worker at
+        # a fixed cadence (every 3rd rank-harvest dies; recovery is an
+        # exact inline re-run) and one solve.nonfinite lane on the last
+        # rank (the in-kernel quarantine stops it with NUMERIC_FAULT
+        # and masks it from the consensus). Books: goodput retention
+        # and latency overhead vs the clean 1.0x rung. Parity: every
+        # request gates bit-identical against a solo reference run
+        # under the SAME armed faults, and — fault isolation — the
+        # non-poisoned first rank additionally gates bit-identical
+        # against the CLEAN references.
+        from nmfx import faults as faults_mod
+        from nmfx.solvers.base import StopReason
+
+        clean_1x = next(r for r in ladder if r["offered_load"] == 1.0)
+        chaos_k = ks_t[-1]
+        chaos_lane = (chaos_k, restarts_t - 1)
+        faults_mod.arm("harvest.worker", every=3)
+        faults_mod.arm("solve.nonfinite", lanes=(chaos_lane,))
+        try:
+            # references under the same armed generation: the trace
+            # token keys the executables, so refs and served requests
+            # run the identical quarantined program
+            chaos_refs = {sd: nmfconsensus(
+                a, ks=ks_t, restarts=restarts_t, seed=sd,
+                solver_cfg=scfg_t, use_mesh=False, exec_cache=cache)
+                for sd in seeds_t}
+            rate = capacity
+            with NMFXServer(serve_cfg, exec_cache=cache) as srv:
+                t0 = time.perf_counter()
+                futs = []
+                for i in range(n_req):
+                    sd = seeds_t[i % len(seeds_t)]
+                    futs.append((sd, srv.submit(
+                        a, ks=ks_t, restarts=restarts_t, seed=sd,
+                        solver_cfg=scfg_t)))
+                    if i < n_req - 1:
+                        time.sleep(rng.exponential(1.0 / rate))
+                results = [(sd, f, f.result()) for sd, f in futs]
+                chaos_wall = time.perf_counter() - t0
+            quarantined = 0
+            for sd, f, res in results:
+                gate(_serve_parity_problems(
+                    res, chaos_refs[sd], f"chaos seed={sd}"))
+                stops = np.asarray(res.per_k[chaos_k].stop_reasons)
+                quarantined += int(
+                    (stops == int(StopReason.NUMERIC_FAULT)).sum())
+                if len(ks_t) > 1:
+                    # fault isolation: the rank with no injected lane
+                    # must be bit-identical to the CLEAN reference
+                    iso = _serve_parity_problems(
+                        res, refs[sd], f"chaos-isolation seed={sd}")
+                    iso = [p for p in iso if f"k={chaos_k}" not in p]
+                    gate(iso)
+            if quarantined != len(results):
+                gate([f"chaos: expected 1 quarantined lane per request "
+                      f"({len(results)}), saw {quarantined}"])
+            lat = np.asarray(sorted(f.stats.latency_s
+                                    for _, f in futs))
+            chaos = {
+                "fault_plan": {
+                    "harvest.worker": "every 3rd rank-harvest",
+                    "solve.nonfinite":
+                        f"lane (k={chaos_lane[0]}, "
+                        f"restart={chaos_lane[1]})"},
+                "goodput_req_per_s": round(len(results) / chaos_wall,
+                                           4),
+                "goodput_retention": round(
+                    (len(results) / chaos_wall)
+                    / max(clean_1x["goodput_req_per_s"], 1e-9), 4),
+                "p50_latency_s": round(float(np.percentile(lat, 50)),
+                                       3),
+                "p99_latency_s": round(float(np.percentile(lat, 99)),
+                                       3),
+                "p50_overhead_vs_clean": round(
+                    float(np.percentile(lat, 50))
+                    / max(clean_1x["p50_latency_s"], 1e-9), 4),
+                "harvest_fault_fires":
+                    faults_mod.fires("harvest.worker"),
+                "quarantined_lanes": quarantined,
+                "parity": "ok",
+                # the armed trace token keys fresh PACKED executables,
+                # so their compiles land inside this rung's wall (the
+                # clean ladder amortized its layouts across rungs) —
+                # on short smoke configs retention under-reads; the
+                # steady-state recovery overhead is the hardware
+                # measurement at real iteration counts
+                "note": "chaos wall includes armed-generation packed "
+                        "compiles",
+            }
+            print(f"bench: serve chaos rung: goodput_retention="
+                  f"{chaos['goodput_retention']} "
+                  f"p50_overhead={chaos['p50_overhead_vs_clean']} "
+                  f"quarantined={quarantined} "
+                  f"harvest_fires={chaos['harvest_fault_fires']}",
+                  file=sys.stderr)
+        finally:
+            faults_mod.disarm("harvest.worker")
+            faults_mod.disarm("solve.nonfinite")
+
         return {
             "unit": f"ks={list(ks_t)} x {restarts_t} restarts over the "
                     f"{args.genes}x{args.samples} bench matrix",
@@ -1234,6 +1346,7 @@ def main():
             "solo_latency_s": round(solo_latency_s, 3),
             "capacity_req_per_s_est": round(capacity, 4),
             "ladder": ladder,
+            "chaos": chaos,
             "parity": "ok",
             "module_counters": {
                 "dispatches": serve_mod.dispatch_count(),

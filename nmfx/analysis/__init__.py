@@ -15,6 +15,8 @@ NMFX002    trace-time environment reads
 NMFX003    donation/aliasing safety (read-after-donate)
 NMFX004    PRNG discipline (key reuse, host RNG in traced code)
 NMFX005    implicit host syncs in traced/hot-path code
+NMFX006    silent degradation: broad except must re-raise, resolve a
+           Future, or route through nmfx.faults.warn_once
 NMFX101    engine jaxpr stays f32 under x64 parity (jaxpr layer)
 NMFX102    no device_put inside engine loop bodies (jaxpr layer)
 =========  ==============================================================
@@ -42,6 +44,7 @@ from nmfx.analysis.ast_scan import Project, load_project
 from nmfx.analysis import rules_config  # noqa: F401  (NMFX001)
 from nmfx.analysis import rules_traced  # noqa: F401  (NMFX002/004/005)
 from nmfx.analysis import rules_alias   # noqa: F401  (NMFX003)
+from nmfx.analysis import rules_handlers  # noqa: F401  (NMFX006)
 from nmfx.analysis import jaxpr_rules   # noqa: F401  (NMFX101/102)
 
 __all__ = ["run", "RULES", "Finding", "Rule", "register", "active",

@@ -149,14 +149,35 @@ class ConsensusResult:
 
 
 def _build_k_result(k: int, out, linkage: str,
-                    selection=None) -> KResult:
+                    selection=None, min_restarts: int = 1) -> KResult:
     """One rank's host-side assembly — the SINGLE implementation both
     the sequential loop and the streaming harvest workers
     (``nmfx/harvest.py``) call, so the two paths are bit-identical by
     construction. ``out`` is a host-materialized ``KSweepOutput``;
     ``selection`` injects a precomputed (rho, membership, order) (the
     device rank-selection path), else the host
-    hclust/cophenetic/cutree runs here."""
+    hclust/cophenetic/cutree runs here.
+
+    ``min_restarts``: the numeric-quarantine survivor floor
+    (``ConsensusConfig.min_restarts``) — enforced HERE, the one funnel
+    every consumer's per-rank assembly passes through (sequential,
+    streamed, served), so a rank whose surviving restarts fell below it
+    raises a typed :class:`nmfx.faults.InsufficientRestarts` on every
+    path instead of silently serving a thin consensus."""
+    from nmfx.faults import InsufficientRestarts
+    from nmfx.solvers.base import StopReason
+
+    stops = np.asarray(out.stop_reasons)
+    survivors = int((stops != int(StopReason.NUMERIC_FAULT)).sum())
+    if survivors < min_restarts:
+        raise InsufficientRestarts(
+            f"rank k={k}: only {survivors} of {stops.size} restarts "
+            "survived the numeric quarantine (stop reason "
+            f"NUMERIC_FAULT on {stops.size - survivors}), below the "
+            f"configured floor min_restarts={min_restarts} — the "
+            "consensus for this rank is not trustworthy. Inspect the "
+            "input conditioning / solver settings, or lower "
+            "min_restarts to accept thinner consensus")
     cons = np.asarray(out.consensus, dtype=np.float64)
     if selection is not None:
         rho, membership, order = selection
@@ -331,6 +352,7 @@ def nmfconsensus(
     grid_exec: str = "auto",
     grid_slots: int = 48,
     grid_tail_slots: "int | None | str | tuple" = "auto",
+    min_restarts: int = 1,
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
     profiler=None,
@@ -383,6 +405,12 @@ def nmfconsensus(
     widths (``ConsensusConfig.grid_tail_slots``; "auto"/0-to-disable;
     per-job stop decisions identical in every case).
 
+    ``min_restarts``: floor on the restarts that must survive the
+    numeric quarantine (``SolverConfig.nonfinite_guard``) at each rank
+    — below it the rank raises a typed
+    ``nmfx.faults.InsufficientRestarts`` instead of serving a consensus
+    averaged over too few runs (``ConsensusConfig.min_restarts``).
+
     ``exec_cache``: an ``nmfx.exec_cache.ExecCache`` serving this and
     future calls — repeat requests whose dataset shapes land in an
     already-compiled bucket skip the sweep's trace+compile entirely
@@ -421,7 +449,8 @@ def nmfconsensus(
                            label_rule=label_rule, linkage=linkage,
                            keep_factors=keep_factors, grid_exec=grid_exec,
                            grid_slots=grid_slots,
-                           grid_tail_slots=grid_tail_slots)
+                           grid_tail_slots=grid_tail_slots,
+                           min_restarts=min_restarts)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
     if mesh is None and use_mesh:
         mesh = default_mesh()
@@ -449,7 +478,8 @@ def nmfconsensus(
         # sequential one below.
         from nmfx.harvest import HarvestPipeline
 
-        pipeline = HarvestPipeline(linkage=ccfg.linkage, profiler=profiler)
+        pipeline = HarvestPipeline(linkage=ccfg.linkage, profiler=profiler,
+                                   min_restarts=ccfg.min_restarts)
         try:
             sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
                   profiler=profiler, exec_cache=exec_cache,
@@ -496,7 +526,8 @@ def nmfconsensus(
             with profiler.phase("rank_selection"):
                 per_k[k] = _build_k_result(
                     k, out, ccfg.linkage,
-                    selection=None if dev_sel is None else dev_sel[k])
+                    selection=None if dev_sel is None else dev_sel[k],
+                    min_restarts=ccfg.min_restarts)
 
     result = ConsensusResult(ks=ccfg.ks, per_k=per_k,
                              col_names=tuple(col_names))

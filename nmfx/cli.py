@@ -502,8 +502,11 @@ def main(argv: list[str] | None = None) -> int:
             for rec in warm_task.result():
                 print(_warm_line(rec), file=sys.stderr)
         except Exception as e:
-            print(f"nmfx: background warmup failed ({e}); the run "
-                  "itself is unaffected", file=sys.stderr)
+            from nmfx.faults import warn_once
+
+            warn_once("cli-background-warm",
+                      f"background warmup failed ({e}); the run "
+                      "itself is unaffected")
     if args.save_result:
         result.save(args.save_result)
     print(result.summary())

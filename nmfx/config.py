@@ -258,6 +258,22 @@ class SolverConfig:
     sparsity_beta: float = 0.01
     #: snmf only — ridge on W; None = max(A)^2 (the Kim & Park default)
     ridge_eta: float | None = None
+    #: in-kernel numeric quarantine (ISSUE 7): at every convergence
+    #: check, a lane whose factors contain a non-finite value stops with
+    #: ``StopReason.NUMERIC_FAULT`` and is masked out of the
+    #: consensus/labels/best-restart reductions exactly like a pad lane
+    #: — one diverged restart can no longer poison a rank's consensus
+    #: matrix (the sweep layer fails the rank loudly, typed
+    #: ``InsufficientRestarts``, only when survivors drop below
+    #: ``ConsensusConfig.min_restarts``). On the batched dense engines
+    #: the guard costs one isfinite reduction per lane per check; the
+    #: packed-column mu engine additionally screens every iteration so
+    #: a non-finite lane is frozen before its NaN can cross the shared
+    #: Grams to its batch-mates. Fault-free runs are bit-identical with
+    #: the guard on or off; disabling it restores the pre-quarantine
+    #: behavior (a non-finite lane burns to max_iter and poisons the
+    #: consensus mean).
+    nonfinite_guard: bool = True
     #: cap on restarts solved concurrently in the vmapped driver (chunks run
     #: sequentially). Bounds peak memory for solvers with O(m·n) per-restart
     #: intermediates — kl materializes the A/(WH) quotient per lane, so an
@@ -389,6 +405,14 @@ class ConsensusConfig:
     #: sweep (450 jobs on one v5e chip); larger pools help only when the
     #: grid is iteration-rich relative to its stragglers
     grid_slots: int = 48
+    #: floor on the restarts that must SURVIVE the numeric quarantine
+    #: (``SolverConfig.nonfinite_guard``) at each rank: a rank whose
+    #: non-quarantined restart count drops below this raises a typed
+    #: ``nmfx.faults.InsufficientRestarts`` at harvest instead of
+    #: serving a consensus averaged over too few runs. The default (1)
+    #: errors only when EVERY restart diverged — the loud floor under
+    #: graceful degradation.
+    min_restarts: int = 1
     #: straggler-tail cascade of the whole-grid scheduler: an int or a
     #: decreasing tuple of pool widths. Once the job queue drains and at
     #: most the next width's worth of jobs are live, the survivors
@@ -412,6 +436,10 @@ class ConsensusConfig:
             raise ValueError("all k must be >= 2")
         if self.restarts < 1:
             raise ValueError("restarts must be >= 1")
+        if not 1 <= self.min_restarts <= self.restarts:
+            raise ValueError(
+                f"min_restarts must be in [1, restarts={self.restarts}], "
+                f"got {self.min_restarts}")
         if self.label_rule not in ("argmax", "argmin"):
             raise ValueError("label_rule must be 'argmax' or 'argmin'")
         if self.grid_exec not in ("auto", "grid", "per_k"):

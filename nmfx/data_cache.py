@@ -61,7 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["DataCache", "DataKey", "data_key_fields", "default_cache",
-           "h2d_bytes", "transfer_count"]
+           "h2d_bytes", "place_resilient", "transfer_count"]
 
 # module-wide counters of ACTUAL input host->device transfers — the
 # honesty counters behind the zero-transfer warm-path contract (a cached
@@ -287,19 +287,16 @@ class DataCache:
         if entry is not None:
             prof.mark("xfer.h2d_cache_hit")
             return entry.array
-        host = np.asarray(a, dtype)
-        if pad_shape is not None:
-            m, n = a.shape
-            m_pad, n_pad = pad_shape
-            padded = np.zeros(pad_shape, dtype)
-            padded[:m, :n] = host
-            host = padded
+        from nmfx import faults
+
+        # chaos site: the actual input transfer (cache hits never
+        # transfer, so they sit above this); callers that can degrade
+        # route through place_resilient, whose direct fallback does NOT
+        # pass this site again
+        faults.inject("h2d.transfer")
         t0 = time.perf_counter()
-        if mesh is not None:
-            placed = place_input(host, solver_cfg, mesh)
-        else:
-            placed = self._chunked_put(host)
-        _note_transfer(host.nbytes)
+        host, placed = _pad_and_transfer(a, dtype, pad_shape,
+                                         solver_cfg, mesh)
         prof.add_seconds("xfer.h2d_overlap", time.perf_counter() - t0)
         if host.nbytes <= self.max_bytes:
             with self._lock:
@@ -324,6 +321,30 @@ class DataCache:
         return jnp.concatenate(chunks, axis=0)
 
 
+def _pad_and_transfer(a, dtype, pad_shape, solver_cfg, mesh
+                      ) -> "tuple[np.ndarray, jax.Array]":
+    """The ONE host-materialize → zero-pad → host→device transfer both
+    :meth:`DataCache.place`'s miss path and :func:`place_resilient`'s
+    direct fallback run — the degraded path transfers bit-identical
+    device bytes by construction, not by parallel maintenance of two
+    copies. Returns ``(host_array, placed)`` and books the transfer
+    counters."""
+    from nmfx.sweep import place_input
+
+    host = np.asarray(a, dtype)
+    if pad_shape is not None:
+        m, n = a.shape
+        padded = np.zeros(pad_shape, dtype)
+        padded[:m, :n] = host
+        host = padded
+    if mesh is not None:
+        placed = place_input(host, solver_cfg, mesh)
+    else:
+        placed = DataCache._chunked_put(host)
+    _note_transfer(host.nbytes)
+    return host, placed
+
+
 _default = DataCache()
 
 
@@ -331,3 +352,36 @@ def default_cache() -> DataCache:
     """The process-wide cache ``sweep()``/``ExecCache.prefetch`` place
     inputs through."""
     return _default
+
+
+def place_resilient(a, solver_cfg, mesh=None, *,
+                    pad_shape: "tuple | None" = None,
+                    profiler=None) -> jax.Array:
+    """:meth:`DataCache.place` with graceful degradation: a placement
+    failure inside the cache (an injected ``h2d.transfer`` fault, an
+    allocator hiccup, a poisoned cache state) falls back to a DIRECT
+    uncached host→device transfer of the same padded bytes — the device
+    values, and therefore every downstream result, are bit-identical;
+    only residency (and the zero-transfer warm-path win) is lost until
+    the cache recovers. The fallback is warn-once per process
+    (``nmfx.faults.warn_once``) and keeps the transfer counters honest.
+    The serving stack places every input through this wrapper
+    (``ExecCache.prefetch``, ``sweep.sweep``)."""
+    try:
+        return default_cache().place(a, solver_cfg, mesh,
+                                     pad_shape=pad_shape,
+                                     profiler=profiler)
+    except Exception as e:
+        from nmfx.faults import warn_once
+
+        warn_once(
+            "h2d-direct-fallback",
+            f"input-cache placement failed ({e!r}); serving this (and "
+            "only this) placement through a direct uncached transfer — "
+            "results are unaffected, the resident-input optimization is "
+            "bypassed")
+        if isinstance(a, jax.Array):  # place() cannot fail before its
+            raise  # device-input passthrough; don't re-place blindly
+        _, placed = _pad_and_transfer(a, jnp.dtype(solver_cfg.dtype),
+                                      pad_shape, solver_cfg, mesh)
+        return placed

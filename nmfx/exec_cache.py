@@ -353,10 +353,19 @@ class ExecCache:
         # built outside per true shape and leave the executable
         # init-agnostic
         init_key = icfg if icfg.method == "random" else "external"
-        return (bucket, tuple(sorted(ccfg.ks, reverse=True)),
-                ccfg.restarts, scfg, init_key, ccfg.label_rule,
-                ccfg.keep_factors, ccfg.grid_slots, tail, mesh,
-                jax.__version__, jax.default_backend())
+        key = (bucket, tuple(sorted(ccfg.ks, reverse=True)),
+               ccfg.restarts, scfg, init_key, ccfg.label_rule,
+               ccfg.keep_factors, ccfg.grid_slots, tail, mesh,
+               jax.__version__, jax.default_backend())
+        # trace-affecting fault state (nmfx.faults — solve.nonfinite /
+        # sched.stale_reload) keys the executable: an armed process can
+        # never serve a clean cached/persisted executable and vice
+        # versa. None (nothing armed, the production state) leaves the
+        # key — and hence every existing disk entry — untouched.
+        from nmfx import faults
+
+        tok = faults.trace_token()
+        return key if tok is None else key + (tok,)
 
     def _donate(self) -> bool:
         # donation is a no-op-with-warning on backends that ignore it;
@@ -445,6 +454,13 @@ class ExecCache:
                 f"could not read cache entry ({e}); recompiling")
             return None
         try:
+            # chaos site: deserializing a persisted executable — the
+            # recovery is THIS handler's existing fallback (drop the
+            # entry, warn once, recompile), which is exact: a recompiled
+            # executable produces bit-identical results
+            from nmfx import faults
+
+            faults.inject("persist.deserialize")
             rec = pickle.loads(data)
             if not (isinstance(rec, dict)
                     and rec.get("format") == _DISK_FORMAT):
@@ -658,6 +674,13 @@ class ExecCache:
         return entry, False
 
     def _compile(self, bucket, ccfg, scfg, icfg, mesh, prof) -> _Entry:
+        from nmfx import faults
+
+        # chaos site: the AOT trace+compile. Fired BEFORE any counter
+        # moves, so an injected build failure never books a phantom
+        # miss/compile; recovery lives in the callers (the serve layer
+        # retries solo with backoff, warm() records per-bucket failures)
+        faults.inject("compile.build")
         with self._lock:
             self.misses += 1
         _note_compile()
@@ -674,7 +697,8 @@ class ExecCache:
                 tuple(ccfg.ks), ccfg.restarts, scfg, ccfg.label_rule,
                 mesh, ccfg.keep_factors, ccfg.grid_slots, tail, bucket,
                 donate_inits=self._donate(),
-                init_cfg=icfg if inside_init else None)
+                init_cfg=icfg if inside_init else None,
+                fault_token=faults.trace_token())
             m_pad, n_pad = bucket
             dtype = jnp.dtype(scfg.dtype)
             padded = _pad_count(ccfg.restarts, mesh)
@@ -729,7 +753,7 @@ class ExecCache:
                         shapes, ccfg, scfg, icfg, mesh, profiler=None,
                         parallel=parallel, background=False,
                         _record_failures=True)
-                except BaseException as e:  # surfaced by WarmTask.result
+                except BaseException as e:  # nmfx: ignore[NMFX006] -- WarmTask re-raises
                     box["error"] = e
 
             thread = threading.Thread(target=work, daemon=True,
@@ -785,7 +809,7 @@ class ExecCache:
             for i in range(len(specs)):
                 try:
                     results.append(futs[i].result())
-                except BaseException as e:
+                except BaseException as e:  # nmfx: ignore[NMFX006] -- re-raised below
                     note_failure(specs[i], e)
                     if first_err is None:
                         first_err = e
@@ -853,9 +877,13 @@ class ExecCache:
         # NOT wrapped in a phase here: place() books its own elapsed
         # time (xfer.h2d_overlap on a miss, an xfer.h2d_cache_hit mark
         # on a hit) — an outer span would double-count the same seconds
-        # in the audit's overlap ledger
-        a_pad = default_cache().place(a, scfg, mesh, pad_shape=bucket,
-                                      profiler=prof)
+        # in the audit's overlap ledger. place_resilient: a cache-layer
+        # placement failure degrades to a direct uncached transfer of
+        # the same padded bytes (bit-identical results, warn-once)
+        from nmfx.data_cache import place_resilient
+
+        a_pad = place_resilient(a, scfg, mesh, pad_shape=bucket,
+                                profiler=prof)
         return PlacedMatrix(a_pad, (m, n), bucket)
 
     def _solve_args(self, placed: PlacedMatrix, ccfg: ConsensusConfig,

@@ -1,0 +1,325 @@
+"""Structured fault injection + the typed failure taxonomy of the
+serve stack.
+
+A server that survives failures must be able to REHEARSE them: every
+recovery path in this repo (solo retry after a failed packed dispatch,
+direct h2d after a data-cache placement failure, sequential harvest
+after a worker death, the scheduler watchdog, the in-kernel numeric
+quarantine) is exercised by arming a named fault site from this
+registry and asserting the recovery contract — bit-identical results
+where recovery is exact, a typed error otherwise, bounded wall time
+always (tests/test_faults.py; bench.py's ``detail.serve.chaos`` rung).
+The distributed-NMF literature treats per-worker failure/recovery as
+first-class (MPI-FAUN, arxiv 1609.09154; out-of-memory tile streaming,
+arxiv 2202.09518, is only viable if a lost tile is recoverable); this
+module is the single-device analogue.
+
+Design rules, learned from the retired ``NMFX_FAULT_INJECT_STALE_RELOAD``
+env hook (ADVICE.md round 5; lint rule NMFX002):
+
+* **Explicit arming only.** A site fires only after an in-process
+  :func:`arm` call (or :func:`scoped`). Environment variables alone are
+  inert — an inherited var can never corrupt a run.
+* **Deterministic and seeded.** Hit-counted sites fire on an exact
+  schedule (``every``-th hit, at most ``max_fires`` times); lane-rate
+  sites select lanes by a splitmix of ``(seed, k, restart)``
+  (:func:`poison_restarts`), never by wall clock or host RNG.
+* **Trace-honest.** The two sites that alter TRACED code
+  (``solve.nonfinite``, ``sched.stale_reload``) are keyed into every
+  builder/executable cache through :func:`trace_token`, a
+  content-addressed tuple of the armed specs themselves: an armed
+  process can never silently serve a clean (or differently-armed,
+  even from another process via the persistent disk cache) executable
+  — the staleness class the old env hook suffered from — and an
+  UNARMED process's cache keys are byte-identical to before this
+  module existed.
+* **Loud.** Arming any site logs a warning banner: results from an
+  armed process are suspect by construction.
+
+Sites (see docs/serving.md "Failure model" for the recovery matrix):
+
+==================== ====================================================
+``h2d.transfer``      the data cache's host→device input transfer
+``compile.build``     the exec cache's AOT trace+compile
+``persist.deserialize``  reading a persisted executable back from disk
+``harvest.worker``    a harvest worker thread (serve + pipeline)
+``serve.scheduler``   the serving scheduler loop (thread death)
+``solve.nonfinite``   a restart lane's factors go non-finite in-kernel
+``sched.stale_reload``  the slot scheduler's reload factor write (the
+                      round-3 signature; ``bench.py --verify`` gate)
+==================== ====================================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import threading
+import warnings
+
+__all__ = ["SITES", "FaultConfig", "FaultInjected", "InsufficientRestarts",
+           "arm", "disarm", "armed", "fire", "fires", "hits", "inject",
+           "poison_restarts", "scoped", "trace_token", "warn_once"]
+
+#: every registered fault site (arming an unknown site is an error, so a
+#: typo'd chaos test fails loudly instead of silently testing nothing)
+SITES = ("h2d.transfer", "compile.build", "persist.deserialize",
+         "harvest.worker", "serve.scheduler", "solve.nonfinite",
+         "sched.stale_reload")
+
+#: sites whose armed state changes TRACED code and therefore must key
+#: the builder/executable caches (see trace_token)
+_TRACE_SITES = ("solve.nonfinite", "sched.stale_reload")
+
+#: sites configured by a per-lane/per-reload ``rate`` (or explicit
+#: ``lanes``) instead of the hit counter
+_RATE_SITES = ("solve.nonfinite", "sched.stale_reload")
+
+_log = logging.getLogger("nmfx")
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an armed hit-counted fault site. Carries the site name
+    so recovery tests can assert WHICH failure they survived."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(
+            f"injected fault at site {site!r} (hit #{hit}) — this "
+            "process has fault injection armed; results are part of a "
+            "chaos rehearsal, not production output")
+        self.site = site
+        self.hit = hit
+
+
+class InsufficientRestarts(RuntimeError):
+    """A rank's surviving (non-quarantined) restarts fell below the
+    configured floor (``ConsensusConfig.min_restarts`` /
+    ``nmfconsensus(min_restarts=...)``): too many lanes stopped with
+    ``StopReason.NUMERIC_FAULT`` for the consensus to be trustworthy.
+    The quarantine masks a diverged lane exactly like a pad lane, so a
+    FEW faulted restarts degrade gracefully; this error is the loud
+    floor under that degradation."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """One armed site's firing policy (see :func:`arm`)."""
+
+    site: str
+    #: hit-counted sites: fire on every ``every``-th hit of the site
+    every: int = 1
+    #: stop firing (stay armed, inert) after this many fires; None =
+    #: unlimited
+    max_fires: "int | None" = None
+    #: lane-rate sites: fraction of lanes/reloads faulted, selected
+    #: deterministically from ``seed`` (``solve.nonfinite``,
+    #: ``sched.stale_reload``)
+    rate: "float | None" = None
+    #: seed of the deterministic lane selection
+    seed: int = 0
+    #: explicit ``((k, restart), ...)`` lanes for ``solve.nonfinite`` —
+    #: overrides ``rate`` (the exactness tests poison one named lane)
+    lanes: "tuple | None" = None
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; registered sites: "
+                f"{SITES}")
+        if self.every < 1:
+            raise ValueError("every must be >= 1")
+        if self.max_fires is not None and self.max_fires < 1:
+            raise ValueError("max_fires must be >= 1 or None")
+        if self.rate is not None and not 0.0 <= self.rate <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {self.rate}")
+        if self.lanes is not None:
+            lanes = tuple((int(k), int(r)) for k, r in self.lanes)
+            object.__setattr__(self, "lanes", lanes)
+        if self.site in _RATE_SITES and self.rate is None \
+                and self.lanes is None:
+            raise ValueError(
+                f"site {self.site!r} is lane-rate-armed: pass rate= "
+                "(a fraction) or, for solve.nonfinite, explicit lanes=")
+
+
+_lock = threading.Lock()
+_specs: "dict[str, FaultConfig]" = {}
+_hits: "dict[str, int]" = {}
+_fires: "dict[str, int]" = {}
+
+
+def arm(site: str, **kw) -> FaultConfig:
+    """Arm ``site`` with a :class:`FaultConfig` built from ``kw``.
+    Re-arming replaces the previous policy and resets the site's hit
+    and fire counters. Logs a loud banner: an armed process's results
+    are rehearsal output."""
+    spec = FaultConfig(site=site, **kw)
+    with _lock:
+        _specs[site] = spec
+        _hits[site] = 0
+        _fires[site] = 0
+    _log.warning(
+        "fault site %r ARMED (%s): failures are being injected "
+        "deliberately — results from this process are a chaos "
+        "rehearsal", site, spec)
+    return spec
+
+
+def disarm(site: "str | None" = None) -> None:
+    """Disarm one site (or every site, with ``None``). Counters are
+    kept readable until the next :func:`arm`."""
+    with _lock:
+        if site is None:
+            _specs.clear()
+        else:
+            _specs.pop(site, None)
+
+
+def armed(site: str) -> "FaultConfig | None":
+    """The site's armed policy, or None."""
+    with _lock:
+        return _specs.get(site)
+
+
+def hits(site: str) -> int:
+    """How many times the site was REACHED since it was last armed."""
+    with _lock:
+        return _hits.get(site, 0)
+
+
+def fires(site: str) -> int:
+    """How many times the site actually FIRED since it was last armed."""
+    with _lock:
+        return _fires.get(site, 0)
+
+
+@contextlib.contextmanager
+def scoped(site: str, **kw):
+    """Arm ``site`` for the duration of a ``with`` block, restoring the
+    previous (usually unarmed) policy on exit — the chaos suite's
+    bread-and-butter shape."""
+    with _lock:
+        prev = _specs.get(site)
+    spec = arm(site, **kw)
+    try:
+        yield spec
+    finally:
+        if prev is None:
+            disarm(site)
+        else:
+            arm(prev.site, **{f.name: getattr(prev, f.name)
+                              for f in dataclasses.fields(prev)
+                              if f.name != "site"})
+
+
+def fire(site: str) -> bool:
+    """Count one hit of ``site``; True when this hit should fault.
+    Unarmed sites cost one dict lookup under a lock and return False —
+    cheap enough for the host-side hot paths they sit on."""
+    with _lock:
+        spec = _specs.get(site)
+        if spec is None:
+            return False
+        _hits[site] = _hits.get(site, 0) + 1
+        if spec.max_fires is not None and _fires[site] >= spec.max_fires:
+            return False
+        if _hits[site] % spec.every != 0:
+            return False
+        _fires[site] += 1
+        return True
+
+
+def inject(site: str) -> None:
+    """Raise :class:`FaultInjected` when this hit of ``site`` fires
+    (the one-liner the instrumented host paths call)."""
+    if fire(site):
+        raise FaultInjected(site, hits(site))
+
+
+# -- trace-affecting sites ------------------------------------------------
+def trace_token() -> "tuple | None":
+    """Hashable token the sweep builders / exec-cache keys include so
+    TRACED fault state can never go stale in a cached executable: None
+    while no trace-affecting site is armed (cache keys unchanged vs a
+    fault-free build), else a tuple of the armed trace-affecting
+    specs themselves. CONTENT-addressed, not generation-stamped: the
+    token (and hence every in-memory AND persistent-disk executable
+    key) differs exactly when the armed fault plan differs — two
+    processes arming different lane sets can never collide on one
+    persisted executable, re-arming the identical spec correctly
+    reuses the already-built poisoned executable, and a ``scoped``
+    block restores the surrounding build's keys on exit instead of
+    forcing a spurious recompile."""
+    with _lock:
+        armed_specs = tuple((s, _specs[s]) for s in _TRACE_SITES
+                            if s in _specs)
+    if not armed_specs:
+        return None
+    return ("nmfx-faults", armed_specs)
+
+
+def _mix01(*vals: int) -> float:
+    """Deterministic uniform [0, 1) from integers — splitmix64-style,
+    stable across processes (never Python ``hash``)."""
+    x = 0
+    for v in vals:
+        x = (x + int(v) + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
+        x = (x ^ (x >> 27)) * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 31
+    return (x >> 32) / float(1 << 32)
+
+
+def poison_restarts(k: int, restarts: int) -> tuple[int, ...]:
+    """The restart indices of rank ``k`` the armed ``solve.nonfinite``
+    site poisons (empty when unarmed). Read by the sweep builders at
+    TRACE time — the armed spec is static there (``trace_token`` keys
+    the builder caches), so the poison set compiles in as constant
+    indices: deterministic, seeded, identical for a lane whether it
+    solves solo, whole-grid, bucketed, or packed with dispatch-mates
+    (the quarantine-exactness tests depend on that invariance)."""
+    spec = armed("solve.nonfinite")
+    if spec is None:
+        return ()
+    if spec.lanes is not None:
+        return tuple(r for kk, r in spec.lanes
+                     if kk == int(k) and 0 <= r < restarts)
+    return tuple(r for r in range(restarts)
+                 if _mix01(spec.seed, int(k), r) < spec.rate)
+
+
+def stale_reload_fraction() -> float:
+    """The armed ``sched.stale_reload`` rate (0.0 = off) — read at
+    trace time by ``nmfx.ops.sched_mu`` (the builder caches are
+    trace_token-keyed, so arming after a trace can no longer silently
+    serve the clean executable)."""
+    spec = armed("sched.stale_reload")
+    return float(spec.rate) if spec is not None else 0.0
+
+
+# -- the shared degradation warn-once helper ------------------------------
+_warned_lock = threading.Lock()
+_warned: "set[str]" = set()
+
+
+def warn_once(category: str, msg: str) -> None:
+    """One warning per degradation category per process — the shared
+    helper every graceful-fallback ``except`` handler routes through
+    (lint rule NMFX006 enforces that broad handlers either re-raise,
+    resolve a Future, or call this): the FIRST fallback of a kind is
+    loud, steady-state degradation doesn't flood the logs, and nothing
+    is ever silently swallowed."""
+    with _warned_lock:
+        if category in _warned:
+            return
+        _warned.add(category)
+    warnings.warn(f"nmfx [{category}]: {msg}", RuntimeWarning,
+                  stacklevel=3)
+    _log.warning("[%s] %s", category, msg)
+
+
+def _reset_warned() -> None:
+    """Test hook: forget which degradation categories already warned."""
+    with _warned_lock:
+        _warned.clear()

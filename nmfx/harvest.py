@@ -48,8 +48,8 @@ import jax
 __all__ = ["HarvestPipeline", "harvest_rank"]
 
 
-def harvest_rank(k: int, out, linkage: str,
-                 profiler) -> "tuple[object, float, float]":
+def harvest_rank(k: int, out, linkage: str, profiler,
+                 min_restarts: int = 1) -> "tuple[object, float, float]":
     """The per-rank harvest body: blocking device→host fetch of rank
     ``k``'s output, then the host rank selection, through the SAME
     ``api._build_k_result`` as the sequential path — the single
@@ -57,6 +57,9 @@ def harvest_rank(k: int, out, linkage: str,
     the serving engine's completion workers (``nmfx/serve.py``), so
     every consumer is bit-identical by construction.
 
+    ``min_restarts`` is the numeric-quarantine survivor floor
+    (``ConsensusConfig.min_restarts``; raises a typed
+    ``nmfx.faults.InsufficientRestarts`` through ``_build_k_result``).
     Returns ``(KResult, fetch_seconds, select_seconds)``; the walls are
     also credited to the overlap phases ``xfer.d2h_overlap`` /
     ``post.rank_selection`` on ``profiler`` (thread-safe
@@ -71,7 +74,7 @@ def harvest_rank(k: int, out, linkage: str,
     t1 = time.perf_counter()
     fetch_s = t1 - t0
     profiler.add_seconds("xfer.d2h_overlap", fetch_s)
-    res = _build_k_result(k, host, linkage)
+    res = _build_k_result(k, host, linkage, min_restarts=min_restarts)
     select_s = time.perf_counter() - t1
     profiler.add_seconds("post.rank_selection", select_s)
     return res, fetch_s, select_s
@@ -89,17 +92,22 @@ class HarvestPipeline:
     """
 
     def __init__(self, linkage: str = "average", profiler=None,
-                 workers: "int | None" = None):
+                 workers: "int | None" = None, min_restarts: int = 1):
         from nmfx.profiling import NullProfiler
 
         self._linkage = linkage
         self._prof = profiler if profiler is not None else NullProfiler()
+        self._min_restarts = min_restarts
         self._max_workers = (workers if workers is not None
                              else max(1, min(4, (os.cpu_count() or 2) // 2)))
         if self._max_workers < 1:
             raise ValueError("workers must be >= 1")
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._futures: "dict[int, Future]" = {}
+        #: each rank's device output, retained so a dead worker's rank
+        #: can be re-harvested sequentially in results(); dropped the
+        #: moment the rank resolves (progressive deallocation)
+        self._outs: "dict[int, object]" = {}
         self._threads: "list[threading.Thread]" = []
         self._closed = False
 
@@ -119,6 +127,7 @@ class HarvestPipeline:
             raise ValueError(f"rank {k} submitted twice")
         fut: Future = Future()
         self._futures[k] = fut
+        self._outs[k] = out
         self._queue.put((k, out, fut))
         if len(self._threads) < min(self._max_workers,
                                     len(self._futures)):
@@ -129,24 +138,60 @@ class HarvestPipeline:
 
     # -- consumer side ----------------------------------------------------
     def _work(self) -> None:
+        from nmfx import faults
+
         while True:
             item = self._queue.get()
             if item is None:
                 return
             k, out, fut = item
             try:
+                # chaos site: a harvest WORKER dying (thread-level
+                # failure, distinct from the harvest math itself — the
+                # sequential fallback in results() re-runs the rank
+                # without passing this site)
+                faults.inject("harvest.worker")
                 res, _, _ = harvest_rank(k, out, self._linkage,
-                                         self._prof)
+                                         self._prof, self._min_restarts)
                 fut.set_result(res)
-            except BaseException as e:  # re-raised by results()
-                fut.set_exception(e)
+                # a resolved rank no longer needs its re-harvest copy:
+                # drop the device-output reference NOW so buffers (and
+                # keep_factors stacks) free progressively, not at
+                # pipeline teardown
+                self._outs.pop(k, None)
+            except BaseException as e:  # re-raised (or recovered
+                fut.set_exception(e)   # sequentially) by results()
 
     def results(self) -> dict:
         """Join every submitted rank and return ``{k: KResult}`` in
-        submission order; the first worker failure re-raises here."""
+        submission order. A rank whose WORKER died is re-harvested
+        sequentially on this thread (warn-once) — the same device
+        output through the same host math, so the recovery is exact;
+        deterministic per-rank failures (``InsufficientRestarts``, a
+        corrupt device output) re-raise as before."""
+        from nmfx.faults import InsufficientRestarts, warn_once
+
         try:
-            return {k: fut.result() for k, fut in self._futures.items()}
+            out: dict = {}
+            for k, fut in self._futures.items():
+                try:
+                    out[k] = fut.result()
+                except InsufficientRestarts:
+                    raise  # deterministic: a re-run cannot succeed
+                except BaseException as e:
+                    warn_once(
+                        "harvest-worker-fallback",
+                        f"harvest worker for rank {k} died ({e!r}); "
+                        "re-running that rank's harvest sequentially — "
+                        "results are unaffected, the overlap win is "
+                        "lost for this rank")
+                    out[k], _, _ = harvest_rank(
+                        k, self._outs[k], self._linkage, self._prof,
+                        self._min_restarts)
+                    self._outs.pop(k, None)
+            return out
         finally:
+            self._outs.clear()
             self.close()
 
     def close(self) -> None:

@@ -459,12 +459,20 @@ def _check(a_res, state: GridState, cfg: SolverConfig) -> GridState:
         delta = jnp.maximum(_delta(state.w, state.w_prev),
                             _delta(state.h, state.h_prev))  # (B,)
 
+    nonfinite = None
+    if cfg.nonfinite_guard:
+        # numeric quarantine, dense layout: each lane is its own batch
+        # entry of every einsum, so a non-finite lane is contained by
+        # construction — the guard only has to STOP it (NUMERIC_FAULT)
+        # before its NaN labels can masquerade as a stable class
+        nonfinite = ~(jnp.all(jnp.isfinite(state.w), axis=(1, 2))
+                      & jnp.all(jnp.isfinite(state.h), axis=(1, 2)))
     done_in = state.done
     classes, stable, done, done_iter, reason = batch_convergence(
         cfg, state.iteration, new_classes=_labels(state.h), delta=delta,
         n_glob=state.h.shape[2], classes=state.classes, stable=state.stable,
         done=state.done, done_iter=state.done_iter,
-        stop_reason=state.stop_reason)
+        stop_reason=state.stop_reason, nonfinite=nonfinite)
     dnorm = state.dnorm
     if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
         dnorm, done, reason = tolfun_update(

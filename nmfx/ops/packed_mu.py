@@ -60,6 +60,10 @@ class PackedState(NamedTuple):
     done: jax.Array  # (R,) bool
     done_iter: jax.Array  # (R,) i32 — iteration at which each restart stopped
     stop_reason: jax.Array  # (R,) i32
+    #: (R,) bool — sticky numeric-quarantine flag (nonfinite_guard): the
+    #: lane's factors went non-finite; it is frozen at its last finite
+    #: iterate and stops with NUMERIC_FAULT at the next check
+    nonfinite: jax.Array = None
 
 
 class PackedMUResult(NamedTuple):
@@ -74,6 +78,27 @@ def block_diag_mask(r: int, k: int, dtype) -> jax.Array:
     """(R·k, R·k) 0/1 mask keeping only within-restart k×k blocks."""
     rk = jnp.arange(r * k) // k
     return (rk[:, None] == rk[None, :]).astype(dtype)
+
+
+def bd_select(g: jax.Array, bd: jax.Array) -> jax.Array:
+    """Apply the block-diagonal Gram mask as a SELECT, not a multiply.
+    Identical values for finite Grams (g·1 = g, masked entries exactly
+    zero), but a non-finite CROSS-lane Gram entry becomes a true zero
+    instead of ``NaN·0 = NaN`` — the numeric quarantine's containment
+    fence: one diverged lane's inf/NaN cannot leak through the masked
+    Gram into its dispatch-mates' denominators."""
+    return jnp.where(bd != 0, g, jnp.zeros((), g.dtype))
+
+
+def _lanes_finite(x: jax.Array, axes, mesh_axis: "str | None" = None
+                  ) -> jax.Array:
+    """Per-lane all-finite verdict of a lane-stacked factor array; with
+    ``mesh_axis`` (the factor's shard axis inside ``shard_map``) the
+    verdict reduces globally, so every device of a lane's group agrees."""
+    ok = jnp.all(jnp.isfinite(x), axis=axes)
+    if mesh_axis is not None:
+        ok = lax.psum((~ok).astype(jnp.int32), mesh_axis) == 0
+    return ok
 
 
 def pack(w0s: jax.Array, h0s: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -232,7 +257,7 @@ def flip_budget(class_flip_tol: float, n: int) -> int:
 
 def batch_convergence(cfg: SolverConfig, it, *, new_classes, delta, n_glob,
                       classes, stable, done, done_iter, stop_reason,
-                      mism_reduce=None, flip_floor=None):
+                      mism_reduce=None, flip_floor=None, nonfinite=None):
     """(B,)-batched convergence bookkeeping shared by the packed and
     whole-grid formulations: the noise-tolerant class-stability snapshot
     rule plus the TolX test, with per-lane freeze flags — mirroring
@@ -248,11 +273,21 @@ def batch_convergence(cfg: SolverConfig, it, *, new_classes, delta, n_glob,
     — the shape-bucketed executables compute it host-side from the TRUE
     sample count in exact double math, since their static n is the padded
     bucket width and a traced f32 ``floor`` would round differently.
+    ``nonfinite`` (or None): the caller's per-lane numeric-quarantine
+    verdict — a flagged lane stops FIRST with ``NUMERIC_FAULT``, before
+    the class/TolX tests can read its NaN-derived labels or deltas.
     Returns the five updated bookkeeping arrays."""
     is_check = (it > 1) & (it % cfg.check_every == 0)
     active = is_check & (~done)
     done_in = done
     reason = stop_reason
+
+    if nonfinite is not None:
+        bad = active & nonfinite
+        done = done | bad
+        active = active & ~bad
+        reason = jnp.where(bad, jnp.int32(base.StopReason.NUMERIC_FAULT),
+                           reason)
 
     if cfg.use_class_stop:
         flip_tol = (flip_budget(cfg.class_flip_tol, n_glob)
@@ -299,7 +334,7 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
             a, wp0, hp0, k=k, block_m=block_m, eps=cfg.div_eps,
             zero_threshold=cfg.zero_threshold,
             matmul_precision=cfg.matmul_precision, interpret=interpret)
-        gh = (hp @ hp.T) * bd  # tiny; stays in XLA
+        gh = bd_select(hp @ hp.T, bd)  # tiny; stays in XLA
         wp = fused_w_update(
             a, wp0, hp, gh, block_m=block_m, eps=cfg.div_eps,
             zero_threshold=cfg.zero_threshold,
@@ -319,7 +354,7 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
             # A/Wp are row shards: the m-contracted terms are partial sums
             numerh = lax.psum(numerh, feature_axis)
             gw = lax.psum(gw, feature_axis)
-        denomh = (gw * bd) @ hp0
+        denomh = bd_select(gw, bd) @ hp0
         hp = _mu_update(hp0, numerh, denomh, cfg)
 
         hb = hp.astype(jnp.bfloat16)
@@ -329,7 +364,7 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
             # A/Hp are column shards: the n-contracted terms are partials
             gh = lax.psum(gh, sample_axis)
             numerw = lax.psum(numerw, sample_axis)
-        denomw = wp0 @ (gh * bd)
+        denomw = wp0 @ bd_select(gh, bd)
         wp = _mu_update(wp0, numerw, denomw, cfg)
     else:
         # H update — numerator GEMM plus the full W-Gram (cross-restart
@@ -339,7 +374,7 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
         if feature_axis is not None:
             numerh = lax.psum(numerh, feature_axis)
             gw = lax.psum(gw, feature_axis)
-        denomh = (gw * bd) @ hp0
+        denomh = bd_select(gw, bd) @ hp0
         hp = _mu_update(hp0, numerh, denomh, cfg)
 
         # W update with the fresh H (reference order, nmf_mu.c:198-216)
@@ -348,17 +383,34 @@ def _step(a, bd, state: PackedState, cfg: SolverConfig, r: int,
         if sample_axis is not None:
             gh = lax.psum(gh, sample_axis)
             numerw = lax.psum(numerw, sample_axis)
-        denomw = wp0 @ (gh * bd)
+        denomw = wp0 @ bd_select(gh, bd)
         wp = _mu_update(wp0, numerw, denomw, cfg)
 
+    # numeric quarantine containment (nonfinite_guard): the packed
+    # layout shares Grams across lanes, so a lane that diverges must be
+    # ROLLED BACK to its last finite iterate the same iteration it goes
+    # non-finite — by induction the carry (and hence every shared-GEMM
+    # operand) stays finite, and bd_select keeps the one remaining
+    # cross-lane term (the masked Gram) NaN-proof. The sticky flag
+    # stops the lane with NUMERIC_FAULT at its next check.
+    bad = state.nonfinite
+    if cfg.nonfinite_guard:
+        new_bad = ~(_lanes_finite(wp.reshape(-1, r, k), (0, 2),
+                                  feature_axis)
+                    & _lanes_finite(hp.reshape(r, k, -1), (1, 2),
+                                    sample_axis))
+        bad = new_bad if bad is None else bad | new_bad
+
     # freeze converged restarts (the vmapped while_loop does this masking
-    # implicitly; here the restart axis lives inside one GEMM, so explicitly)
-    frozen_col = jnp.repeat(state.done, k)  # (R·k,)
+    # implicitly; here the restart axis lives inside one GEMM, so
+    # explicitly); quarantined lanes freeze the same way
+    frozen = state.done if bad is None else state.done | bad
+    frozen_col = jnp.repeat(frozen, k)  # (R·k,)
     hp = jnp.where(frozen_col[:, None], hp0, hp)
     wp = jnp.where(frozen_col[None, :], wp0, wp)
 
     state = state._replace(wp=wp, hp=hp, wp_prev=wp0, hp_prev=hp0,
-                           iteration=it)
+                           iteration=it, nonfinite=bad)
     if not check:
         return state
     return _check(state, cfg, r, feature_axis, sample_axis, n_total)
@@ -432,7 +484,7 @@ def _check(state: PackedState, cfg: SolverConfig, r: int,
         cfg, it, new_classes=new_classes, delta=delta, n_glob=n_glob,
         classes=state.classes, stable=state.stable, done=state.done,
         done_iter=state.done_iter, stop_reason=state.stop_reason,
-        mism_reduce=mism_reduce)
+        mism_reduce=mism_reduce, nonfinite=state.nonfinite)
     return state._replace(classes=classes, stable=stable, done=done,
                           done_iter=done_iter, stop_reason=reason)
 
@@ -513,6 +565,23 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
                 x = pcast(x, ax, to="varying")
             return x
 
+        nonfinite0 = None
+        if cfg.nonfinite_guard:
+            # quarantine induction base: a lane whose INITIAL factors are
+            # already non-finite (an injected fault, a corrupt warm
+            # start) is zeroed at pack time — zero factors are inert
+            # under MU and contribute exact zeros to the shared Grams,
+            # the pad-lane invariant — and flagged sticky, so the next
+            # check stops it with NUMERIC_FAULT
+            bad0 = ~(_lanes_finite(wp.reshape(-1, r, k), (0, 2),
+                                   feature_axis)
+                     & _lanes_finite(hp.reshape(r, k, n), (1, 2),
+                                     sample_axis))
+            zero_col = jnp.repeat(bad0, k)
+            wp = jnp.where(zero_col[None, :], 0.0, wp)
+            hp = jnp.where(zero_col[:, None], 0.0, hp)
+            nonfinite0 = vary(bad0)
+
         state0 = PackedState(
             wp=wp, hp=hp, wp_prev=wp, hp_prev=hp,
             iteration=jnp.zeros((), jnp.int32),
@@ -522,6 +591,7 @@ def mu_packed(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
             done_iter=vary(jnp.zeros((r,), jnp.int32)),
             stop_reason=vary(jnp.full((r,), base.StopReason.MAX_ITER,
                                       jnp.int32)),
+            nonfinite=nonfinite0,
         )
         a_loop = a
         if (not use_pallas and cfg.matmul_precision == "bfloat16"

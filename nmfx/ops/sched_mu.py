@@ -53,43 +53,51 @@ from nmfx.ops.packed_mu import batch_convergence, residual_norms_direct
 from nmfx.solvers import base
 
 
-#: TEST-ONLY fault-injection state: fraction of pallas-path slot
+#: TEST-ONLY stale-reload fault injection: fraction of pallas-path slot
 #: reloads whose FACTOR writes are dropped while the scheduler's
 #: bookkeeping proceeds as if the reload happened — the exact round-3
 #: failure signature (VERDICT.md round 3; the fault class the
 #: ``bench.py --verify`` hardware gate is proven against,
-#: ``benchmarks/probe_fault_gate.py``). 0.0 = off. Settable ONLY via
-#: :func:`enable_stale_reload_fault` — an explicit in-process call.
-#: The ``NMFX_FAULT_INJECT_STALE_RELOAD`` env var alone is INERT in
-#: library code since round 7: it used to be read at trace time inside
-#: the production reload path, so a process that merely *inherited* the
-#: var (a test harness spawning a service) silently produced corrupted
-#: factors, and toggling it mid-process silently served the previously
-#: cached executable (ADVICE.md round 5; lint rule NMFX002 now rejects
-#: the whole pattern). ``bench.py --verify`` — the one sanctioned
-#: harness — translates the env var into the explicit call at process
-#: startup, which keeps ``probe_fault_gate.py``'s subprocess protocol
-#: working without the library ever reading env at trace time.
-_fault_state = {"fraction": 0.0, "announced": False}
+#: ``benchmarks/probe_fault_gate.py``). Since ISSUE 7 the armed state
+#: lives on the ``nmfx.faults`` registry (site ``sched.stale_reload``,
+#: rate-armed), which also keys the sweep builders' caches through
+#: ``faults.trace_token()`` — arming after a trace can no longer
+#: silently serve the previously cached clean executable, the staleness
+#: class both this hook's env-var ancestor (ADVICE.md round 5; lint
+#: rule NMFX002) and its explicit-call successor still carried. The
+#: ``NMFX_FAULT_INJECT_STALE_RELOAD`` env var alone remains INERT in
+#: library code; ``bench.py --verify`` — the one sanctioned harness —
+#: translates it into the explicit call at process startup, which keeps
+#: ``probe_fault_gate.py``'s subprocess protocol working unchanged.
+_announced = {"done": False}
 
 
 def enable_stale_reload_fault(fraction: float) -> None:
-    """Explicitly arm the TEST-ONLY stale-reload fault injection.
+    """Deprecated shim: arm the stale-reload fault through the
+    ``nmfx.faults`` registry (``faults.arm("sched.stale_reload",
+    rate=fraction)`` is the canonical spelling). Kept because
+    ``bench.py --verify``'s env→call subprocess protocol and external
+    probe harnesses target this name; announces itself loudly on
+    stderr + the nmfx logger exactly as before — results from an armed
+    process are INVALID by design."""
+    import warnings
 
-    Must be called before the first ``mu_sched`` trace of the process
-    (the fraction is read at trace time; arming later would silently
-    serve the previously cached clean executable — the same staleness
-    the env-var hook had, which is why there is no "disarm"). Announces
-    itself loudly on stderr + the nmfx logger: results from an armed
-    process are INVALID by design.
-    """
+    from nmfx import faults
+
     frac = float(fraction)
     if not 0.0 <= frac <= 1.0:
         raise ValueError(
             f"fault fraction must be in [0, 1], got {fraction!r}")
-    _fault_state["fraction"] = frac
-    if frac > 0 and not _fault_state["announced"]:
-        _fault_state["announced"] = True
+    warnings.warn(
+        "enable_stale_reload_fault() is a deprecated shim; arm the "
+        "registry directly: nmfx.faults.arm('sched.stale_reload', "
+        "rate=...)", DeprecationWarning, stacklevel=2)
+    if frac > 0:
+        faults.arm("sched.stale_reload", rate=frac)
+    else:
+        faults.disarm("sched.stale_reload")
+    if frac > 0 and not _announced["done"]:
+        _announced["done"] = True
         import logging
         import sys
 
@@ -127,9 +135,14 @@ _warn_inert_env_hook()
 
 
 def _stale_reload_fraction() -> float:
-    """The armed fault fraction (0.0 = off). Module state, never env:
-    trace-time environment reads are the NMFX002 lint class."""
-    return _fault_state["fraction"]
+    """The armed fault fraction (0.0 = off), from the ``nmfx.faults``
+    registry — never env: trace-time environment reads are the NMFX002
+    lint class, and the registry's ``trace_token`` keys the builder
+    caches so this trace-time read can never go stale in a cached
+    executable."""
+    from nmfx import faults
+
+    return faults.stale_reload_fraction()
 
 
 def _stale_load_mask(load, gather):
@@ -523,13 +536,19 @@ def _make_ragged_stage(layout, a_loop, w0, h0, cfg: SolverConfig,
                                       ratio(hd_c, hm_c))
             labels_c = jnp.argmax(hp[sl].reshape(c.slots, c.k, n),
                                   axis=1).astype(jnp.int32)
+            nonfinite_c = None
+            if cfg.nonfinite_guard:
+                nonfinite_c = ~(jnp.all(jnp.isfinite(
+                    wp[:, sl].reshape(m_pad, c.slots, c.k)), axis=(0, 2))
+                    & jnp.all(jnp.isfinite(
+                        hp[sl].reshape(c.slots, c.k, n)), axis=(1, 2)))
             cls_c, stb_c, conv_c, _, rsn_c = batch_convergence(
                 cfg, it_c, new_classes=labels_c, delta=delta_c,
                 n_glob=n, classes=st.classes[ci], stable=st.stable[ci],
                 done=~st.active[ci],
                 done_iter=jnp.zeros_like(it_c),
                 stop_reason=jnp.full_like(it_c, base.StopReason.MAX_ITER),
-                flip_floor=flip_floor)
+                flip_floor=flip_floor, nonfinite=nonfinite_c)
             it_new.append(it_c)
             classes.append(cls_c)
             stable.append(stb_c)
@@ -1006,7 +1025,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                     frozen_col = jnp.repeat(frozen, k_max)
                     hn = fused_h_update(a_loop, wp, hp, k=k_max, **kern_kw)
                     hn = jnp.where(frozen_col[:, None], hp, hn)
-                    gh = (hn @ hn.T) * bd  # tiny; stays in XLA
+                    from nmfx.ops.packed_mu import bd_select
+                    gh = bd_select(hn @ hn.T, bd)  # tiny; stays in XLA
                     wn = fused_w_update(a_loop, wp, hn, gh, **kern_kw)
                     wn = jnp.where(frozen_col[None, :], wp, wn)
                     return wn, hn
@@ -1067,6 +1087,14 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             def slot_labels(hp):
                 return jnp.argmax(hp.reshape(-1, k_max, n),
                                   axis=1).astype(jnp.int32)
+
+            def slot_nonfinite(wp, hp):
+                # packed-column layout: per-slot all-finite verdict over
+                # the slot's k_max columns of W and rows of H
+                return ~(jnp.all(jnp.isfinite(
+                    wp.reshape(wp.shape[0], -1, k_max)), axis=(0, 2))
+                    & jnp.all(jnp.isfinite(hp.reshape(-1, k_max, n)),
+                              axis=(1, 2)))
 
             def dense_views(wp, hp):
                 wd = jnp.transpose(wp.reshape(m_pad, -1, k_max),
@@ -1137,6 +1165,13 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             def slot_labels(hp):
                 return jnp.argmax(hp, axis=1).astype(jnp.int32)
 
+            def slot_nonfinite(wp, hp):
+                # dense layout: lanes are separate batch entries of every
+                # block einsum, so a non-finite slot is contained by
+                # construction; the guard stops it at the next check
+                return ~(jnp.all(jnp.isfinite(wp), axis=(1, 2))
+                         & jnp.all(jnp.isfinite(hp), axis=(1, 2)))
+
             def dense_views(wp, hp):
                 return wp, hp
 
@@ -1205,6 +1240,8 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             it_new = jnp.minimum(st.slot_iter + ce, cfg.max_iter)
             if not cfg.use_tol_checks:
                 delta = None
+            nonfinite = (slot_nonfinite(wp, hp) if cfg.nonfinite_guard
+                         else None)
             classes, stable, conv, _, reason = batch_convergence(
                 cfg, it_new, new_classes=new_labels, delta=delta,
                 n_glob=n, classes=st.classes, stable=st.stable,
@@ -1212,7 +1249,7 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 done_iter=jnp.zeros_like(st.slot_iter),
                 stop_reason=jnp.full_like(st.slot_iter,
                                           base.StopReason.MAX_ITER),
-                flip_floor=flip_floor)
+                flip_floor=flip_floor, nonfinite=nonfinite)
             dnorm = st.dnorm
             if USES_TOLFUN[cfg.algorithm] and cfg.use_tol_checks:
                 wd, hd = dense_views(wp, hp)

@@ -37,7 +37,12 @@ _META_NAME = "registry.json"
 #: pallas drift class is real numerics) and the experimental knobs
 #: (incl. kl_bf16_quotient, moved) regrouped under
 #: SolverConfig.experimental, changing the hashed field map
-_FORMAT_VERSION = 6
+#: v7: ISSUE 7 — SolverConfig gained nonfinite_guard (the numeric
+#: quarantine changes stop reasons and reduction masks whenever a lane
+#: diverges, so checkpoints must not cross the setting; fault-free runs
+#: are bit-identical either way, but the v3 rule — any new field
+#: invalidates — applies)
+_FORMAT_VERSION = 7
 
 #: AUTHORITATIVE list of SolverConfig fields excluded from the
 #: fingerprint payload. Every entry must be declared execution-strategy
@@ -233,7 +238,7 @@ class SweepRegistry:
             return None
         try:
             return self.load(k)
-        except Exception as e:  # noqa: BLE001 — any unreadable file heals
+        except Exception as e:  # nmfx: ignore[NMFX006] -- logged; heals by recompute
             import logging
 
             logging.getLogger("nmfx").warning(

@@ -81,8 +81,9 @@ if TYPE_CHECKING:
     from nmfx.sweep import KSweepOutput
 
 __all__ = ["DeadlineExceeded", "Engine", "ExecCacheEngine", "NMFXServer",
-           "QueueFull", "RequestStats", "ServeConfig", "ServeError",
-           "ServerClosed", "dispatch_count", "packed_dispatch_count",
+           "QueueFull", "RequestFailed", "RequestStats", "ServeConfig",
+           "ServeError", "ServerClosed", "ServerCrashed",
+           "dispatch_count", "packed_dispatch_count",
            "packing_efficiency", "serve_key_fields"]
 
 
@@ -150,6 +151,23 @@ class DeadlineExceeded(ServeError, TimeoutError):
     budget; the computed results are discarded)."""
 
 
+class RequestFailed(ServeError):
+    """Every dispatch attempt for the request failed — the packed
+    attempt (if any) and ``ServeConfig.dispatch_retries`` solo retries
+    with exponential backoff. ``__cause__`` chains the last underlying
+    failure; other requests in the same batch are unaffected (failure
+    isolation is per-request)."""
+
+
+class ServerCrashed(ServeError):
+    """The scheduler thread died with this request pending — the
+    watchdog resolved the future instead of leaving it hanging forever
+    (``__cause__`` chains the exception that killed the scheduler).
+    With ``ServeConfig.restart_scheduler`` the server keeps accepting
+    NEW requests on a fresh scheduler; work pending at crash time is
+    failed loudly, never replayed silently (at-most-once dispatch)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Serving-engine policy (``nmfx/serve.py``).
@@ -196,6 +214,24 @@ class ServeConfig:
     #: completion worker threads (device→host fetch + host rank
     #: selection per finished request)
     harvest_workers: int = 2
+    #: solo dispatch retries after a failed attempt (a failed PACKED
+    #: dispatch always falls back to per-request solo first; these are
+    #: the additional attempts each solo dispatch gets). Exhausting them
+    #: resolves the future with a typed :class:`RequestFailed` whose
+    #: cause chains the last failure
+    dispatch_retries: int = 1
+    #: base seconds of the exponential backoff between dispatch retries
+    #: (attempt i sleeps ``retry_backoff_s * 2**i``)
+    retry_backoff_s: float = 0.05
+    #: scheduler-death policy: True (default) = the watchdog fails every
+    #: request pending at crash time with :class:`ServerCrashed` and
+    #: starts a fresh scheduler thread for subsequent submits; False =
+    #: the server stays down (submits raise :class:`ServerCrashed`)
+    restart_scheduler: bool = True
+    #: watchdog poll interval: how often the monitor thread checks the
+    #: scheduler's liveness/heartbeat (bounds crash-to-resolution
+    #: latency)
+    watchdog_interval_s: float = 0.25
 
     def __post_init__(self):
         if self.max_queue_depth < 1:
@@ -216,6 +252,12 @@ class ServeConfig:
             raise ValueError("iter_rate_estimate must be positive or None")
         if self.harvest_workers < 1:
             raise ValueError("harvest_workers must be >= 1")
+        if self.dispatch_retries < 0:
+            raise ValueError("dispatch_retries must be >= 0")
+        if self.retry_backoff_s < 0:
+            raise ValueError("retry_backoff_s must be >= 0")
+        if self.watchdog_interval_s <= 0:
+            raise ValueError("watchdog_interval_s must be positive")
 
 
 def serve_key_fields() -> frozenset:
@@ -288,6 +330,8 @@ class _Request:
     stats: RequestStats
     compat: "tuple | None"  # packing-compatibility key; None = solo only
     submitted: float = 0.0
+    #: numeric-quarantine survivor floor (ConsensusConfig.min_restarts)
+    min_restarts: int = 1
 
     @property
     def lanes(self) -> int:
@@ -353,7 +397,8 @@ class ExecCacheEngine:
                                seed=req.seed, label_rule=req.label_rule,
                                linkage=req.linkage,
                                grid_slots=req.grid_slots,
-                               grid_tail_slots=req.grid_tail_slots)
+                               grid_tail_slots=req.grid_tail_slots,
+                               min_restarts=req.min_restarts)
 
     def compatibility_key(self, req: _Request) -> "tuple | None":
         from nmfx.data_cache import default_cache
@@ -416,9 +461,12 @@ class ExecCacheEngine:
         tail = req0.grid_tail_slots
         if isinstance(tail, list):
             tail = tuple(tail)
+        from nmfx import faults
+
         fn = _build_packed_serve_fn(layout, req0.scfg, req0.label_rule,
                                     req0.grid_slots, tail, placed.bucket,
-                                    req0.icfg)
+                                    req0.icfg,
+                                    fault_token=faults.trace_token())
         # canonical chain: fold_in(key(seed), k) per group, split over
         # the restart axis inside the executable — identical draws to
         # each request's solo path
@@ -479,6 +527,23 @@ class NMFXServer:
         self._harvest_cond = threading.Condition()
         self._harvesters: "list[threading.Thread]" = []
         self._inflight = 0  # dispatched, not yet resolved
+        # -- watchdog state (docs/serving.md "Failure model"): every
+        # unresolved request is tracked from submit to resolution, so a
+        # scheduler crash can never strand a Future — the watchdog
+        # resolves whatever the dead scheduler held (ServerCrashed),
+        # skipping requests the (still-alive) harvesters own
+        # own lock (ordered strictly AFTER self._lock): _untrack runs
+        # as a Future done-callback on whatever thread resolved the
+        # future — including threads holding self._lock (_expire_locked,
+        # close(cancel_pending=True)) — so it must not touch self._lock
+        self._tracked_lock = threading.Lock()
+        self._tracked: "dict[int, _Request]" = {}
+        self._harvest_owned: "set[int]" = set()  # guarded by _harvest_cond
+        self._crash: "BaseException | None" = None  # set by _scheduler_main
+        self._sched_clean = False  # scheduler exited via close(), not crash
+        self._down: "BaseException | None" = None  # crashed, no restart
+        self._watchdog: "threading.Thread | None" = None
+        self._heartbeat = 0.0  # scheduler loop progress (introspection)
         self.counters = {"submitted": 0, "completed": 0, "failed": 0,
                          "cancelled": 0, "deadline_expired": 0,
                          "rejected": 0, "dispatches": 0,
@@ -526,6 +591,15 @@ class NMFXServer:
             scheduler = self._scheduler
         if scheduler is not None:
             scheduler.join()
+        with self._cond:
+            self._cond.notify_all()  # wake the watchdog promptly
+        # the watchdog exits once it has observed the closed+dead (or
+        # closed+crashed — it still resolves the crash's strays first)
+        # scheduler; join AFTER the scheduler so a crash racing close()
+        # is fully handled before the harvest drain below
+        watchdog = self._watchdog
+        if watchdog is not None:
+            watchdog.join()
         with self._harvest_cond:
             for _ in self._harvesters:
                 self._harvest_q.append(None)
@@ -540,6 +614,7 @@ class NMFXServer:
                init_cfg: "InitConfig | None" = None,
                label_rule: str = "argmax", linkage: str = "average",
                grid_slots: int = 48, grid_tail_slots="auto",
+               min_restarts: int = 1,
                priority: int = 0, deadline: "float | None" = None,
                timeout: "float | None" = None) -> _ServeFuture:
         """Enqueue one consensus request; returns a
@@ -553,6 +628,10 @@ class NMFXServer:
         while queued resolves the future to :class:`DeadlineExceeded`
         without dispatching. ``future.cancel()`` works until dispatch;
         ``future.stats`` carries the per-request serving spans.
+        ``min_restarts`` is the numeric-quarantine survivor floor
+        (``ConsensusConfig.min_restarts``): a rank with fewer surviving
+        restarts resolves the future to a typed
+        ``nmfx.faults.InsufficientRestarts``.
         """
         from nmfx.api import _as_matrix
 
@@ -572,6 +651,10 @@ class NMFXServer:
                              f"({arr.shape[1]})")
         if restarts < 1:
             raise ValueError("restarts must be >= 1")
+        if not 1 <= min_restarts <= restarts:
+            raise ValueError(
+                f"min_restarts must be in [1, restarts={restarts}], "
+                f"got {min_restarts}")
         if deadline is not None and timeout is not None:
             raise ValueError("pass either deadline or timeout, not both")
         if timeout is None and deadline is None \
@@ -590,7 +673,8 @@ class NMFXServer:
                        grid_tail_slots=grid_tail_slots,
                        priority=priority, deadline=deadline,
                        future=_ServeFuture(stats), stats=stats,
-                       compat=None, submitted=time.monotonic())
+                       compat=None, submitted=time.monotonic(),
+                       min_restarts=min_restarts)
         # admission pre-check BEFORE the O(bytes) fingerprint: under
         # overload QueueFull is the hot path, and rejecting must stay
         # cheap; the authoritative (race-free) check re-runs at enqueue
@@ -606,15 +690,30 @@ class NMFXServer:
             self._queued += 1
             self._pending_bytes += arr.nbytes
             self.counters["submitted"] += 1
+            # watchdog registry: tracked until the future resolves, so
+            # a scheduler crash can enumerate (and fail, typed) every
+            # request it would otherwise strand
+            with self._tracked_lock:
+                self._tracked[req.seq] = req
+            req.future.add_done_callback(
+                lambda _f, seq=req.seq: self._untrack(seq))
             self._ensure_workers()
             self._cond.notify_all()
         return req.future
+
+    def _untrack(self, seq: int) -> None:
+        with self._tracked_lock:
+            self._tracked.pop(seq, None)
 
     def _admit_locked(self, nbytes: int) -> None:
         """Admission control (caller holds the lock): typed rejection
         when the queue is over its depth or pending-byte bound."""
         if self._closed:
             raise ServerClosed("server is closed")
+        if self._down is not None:
+            raise ServerCrashed(
+                "the scheduler crashed and ServeConfig.restart_scheduler "
+                "is False — the server is down") from self._down
         if self._queued >= self.cfg.max_queue_depth:
             self.counters["rejected"] += 1
             raise QueueFull(
@@ -641,10 +740,16 @@ class NMFXServer:
     def _ensure_workers(self) -> None:
         # caller holds the lock
         if self._scheduler is None:
+            self._sched_clean = False
             self._scheduler = threading.Thread(
-                target=self._run_scheduler, daemon=True,
+                target=self._scheduler_main, daemon=True,
                 name="nmfx-serve-sched")
             self._scheduler.start()
+        if self._watchdog is None:
+            self._watchdog = threading.Thread(
+                target=self._run_watchdog, daemon=True,
+                name="nmfx-serve-watchdog")
+            self._watchdog.start()
         while len(self._harvesters) < self.cfg.harvest_workers:
             t = threading.Thread(target=self._run_harvester, daemon=True,
                                  name="nmfx-serve-harvest")
@@ -729,9 +834,28 @@ class NMFXServer:
             heapq.heapify(self._queue)
         return mates
 
+    def _scheduler_main(self) -> None:
+        """Scheduler thread body: the loop, plus the crash fence. An
+        exception escaping ``_run_scheduler`` used to kill the one
+        thread that owns the device and leave every queued Future
+        hanging forever (the ISSUE 7 motivation); now it is recorded
+        and the watchdog resolves every stranded Future with a typed
+        :class:`ServerCrashed` — never a hang."""
+        try:
+            self._run_scheduler()
+            with self._cond:
+                self._sched_clean = True
+        except BaseException as e:  # nmfx: ignore[NMFX006] -- watchdog resolves strays
+            with self._cond:
+                self._crash = e
+                self._cond.notify_all()
+
     def _run_scheduler(self) -> None:
+        from nmfx import faults
+
         while True:
             with self._cond:
+                self._heartbeat = time.monotonic()
                 while True:
                     now = time.monotonic()
                     self._expire_locked(now)
@@ -745,6 +869,13 @@ class NMFXServer:
                 head = self._pop_locked()
                 if head is None:
                     continue
+                # chaos site: scheduler death with a request IN FLIGHT
+                # (popped from the queue, dispatch not yet started) —
+                # the worst-placed crash: the request is in no queue, so
+                # only the watchdog's tracked-request registry can still
+                # resolve its Future (tests/test_faults.py pins that it
+                # does)
+                faults.inject("serve.scheduler")
                 batch = [head]
                 packable = (self.cfg.pack and head.compat is not None
                             and not self._budget_clamps(head))
@@ -767,6 +898,86 @@ class NMFXServer:
                         self._pending_bytes += req.a.nbytes
                 continue
             self._dispatch(batch)
+
+    # -- watchdog ----------------------------------------------------------
+    def _run_watchdog(self) -> None:
+        """Heartbeat-checked scheduler monitor (docs/serving.md
+        "Failure model"): polls every ``ServeConfig.watchdog_interval_s``
+        for a recorded scheduler crash (``_scheduler_main``'s fence) or
+        a scheduler thread that died WITHOUT recording one (an exotic
+        interpreter-level death — the heartbeat's last reading is then
+        the only evidence). On crash: every tracked, unresolved request
+        the harvesters don't own resolves to a typed
+        :class:`ServerCrashed` chaining the scheduler's exception —
+        never a hang — and, with ``ServeConfig.restart_scheduler``, a
+        fresh scheduler thread takes over NEW submissions (work pending
+        at crash time is failed loudly, never replayed: at-most-once
+        dispatch)."""
+        from nmfx.faults import warn_once
+
+        while True:
+            with self._cond:
+                cause = self._crash
+                sched = self._scheduler
+                if cause is None and sched is not None \
+                        and not sched.is_alive() and not self._sched_clean:
+                    cause = RuntimeError(
+                        "scheduler thread died without recording an "
+                        "exception (last heartbeat "
+                        f"{time.monotonic() - self._heartbeat:.1f}s ago)")
+                if cause is None:
+                    if self._closed and (
+                            sched is None or not sched.is_alive()):
+                        return
+                    self._cond.wait(
+                        timeout=self.cfg.watchdog_interval_s)
+                    continue
+                # crash: collect the strays atomically with the queue
+                # reset, so a submit racing the restart lands on the
+                # fresh queue and is never failed spuriously
+                self._crash = None
+                self._scheduler = None
+                self._queue.clear()
+                self._queued = 0
+                self._pending_bytes = 0
+                restart = self.cfg.restart_scheduler and not self._closed
+                if not restart:
+                    self._down = cause
+                with self._tracked_lock:  # lock order: _lock → _tracked
+                    strays = list(self._tracked.values())
+            with self._harvest_cond:
+                owned = set(self._harvest_owned)
+            failed = 0
+            for req in strays:
+                if req.seq in owned:
+                    continue  # a live harvester will resolve it
+                fut = req.future
+                if fut.done():
+                    continue
+                fut.set_running_or_notify_cancel()
+                if fut.done():
+                    continue
+                req.stats.latency_s = time.monotonic() - req.submitted
+                err = ServerCrashed(
+                    "the scheduler thread died while this request was "
+                    "pending; it was never (or only partially) "
+                    "dispatched and is failed rather than replayed "
+                    "(at-most-once dispatch)")
+                err.__cause__ = cause
+                fut.set_exception(err)
+                failed += 1
+            with self._lock:
+                self.counters["failed"] += failed
+            warn_once(
+                "scheduler-crash",
+                f"serve scheduler crashed ({cause!r}); {failed} pending "
+                "request(s) resolved with ServerCrashed"
+                + (", scheduler restarted" if restart
+                   else ", server is down (restart_scheduler=False)"))
+            if restart:
+                with self._cond:
+                    if not self._closed:
+                        self._ensure_workers()
 
     def _linger(self, head: _Request,
                 batch: "list[_Request]") -> "list[_Request]":
@@ -827,6 +1038,8 @@ class NMFXServer:
         req.future.set_exception(DeadlineExceeded(msg))
 
     def _dispatch(self, batch: "list[_Request]") -> None:
+        from nmfx.faults import warn_once
+
         t0 = time.monotonic()
         live = [r for r in batch
                 if r.future.set_running_or_notify_cancel()]
@@ -836,35 +1049,88 @@ class NMFXServer:
             return
         for req in live:
             req.stats.queue_wait_s = t0 - req.submitted
-        lanes = sum(r.lanes for r in live)
-        try:
-            with self._prof.phase("serve.pack"):
-                if len(live) >= 2:
+        if len(live) >= 2:
+            try:
+                with self._prof.phase("serve.pack"):
                     placed = self.engine.place(live[0])
                     raws = self.engine.dispatch_packed(live, placed)
-                else:
-                    req = live[0]
-                    scfg = req.scfg
-                    budget = self._budget_iters(req)
-                    if budget is not None and budget < scfg.max_iter:
-                        scfg = dataclasses.replace(scfg, max_iter=budget)
-                        req.stats.budget_iters = budget
-                        with self._lock:
-                            self.counters["budget_clamped"] += 1
-                    placed = self.engine.place(req)
-                    raws = [self.engine.dispatch_solo(req, placed, scfg)]
-        except BaseException as e:
-            with self._lock:
-                self.counters["failed"] += len(live)
-            for req in live:
-                req.future.set_exception(e)
-            return
+            except BaseException as e:
+                # degradation rung 1 (docs/serving.md "Failure model"):
+                # a failed PACKED dispatch retries each request solo —
+                # failure isolation becomes per-request, and a fault in
+                # the shared packed path cannot take down its mates
+                warn_once(
+                    "packed-dispatch-fallback",
+                    f"packed dispatch of {len(live)} requests failed "
+                    f"({e!r}); retrying each request solo — results "
+                    "are unaffected, the cross-request batching win is "
+                    "lost for this batch")
+            else:
+                self._handoff(live, raws, t0, packed=True)
+                return
+        # solo: a single head, or every member of a failed packed batch
+        for req in live:
+            scfg = req.scfg
+            budget = self._budget_iters(req)
+            if budget is not None and budget < scfg.max_iter:
+                scfg = dataclasses.replace(scfg, max_iter=budget)
+                req.stats.budget_iters = budget
+                with self._lock:
+                    self.counters["budget_clamped"] += 1
+            try:
+                with self._prof.phase("serve.pack"):
+                    raw = self._dispatch_solo_retrying(req, scfg)
+            except BaseException as e:
+                with self._lock:
+                    self.counters["failed"] += 1
+                if not req.future.done():
+                    req.future.set_exception(e)
+            else:
+                self._handoff([req], [raw], t0, packed=False)
+
+    def _dispatch_solo_retrying(self, req: _Request, scfg: SolverConfig):
+        """Degradation rung 2: each solo dispatch gets
+        ``ServeConfig.dispatch_retries`` additional attempts with
+        exponential backoff (``retry_backoff_s * 2**i`` before retry
+        ``i``); exhausting them raises a typed :class:`RequestFailed`
+        whose ``__cause__`` chains the last underlying failure."""
+        from nmfx.faults import warn_once
+
+        last: "BaseException | None" = None
+        for attempt in range(self.cfg.dispatch_retries + 1):
+            if attempt:
+                time.sleep(self.cfg.retry_backoff_s * 2 ** (attempt - 1))
+            try:
+                placed = self.engine.place(req)
+                return self.engine.dispatch_solo(req, placed, scfg)
+            except BaseException as e:  # retried; typed RequestFailed
+                last = e                # below when exhausted
+                warn_once(
+                    "solo-dispatch-retry",
+                    f"solo dispatch attempt {attempt + 1} failed "
+                    f"({e!r}); "
+                    + (f"retrying (up to {self.cfg.dispatch_retries} "
+                       "retr(y/ies) with exponential backoff)"
+                       if self.cfg.dispatch_retries else
+                       "no retries configured"))
+        raise RequestFailed(
+            f"every dispatch attempt failed "
+            f"({self.cfg.dispatch_retries + 1} solo attempt(s)"
+            + (" after the packed attempt" if req.compat is not None
+               else "") + ")") from last
+
+    def _handoff(self, live: "list[_Request]", raws: list, t0: float,
+                 packed: bool) -> None:
+        """Book a successful dispatch and hand each request to the
+        completion workers (who own its Future from here — the
+        watchdog's ``_harvest_owned`` contract)."""
         t1 = time.monotonic()
+        lanes = sum(r.lanes for r in live)
         _note_dispatch(len(live), lanes)
         with self._lock:
             self.counters["dispatches"] += 1
             self.counters["total_lanes"] += lanes
-            if len(live) >= 2:
+            if packed:
                 self.counters["packed_dispatches"] += 1
                 self.counters["packed_requests"] += len(live)
                 self.counters["packed_lanes"] += lanes
@@ -873,12 +1139,15 @@ class NMFXServer:
             req.stats.pack_s = t1 - t0
             req.stats.packed_requests = len(live)
             with self._harvest_cond:
+                self._harvest_owned.add(req.seq)
                 self._harvest_q.append((req, raw, t1))
                 self._harvest_cond.notify()
 
     # -- completion --------------------------------------------------------
     def _run_harvester(self) -> None:
+        from nmfx import faults
         from nmfx.api import ConsensusResult
+        from nmfx.faults import InsufficientRestarts, warn_once
         from nmfx.harvest import harvest_rank
 
         while True:
@@ -893,8 +1162,29 @@ class NMFXServer:
                 fetch_s = select_s = 0.0
                 per_k = {}
                 for k in req.ks:
-                    kres, f_s, s_s = harvest_rank(k, raw[k], req.linkage,
-                                                  self._prof)
+                    try:
+                        # chaos site: a completion (harvest) worker
+                        # dying mid-rank — same site the streamed
+                        # pipeline's workers pass (nmfx/harvest.py)
+                        faults.inject("harvest.worker")
+                        kres, f_s, s_s = harvest_rank(
+                            k, raw[k], req.linkage, self._prof,
+                            req.min_restarts)
+                    except InsufficientRestarts:
+                        raise  # deterministic: a re-run cannot succeed
+                    except BaseException as e:
+                        # recovery: the same device output through the
+                        # same host math, inline — exact; a second
+                        # failure resolves the future via the outer
+                        # handler
+                        warn_once(
+                            "harvest-worker-fallback",
+                            f"serve completion worker failed on rank "
+                            f"{k} ({e!r}); re-running that rank's "
+                            "harvest inline — results are unaffected")
+                        kres, f_s, s_s = harvest_rank(
+                            k, raw[k], req.linkage, self._prof,
+                            req.min_restarts)
                     per_k[k] = kres
                     fetch_s += f_s
                     select_s += s_s
@@ -910,11 +1200,13 @@ class NMFXServer:
                     req.future.set_result(result)
                     with self._lock:
                         self.counters["completed"] += 1
-            except BaseException as e:
+            except BaseException as e:  # resolves the request's Future
                 with self._lock:
                     self.counters["failed"] += 1
                 if not req.future.done():
                     req.future.set_exception(e)
             finally:
+                with self._harvest_cond:
+                    self._harvest_owned.discard(req.seq)
                 with self._lock:
                     self._inflight -= 1

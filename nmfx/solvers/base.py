@@ -86,6 +86,11 @@ class StopReason(enum.IntEnum):
     #: projected-gradient norm below tol * initial gradient norm (Lin 2007;
     #: reference nmf_pg.c:228-243 / nmf_alspg.c:193-209)
     PG_TOL = 4
+    #: numeric quarantine (``SolverConfig.nonfinite_guard``): the lane's
+    #: factors went non-finite and the lane was stopped and masked out of
+    #: the consensus/labels/best-restart reductions exactly like a pad
+    #: lane — its recorded factors/dnorm are diagnostic only
+    NUMERIC_FAULT = 5
 
 
 class State(NamedTuple):
@@ -212,6 +217,26 @@ def check_convergence(
     reason = state.stop_reason
     f_ax = shard.feature_axis if shard is not None else None
     s_ax = shard.sample_axis if shard is not None else None
+
+    if cfg.nonfinite_guard:
+        # numeric quarantine FIRST: a non-finite lane must stop with
+        # NUMERIC_FAULT before the class/TolX tests can read its NaN
+        # labels or deltas (NaN comparisons are all False, but a stable
+        # counter banked before divergence could still fire). Under a
+        # factor-sharded mesh the verdict is global: W is row-sharded
+        # over features, H column-sharded over samples, so each factor's
+        # local non-finite flag reduces over its own axis.
+        bad_w = ~jnp.all(jnp.isfinite(state.w))
+        bad_h = ~jnp.all(jnp.isfinite(state.h))
+        if f_ax is not None:
+            bad_w = lax.psum(bad_w.astype(jnp.int32), f_ax) > 0
+        if s_ax is not None:
+            bad_h = lax.psum(bad_h.astype(jnp.int32), s_ax) > 0
+        faulted = is_check & (bad_w | bad_h)
+        done = done | faulted
+        is_check = is_check & ~faulted
+        reason = jnp.where(faulted, jnp.int32(StopReason.NUMERIC_FAULT),
+                           reason)
 
     classes = state.classes
     stable = state.stable

@@ -29,9 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from nmfx._compat import shard_map
 from nmfx.config import (PACKED_ALGORITHMS, ConsensusConfig,
                          InitConfig, SolverConfig)
-from nmfx.consensus import consensus_matrix, labels_from_h
+from nmfx.consensus import labels_from_h
 from nmfx.init import initialize, random_init
-from nmfx.solvers.base import solve
+from nmfx.solvers.base import StopReason, solve
 
 _log = logging.getLogger("nmfx")
 
@@ -72,6 +72,50 @@ class KSweepOutput(NamedTuple):
     #: nmf.r:50; see also restart_factors for the recompute-by-key route)
     all_w: jax.Array | None = None  # (restarts, m, k) or None
     all_h: jax.Array | None = None  # (restarts, k, n) or None
+
+
+def _quarantine_lanes(labels, dnorm, stops):
+    """Per-rank numeric-quarantine masking shared by every sweep
+    epilogue: lanes that stopped with ``StopReason.NUMERIC_FAULT``
+    (``SolverConfig.nonfinite_guard``) get their labels masked to -1 —
+    ``one_hot`` then drops them from the consensus reduction exactly
+    like pad lanes/columns — and their (possibly non-finite) dnorm
+    masked to +inf so the best-restart argmin never selects them.
+    Fault-free ranks pass through bit-identically (all-False selects).
+    Returns ``(labels, dnorm_for_best, faulted)``."""
+    faulted = stops == jnp.int32(StopReason.NUMERIC_FAULT)
+    labels = jnp.where(faulted[:, None], -1, labels)
+    dnorm_best = jnp.where(faulted, jnp.array(jnp.inf, dnorm.dtype), dnorm)
+    return labels, dnorm_best, faulted
+
+
+def _quarantined_consensus(labels, k: int, restarts: int, faulted):
+    """Mean connectivity over the SURVIVING lanes: quarantined lanes
+    contribute exact zeros to the one-hot einsum (labels -1), and the
+    normalizer becomes the survivor count — so a rank with one diverged
+    restart reports exactly the consensus of the same sweep without that
+    restart. The fault-free branch keeps the original CONSTANT-divisor
+    graph, so quarantine-off and quarantine-on runs of healthy data are
+    bit-identical."""
+    onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32)
+    raw = jnp.einsum("rik,rjk->ij", onehot, onehot)
+    n_fault = jnp.sum(faulted, dtype=jnp.int32)
+    survivors = jnp.maximum(restarts - n_fault, 1).astype(jnp.float32)
+    return jnp.where(n_fault > 0, raw / survivors, raw / restarts)
+
+
+def _poison_restart_lanes(w0, lane_idx: tuple) -> jax.Array:
+    """Trace-time ``solve.nonfinite`` injection (``nmfx.faults``): set
+    one entry of each selected lane's W0 to NaN. The armed spec is
+    static at trace time — the builders' caches are keyed by
+    ``faults.trace_token()`` — so the poison compiles in as constant
+    indices and a lane is poisoned identically on every execution path
+    (solo, whole-grid, bucketed, packed), which is what the
+    quarantine-exactness tests pin."""
+    if not lane_idx:
+        return w0
+    return w0.at[jnp.asarray(lane_idx), 0, 0].set(
+        jnp.asarray(jnp.nan, w0.dtype))
 
 
 def _pad_count(restarts: int, mesh: Mesh | None) -> int:
@@ -159,7 +203,12 @@ def resolve_engine_family(solver_cfg: SolverConfig,
 def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
                     init_cfg: InitConfig, label_rule: str, mesh: Mesh | None,
                     keep_factors: bool = False, grid_slots: int = 48,
-                    grid_tail_slots="auto"):
+                    grid_tail_slots="auto", fault_token=None):
+    # fault_token = faults.trace_token(): keys this cache (and every
+    # builder below) by the armed trace-affecting fault state, so
+    # arming/disarming solve.nonfinite or sched.stale_reload can never
+    # silently serve a previously built clean function; None (nothing
+    # armed) keys identically to the pre-fault-registry world
     grid = grid_axes_active(mesh)
     if grid:
         grid_ok = ((_use_packed(solver_cfg)
@@ -201,7 +250,8 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         # (_GRID_EXEC_BACKENDS)
         grid_fn = _build_grid_exec_sweep_fn(
             (k,), restarts, solver_cfg, init_cfg, label_rule, mesh,
-            keep_factors, grid_slots, grid_tail_slots, fold_keys=False)
+            keep_factors, grid_slots, grid_tail_slots, fold_keys=False,
+            fault_token=fault_token)
 
         def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
             return grid_fn(a, key)[k]
@@ -219,11 +269,20 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     if solver_cfg.restart_chunk is not None:
         chunk_eff = -(-solver_cfg.restart_chunk // mesh_size) * mesh_size
     use_chunks = chunk_eff is not None and chunk_eff < padded
+    from nmfx import faults
+
+    poison = faults.poison_restarts(k, restarts)
+    if poison and use_chunks:
+        raise ValueError(
+            "solve.nonfinite fault injection does not compose with "
+            "restart_chunk (chunked batches lose the global lane index); "
+            "disarm the site or drop restart_chunk for the chaos run")
 
     def _solve_batch(a: jax.Array, keys: jax.Array):
         """Init + solve + labels for one concurrent batch of restarts."""
         w0s, h0s = jax.vmap(
             lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys)
+        w0s = _poison_restart_lanes(w0s, poison)
         if mesh_size > 1:
             shard = NamedSharding(mesh, P(RESTART_AXIS))
             w0s = lax.with_sharding_constraint(w0s, shard)
@@ -256,8 +315,10 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         else:
             res, labels = _solve_batch(a, keys)
         labels = labels[:restarts]  # drop padding lanes before the reduction
-        cons = consensus_matrix(labels, k)
-        best = jnp.argmin(res.dnorm[:restarts])
+        labels, dnorm_best, faulted = _quarantine_lanes(
+            labels, res.dnorm[:restarts], res.stop_reason[:restarts])
+        cons = _quarantined_consensus(labels, k, restarts, faulted)
+        best = jnp.argmin(dnorm_best)
         all_w = all_h = None
         if keep_factors:
             all_w, all_h = res.w, res.h  # padded; sliced after replication
@@ -300,15 +361,24 @@ def _sharded_rank_output(k: int, labels, iters, dnorm, stops, wk, hk,
     connectivity; per-restart stats gather the padded axis (pad sliced off
     after); best restart = local argmin candidate per shard, then a tiny
     gathered argmin across shards."""
+    # numeric quarantine: faulted lanes mask out of the reduction
+    # exactly like the pad lanes `valid` already masks; the normalizer
+    # becomes the global survivor count (constant-divisor graph kept on
+    # the fault-free branch — see _quarantined_consensus)
+    labels, dnorm_masked, faulted = _quarantine_lanes(labels, dnorm, stops)
     onehot = (jax.nn.one_hot(labels, k, dtype=jnp.float32)
               * valid[:, None, None])
-    cons = lax.psum(jnp.einsum("rik,rjk->ij", onehot, onehot),
-                    RESTART_AXIS) / restarts
+    raw = lax.psum(jnp.einsum("rik,rjk->ij", onehot, onehot),
+                   RESTART_AXIS)
+    n_fault = lax.psum(jnp.sum(faulted & valid, dtype=jnp.int32),
+                       RESTART_AXIS)
+    survivors = jnp.maximum(restarts - n_fault, 1).astype(jnp.float32)
+    cons = jnp.where(n_fault > 0, raw / survivors, raw / restarts)
     iters_g = lax.all_gather(iters, RESTART_AXIS, tiled=True)
     dnorm_g = lax.all_gather(dnorm, RESTART_AXIS, tiled=True)
     stop_g = lax.all_gather(stops, RESTART_AXIS, tiled=True)
     labels_g = lax.all_gather(labels, RESTART_AXIS, tiled=True)
-    masked = jnp.where(valid, dnorm, jnp.inf)
+    masked = jnp.where(valid, dnorm_masked, jnp.inf)
     best = jnp.argmin(masked)
     bws = lax.all_gather(wk[best], RESTART_AXIS)
     bhs = lax.all_gather(hk[best], RESTART_AXIS)
@@ -340,10 +410,17 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     matrix over ICI and small ``all_gather``s replicate the per-restart
     stats, mirroring the replicated-output contract of the vmap path.
     """
+    from nmfx import faults
     from nmfx.ops.packed_mu import mu_packed, unpack_w
 
     padded = _pad_count(restarts, mesh)
     dtype = jnp.dtype(solver_cfg.dtype)
+    poison = faults.poison_restarts(k, restarts)
+    if poison and mesh is not None and RESTART_AXIS in mesh.axis_names:
+        raise ValueError(
+            "solve.nonfinite fault injection is not supported on a "
+            "restart-sharded mesh (per-shard lane indices); disarm the "
+            "site or run unmeshed for the chaos run")
 
     def _solve_local(a: jax.Array, keys: jax.Array,
                      varying_axes: tuple[str, ...] = ()):
@@ -351,6 +428,7 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         r_local = keys.shape[0]
         w0s, h0s = jax.vmap(
             lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys)
+        w0s = _poison_restart_lanes(w0s, poison)
         res = mu_packed(a, w0s, h0s, solver_cfg, varying_axes=varying_axes)
         hs = res.hp.reshape(r_local, k, -1)
         labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hs)
@@ -368,10 +446,14 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
             keys = jax.random.split(key, padded)
             res, hs, labels = _solve_local(a, keys)
             labels = labels[:restarts]
-            cons = consensus_matrix(labels, k)
-            best_w, best_h, _ = _best(
-                res, hs, jnp.where(jnp.arange(padded) < restarts, res.dnorm,
-                                   jnp.inf), padded)
+            labels, _, faulted = _quarantine_lanes(
+                labels, res.dnorm[:restarts], res.stop_reason[:restarts])
+            cons = _quarantined_consensus(labels, k, restarts, faulted)
+            masked = jnp.where(jnp.arange(padded) < restarts, res.dnorm,
+                               jnp.inf)
+            masked = jnp.where(jnp.pad(faulted, (0, padded - restarts)),
+                               jnp.inf, masked)
+            best_w, best_h, _ = _best(res, hs, masked, padded)
             extra = ((unpack_w(res.wp, padded)[:restarts], hs[:restarts])
                      if keep_factors else (None, None))
             return KSweepOutput(cons, res.iterations[:restarts],
@@ -567,12 +649,17 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
         gidx = ((lax.axis_index(RESTART_AXIS) if has_restart else 0)
                 * r_local + jnp.arange(r_local))
         valid = gidx < restarts
+        labels, dnorm_q, faulted = _quarantine_lanes(labels, res.dnorm,
+                                                     res.stop_reason)
         onehot = (jax.nn.one_hot(labels, k, dtype=jnp.float32)
                   * valid[:, None, None])
         cons = jnp.einsum("rik,rjk->ij", onehot, onehot)
+        n_fault = jnp.sum(faulted & valid, dtype=jnp.int32)
         if has_restart:
             cons = lax.psum(cons, RESTART_AXIS)
-        cons = cons / restarts
+            n_fault = lax.psum(n_fault, RESTART_AXIS)
+        survivors = jnp.maximum(restarts - n_fault, 1).astype(jnp.float32)
+        cons = jnp.where(n_fault > 0, cons / survivors, cons / restarts)
 
         def rgather(x, tiled=True):
             return (lax.all_gather(x, RESTART_AXIS, tiled=tiled)
@@ -587,7 +674,7 @@ def _build_grid_sharded_sweep_fn(k: int, restarts: int,
         # factors with a masked psum, then one feature/sample gather into
         # the full factors — at no point does any device hold more than one
         # full-size factor matrix
-        masked_dnorm = jnp.where(valid, res.dnorm, jnp.inf)
+        masked_dnorm = jnp.where(valid, dnorm_q, jnp.inf)
         best = jnp.argmin(masked_dnorm)
         bw_loc = w_all_loc[best]  # (m_loc, k)
         bh_loc = hs_loc[best]  # (k, n_loc)
@@ -673,7 +760,8 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
                               keep_factors: bool = False,
                               slots: int = 48,
                               tail_slots="auto",
-                              fold_keys: bool = True):
+                              fold_keys: bool = True,
+                              fault_token=None):
     """Sweep builder for the whole-grid path (``nmfx.ops.sched_mu``):
     EVERY (k, restart) cell solves through one jit'd slot-scheduled
     while_loop — the reference's whole-grid-concurrent job array with
@@ -688,6 +776,7 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
     reduces the consensus and small all_gathers replicate the stats — the
     same replicated-output contract as the per-k builders.
     """
+    from nmfx import faults
     from nmfx.ops.sched_mu import mu_sched
 
     if not fold_keys and len(ks) != 1:
@@ -697,6 +786,17 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
     k_max = max(ks)
     padded = _pad_count(restarts, mesh)
     dtype = jnp.dtype(solver_cfg.dtype)
+    # solve.nonfinite injection (trace-time constant — fault_token keys
+    # this cache): global lane index of each poisoned (k, restart) cell
+    # in the rank-major lane stack
+    poison = tuple(g * padded + r for g, k in enumerate(ks)
+                   for r in faults.poison_restarts(k, restarts))
+    if poison and mesh is not None and RESTART_AXIS in mesh.axis_names \
+            and mesh.shape[RESTART_AXIS] > 1:
+        raise ValueError(
+            "solve.nonfinite fault injection is not supported on a "
+            "restart-sharded mesh (per-shard lane indices); disarm the "
+            "site or run unmeshed for the chaos run")
 
     def _init_lanes(a, rank_keys):
         """[(k, (r,) keys)] → zero-padded dense (B, m, k_max), (B, k_max, n)
@@ -725,6 +825,7 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
                     else root_key, padded))
                 for k in ks]
             w0, h0 = _init_lanes(a, rank_keys)
+            w0 = _poison_restart_lanes(w0, poison)
             res = mu_sched(a, w0, h0, solver_cfg, slots=slots,
                            tail_slots=tail_slots,
                            job_ks=tuple(k for k in ks
@@ -736,8 +837,10 @@ def _build_grid_exec_sweep_fn(ks: tuple[int, ...], restarts: int,
                 wk = res.w[sl, :, :k]  # both label rules
                 labels = jax.vmap(partial(labels_from_h,
                                           rule=label_rule))(hk)
-                cons = consensus_matrix(labels, k)
-                best = jnp.argmin(res.dnorm[sl])
+                labels, dnorm_best, faulted = _quarantine_lanes(
+                    labels, res.dnorm[sl], res.stop_reason[sl])
+                cons = _quarantined_consensus(labels, k, restarts, faulted)
+                best = jnp.argmin(dnorm_best)
                 extra = (wk, hk) if keep_factors else (None, None)
                 out[k] = KSweepOutput(cons, res.iterations[sl],
                                       res.dnorm[sl], res.stop_reason[sl],
@@ -879,7 +982,8 @@ def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
                              grid_slots: int, grid_tail_slots,
                              bucket_shape: tuple[int, int],
                              donate_inits: bool = False,
-                             init_cfg: InitConfig | None = None):
+                             init_cfg: InitConfig | None = None,
+                             fault_token=None):
     """Sweep builder for the shape-bucketed executable-reuse layer
     (``nmfx/exec_cache.py``): the whole-grid slot-scheduled solve of
     ``_build_grid_exec_sweep_fn``, restructured so ONE compiled
@@ -916,6 +1020,7 @@ def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
     executable (they are rebuilt per request; ignored for the
     inside-init signature, which has none).
     """
+    from nmfx import faults
     from nmfx.ops.sched_mu import mu_sched
 
     ks = tuple(sorted(ks, reverse=True))
@@ -924,6 +1029,13 @@ def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
     padded = _pad_count(restarts, mesh)
     dtype = jnp.dtype(solver_cfg.dtype)
     inside_init = init_cfg is not None
+    poison = tuple(g * padded + r for g, k in enumerate(ks)
+                   for r in faults.poison_restarts(k, restarts))
+    if poison and (not inside_init or mesh is not None):
+        raise ValueError(
+            "solve.nonfinite fault injection on the bucketed executables "
+            "needs the random-init unmeshed route (init inside the "
+            "executable); disarm the site for NNDSVD/meshed runs")
     if inside_init and init_cfg.method != "random":
         raise ValueError(
             "inside-executable init is the random-init fast path; NNDSVD "
@@ -968,9 +1080,11 @@ def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
                 # pad columns → -1: one_hot drops them from the
                 # consensus reduction and the host layer slices them off
                 labels = jnp.where(valid[None, :], labels, -1)
-                cons = consensus_matrix(labels, k)
                 dnorm = res.dnorm[sl] * scale
-                best = jnp.argmin(dnorm)
+                labels, dnorm_best, faulted = _quarantine_lanes(
+                    labels, dnorm, res.stop_reason[sl])
+                cons = _quarantined_consensus(labels, k, restarts, faulted)
+                best = jnp.argmin(dnorm_best)
                 extra = (wk, hk) if keep_factors else (None, None)
                 out[k] = KSweepOutput(cons, res.iterations[sl], dnorm,
                                       res.stop_reason[sl], labels,
@@ -982,6 +1096,7 @@ def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
             def impl(a_pad, root_key, m_true, n_true, flip_floor):
                 w0, h0 = dyn_init(_rank_keys(root_key, padded),
                                   m_true, n_true)
+                w0 = _poison_restart_lanes(w0, poison)
                 return run(a_pad, w0, h0, m_true, n_true, flip_floor)
 
             return jax.jit(impl)
@@ -1064,7 +1179,8 @@ def _build_packed_serve_fn(layout: tuple, solver_cfg: SolverConfig,
                            label_rule: str, grid_slots: int,
                            grid_tail_slots,
                            bucket_shape: tuple[int, int],
-                           init_cfg: InitConfig):
+                           init_cfg: InitConfig,
+                           fault_token=None):
     """Sweep builder for CROSS-REQUEST lane packing (``nmfx/serve.py``):
     one slot-scheduled dispatch whose lanes come from SEVERAL serve
     requests — the token-level-batching analogue for consensus NMF.
@@ -1127,6 +1243,17 @@ def _build_packed_serve_fn(layout: tuple, solver_cfg: SolverConfig,
     dtype = jnp.dtype(solver_cfg.dtype)
     dyn_init = _dyn_lane_init(init_cfg, dtype, n_pad, m_pad, k_max)
     job_ks = tuple(k for k, r in layout for _ in range(r))
+    # solve.nonfinite injection: each group poisons the SAME per-(k,
+    # restart) lanes its solo bucketed run would (lane selection is
+    # (k, restart)-keyed, not request-keyed), so packed == solo parity
+    # holds under injection too
+    from nmfx import faults
+
+    poison, _off = [], 0
+    for k, r in layout:
+        poison.extend(_off + rr for rr in faults.poison_restarts(k, r))
+        _off += r
+    poison = tuple(poison)
 
     def impl(a_pad, group_roots, m_true, n_true,
              flip_floor) -> tuple[KSweepOutput, ...]:
@@ -1134,6 +1261,7 @@ def _build_packed_serve_fn(layout: tuple, solver_cfg: SolverConfig,
         rank_keys = [(k, jax.random.split(group_roots[g], r))
                      for g, (k, r) in enumerate(layout)]
         w0, h0 = dyn_init(rank_keys, m_true, n_true)
+        w0 = _poison_restart_lanes(w0, poison)
         res = mu_sched(a_pad, w0, h0, solver_cfg, slots=grid_slots,
                        tail_slots=grid_tail_slots, job_ks=job_ks,
                        flip_floor=flip_floor)
@@ -1156,9 +1284,11 @@ def _build_packed_serve_fn(layout: tuple, solver_cfg: SolverConfig,
             labels = jax.vmap(partial(labels_from_h,
                                       rule=label_rule))(hk)
             labels = jnp.where(valid[None, :], labels, -1)
-            cons = consensus_matrix(labels, k)
             dnorm = res.dnorm[sl] * scale
-            best = jnp.argmin(dnorm)
+            labels, dnorm_best, faulted = _quarantine_lanes(
+                labels, dnorm, res.stop_reason[sl])
+            cons = _quarantined_consensus(labels, k, r, faulted)
+            best = jnp.argmin(dnorm_best)
             out.append(KSweepOutput(cons, res.iterations[sl], dnorm,
                                     res.stop_reason[sl], labels,
                                     wk[best], hk[best]))
@@ -1245,8 +1375,11 @@ def sweep_one_k(a, key, k: int, restarts: int,
         # force a re-trace of unrelated builders
         grid_slots = 48
         grid_tail_slots = "auto"
+    from nmfx import faults
+
     fn = _build_sweep_fn(k, restarts, solver_cfg, init_cfg, label_rule, mesh,
-                         keep_factors, grid_slots, grid_tail_slots)
+                         keep_factors, grid_slots, grid_tail_slots,
+                         fault_token=faults.trace_token())
     return fn(jnp.asarray(a), key)
 
 
@@ -1336,10 +1469,12 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     # first rank's trace/compile instead of blocking here —
     # re-transferring the matrix for every rank costs more than a
     # rank's whole solve at small sizes (~0.14 s/call through the TPU
-    # tunnel for a 10 MB matrix)
-    from nmfx.data_cache import default_cache
+    # tunnel for a 10 MB matrix). place_resilient: a cache-layer
+    # placement failure degrades to a direct uncached transfer instead
+    # of failing the sweep (docs/serving.md "Failure model")
+    from nmfx.data_cache import place_resilient
 
-    a_dev = default_cache().place(a, solver_cfg, mesh, profiler=profiler)
+    a_dev = place_resilient(a, solver_cfg, mesh, profiler=profiler)
 
     eligible = grid_exec_ok(solver_cfg, mesh)
     if cfg.grid_exec == "grid" and not eligible:
@@ -1356,10 +1491,13 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
                              or (cfg.grid_exec == "auto" and len(needed) > 1))
     coord = not multi or jax.process_index() == 0
     if use_grid:
+        from nmfx import faults
+
         fn = _build_grid_exec_sweep_fn(tuple(needed), cfg.restarts,
                                        solver_cfg, init_cfg, cfg.label_rule,
                                        mesh, cfg.keep_factors,
-                                       cfg.grid_slots, cfg.grid_tail_slots)
+                                       cfg.grid_slots, cfg.grid_tail_slots,
+                                       fault_token=faults.trace_token())
         t0 = time.perf_counter()
         with profiler.phase("solve.grid") as sync:
             solved = sync(fn(a_dev, root))

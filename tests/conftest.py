@@ -103,6 +103,50 @@ def _restore_compile_cache_config():
     compilation_cache.reset_cache()
 
 
+#: test modules that run threads (serve scheduler/watchdog/harvesters,
+#: streamed harvest workers, chaos injection) — the suites a
+#: lost-wakeup or deadlock regression would otherwise turn into a
+#: silent multi-minute hang
+_THREADED_MODULES = frozenset({
+    "test_serve", "test_harvest", "test_faults", "test_pipeline"})
+
+#: per-test hang budget for those modules, seconds. Generous against
+#: the slowest legitimate test (cold compiles on this CPU image are
+#: tens of seconds) but a small fraction of the 870 s tier-1 budget: a
+#: watchdog/drain regression fails ONE test in 4 minutes with a full
+#: thread dump instead of eating the whole run. Override via
+#: NMFX_TEST_HANG_GUARD_S (0 disables — debugger sessions).
+_HANG_GUARD_S = 240.0
+
+
+@pytest.fixture(autouse=True)
+def _threaded_hang_guard(request):
+    """Per-test hang guard for the threaded suites (ISSUE 7 satellite):
+    ``faulthandler.dump_traceback_later`` dumps EVERY thread's stack and
+    kills the process when a test overstays ``_HANG_GUARD_S`` — turning
+    a hung Future (the exact failure class the serve watchdog exists to
+    prevent) into a loud, attributed tier-1 failure with the stuck
+    stacks in the log."""
+    import faulthandler
+    import os
+
+    mod = request.node.fspath.purebasename \
+        if request.node.fspath else ""
+    if mod not in _THREADED_MODULES:
+        yield
+        return
+    budget = float(os.environ.get("NMFX_TEST_HANG_GUARD_S",
+                                  _HANG_GUARD_S))
+    if budget <= 0:
+        yield
+        return
+    faulthandler.dump_traceback_later(budget, exit=True)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+
+
 @pytest.fixture(scope="session")
 def two_group_data():
     """Synthetic 2-group expression-like matrix (fixture factory standing in

@@ -1,0 +1,545 @@
+"""Chaos suite (ISSUE 7 tentpole): every registered fault site armed
+against the e2e consensus path, asserting the recovery contract —
+**bit-identical results where recovery is exact** (h2d fallback, harvest
+re-run, deserialize-recompile, solo retry after a failed packed/compile
+attempt), **typed errors otherwise** (``FaultInjected``,
+``InsufficientRestarts``, ``RequestFailed``, ``ServerCrashed``), and
+**bounded wall time always** — zero hangs (every ``Future.result`` here
+carries a timeout, and ``tests/conftest.py``'s per-test hang guard
+dumps all thread stacks and kills the run if a regression wedges one of
+these threaded paths).
+
+The quarantine-exactness block is the acceptance criterion's core: a
+sweep with an injected non-finite lane must produce consensus /
+rho / membership identical to the same sweep without that restart,
+pinned across the grid (slot-scheduled), vmapped-dense, and packed
+engines. The reference side is computed from the CLEAN run's
+per-restart outputs (surviving lanes are bit-identical by lane
+independence), never from a re-keyed smaller sweep.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from nmfx import faults
+from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.faults import FaultInjected, InsufficientRestarts
+from nmfx.solvers.base import StopReason
+
+KS = (2, 3)
+RESTARTS = 3
+MAX_ITER = 20
+SEED = 11
+
+
+@pytest.fixture(autouse=True)
+def _pristine_registry():
+    """Every test starts and ends with nothing armed and the warn-once
+    ledger clear (warn_once fires once per category per PROCESS — the
+    ledger reset is what lets each test assert its own warning)."""
+    faults.disarm()
+    faults._reset_warned()
+    yield
+    faults.disarm()
+    faults._reset_warned()
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    from nmfx.datasets import two_group_matrix
+
+    return two_group_matrix(n_genes=48, n_per_group=8, seed=5)
+
+
+def _consensus(data, *, algorithm="mu", backend="auto", grid_exec="auto",
+               ks=KS, restarts=RESTARTS, **kw):
+    from nmfx.api import nmfconsensus
+
+    scfg = SolverConfig(algorithm=algorithm, backend=backend,
+                        max_iter=MAX_ITER)
+    return nmfconsensus(data, ks=ks, restarts=restarts, seed=SEED,
+                        solver_cfg=scfg, use_mesh=False, **kw)
+
+
+def _sweep(data, *, algorithm="mu", backend="auto", grid_exec="auto",
+           ks=KS, restarts=RESTARTS):
+    import jax
+
+    from nmfx.sweep import sweep
+
+    ccfg = ConsensusConfig(ks=ks, restarts=restarts, seed=SEED,
+                           grid_exec=grid_exec)
+    scfg = SolverConfig(algorithm=algorithm, backend=backend,
+                        max_iter=MAX_ITER)
+    out = sweep(np.asarray(data), ccfg, scfg, InitConfig(), None)
+    return {k: jax.device_get(v) for k, v in out.items()}
+
+
+def assert_result_bit_equal(got, ref):
+    assert set(got.per_k) == set(ref.per_k)
+    for k in ref.per_k:
+        s, q = got.per_k[k], ref.per_k[k]
+        for field in ("consensus", "rho", "membership", "order",
+                      "iterations", "dnorms", "stop_reasons", "best_w",
+                      "best_h"):
+            assert np.array_equal(np.asarray(getattr(s, field)),
+                                  np.asarray(getattr(q, field))), \
+                f"{field} k={k}"
+
+
+# ---------------------------------------------------------------------
+# registry semantics (no device work)
+# ---------------------------------------------------------------------
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.arm("no.such.site", every=1)
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultConfig(site="typo.site")
+
+
+def test_fault_config_validation():
+    with pytest.raises(ValueError, match="every"):
+        faults.arm("h2d.transfer", every=0)
+    with pytest.raises(ValueError, match="max_fires"):
+        faults.arm("h2d.transfer", max_fires=0)
+    with pytest.raises(ValueError, match="rate"):
+        faults.arm("solve.nonfinite", rate=1.5)
+    # lane-rate sites demand an explicit rate or lane set
+    with pytest.raises(ValueError, match="rate"):
+        faults.arm("solve.nonfinite")
+
+
+def test_every_and_max_fires_schedule():
+    faults.arm("compile.build", every=2, max_fires=2)
+    fired = [faults.fire("compile.build") for _ in range(8)]
+    # hits 2 and 4 fire; max_fires=2 then keeps the site inert
+    assert fired == [False, True, False, True, False, False, False,
+                     False]
+    assert faults.hits("compile.build") == 8
+    assert faults.fires("compile.build") == 2
+
+
+def test_inject_raises_typed():
+    faults.arm("persist.deserialize", every=1)
+    with pytest.raises(FaultInjected) as exc:
+        faults.inject("persist.deserialize")
+    assert exc.value.site == "persist.deserialize"
+    assert exc.value.hit == 1
+
+
+def test_scoped_restores_previous_policy():
+    assert faults.armed("h2d.transfer") is None
+    faults.arm("h2d.transfer", every=3)
+    with faults.scoped("h2d.transfer", every=1):
+        assert faults.armed("h2d.transfer").every == 1
+    assert faults.armed("h2d.transfer").every == 3
+    faults.disarm("h2d.transfer")
+    with faults.scoped("h2d.transfer", every=5):
+        assert faults.armed("h2d.transfer").every == 5
+    assert faults.armed("h2d.transfer") is None
+
+
+def test_poison_restarts_deterministic():
+    # explicit lanes: exact selection, restart bounds respected
+    faults.arm("solve.nonfinite", lanes=((2, 1), (3, 7)))
+    assert faults.poison_restarts(2, 3) == (1,)
+    assert faults.poison_restarts(3, 3) == ()  # lane 7 out of range
+    assert faults.poison_restarts(4, 3) == ()
+    # rate arming: seeded, process-stable, k-dependent
+    faults.arm("solve.nonfinite", rate=0.5, seed=7)
+    first = faults.poison_restarts(2, 64)
+    assert faults.poison_restarts(2, 64) == first
+    assert 8 < len(first) < 56  # a real subset, not all-or-nothing
+    faults.arm("solve.nonfinite", rate=0.5, seed=8)
+    assert faults.poison_restarts(2, 64) != first
+    faults.arm("solve.nonfinite", rate=0.0, seed=7)
+    assert faults.poison_restarts(2, 64) == ()
+    assert faults.poison_restarts(2, 0) == ()
+
+
+def test_trace_token_fences_trace_affecting_sites():
+    assert faults.trace_token() is None
+    faults.arm("h2d.transfer", every=1)  # host-side: no token change
+    assert faults.trace_token() is None
+    faults.arm("solve.nonfinite", lanes=((2, 0),))
+    tok1 = faults.trace_token()
+    assert tok1 is not None
+    faults.arm("solve.nonfinite", lanes=((2, 1),))
+    tok2 = faults.trace_token()
+    assert tok2 is not None and tok2 != tok1  # re-arm bumps generation
+    faults.disarm("solve.nonfinite")
+    assert faults.trace_token() is None
+
+
+def test_warn_once_per_category():
+    with pytest.warns(RuntimeWarning, match="first"):
+        faults.warn_once("chaos-test-cat", "first")
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # a second warning would raise
+        faults.warn_once("chaos-test-cat", "second (suppressed)")
+    with pytest.warns(RuntimeWarning, match="other"):
+        faults.warn_once("chaos-test-cat-2", "other")
+
+
+# ---------------------------------------------------------------------
+# exact-recovery sites: bit-identical results through the fallback
+# ---------------------------------------------------------------------
+
+def test_h2d_transfer_fault_falls_back_direct_exact(small_data):
+    """An injected input-transfer failure degrades to a direct uncached
+    h2d (warn-once); the device values — and every downstream result —
+    are bit-identical to the cached-placement run."""
+    from nmfx.datasets import two_group_matrix
+
+    fresh = two_group_matrix(n_genes=48, n_per_group=8, seed=9)
+    faults.arm("h2d.transfer", every=1)
+    with pytest.warns(RuntimeWarning, match="h2d-direct-fallback"):
+        faulted = _consensus(fresh)
+    assert faults.fires("h2d.transfer") >= 1
+    faults.disarm("h2d.transfer")
+    clean = _consensus(fresh)  # cache path, same content
+    assert_result_bit_equal(faulted, clean)
+
+
+def test_harvest_worker_death_sequential_fallback_exact(small_data):
+    """Every streamed-harvest worker dying falls back to sequential
+    re-harvest of the same device outputs — exact recovery."""
+    clean = _consensus(small_data)
+    faults.arm("harvest.worker", every=1)
+    with pytest.warns(RuntimeWarning, match="harvest-worker-fallback"):
+        faulted = _consensus(small_data, harvest="streamed")
+    assert faults.fires("harvest.worker") == len(KS)
+    assert_result_bit_equal(faulted, clean)
+
+
+def test_persist_deserialize_fault_recompiles_exact(small_data,
+                                                    tmp_path):
+    """A corrupt/injected persisted-executable read drops the entry,
+    warns once, and recompiles — the recompiled executable is
+    bit-identical (the PR 4 fallback, now rehearsable on demand)."""
+    from nmfx.config import ExecCacheConfig
+    from nmfx.exec_cache import ExecCache, compile_count
+
+    cfg = ExecCacheConfig(cache_dir=str(tmp_path / "exec"))
+    warm = ExecCache(cfg)
+    ref = _consensus(small_data, ks=(2,), restarts=2, exec_cache=warm)
+    fresh = ExecCache(cfg)  # same disk cache, empty memory LRU
+    faults.arm("persist.deserialize", every=1, max_fires=1)
+    before = compile_count()
+    with pytest.warns(RuntimeWarning, match="recompiling"):
+        got = _consensus(small_data, ks=(2,), restarts=2,
+                         exec_cache=fresh)
+    assert faults.fires("persist.deserialize") == 1
+    assert compile_count() == before + 1  # fallback really recompiled
+    assert_result_bit_equal(got, ref)
+
+
+def test_compile_build_fault_direct_is_typed(small_data):
+    """Without a retrying layer above it, an injected compile failure
+    surfaces as the typed FaultInjected — loud, attributed, bounded."""
+    from nmfx.exec_cache import ExecCache
+
+    faults.arm("compile.build", every=1)
+    with pytest.raises(FaultInjected) as exc:
+        _consensus(small_data, ks=(2,), restarts=2,
+                   exec_cache=ExecCache())
+    assert exc.value.site == "compile.build"
+
+
+def test_compile_build_fault_serve_retries_exact(small_data):
+    """Through the serving layer the same compile fault is survived:
+    the solo dispatch retries (exponential backoff), the second attempt
+    compiles, and the served result is bit-identical to the solo run
+    through the same layer."""
+    from nmfx.exec_cache import ExecCache
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    cache = ExecCache()
+    scfg = SolverConfig(max_iter=MAX_ITER)
+    faults.arm("compile.build", every=1, max_fires=1)
+    cfg = ServeConfig(dispatch_retries=1, retry_backoff_s=0.01)
+    with pytest.warns(RuntimeWarning, match="solo-dispatch-retry"):
+        with NMFXServer(cfg, exec_cache=cache) as srv:
+            fut = srv.submit(small_data, ks=(2,), restarts=2, seed=SEED,
+                             solver_cfg=scfg)
+            got = fut.result(timeout=600)
+    assert faults.fires("compile.build") == 1
+    from nmfx.api import nmfconsensus
+
+    ref = nmfconsensus(small_data, ks=(2,), restarts=2, seed=SEED,
+                       solver_cfg=scfg, use_mesh=False,
+                       exec_cache=cache)
+    assert_result_bit_equal(got, ref)
+
+
+# ---------------------------------------------------------------------
+# numeric quarantine: the exactness acceptance criterion
+# ---------------------------------------------------------------------
+
+def _expected_masked_kresult(out, r_bad: int, k: int):
+    """The reference KResult for a rank whose lane ``r_bad`` was
+    quarantined, built from the CLEAN sweep output: surviving lanes are
+    bit-identical by lane independence, so the survivor-mean consensus
+    and the masked fields below ARE "the same sweep without that
+    restart"."""
+    from nmfx.api import _build_k_result
+
+    labels = np.asarray(out.labels).copy()
+    n = labels.shape[1]
+    survivors = [r for r in range(labels.shape[0]) if r != r_bad]
+    conn = np.zeros((n, n), np.float32)
+    for r in survivors:
+        lab = labels[r]
+        conn += (lab[:, None] == lab[None, :]).astype(np.float32)
+    cons = conn / np.float32(len(survivors))
+    labels[r_bad] = -1
+    stops = np.asarray(out.stop_reasons).copy()
+    stops[r_bad] = int(StopReason.NUMERIC_FAULT)
+    masked = out._replace(consensus=cons, labels=labels,
+                          stop_reasons=stops)
+    return _build_k_result(k, masked, "average")
+
+
+@pytest.mark.parametrize("algorithm,backend,grid_exec", [
+    ("mu", "auto", "auto"),      # whole-grid slot-scheduled engine
+    ("mu", "vmap", "per_k"),     # vmapped dense engine
+    ("hals", "packed", "auto"),  # packed-column engine (shared Grams)
+])
+def test_quarantine_exactness(small_data, algorithm, backend,
+                              grid_exec):
+    """The acceptance criterion: one injected non-finite lane in rank 2
+    stops with NUMERIC_FAULT and the rank's consensus/rho/membership
+    equal the same sweep without that restart; rank 3 (untouched) is
+    bit-identical to the clean run end to end."""
+    kw = dict(algorithm=algorithm, backend=backend, grid_exec=grid_exec)
+    clean_out = _sweep(small_data, **kw)
+    clean_res = _consensus(small_data, **kw)
+    # poison the WORST clean lane of rank 2 (never the best-restart
+    # winner), so best_w/best_h must survive quarantine unchanged
+    r_bad = int(np.argmax(np.asarray(clean_out[2].dnorms)))
+    assert r_bad != int(np.argmin(np.asarray(clean_out[2].dnorms)))
+    faults.arm("solve.nonfinite", lanes=((2, r_bad),))
+    faulted = _consensus(small_data, **kw)
+
+    # rank 3 carried no fault: bit-identical end to end
+    f3, c3 = faulted.per_k[3], clean_res.per_k[3]
+    for field in ("consensus", "rho", "membership", "order",
+                  "iterations", "dnorms", "stop_reasons", "best_w",
+                  "best_h"):
+        assert np.array_equal(np.asarray(getattr(f3, field)),
+                              np.asarray(getattr(c3, field))), field
+
+    # rank 2: the poisoned lane stopped with NUMERIC_FAULT...
+    f2 = faulted.per_k[2]
+    stops = np.asarray(f2.stop_reasons)
+    assert stops[r_bad] == int(StopReason.NUMERIC_FAULT)
+    survivors = [r for r in range(RESTARTS) if r != r_bad]
+    # ...surviving lanes are bit-identical to the clean run...
+    clean2 = clean_out[2]
+    assert np.array_equal(stops[survivors],
+                          np.asarray(clean2.stop_reasons)[survivors])
+    assert np.array_equal(np.asarray(f2.iterations)[survivors],
+                          np.asarray(clean2.iterations)[survivors])
+    assert np.array_equal(np.asarray(f2.dnorms)[survivors],
+                          np.asarray(clean2.dnorms)[survivors])
+    # ...and consensus/rho/membership/order/best equal the same sweep
+    # without that restart (reference from the clean lanes)
+    ref2 = _expected_masked_kresult(clean2, r_bad, 2)
+    for field in ("consensus", "rho", "membership", "order", "best_w",
+                  "best_h"):
+        assert np.array_equal(np.asarray(getattr(f2, field)),
+                              np.asarray(getattr(ref2, field))), field
+
+
+def test_quarantine_insufficient_restarts_floor(small_data):
+    """The loud floor: survivors below min_restarts raise the typed
+    InsufficientRestarts instead of serving a thin consensus; at the
+    default floor (1) a single survivor still serves."""
+    faults.arm("solve.nonfinite", lanes=((2, 0),))
+    with pytest.raises(InsufficientRestarts, match="min_restarts=2"):
+        _consensus(small_data, backend="vmap", grid_exec="per_k",
+                   ks=(2,), restarts=2, min_restarts=2)
+    # same armed generation (no re-arm): the builder is reused and the
+    # default floor accepts the single survivor
+    res = _consensus(small_data, backend="vmap", grid_exec="per_k",
+                     ks=(2,), restarts=2)
+    stops = np.asarray(res.per_k[2].stop_reasons)
+    assert stops[0] == int(StopReason.NUMERIC_FAULT)
+    assert stops[1] != int(StopReason.NUMERIC_FAULT)
+
+
+def test_quarantine_all_lanes_faulted_raises(small_data):
+    faults.arm("solve.nonfinite", lanes=((2, 0), (2, 1)))
+    with pytest.raises(InsufficientRestarts, match="0 of 2"):
+        _consensus(small_data, backend="vmap", grid_exec="per_k",
+                   ks=(2,), restarts=2)
+
+
+# ---------------------------------------------------------------------
+# scheduler watchdog: no Future is ever left pending
+# ---------------------------------------------------------------------
+
+def _fake_raw(req):
+    from nmfx.sweep import KSweepOutput
+
+    m, n = req.a.shape
+    out = {}
+    for k in req.ks:
+        labels = np.arange(n) * k // n
+        cons = (labels[:, None] == labels[None, :]).astype(np.float32)
+        out[k] = KSweepOutput(
+            consensus=cons,
+            iterations=np.full(req.restarts, 7, np.int32),
+            dnorms=np.linspace(0.5, 0.6, req.restarts).astype(
+                np.float32),
+            stop_reasons=np.zeros(req.restarts, np.int32),
+            labels=np.tile(labels, (req.restarts, 1)).astype(np.int32),
+            best_w=np.ones((m, k), np.float32),
+            best_h=np.ones((k, n), np.float32))
+    return out
+
+
+class _FakeEngine:
+    """Minimal scriptable Engine for thread-level chaos (no device)."""
+
+    def __init__(self, compat="shared", solo_failures=0,
+                 packed_fails=False):
+        self.compat = compat
+        self.solo_failures = solo_failures
+        self.packed_fails = packed_fails
+        self.solo_calls = 0
+        self.packed_calls = 0
+
+    def compatibility_key(self, req):
+        return self.compat
+
+    def place(self, req):
+        return None
+
+    def dispatch_solo(self, req, placed, scfg):
+        self.solo_calls += 1
+        if self.solo_failures > 0:
+            self.solo_failures -= 1
+            raise RuntimeError("transient dispatch failure")
+        return _fake_raw(req)
+
+    def dispatch_packed(self, reqs, placed):
+        self.packed_calls += 1
+        if self.packed_fails:
+            raise RuntimeError("packed lane composition failed")
+        return [_fake_raw(r) for r in reqs]
+
+
+def _mat(m=8, n=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.random((m, n)).astype(np.float32)
+
+
+def test_scheduler_death_no_future_left_pending():
+    """The acceptance property: the scheduler dies with one request
+    IN FLIGHT (popped, undispatched) and more queued — the watchdog
+    resolves every one with a typed ServerCrashed chaining the injected
+    fault; nothing hangs, and with restart_scheduler=False subsequent
+    submits are refused typed."""
+    from nmfx.serve import NMFXServer, ServeConfig, ServerCrashed
+
+    faults.arm("serve.scheduler", every=1)
+    cfg = ServeConfig(restart_scheduler=False, watchdog_interval_s=0.05,
+                      pack=False)
+    srv = NMFXServer(cfg, engine=_FakeEngine(compat=None), start=False)
+    with pytest.warns(RuntimeWarning, match="scheduler-crash"):
+        futs = [srv.submit(_mat(), ks=(2,), restarts=2)
+                for _ in range(3)]
+        srv.resume()
+        for f in futs:
+            with pytest.raises(ServerCrashed) as exc:
+                f.result(timeout=30)
+            assert isinstance(exc.value.__cause__, FaultInjected)
+            assert exc.value.__cause__.site == "serve.scheduler"
+    assert all(f.done() for f in futs)  # zero pending futures
+    assert srv.stats()["failed"] == 3
+    with pytest.raises(ServerCrashed):
+        srv.submit(_mat(), ks=(2,), restarts=2)
+    srv.close()  # bounded: close after crash must not hang either
+
+
+def test_scheduler_crash_restarts_and_serves_again():
+    """restart_scheduler=True: pending work at crash time fails loudly
+    (never silently replayed), then a fresh scheduler serves new
+    submissions on the same server."""
+    from nmfx.serve import NMFXServer, ServeConfig, ServerCrashed
+
+    faults.arm("serve.scheduler", every=1, max_fires=1)
+    cfg = ServeConfig(restart_scheduler=True, watchdog_interval_s=0.05,
+                      pack=False)
+    with NMFXServer(cfg, engine=_FakeEngine(compat=None)) as srv:
+        with pytest.warns(RuntimeWarning, match="scheduler restarted"):
+            f1 = srv.submit(_mat(), ks=(2,), restarts=2)
+            with pytest.raises(ServerCrashed):
+                f1.result(timeout=30)
+        f2 = srv.submit(_mat(), ks=(2,), restarts=2)
+        res = f2.result(timeout=30)  # the restarted scheduler serves
+    assert res.per_k[2] is not None
+    assert srv.stats()["failed"] == 1
+    assert srv.stats()["completed"] == 1
+
+
+def test_packed_dispatch_failure_degrades_to_solo():
+    """A failed packed dispatch retries each mate solo: failure
+    isolation becomes per-request and every future resolves with a
+    RESULT (warn-once on the degradation)."""
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    eng = _FakeEngine(compat="shared", packed_fails=True)
+    with NMFXServer(ServeConfig(), engine=eng, start=False) as srv:
+        with pytest.warns(RuntimeWarning,
+                          match="packed-dispatch-fallback"):
+            f1 = srv.submit(_mat(), ks=(2,), restarts=2)
+            f2 = srv.submit(_mat(), ks=(2,), restarts=2)
+            srv.resume()
+            r1 = f1.result(timeout=30)
+            r2 = f2.result(timeout=30)
+    assert eng.packed_calls == 1 and eng.solo_calls == 2
+    assert r1.per_k[2] is not None and r2.per_k[2] is not None
+    assert srv.stats()["completed"] == 2
+
+
+def test_solo_retry_with_backoff_recovers():
+    """A transient solo failure is retried with exponential backoff and
+    the request completes — no typed error reaches the caller."""
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    eng = _FakeEngine(compat=None, solo_failures=2)
+    cfg = ServeConfig(dispatch_retries=2, retry_backoff_s=0.01)
+    t0 = time.monotonic()
+    with NMFXServer(cfg, engine=eng) as srv:
+        with pytest.warns(RuntimeWarning, match="solo-dispatch-retry"):
+            f = srv.submit(_mat(), ks=(2,), restarts=2)
+            res = f.result(timeout=30)
+    assert res.per_k[2] is not None
+    assert eng.solo_calls == 3  # 2 failures + the succeeding attempt
+    assert time.monotonic() - t0 >= 0.01 + 0.02  # backoff really slept
+    assert srv.stats()["completed"] == 1 and srv.stats()["failed"] == 0
+
+
+def test_serve_harvest_worker_fault_recovers_inline():
+    """The serve completion worker passes the harvest.worker site too:
+    an injected worker death re-runs that rank's harvest inline and the
+    request still completes."""
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    faults.arm("harvest.worker", every=1, max_fires=1)
+    with NMFXServer(ServeConfig(), engine=_FakeEngine(compat=None)) \
+            as srv:
+        with pytest.warns(RuntimeWarning,
+                          match="harvest-worker-fallback"):
+            f = srv.submit(_mat(), ks=(2,), restarts=2)
+            res = f.result(timeout=30)
+    assert res.per_k[2] is not None
+    assert faults.fires("harvest.worker") == 1
+    assert srv.stats()["completed"] == 1

@@ -629,6 +629,129 @@ def test_nmfx005_item_call(tmp_path):
     assert ".item()" in findings[0].message
 
 
+# ---------------------------------------------------------------- NMFX006
+
+_HANDLER_BAD = """
+    def fetch(cache, key):
+        try:
+            return cache[key].load()
+        except Exception:
+            return None  # silent degradation: nobody will ever know
+"""
+
+_HANDLER_CLEAN_RERAISE = """
+    class TypedError(RuntimeError):
+        pass
+
+    def fetch(cache, key):
+        try:
+            return cache[key].load()
+        except Exception as e:
+            raise TypedError("load failed") from e
+"""
+
+_HANDLER_CLEAN_FUTURE = """
+    def resolve(fut, work):
+        try:
+            fut.set_result(work())
+        except BaseException as e:
+            fut.set_exception(e)
+"""
+
+_HANDLER_CLEAN_WARN = """
+    from nmfx.faults import warn_once
+
+    def fetch(cache, key, fallback):
+        try:
+            return cache[key].load()
+        except Exception as e:
+            warn_once("cache-fallback", f"degraded ({e!r})")
+            return fallback()
+"""
+
+_HANDLER_CLEAN_NARROW = """
+    def fetch(cache, key):
+        try:
+            return cache[key].load()
+        except KeyError:
+            return None  # narrow: a considered, specific decision
+"""
+
+
+def test_nmfx006_silent_swallow_fires(tmp_path):
+    findings = _lint(tmp_path, _HANDLER_BAD, ["NMFX006"])
+    assert _ids(findings) == ["NMFX006"]
+    assert "except Exception" in findings[0].message
+
+
+def test_nmfx006_bare_except_fires(tmp_path):
+    src = _HANDLER_BAD.replace("except Exception:", "except:")
+    findings = _lint(tmp_path, src, ["NMFX006"])
+    assert _ids(findings) == ["NMFX006"]
+    assert "bare except" in findings[0].message
+
+
+def test_nmfx006_broad_in_tuple_fires(tmp_path):
+    src = _HANDLER_BAD.replace("except Exception:",
+                               "except (KeyError, Exception):")
+    assert _ids(_lint(tmp_path, src, ["NMFX006"])) == ["NMFX006"]
+
+
+def test_nmfx006_reraise_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _HANDLER_CLEAN_RERAISE,
+                      ["NMFX006"])) == []
+
+
+def test_nmfx006_future_resolution_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _HANDLER_CLEAN_FUTURE,
+                      ["NMFX006"])) == []
+
+
+def test_nmfx006_warn_once_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _HANDLER_CLEAN_WARN, ["NMFX006"])) == []
+
+
+def test_nmfx006_scoped_warn_once_variant_quiet(tmp_path):
+    """An instance-level warn-once helper (ExecCache._warn_once) is the
+    same loudness contract with narrower dedup scope — compliant."""
+    src = _HANDLER_CLEAN_WARN.replace(
+        "from nmfx.faults import warn_once\n", "").replace(
+        'warn_once("cache-fallback"', 'cache._warn_once("cache-fallback"')
+    assert _ids(_lint(tmp_path, src, ["NMFX006"])) == []
+
+
+def test_nmfx006_narrow_handler_quiet(tmp_path):
+    assert _ids(_lint(tmp_path, _HANDLER_CLEAN_NARROW,
+                      ["NMFX006"])) == []
+
+
+def test_nmfx006_nested_def_does_not_count(tmp_path):
+    """A warn_once inside a callback DEFINED in the handler runs later
+    — it is not this handler's disposal, so the handler still fires."""
+    src = """
+        from nmfx.faults import warn_once
+
+        def fetch(cache, key):
+            try:
+                return cache[key].load()
+            except Exception as e:
+                def later():
+                    warn_once("cache", f"degraded ({e!r})")
+                return later
+    """
+    assert _ids(_lint(tmp_path, src, ["NMFX006"])) == ["NMFX006"]
+
+
+def test_nmfx006_suppression_with_reason(tmp_path):
+    src = _HANDLER_BAD.replace(
+        "except Exception:",
+        "except Exception:  # nmfx: ignore[NMFX006] -- best-effort")
+    findings = _lint(tmp_path, src, ["NMFX006"])
+    assert _ids(findings) == []  # suppressed findings are not active
+    assert any(f.rule_id == "NMFX006" and f.suppressed
+               for f in findings)
+
+
 # ----------------------------------------------------------- jaxpr layer
 
 def test_jaxpr_f64_leak_detected():

@@ -344,46 +344,70 @@ def test_job_ks_length_validation(jobs):
 
 
 def test_fault_inject_requires_explicit_optin(jobs, monkeypatch, capsys):
-    """The stale-reload fault injection arms ONLY through the explicit
-    ``enable_stale_reload_fault()`` call: an inherited
+    """The stale-reload fault injection arms ONLY through an explicit
+    in-process call — since ISSUE 7 the ``nmfx.faults`` registry (site
+    ``sched.stale_reload``), with ``enable_stale_reload_fault()`` kept
+    as the deprecation shim the ``bench.py --verify`` env→call
+    subprocess protocol targets. An inherited
     NMFX_FAULT_INJECT_STALE_RELOAD env var alone is inert in library
     code (but announces its inertness at import), so a test-harness
     environment can no longer corrupt a production run silently
     (ADVICE.md round 5; ISSUE 3 satellite; lint rule NMFX002)."""
+    from nmfx import faults
     from nmfx.ops import sched_mu
 
-    # env var alone: inert — the library never reads it at trace time
-    monkeypatch.setenv("NMFX_FAULT_INJECT_STALE_RELOAD", "0.5")
-    monkeypatch.setitem(sched_mu._fault_state, "fraction", 0.0)
-    monkeypatch.setitem(sched_mu._fault_state, "announced", False)
-    assert sched_mu._stale_reload_fraction() == 0.0
-    # the import-time notice names the explicit opt-in it now requires
-    sched_mu._warn_inert_env_hook()
-    err = capsys.readouterr().err
-    assert "IGNORED" in err
-    assert "enable_stale_reload_fault" in err
-    # explicit opt-in: arms, and announces loudly exactly once
-    sched_mu.enable_stale_reload_fault(0.5)
-    assert sched_mu._stale_reload_fraction() == 0.5
-    err = capsys.readouterr().err
-    assert "ARMED" in err
-    assert "INVALID" in err
-    sched_mu.enable_stale_reload_fault(0.5)
-    assert "ARMED" not in capsys.readouterr().err
-    # and the armed state is what the reload path consumes: the mask
-    # now drops factor writes (identity when disarmed)
-    load = jnp.ones((8,), bool)
-    gather = jnp.arange(8, dtype=jnp.int32)
-    masked = np.asarray(sched_mu._stale_load_mask(load, gather))
-    assert masked.sum() < 8  # some reloads deliberately dropped
-    monkeypatch.setitem(sched_mu._fault_state, "fraction", 0.0)
-    np.testing.assert_array_equal(
-        np.asarray(sched_mu._stale_load_mask(load, gather)),
-        np.asarray(load))
-    # out-of-range fractions are rejected
-    with pytest.raises(ValueError, match="fraction"):
-        sched_mu.enable_stale_reload_fault(1.5)
-    # unset env: the import-time notice stays silent
-    monkeypatch.delenv("NMFX_FAULT_INJECT_STALE_RELOAD")
-    sched_mu._warn_inert_env_hook()
-    assert "NMFX_FAULT_INJECT" not in capsys.readouterr().err
+    faults.disarm("sched.stale_reload")
+    try:
+        # env var alone: inert — the library never reads it at trace
+        # time
+        monkeypatch.setenv("NMFX_FAULT_INJECT_STALE_RELOAD", "0.5")
+        monkeypatch.setitem(sched_mu._announced, "done", False)
+        assert sched_mu._stale_reload_fraction() == 0.0
+        # the import-time notice names the explicit opt-in it requires
+        sched_mu._warn_inert_env_hook()
+        err = capsys.readouterr().err
+        assert "IGNORED" in err
+        assert "enable_stale_reload_fault" in err
+        # explicit opt-in: the SHIM arms the registry (deprecation
+        # warning + the loud banner, banner exactly once)
+        with pytest.deprecated_call():
+            sched_mu.enable_stale_reload_fault(0.5)
+        assert sched_mu._stale_reload_fraction() == 0.5
+        spec = faults.armed("sched.stale_reload")
+        assert spec is not None and spec.rate == 0.5
+        err = capsys.readouterr().err
+        assert "ARMED" in err
+        assert "INVALID" in err
+        with pytest.deprecated_call():
+            sched_mu.enable_stale_reload_fault(0.5)
+        assert "ARMED" not in capsys.readouterr().err
+        # direct registry arming is equivalent (the canonical spelling)
+        faults.arm("sched.stale_reload", rate=0.25)
+        assert sched_mu._stale_reload_fraction() == 0.25
+        faults.arm("sched.stale_reload", rate=0.5)
+        # and the armed state is what the reload path consumes: the
+        # mask now drops factor writes (identity when disarmed)
+        load = jnp.ones((8,), bool)
+        gather = jnp.arange(8, dtype=jnp.int32)
+        masked = np.asarray(sched_mu._stale_load_mask(load, gather))
+        assert masked.sum() < 8  # some reloads deliberately dropped
+        faults.disarm("sched.stale_reload")
+        np.testing.assert_array_equal(
+            np.asarray(sched_mu._stale_load_mask(load, gather)),
+            np.asarray(load))
+        # arming a trace-affecting site keys the builder caches
+        assert faults.trace_token() is None
+        faults.arm("sched.stale_reload", rate=0.5)
+        tok = faults.trace_token()
+        assert tok is not None
+        faults.disarm("sched.stale_reload")
+        assert faults.trace_token() is None
+        # out-of-range fractions are rejected
+        with pytest.raises(ValueError, match="fraction"):
+            sched_mu.enable_stale_reload_fault(1.5)
+        # unset env: the import-time notice stays silent
+        monkeypatch.delenv("NMFX_FAULT_INJECT_STALE_RELOAD")
+        sched_mu._warn_inert_env_hook()
+        assert "NMFX_FAULT_INJECT" not in capsys.readouterr().err
+    finally:
+        faults.disarm("sched.stale_reload")

@@ -424,15 +424,113 @@ def test_close_cancel_pending_fails_queued():
     assert eng.solo == []
 
 
+class _SlowStartEngine(FakeEngine):
+    """FakeEngine that signals when a dispatch has ENTERED the engine —
+    the close()-race tests use it to call close() while a dispatch is
+    genuinely in flight, not merely queued."""
+
+    def __init__(self, compat="shared", delay=0.25, packed_fails=False):
+        super().__init__(compat=compat, delay=delay)
+        self.packed_fails = packed_fails
+        self.started = threading.Event()
+
+    def dispatch_packed(self, reqs, placed):
+        self.started.set()
+        if self.packed_fails:
+            raise RuntimeError("packed path down")
+        return super().dispatch_packed(reqs, placed)
+
+    def dispatch_solo(self, req, placed, scfg):
+        self.started.set()
+        return super().dispatch_solo(req, placed, scfg)
+
+
+def test_close_races_inflight_packed_dispatch():
+    """ISSUE 7 satellite: close() called while a PACKED dispatch is in
+    flight must drain it — both packed requests resolve with RESULTS,
+    no future is left unresolved, and the close returns only after the
+    harvest queue is empty."""
+    eng = _SlowStartEngine(delay=0.25)
+    srv = NMFXServer(ServeConfig(), engine=eng, start=False)
+    f1 = srv.submit(_mat(), ks=(2,), restarts=2)
+    f2 = srv.submit(_mat(), ks=(2,), restarts=2)
+    srv.resume()
+    assert eng.started.wait(timeout=10)
+    srv.close()  # racing the in-flight packed dispatch
+    # drained: both futures already resolved when close() returned
+    assert f1.done() and f2.done()
+    assert f1.result(timeout=0).per_k[2] is not None
+    assert f2.result(timeout=0).per_k[2] is not None
+    assert eng.packed == [tuple(sorted(p)) for p in eng.packed]
+    assert srv.stats()["completed"] == 2
+
+
+def test_close_races_inflight_solo_fallback():
+    """close() racing the solo FALLBACK of a failed packed dispatch:
+    the degraded per-request solo retries still run to completion under
+    close — every future resolves with a result."""
+    import nmfx.faults as faults
+
+    faults._reset_warned()
+    eng = _SlowStartEngine(delay=0.2, packed_fails=True)
+    srv = NMFXServer(ServeConfig(dispatch_retries=1,
+                                 retry_backoff_s=0.01),
+                     engine=eng, start=False)
+    f1 = srv.submit(_mat(), ks=(2,), restarts=2)
+    f2 = srv.submit(_mat(), ks=(2,), restarts=2)
+    srv.resume()
+    assert eng.started.wait(timeout=10)
+    srv.close()  # racing the in-flight solo fallback
+    assert f1.done() and f2.done()
+    assert f1.result(timeout=0).per_k[2] is not None
+    assert f2.result(timeout=0).per_k[2] is not None
+    assert len(eng.solo) == 2  # both mates degraded to solo
+    assert srv.stats()["completed"] == 2
+
+
+def test_close_cancel_pending_spares_inflight():
+    """close(cancel_pending=True) racing a dispatch: the IN-FLIGHT
+    request completes with a result, queued-undispatched ones fail with
+    ServerClosed — and nothing is left unresolved either way."""
+    eng = _SlowStartEngine(compat=None, delay=0.25)
+    srv = NMFXServer(ServeConfig(pack=False), engine=eng, start=False)
+    futs = [srv.submit(_mat(), ks=(2,), restarts=2) for _ in range(3)]
+    srv.resume()
+    assert eng.started.wait(timeout=10)  # head is in flight
+    srv.close(cancel_pending=True)
+    assert all(f.done() for f in futs)
+    outcomes = []
+    for f in futs:
+        try:
+            outcomes.append(type(f.result(timeout=0)).__name__)
+        except ServerClosed:
+            outcomes.append("ServerClosed")
+    # exactly the in-flight head completed; the rest were refused typed
+    assert outcomes.count("ConsensusResult") == 1
+    assert outcomes.count("ServerClosed") == 2
+
+
 def test_engine_failure_propagates_to_futures():
+    """A permanently failing dispatch resolves the future with the
+    typed RequestFailed (ISSUE 7) whose __cause__ chains the underlying
+    engine error — after exhausting the configured solo retries."""
+    from nmfx.serve import RequestFailed
+
+    attempts = []
+
     class Boom(FakeEngine):
         def dispatch_solo(self, req, placed, scfg):
+            attempts.append(time.monotonic())
             raise RuntimeError("device on fire")
 
-    with NMFXServer(ServeConfig(), engine=Boom(compat=None)) as srv:
+    cfg = ServeConfig(dispatch_retries=2, retry_backoff_s=0.01)
+    with NMFXServer(cfg, engine=Boom(compat=None)) as srv:
         f = srv.submit(_mat(), ks=(2,), restarts=2)
-        with pytest.raises(RuntimeError, match="device on fire"):
+        with pytest.raises(RequestFailed) as exc:
             f.result(timeout=30)
+    assert isinstance(exc.value.__cause__, RuntimeError)
+    assert "device on fire" in str(exc.value.__cause__)
+    assert len(attempts) == 3  # 1 attempt + dispatch_retries
     assert srv.stats()["failed"] == 1
 
 
