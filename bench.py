@@ -584,6 +584,52 @@ def run_verify(args) -> int:
     return 0 if ok else 1
 
 
+def run_durability_child(args) -> int:
+    """The durability rung's kill-at-~50% subprocess body: build the
+    SAME matrix/config as the parent (deterministic from the args), arm
+    ``proc.preempt`` to fire once after ``--preempt-after`` chunk
+    solves, and run the checkpointed sweep. The injected preemption
+    lands AFTER a chunk's device solve and BEFORE its commit — the
+    worst realistic kill point: that chunk's work is lost
+    (``wasted_work_frac``), every committed record survives — and the
+    child exits 137 (the SIGKILL code) for the parent to assert."""
+    import numpy as np  # noqa: F401  (grouped_matrix returns ndarray)
+
+    from nmfx import checkpoint as ckpt
+    from nmfx import faults
+    from nmfx.api import nmfconsensus
+    from nmfx.config import CheckpointConfig, SolverConfig
+    from nmfx.datasets import grouped_matrix
+
+    if args.preempt_after is None or args.durability_chunk is None:
+        print("bench: --durability-child needs --preempt-after and "
+              "--durability-chunk", file=sys.stderr)
+        return 2
+    sizes = [args.samples // 4] * 4
+    sizes[0] += args.samples % 4
+    a = grouped_matrix(args.genes, tuple(sizes), effect=2.0, seed=0)
+    scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter,
+                        matmul_precision=args.precision,
+                        backend=args.backend)
+    faults.arm("proc.preempt", every=args.preempt_after, max_fires=1)
+    cfg = CheckpointConfig(args.durability_child,
+                           every_n_restarts=args.durability_chunk)
+    try:
+        nmfconsensus(a, ks=tuple(range(2, args.kmax + 1)),
+                     restarts=args.restarts, seed=123, solver_cfg=scfg,
+                     checkpoint=cfg)
+    except ckpt.Preempted:
+        print(json.dumps({"durability_child": {
+            "solved_chunks": ckpt.chunks_solved_count()}}), flush=True)
+        os._exit(137)  # the preemption: no teardown, like SIGKILL
+    # preempt never fired: the parent's chunk arithmetic is wrong —
+    # report loudly so the stage gates on it
+    print(json.dumps({"durability_child": {
+        "solved_chunks": ckpt.chunks_solved_count(),
+        "completed_without_preempt": True}}), flush=True)
+    return 3
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--genes", type=int, default=5000)
@@ -623,6 +669,16 @@ def main():
                    help="whole-grid single-compile execution vs sequential "
                         "per-rank (ConsensusConfig.grid_exec)")
     p.add_argument("--target-s", type=float, default=10.0)
+    # internal: the durability rung's kill-at-50% subprocess re-enters
+    # THIS entrypoint with these flags (the probe_fault_gate discipline:
+    # the child translates its CLI args into explicit in-process fault
+    # arming — env vars stay inert)
+    p.add_argument("--durability-child", default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--preempt-after", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--durability-chunk", type=int, default=None,
+                   help=argparse.SUPPRESS)
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache directory: a "
                         "SECOND bench session re-loads this session's "
@@ -648,6 +704,8 @@ def main():
                               args.compile_cache)
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 0.1)
+    if args.durability_child:
+        raise SystemExit(run_durability_child(args))
     import numpy as np
 
     from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
@@ -1121,6 +1179,112 @@ def main():
               f"{cold_wall[args.backend]:.2f}s", file=sys.stderr)
         return out
 
+    # --- durability rung (ISSUE 9, detail.durability) ------------------
+    # Kill a checkpointed
+    # sweep subprocess at ~50% chunk completion (the injected preemption
+    # lands between a chunk's solve and its commit — the in-flight
+    # chunk is LOST), resume it in-process, and gate the resumed result
+    # BIT-IDENTICAL against an uninterrupted checkpointed reference of
+    # the same plan (exit 2 on mismatch). Books resume_overhead_s (the
+    # resume wall beyond the missing chunks' pro-rata share of the full
+    # wall: ledger scan + manifest validation + re-warm) and
+    # wasted_work_frac (chunks solved more than once across kill+resume
+    # — exactly the in-flight chunk the preemption discarded).
+    def run_durability_stage():
+        import shutil
+        import subprocess
+        import tempfile
+
+        from nmfx import checkpoint as ckpt
+        from nmfx.api import nmfconsensus
+        from nmfx.config import CheckpointConfig
+
+        scfg_d = cfgs[args.backend]
+        ks_d = ks[:2]
+        restarts_d = min(args.restarts, 8)
+        chunk_d = max(1, restarts_d // 4)
+        plan = ckpt.plan_chunks(restarts_d, chunk_d)
+        total_chunks = len(plan) * len(ks_d)
+        ref_dir = tempfile.mkdtemp(prefix="nmfx-bench-dur-ref-")
+        kill_dir = tempfile.mkdtemp(prefix="nmfx-bench-dur-kill-")
+
+        def gate(probs):
+            if probs:
+                for prob in probs:
+                    print(f"bench DURABILITY PARITY FAILURE: {prob}",
+                          file=sys.stderr)
+                raise SystemExit(2)
+
+        try:
+            t0 = time.perf_counter()
+            ref = nmfconsensus(
+                a, ks=ks_d, restarts=restarts_d, seed=seed,
+                solver_cfg=scfg_d,
+                checkpoint=CheckpointConfig(ref_dir,
+                                            every_n_restarts=chunk_d))
+            full_wall = time.perf_counter() - t0
+            preempt_after = max(1, total_chunks // 2)
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--durability-child", kill_dir,
+                   "--preempt-after", str(preempt_after),
+                   "--durability-chunk", str(chunk_d),
+                   "--genes", str(args.genes),
+                   "--samples", str(args.samples),
+                   "--kmax", str(ks_d[-1]),
+                   "--restarts", str(restarts_d),
+                   "--maxiter", str(args.maxiter),
+                   "--precision", args.precision,
+                   "--algorithm", args.algorithm,
+                   "--backend", args.backend]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 137:
+                print("bench DURABILITY FAILURE: kill-at-50% child "
+                      f"exited {proc.returncode}, expected 137 "
+                      "(injected preemption)\n"
+                      + proc.stderr[-2000:], file=sys.stderr)
+                raise SystemExit(2)
+            child_solved = None
+            for line in proc.stdout.splitlines():
+                try:
+                    child_solved = json.loads(
+                        line)["durability_child"]["solved_chunks"]
+                except (ValueError, KeyError, TypeError):
+                    continue
+            persisted = sum(
+                1 for name in os.listdir(kill_dir)
+                if name.startswith("k") and name.endswith(".npz"))
+            before = ckpt.chunks_solved_count()
+            t0 = time.perf_counter()
+            res = nmfconsensus(
+                a, ks=ks_d, restarts=restarts_d, seed=seed,
+                solver_cfg=scfg_d,
+                checkpoint=CheckpointConfig(kill_dir,
+                                            every_n_restarts=chunk_d))
+            resume_wall = time.perf_counter() - t0
+            resumed = ckpt.chunks_solved_count() - before
+            gate(_serve_parity_problems(res, ref,
+                                        "durability kill-resume"))
+            solved_total = (child_solved if child_solved is not None
+                            else persisted) + resumed
+            wasted = (solved_total - total_chunks) / total_chunks
+            overhead = resume_wall - full_wall * (
+                (total_chunks - persisted) / total_chunks)
+            return {
+                "total_chunks": total_chunks,
+                "chunk_restarts": chunk_d,
+                "persisted_at_kill": persisted,
+                "child_solved_chunks": child_solved,
+                "resumed_chunks": resumed,
+                "full_wall_s": round(full_wall, 3),
+                "resume_wall_s": round(resume_wall, 3),
+                "resume_overhead_s": round(max(overhead, 0.0), 3),
+                "wasted_work_frac": round(max(wasted, 0.0), 4),
+                "parity": "ok",
+            }
+        finally:
+            shutil.rmtree(ref_dir, ignore_errors=True)
+            shutil.rmtree(kill_dir, ignore_errors=True)
+
     # --- serve traffic stage (nmfx.serve) ------------------------------
     # Multi-tenant serving under load: Poisson arrivals over an
     # offered-load ladder into ONE NMFXServer (async request queue +
@@ -1436,6 +1600,10 @@ def main():
     print(f"bench: serve traffic stage: {json.dumps(traffic)}",
           file=sys.stderr)
 
+    durability = run_durability_stage()
+    print(f"bench: durability stage: {json.dumps(durability)}",
+          file=sys.stderr)
+
     # regression tracking: compare against the best prior round's record
     # (the warm metric drifted 1.384 s → 2.041/1.848 s across r03-r05
     # with nothing in the record to flag it) and stamp this run's
@@ -1486,6 +1654,7 @@ def main():
             "best_prior": best_prior,
             "exec_cache": serving,
             "serve": traffic,
+            "durability": durability,
             # cold_wall_s/compile_wall_s are first-session numbers; with
             # a persistent cache dir a second session's cold run re-loads
             # these programs from disk instead of recompiling

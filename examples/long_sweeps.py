@@ -29,6 +29,22 @@ print(f"resumed sweep: {time.perf_counter() - t0:.2f}s "
       "(ranks loaded from checkpoint)")
 assert resumed.summary() == result.summary()
 
+# the DURABLE ledger (docs/serving.md "Durability model") goes finer:
+# per-(rank, restart-chunk) completion records, so even a kill -9
+# mid-RANK loses at most one chunk, and the resumed result is
+# bit-identical to an uninterrupted checkpointed run
+cfg = nmfx.CheckpointConfig("ckpt_demo_chunks", every_n_restarts=5)
+t0 = time.perf_counter()
+durable = nmfx.nmfconsensus(a, ks=(2, 3, 4), restarts=10, seed=42,
+                            checkpoint=cfg, output=None)
+print(f"\ndurable chunked sweep: {time.perf_counter() - t0:.2f}s")
+t0 = time.perf_counter()
+durable2 = nmfx.nmfconsensus(a, ks=(2, 3, 4), restarts=10, seed=42,
+                             checkpoint=cfg, output=None)
+print(f"durable resume: {time.perf_counter() - t0:.2f}s "
+      "(every chunk loaded from its completion record)")
+assert durable2.summary() == durable.summary()
+
 # persist everything for later analysis without rerunning
 result.save("result_demo.npz")
 later = nmfx.ConsensusResult.load("result_demo.npz")

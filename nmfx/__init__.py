@@ -11,6 +11,7 @@ accumulation kept on-device.
 """
 
 from nmfx.config import (
+    CheckpointConfig,
     ConsensusConfig,
     ExecCacheConfig,
     ExperimentalConfig,
@@ -40,6 +41,7 @@ from nmfx.sweep import (
 from nmfx.config import VERSION as __version__
 
 __all__ = [
+    "CheckpointConfig",
     "ConsensusConfig",
     "ExperimentalConfig",
     "ConsensusResult",

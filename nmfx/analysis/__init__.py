@@ -17,6 +17,8 @@ NMFX004    PRNG discipline (key reuse, host RNG in traced code)
 NMFX005    implicit host syncs in traced/hot-path code
 NMFX006    silent degradation: broad except must re-raise, resolve a
            Future, or route through nmfx.faults.warn_once
+NMFX007    checkpoint-manifest coverage (the durable sweep ledger's
+           resume-safety fingerprint, nmfx/checkpoint.py)
 NMFX101    engine jaxpr stays f32 under x64 parity (jaxpr layer)
 NMFX102    no device_put inside engine loop bodies (jaxpr layer)
 =========  ==============================================================

@@ -254,6 +254,118 @@ def _live_universe():
     )
 
 
+def check_manifest_coverage(
+    solver_fields: "frozenset[str]",
+    consensus_fields: "frozenset[str]",
+    manifest_solver: "frozenset[str]",
+    manifest_consensus: "frozenset[str]",
+    declared_non_numerics: "tuple[str, ...]",
+    manifest_consensus_excluded: "tuple[str, ...]",
+    declared_checkpoint_exempt: "tuple[str, ...]",
+) -> "list[str]":
+    """NMFX007's pure contract check (the ``check_config_coverage``
+    pattern): every result-affecting ``SolverConfig``/``ConsensusConfig``
+    field must appear in ``checkpoint.manifest_key_fields()`` or be
+    explicitly declared exempt — a field invisible to the manifest lets
+    a durable-sweep ledger written under one configuration resume under
+    another (plausible records, wrong numbers, no crash: the
+    stale-resume class). Tests inject mutated universes; the Rule
+    wrapper reads the live modules."""
+    problems: "list[str]" = []
+    # 1. declarations must not go stale
+    for name in declared_checkpoint_exempt:
+        if name not in consensus_fields:
+            problems.append(
+                f"ConsensusConfig.CHECKPOINT_EXEMPT_FIELDS names {name!r}, "
+                "which is not a ConsensusConfig field — stale declaration")
+    # 2. every manifest exclusion must be a declared exempt field
+    for name in manifest_consensus_excluded:
+        if name not in declared_checkpoint_exempt:
+            problems.append(
+                f"ConsensusConfig.{name} is excluded from the checkpoint "
+                "manifest (checkpoint.MANIFEST_CONSENSUS_EXCLUDED) but "
+                "not declared in "
+                "ConsensusConfig.CHECKPOINT_EXEMPT_FIELDS — a result-"
+                "affecting field excluded from the manifest resumes "
+                "stale ledgers silently")
+    # 3. every SolverConfig field must reach the manifest unless it is
+    #    declared execution-strategy-only (the registry-fingerprint
+    #    discipline, shared declaration)
+    for name in sorted(solver_fields - manifest_solver):
+        if name not in declared_non_numerics:
+            problems.append(
+                f"SolverConfig.{name} does not reach the checkpoint "
+                "manifest (checkpoint.manifest_key_fields()['solver']) "
+                "and is not declared in NON_NUMERICS_FIELDS — ledgers "
+                "written under different values of it would resume "
+                "interchangeably")
+    # 4. every ConsensusConfig field must reach the manifest unless
+    #    declared checkpoint-exempt (with its rationale on record)
+    for name in sorted(consensus_fields - manifest_consensus):
+        if name not in declared_checkpoint_exempt:
+            problems.append(
+                f"ConsensusConfig.{name} does not reach the checkpoint "
+                "manifest (checkpoint.manifest_key_fields()"
+                "['consensus']) and is not declared in "
+                "CHECKPOINT_EXEMPT_FIELDS — ledgers written under "
+                "different values of it would resume interchangeably")
+    return problems
+
+
+def _live_manifest_universe():
+    from nmfx import checkpoint
+    from nmfx.config import ConsensusConfig, SolverConfig
+
+    covered = checkpoint.manifest_key_fields()
+    return dict(
+        solver_fields=frozenset(
+            f.name for f in dataclasses.fields(SolverConfig)),
+        consensus_fields=frozenset(
+            f.name for f in dataclasses.fields(ConsensusConfig)),
+        manifest_solver=covered["solver"],
+        manifest_consensus=covered["consensus"],
+        declared_non_numerics=tuple(SolverConfig.NON_NUMERICS_FIELDS),
+        manifest_consensus_excluded=tuple(
+            checkpoint.MANIFEST_CONSENSUS_EXCLUDED),
+        declared_checkpoint_exempt=tuple(
+            ConsensusConfig.CHECKPOINT_EXEMPT_FIELDS),
+    )
+
+
+@register
+class CheckpointManifestCoverage(Rule):
+    """NMFX007: every result-affecting SolverConfig/ConsensusConfig
+    field must reach the durable-sweep checkpoint manifest
+    (``nmfx.checkpoint.manifest_key_fields``) or be explicitly declared
+    exempt with its rationale."""
+
+    rule_id = "NMFX007"
+    title = "checkpoint-manifest coverage"
+
+    def check(self, project) -> "Iterable[Finding]":
+        # semantic whole-package rule, same gating as NMFX001: run only
+        # when the real package is the analyzed set, and only against
+        # the checkout the import machinery actually resolves
+        import os
+
+        analyzed_cfg = next(
+            (m.path for m in project.modules
+             if m.path.replace("\\", "/").endswith("nmfx/config.py")),
+            None)
+        if analyzed_cfg is None:
+            return []
+        from nmfx.config import ConsensusConfig
+
+        cfg_file, cfg_line = _decl_site(ConsensusConfig, "nmfx/config.py")
+        if os.path.abspath(cfg_file) != os.path.abspath(analyzed_cfg):
+            # NMFX001 already reports the wrong-tree condition loudly;
+            # don't double-report it per rule
+            return []
+        return [self.finding(cfg_file, cfg_line, msg)
+                for msg in check_manifest_coverage(
+                    **_live_manifest_universe())]
+
+
 @register
 class ConfigFingerprintCoverage(Rule):
     """NMFX001: every numerics-affecting config field must reach the

@@ -355,6 +355,7 @@ def nmfconsensus(
     min_restarts: int = 1,
     output: OutputConfig | None = None,
     checkpoint_dir: str | None = None,
+    checkpoint=None,
     profiler=None,
     exec_cache=None,
 ) -> ConsensusResult:
@@ -368,6 +369,19 @@ def nmfconsensus(
     ``checkpoint_dir``: persist each finished rank there and resume an
     interrupted sweep from the ranks already on disk (guarded by a fingerprint
     of the data + configs, so a registry never serves a different run).
+
+    ``checkpoint`` (an ``nmfx.CheckpointConfig``, or a directory path):
+    the DURABLE sweep ledger (``nmfx/checkpoint.py``, docs/serving.md
+    "Durability model") — finer-grained than ``checkpoint_dir``:
+    per-(rank, restart-chunk) completion records with atomic writes and
+    torn-record tolerance, so a preempted/killed process loses at most
+    the chunk in flight and a re-run recomputes ONLY the missing
+    chunks, bit-identical to an uninterrupted checkpointed run. A
+    manifest mismatch (different data/config/env/plan) triggers a clean
+    cold start, never a wrong resume. Raises on combination with
+    ``checkpoint_dir``, ``keep_factors``, an explicit ``mesh``, or
+    ``exec_cache`` (the chunk executor owns its execution plan; see
+    ``nmfx.distributed`` for elastic multi-device durable sweeps).
 
     ``rank_selection``: "host" (default) runs hclust/cophenetic/cutree in
     host numpy or native C++ (``nmfx/cophenetic.py``); "device" keeps the
@@ -452,6 +466,31 @@ def nmfconsensus(
                            grid_tail_slots=grid_tail_slots,
                            min_restarts=min_restarts)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
+    if checkpoint is not None:
+        from nmfx.config import CheckpointConfig
+
+        if isinstance(checkpoint, (str, os.PathLike)):
+            checkpoint = CheckpointConfig(directory=os.fspath(checkpoint))
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "pass either checkpoint (the durable chunked ledger) or "
+                "checkpoint_dir (the legacy per-rank registry), not both")
+        if mesh is not None:
+            raise ValueError(
+                "checkpoint does not compose with an explicit mesh: the "
+                "chunk executor owns its per-(k, restart-chunk) "
+                "execution plan on the default device (use "
+                "nmfx.distributed's elastic shard runner for "
+                "multi-device durable sweeps)")
+        if exec_cache is not None:
+            # erroring beats silently discarding a cache the caller may
+            # have paid warmup compiles into (the CLI guard's rationale)
+            raise ValueError(
+                "checkpoint does not compose with exec_cache: "
+                "checkpointed sweeps dispatch per (rank, restart-chunk) "
+                "through the durable ledger, which bypasses the "
+                "bucketed executable cache")
+        use_mesh = False  # the chunk plan is the parallelism unit
     if mesh is None and use_mesh:
         mesh = default_mesh()
 
@@ -483,7 +522,7 @@ def nmfconsensus(
         try:
             sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
                   profiler=profiler, exec_cache=exec_cache,
-                  on_rank=pipeline.submit)
+                  on_rank=pipeline.submit, checkpoint=checkpoint)
             per_k = pipeline.results()
         finally:
             pipeline.close()
@@ -492,7 +531,8 @@ def nmfconsensus(
         per_k = {k: per_k[k] for k in ccfg.ks}
     else:
         raw = sweep(arr, ccfg, scfg, icfg, mesh, registry=registry,
-                    profiler=profiler, exec_cache=exec_cache)
+                    profiler=profiler, exec_cache=exec_cache,
+                    checkpoint=checkpoint)
 
         # Device-path rank selection is dispatched for every k BEFORE
         # anything is pulled to host, so the clustering overlaps the

@@ -1,0 +1,705 @@
+"""Durable sweeps: checkpoint/resume ledger, preemption tolerance, and
+the chunk execution engine behind them.
+
+PR 7 made the serving stack survive in-process faults; this module makes
+the PROCESS expendable: a preemption, OOM-kill, or host crash 90%
+through a large ``ks x restarts`` sweep no longer loses every completed
+restart. Distributed out-of-memory NMF (arxiv 2202.09518) assumes
+exactly this block-resumable execution, and MPI-FAUN-style restart-grid
+sharding (arxiv 1609.09154) is only production-viable when losing one
+device/host re-runs that shard's work, not the whole job — the elastic
+runner in ``nmfx/distributed.py`` builds on this ledger.
+
+Design:
+
+* **Deterministic chunk plan.** Each rank's restarts partition into
+  fixed boundaries ``[0,c), [c,2c), ...`` (``CheckpointConfig
+  .every_n_restarts``; default one chunk per rank). The plan is
+  persisted in the manifest, so the killed run, the resume, and any
+  uninterrupted reference all execute the IDENTICAL per-chunk batch
+  compositions — the property that makes resume bit-identical even on
+  engines whose per-lane float results depend on batch composition.
+* **Content-addressed manifest.** The input matrix (its
+  ``data_cache.DataKey`` content fingerprint), every result-affecting
+  ``SolverConfig``/``ConsensusConfig``/``InitConfig`` field (the
+  coverage :func:`manifest_key_fields` declares and lint rule NMFX007
+  enforces — the ``exec_cache`` persist-key discipline), and the
+  jax/device environment. A mismatch on open triggers a clean COLD
+  START (warn + clear records + recompute), never a wrong resume and
+  never a crash.
+* **Per-(k, restart-chunk) completion records.** Atomic tmp+rename
+  writes; a torn/corrupt/mismatched record is skipped with one warning
+  and its chunk re-runs (self-healing, like ``SweepRegistry.try_load``).
+  Records hold per-restart labels/iterations/dnorms/stop-reasons plus
+  the chunk's best-restart candidate — everything finalize needs.
+* **Order-free exact finalize.** The consensus accumulates from the
+  per-restart label records in canonical restart order as INTEGER
+  connectivity counts (host int64 — exact, associative), then divides
+  by the quarantine survivor count in float64: bit-identical regardless
+  of which chunks loaded from disk and which re-ran, and regardless of
+  completion order. Best-restart selection replays the global
+  first-minimum ``argmin`` over the assembled dnorm array.
+* **Preemption tolerance.** ``faults.fire("proc.preempt")`` between a
+  chunk's solve and its commit raises :class:`Preempted` (the rehearsal
+  for SIGKILL landing mid-chunk: the in-flight chunk is lost, every
+  committed record survives); :func:`install_signal_flush` hooks
+  SIGTERM/SIGINT to flush any time-batched (``every_s``) buffered
+  records before the process dies.
+
+Contract note: a checkpointed run is bit-identical to every other
+checkpointed run of the same (data, config, plan) — interrupted or not
+— but agrees with the NON-checkpointed sweep only to float tolerance
+(the device path reduces the consensus in float32 on-device; engines
+with batch-composition-dependent reduction orders also regroup).
+``tests/test_checkpoint.py`` pins both sides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import re
+import signal
+import threading
+import time
+
+import numpy as np
+
+from nmfx.config import (CheckpointConfig, ConsensusConfig, InitConfig,
+                         SolverConfig)
+
+__all__ = ["MANIFEST_CONSENSUS_EXCLUDED", "Preempted", "SweepCheckpoint",
+           "chunks_loaded_count", "chunks_solved_count", "engine_family",
+           "install_signal_flush", "manifest_key_fields", "plan_chunks",
+           "run_checkpointed_sweep", "solve_chunk_host"]
+
+_log = logging.getLogger("nmfx")
+
+_MANIFEST_NAME = "manifest.json"
+#: completion-record filenames — the ONLY files (plus the ledger's own
+#: shard heartbeats below) a cold-start clear may delete; the legacy
+#: SweepRegistry's per-rank ``k<k>.npz`` and any user files in the
+#: directory are never touched
+_RECORD_RE = re.compile(r"^k\d+_r\d+-\d+\.npz$")
+#: shard heartbeat files (:meth:`SweepCheckpoint.heartbeat`) — cleared
+#: on cold start too, or a prior incarnation's stale heartbeats would
+#: report phantom dead shards through :meth:`shard_status`
+_SHARD_RE = re.compile(r"^shard_\d+\.json$")
+#: v1: ISSUE 9 — the initial durable-ledger format
+_FORMAT_VERSION = 1
+
+#: AUTHORITATIVE list of ConsensusConfig fields excluded from the
+#: checkpoint manifest. Every entry must be declared checkpoint-exempt
+#: in ``ConsensusConfig.CHECKPOINT_EXEMPT_FIELDS`` (which records the
+#: per-field rationale) — lint rule NMFX007 cross-references the two
+#: lists, so a result-affecting field can never be dropped from the
+#: manifest silently (the stale-resume class NMFX001 kills for the
+#: registry fingerprint).
+MANIFEST_CONSENSUS_EXCLUDED = ("ks", "linkage", "min_restarts",
+                               "keep_factors", "grid_exec", "grid_slots",
+                               "grid_tail_slots")
+
+
+class Preempted(BaseException):
+    """The armed ``proc.preempt`` fault site fired between a chunk's
+    solve and its commit — the chaos rehearsal of a preemption/SIGKILL
+    landing mid-chunk. ``BaseException`` on purpose: no graceful
+    ``except Exception`` recovery layer (serve retries, harvest
+    fallbacks) may swallow a preemption and keep computing."""
+
+
+# -- honesty counters ------------------------------------------------------
+_counter_lock = threading.Lock()
+_chunks_solved = 0
+_chunks_loaded = 0
+
+
+def chunks_solved_count() -> int:
+    """Restart-chunks this process actually SOLVED on device through the
+    checkpoint engine (loaded records do not count) — the counter the
+    resume contract is gated on: a fully-checkpointed re-run must leave
+    it untouched."""
+    return _chunks_solved
+
+
+def chunks_loaded_count() -> int:
+    """Restart-chunks served from completion records on disk."""
+    return _chunks_loaded
+
+
+def _note(solved: int = 0, loaded: int = 0) -> None:
+    global _chunks_solved, _chunks_loaded
+    with _counter_lock:
+        _chunks_solved += solved
+        _chunks_loaded += loaded
+
+
+# -- manifest --------------------------------------------------------------
+def engine_family(solver_cfg: SolverConfig) -> str:
+    """The engine the CHUNK EXECUTOR runs this configuration through
+    (``sweep._build_chunk_sweep_fn``): "pallas"/"packed" for the
+    packed-family mu backends, "vmap" (the generic driver) for
+    everything else — including the non-mu whole-grid opt-ins, whose
+    slot-scheduled engine has no explicit-key chunk form. Hashed into
+    the manifest so a ledger can never resume under a different engine
+    family."""
+    from nmfx.sweep import _use_packed
+
+    if solver_cfg.backend == "pallas":
+        return "pallas"
+    return "packed" if _use_packed(solver_cfg) else "vmap"
+
+
+def manifest_key_fields() -> "dict[str, frozenset]":
+    """The config fields the checkpoint manifest covers, per config
+    class — the introspection hook lint rule NMFX007 cross-references
+    (the NMFX001 discipline): every result-affecting
+    ``SolverConfig``/``ConsensusConfig`` field must appear here or be
+    declared execution-strategy-/finalize-only. The manifest payload is
+    BUILT from these sets (``_fingerprint``), so the hook cannot drift
+    from the hash."""
+    from nmfx.registry import FINGERPRINT_SOLVER_EXCLUDED
+
+    return {
+        "solver": (frozenset(f.name
+                             for f in dataclasses.fields(SolverConfig))
+                   - set(FINGERPRINT_SOLVER_EXCLUDED)),
+        "consensus": (frozenset(
+            f.name for f in dataclasses.fields(ConsensusConfig))
+            - set(MANIFEST_CONSENSUS_EXCLUDED)),
+    }
+
+
+def _env_info() -> dict:
+    """The execution environment half of the manifest (the exec-cache
+    persist-key discipline): per-restart float trajectories are only
+    guaranteed reproducible on the same jax/jaxlib and device kind, so
+    a ledger written elsewhere cold-starts instead of resuming."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_v = jaxlib.__version__
+    except (ImportError, AttributeError):  # pragma: no cover
+        jaxlib_v = "unknown"
+    return {"jax": jax.__version__, "jaxlib": jaxlib_v,
+            "device_kind": jax.devices()[0].device_kind}
+
+
+def _fingerprint(a: np.ndarray, ccfg: ConsensusConfig,
+                 scfg: SolverConfig, icfg: InitConfig) -> str:
+    """sha256 over everything that determines a completion record's
+    numbers: the input's DataKey content fingerprint, the covered
+    solver/consensus fields (``manifest_key_fields`` — backend hashed
+    as the chunk executor's resolved engine family), the full init
+    config, and the format version."""
+    from nmfx.data_cache import default_cache
+
+    dkey = default_cache().key_for(np.asarray(a), scfg.dtype)
+    covered = manifest_key_fields()
+    solver = {name: getattr(scfg, name)
+              for name in sorted(covered["solver"])}
+    solver["backend"] = engine_family(scfg)
+    solver["experimental"] = dataclasses.asdict(scfg.experimental)
+    consensus = {name: getattr(ccfg, name)
+                 for name in sorted(covered["consensus"])}
+    payload = {
+        "data": {"fingerprint": dkey.fingerprint,
+                 "src_dtype": dkey.src_dtype,
+                 "shape": list(dkey.shape), "dtype": dkey.dtype},
+        "solver": solver,
+        "consensus": consensus,
+        "init": dataclasses.asdict(icfg),
+        "format": _FORMAT_VERSION,
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+def plan_chunks(restarts: int, chunk: "int | None") -> tuple:
+    """The deterministic chunk plan: fixed boundaries ``[0,c), [c,2c),
+    …`` (tail chunk smaller). ``chunk=None`` = one chunk per rank."""
+    c = restarts if chunk is None else min(chunk, restarts)
+    return tuple((r0, min(r0 + c, restarts))
+                 for r0 in range(0, restarts, c))
+
+
+# -- atomic write helper (shared with the serve spill path) ----------------
+def atomic_save_npz(path: str, arrays: dict) -> None:
+    """``np.savez`` through a tmp file + ``os.replace`` so a crash
+    mid-write never leaves a torn record a resume would trust. Passes
+    the ``ckpt.write`` chaos site: an armed write fault raises before
+    any bytes land (callers degrade warn-once — durability lost for
+    that record, results unaffected)."""
+    from nmfx import faults
+
+    faults.inject("ckpt.write")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:  # handle: savez won't append ".npz"
+            np.savez(fh, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed write (disk full — the ckpt.write rehearsal) must
+        # not strand its partial tmp file on an already-full disk
+        try:
+            os.unlink(tmp)
+        except OSError:  # nmfx: ignore[NMFX006] -- tmp never created /
+            pass         # already gone; the original error re-raises
+        raise
+
+
+class SweepCheckpoint:
+    """Directory of per-(rank, restart-chunk) completion records behind
+    one content-addressed manifest — the durable sweep ledger."""
+
+    def __init__(self, directory: str, fingerprint: str, env: dict,
+                 plan: tuple, restarts: int, shape: tuple,
+                 every_s: "float | None" = None, resume: bool = True):
+        from nmfx.faults import warn_once
+
+        self.directory = directory
+        self.fingerprint = fingerprint
+        self.plan = tuple(plan)
+        self.restarts = restarts
+        self.shape = tuple(shape)
+        self.every_s = every_s
+        os.makedirs(directory, exist_ok=True)
+        self._pending: "list[tuple[int, int, int, object]]" = []
+        self._pending_lock = threading.Lock()
+        self._last_flush = time.monotonic()
+        meta = {"fingerprint": fingerprint, "env": env,
+                "plan": [list(c) for c in self.plan],
+                "restarts": restarts, "format": _FORMAT_VERSION}
+        old = self._read_manifest()
+        if old is None and os.path.exists(
+                os.path.join(directory, "registry.json")):
+            # a LEGACY SweepRegistry directory (nmfx/registry.py): its
+            # per-rank k<k>.npz records are a different format this
+            # ledger cannot resume from — say so instead of silently
+            # recomputing next to them
+            warn_once(
+                "ckpt-legacy-registry",
+                f"{directory!r} holds a legacy per-rank SweepRegistry; "
+                "the durable ledger cannot resume from its records "
+                "(they are left untouched). Use "
+                "nmfconsensus(checkpoint_dir=...) to resume the legacy "
+                "registry, or point the checkpoint at a fresh directory")
+        fresh = old is None
+        if not resume and not fresh:
+            warn_once("ckpt-no-resume",
+                      f"checkpoint ledger at {directory!r} cleared on "
+                      "request (resume=False); recomputing from scratch")
+            self._clear_records()
+            fresh = True
+        elif not fresh and old != meta:
+            # the one rule: NEVER a wrong resume. A manifest written for
+            # different data/config/env/plan (or by a different format)
+            # means the records describe a different run — cold start.
+            warn_once(
+                "ckpt-manifest-mismatch",
+                f"checkpoint ledger at {directory!r} was written for a "
+                "different (data, config, environment, chunk-plan) "
+                "combination — starting a CLEAN COLD START (existing "
+                "records cleared and recomputed), never a wrong resume")
+            self._clear_records()
+            fresh = True
+        if fresh:
+            tmp = os.path.join(directory, _MANIFEST_NAME + ".tmp")
+            with open(tmp, "wt") as f:
+                json.dump(meta, f)
+            os.replace(tmp, os.path.join(directory, _MANIFEST_NAME))
+
+    def _read_manifest(self) -> "dict | None":
+        path = os.path.join(self.directory, _MANIFEST_NAME)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, OSError) as e:
+            # nmfx: ignore[NMFX006] -- warn_once + cold start below
+            from nmfx.faults import warn_once
+
+            warn_once("ckpt-manifest-corrupt",
+                      f"checkpoint manifest at {path!r} is unreadable "
+                      f"({e}); treating the ledger as foreign and cold-"
+                      "starting")
+            return None
+
+    @classmethod
+    def open(cls, a, ccfg: ConsensusConfig, scfg: SolverConfig,
+             icfg: InitConfig,
+             cp_cfg: CheckpointConfig) -> "SweepCheckpoint":
+        arr = np.asarray(a)
+        return cls(cp_cfg.directory,
+                   _fingerprint(arr, ccfg, scfg, icfg), _env_info(),
+                   plan_chunks(ccfg.restarts, cp_cfg.every_n_restarts),
+                   ccfg.restarts, arr.shape,
+                   every_s=cp_cfg.every_s, resume=cp_cfg.resume)
+
+    # -- records -----------------------------------------------------------
+    def _path(self, k: int, r0: int, r1: int) -> str:
+        return os.path.join(self.directory, f"k{k}_r{r0}-{r1}.npz")
+
+    def has(self, k: int, r0: int, r1: int) -> bool:
+        return os.path.exists(self._path(k, r0, r1))
+
+    def completed_chunks(self, k: int) -> "list[tuple[int, int]]":
+        return [(r0, r1) for r0, r1 in self.plan if self.has(k, r0, r1)]
+
+    def record_count(self) -> int:
+        return sum(1 for name in os.listdir(self.directory)
+                   if _RECORD_RE.match(name))
+
+    def _clear_records(self) -> None:
+        # delete ONLY this ledger's own files (completion records +
+        # shard heartbeats) — never foreign files a user parked in the
+        # directory (saved results, serve spill records, the legacy
+        # SweepRegistry's k<k>.npz)
+        for name in os.listdir(self.directory):
+            if (_RECORD_RE.match(name) is None
+                    and _SHARD_RE.match(name) is None):
+                continue
+            try:
+                os.unlink(os.path.join(self.directory, name))
+            except OSError:  # nmfx: ignore[NMFX006] -- best-effort clear;
+                pass         # a survivor fails the record validation below
+
+    def save(self, k: int, r0: int, r1: int, rec) -> None:
+        """Commit one chunk's :class:`ChunkSweepOutput` (host arrays).
+        With ``every_s`` the record is buffered and lands on the next
+        time-triggered/explicit/signal :meth:`flush`; otherwise it is
+        written immediately (maximum durability). A write failure —
+        injected (``ckpt.write``) or real (disk full) — degrades
+        warn-once: the run continues, only that record's durability is
+        lost."""
+        if self.every_s is None:
+            self._write(k, r0, r1, rec)
+            return
+        with self._pending_lock:
+            self._pending.append((k, r0, r1, rec))
+            due = time.monotonic() - self._last_flush >= self.every_s
+        if due:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write every buffered record now — the SIGTERM/SIGINT flush
+        hook's body (:func:`install_signal_flush`), also called at rank
+        boundaries and at the end of the sweep. Async-signal-tolerant:
+        pops under the lock, writes outside it."""
+        while True:
+            with self._pending_lock:
+                if not self._pending:
+                    self._last_flush = time.monotonic()
+                    return
+                k, r0, r1, rec = self._pending.pop(0)
+            self._write(k, r0, r1, rec)
+
+    def _write(self, k: int, r0: int, r1: int, rec) -> None:
+        from nmfx.faults import warn_once
+
+        arrays = {name: np.asarray(v)
+                  for name, v in zip(rec._fields, rec)}
+        arrays["record_fingerprint"] = np.asarray(self.fingerprint)
+        try:
+            atomic_save_npz(self._path(k, r0, r1), arrays)
+        except Exception as e:
+            warn_once(
+                "ckpt-write-failed",
+                f"failed to persist checkpoint record k={k} "
+                f"r=[{r0},{r1}) ({e!r}); the sweep continues — only "
+                "this chunk's durability is lost (it will recompute on "
+                "resume)")
+
+    def try_load(self, k: int, r0: int, r1: int):
+        """Load one chunk's record as a host ``ChunkSweepOutput``, or
+        None for missing/torn/corrupt/foreign records (warn-once +
+        re-run that chunk — self-healing, never a crash). Passes the
+        ``ckpt.load`` chaos site so the torn-record tolerance is
+        rehearsable."""
+        from nmfx import faults
+        from nmfx.faults import warn_once
+        from nmfx.sweep import ChunkSweepOutput
+
+        path = self._path(k, r0, r1)
+        if not os.path.exists(path):
+            return None
+        c = r1 - r0
+        m, n = self.shape
+        try:
+            faults.inject("ckpt.load")
+            with np.load(path, allow_pickle=False) as z:
+                if str(z["record_fingerprint"]) != self.fingerprint:
+                    raise ValueError("record fingerprint does not match "
+                                     "the manifest")
+                rec = ChunkSweepOutput(**{f: z[f]
+                                          for f in ChunkSweepOutput._fields})
+            expect = {"labels": (c, n), "iterations": (c,),
+                      "dnorms": (c,), "stop_reasons": (c,),
+                      "best_local": (), "best_w": (m, k),
+                      "best_h": (k, n)}
+            for name, shape in expect.items():
+                got = getattr(rec, name).shape
+                if got != shape:
+                    raise ValueError(f"field {name} has shape {got}, "
+                                     f"expected {shape}")
+            if not 0 <= int(rec.best_local) < c:
+                raise ValueError("best_local out of chunk range")
+        except Exception as e:
+            warn_once(
+                "ckpt-record-corrupt",
+                f"checkpoint record {path!r} is torn/corrupt/foreign "
+                f"({e!r}); skipping it and re-running that chunk — "
+                "results are unaffected, only that chunk's resume win "
+                "is lost")
+            return None
+        _note(loaded=1)
+        return rec
+
+    # -- shard heartbeat/completion ledger (elastic recovery) --------------
+    def heartbeat(self, shard: int, **info) -> None:
+        """Record shard liveness/progress (``shard_<i>.json``, atomic).
+        The elastic runner (``nmfx/distributed.py``) writes one per
+        completed unit and a final ``alive=False`` on shard death;
+        cross-process deployments read :meth:`shard_status` to detect
+        shards whose heartbeat went stale and re-dispatch their
+        incomplete chunks (completion records are the ground truth — a
+        re-dispatched chunk that WAS committed is simply skipped)."""
+        path = os.path.join(self.directory, f"shard_{shard}.json")
+        payload = dict(info, shard=shard, time=time.time())
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wt") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:  # nmfx: ignore[NMFX006] -- liveness side-channel
+            pass         # only; completion records are the ground truth
+
+    def shard_status(self, stale_after_s: "float | None" = None) -> dict:
+        """``{shard: heartbeat_payload}``; with ``stale_after_s`` each
+        payload gains ``stale=True/False`` from its last-write age."""
+        out: dict = {}
+        for name in os.listdir(self.directory):
+            if not (name.startswith("shard_") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    payload = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                # nmfx: ignore[NMFX006] -- a torn heartbeat IS staleness
+                continue
+            if stale_after_s is not None:
+                payload["stale"] = (time.time() - payload.get("time", 0)
+                                    > stale_after_s)
+            out[payload.get("shard")] = payload
+        return out
+
+
+# -- chunk execution -------------------------------------------------------
+def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
+                     ccfg: ConsensusConfig, scfg: SolverConfig,
+                     icfg: InitConfig, keys=None):
+    """Solve restarts ``[r0, r1)`` of rank ``k`` and materialize the
+    chunk's record on host. ``keys`` is the rank's full canonical key
+    array (``split(fold_in(key(seed), k), restarts)``) — recomputed here
+    when absent — so a chunk's draws are independent of which process,
+    shard, or attempt runs it (the same-key-chains-same-results
+    property elastic recovery rests on).
+
+    Passes the ``proc.preempt`` chaos site AFTER the solve completes
+    but BEFORE the caller can commit the record: a fired preemption
+    raises :class:`Preempted`, losing exactly the in-flight chunk —
+    the rehearsal of SIGKILL mid-chunk."""
+    import jax
+
+    from nmfx import faults
+    from nmfx.sweep import _build_chunk_sweep_fn
+
+    if keys is None:
+        keys = jax.random.split(
+            jax.random.fold_in(jax.random.key(ccfg.seed), k),
+            ccfg.restarts)
+    poison = tuple(r - r0 for r in faults.poison_restarts(k, ccfg.restarts)
+                   if r0 <= r < r1)
+    fn = _build_chunk_sweep_fn(k, r1 - r0, scfg, icfg, ccfg.label_rule,
+                               poison, faults.trace_token())
+    host = jax.device_get(fn(a_dev, keys[r0:r1]))
+    _note(solved=1)
+    if faults.fire("proc.preempt"):
+        raise Preempted(
+            f"injected preemption after solving chunk k={k} "
+            f"r=[{r0},{r1}) and before its commit — this chunk is "
+            "lost; every committed record survives for resume")
+    return host
+
+
+def _finalize_rank(k: int, recs: dict, ccfg: ConsensusConfig,
+                   shape: tuple):
+    """Rebuild rank ``k``'s host ``KSweepOutput`` from its chunk
+    records, in canonical restart order. Exact by construction: the
+    connectivity accumulates as int64 counts (associative — completion
+    order can never matter), the survivor division happens once in
+    float64, and best-restart selection replays the global first-min
+    ``argmin`` over the assembled dnorm array."""
+    from nmfx.solvers.base import StopReason
+    from nmfx.sweep import KSweepOutput
+
+    restarts = ccfg.restarts
+    m, n = shape
+    first = next(iter(recs.values()))
+    labels = np.empty((restarts, n), np.int32)
+    iters = np.empty((restarts,), np.asarray(first.iterations).dtype)
+    dnorms = np.empty((restarts,), np.asarray(first.dnorms).dtype)
+    stops = np.empty((restarts,), np.asarray(first.stop_reasons).dtype)
+    for (r0, r1), rec in sorted(recs.items()):
+        labels[r0:r1] = rec.labels
+        iters[r0:r1] = rec.iterations
+        dnorms[r0:r1] = rec.dnorms
+        stops[r0:r1] = rec.stop_reasons
+    faulted = stops == int(StopReason.NUMERIC_FAULT)
+    # integer one-hot connectivity reduction: quarantined lanes drop out
+    # (zero contribution, like pads), every surviving label is in
+    # [0, k), and int64 addition is associative — exact and identical
+    # to a restart-by-restart accumulation, at one einsum instead of
+    # `restarts` sequential n×n passes
+    surv = labels[~faulted]  # (R_surv, n)
+    onehot = (surv[:, :, None] == np.arange(k)[None, None, :]) \
+        .astype(np.int64)
+    counts = np.einsum("rik,rjk->ij", onehot, onehot)
+    n_fault = int(faulted.sum())
+    div = max(restarts - n_fault, 1) if n_fault else restarts
+    cons = counts / np.float64(div)
+    dnorm_best = np.where(faulted, np.inf, dnorms.astype(np.float64))
+    best = int(np.argmin(dnorm_best))
+    best_rec = next(rec for (r0, r1), rec in sorted(recs.items())
+                    if r0 <= best < r1)
+    r0_best = next(r0 for (r0, r1) in recs if r0 <= best < r1)
+    if int(best_rec.best_local) + r0_best != best and n_fault < restarts:
+        # a record that passed validation but nominates a different lane
+        # than the global replay can only be foreign/corrupt data
+        raise ValueError(
+            f"checkpoint records for k={k} are inconsistent: chunk "
+            f"[{r0_best},…) nominates restart "
+            f"{int(best_rec.best_local) + r0_best} as its best but the "
+            f"global replay selects {best}; the ledger is corrupt — "
+            "delete the directory and re-run")
+    return KSweepOutput(
+        consensus=cons, iterations=iters, dnorms=dnorms,
+        stop_reasons=stops, labels=labels,
+        best_w=np.asarray(best_rec.best_w),
+        best_h=np.asarray(best_rec.best_h), all_w=None, all_h=None)
+
+
+def run_checkpointed_sweep(a, cfg: ConsensusConfig,
+                           solver_cfg: SolverConfig,
+                           init_cfg: InitConfig,
+                           cp_cfg: CheckpointConfig,
+                           profiler=None, on_rank=None) -> dict:
+    """The durable sweep engine: execute the (k x restart) grid through
+    the per-(k, chunk) ledger, re-running ONLY chunks without a valid
+    completion record, and finalize each rank exactly from the records
+    (see module docstring). Returns ``{k: KSweepOutput}`` of host
+    arrays — both harvest modes consume it unchanged."""
+    import jax
+
+    from nmfx.data_cache import place_resilient
+
+    if profiler is None:
+        from nmfx.profiling import NullProfiler
+
+        profiler = NullProfiler()
+    if cfg.keep_factors:
+        raise ValueError(
+            "keep_factors is not supported on checkpointed sweeps (the "
+            "ledger persists per-restart stats and best candidates, not "
+            "every factor stack); recompute any restart exactly with "
+            "nmfx.restart_factors")
+    arr = np.asarray(a)
+    ck = SweepCheckpoint.open(arr, cfg, solver_cfg, init_cfg, cp_cfg)
+    restore = install_signal_flush(ck)
+    a_dev = None
+    out: dict = {}
+    try:
+        for k in cfg.ks:
+            recs: dict = {}
+            missing = []
+            for r0, r1 in ck.plan:
+                with profiler.phase("ckpt.load"):
+                    rec = ck.try_load(k, r0, r1)
+                if rec is None:
+                    missing.append((r0, r1))
+                else:
+                    recs[(r0, r1)] = rec
+            if missing:
+                if a_dev is None:  # fully-resumed sweeps never transfer
+                    a_dev = place_resilient(arr, solver_cfg, None,
+                                            profiler=profiler)
+                keys = jax.random.split(
+                    jax.random.fold_in(jax.random.key(cfg.seed), k),
+                    cfg.restarts)
+                for r0, r1 in missing:
+                    with profiler.phase(f"solve.ckpt.k={k}"):
+                        try:
+                            rec = solve_chunk_host(a_dev, k, r0, r1, cfg,
+                                                   solver_cfg, init_cfg,
+                                                   keys=keys)
+                        except Preempted:
+                            ck.flush()  # the SIGTERM-grace analogue:
+                            raise       # committed work must survive
+                    with profiler.phase("checkpoint"):
+                        ck.save(k, r0, r1, rec)
+                    recs[(r0, r1)] = rec
+            with profiler.phase("ckpt.finalize"):
+                out[k] = _finalize_rank(k, recs, cfg, arr.shape)
+            ck.flush()  # rank boundary: buffered records land
+            if on_rank is not None:
+                on_rank(k, out[k])
+        return {k: out[k] for k in cfg.ks}
+    finally:
+        ck.flush()
+        restore()
+
+
+def install_signal_flush(ck: SweepCheckpoint):
+    """Hook SIGTERM/SIGINT so a preemption notice flushes the ledger's
+    buffered (``every_s``) records before the process dies, then defers
+    to the previous disposition (a previously-installed handler runs;
+    the default disposition re-raises as ``KeyboardInterrupt`` /
+    ``SystemExit(128+sig)``; an ignored signal stays ignored). Returns
+    a zero-argument restore callable; a no-op off the main thread
+    (signal handlers are main-thread-only — the serve/harvest worker
+    threads rely on their own drain paths)."""
+    installed: dict = {}
+
+    def _handler(signum, frame):
+        ck.flush()
+        prev = installed.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev is signal.SIG_IGN:
+            return
+        elif signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        else:
+            raise SystemExit(128 + signum)
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            installed[sig] = signal.signal(sig, _handler)
+    except ValueError:
+        # not the main interpreter thread: signal.signal fails on the
+        # FIRST call, so nothing was installed and there is nothing to
+        # restore — the caller simply runs without the flush hook
+        return lambda: None
+
+    def restore():
+        for sig, prev in installed.items():
+            signal.signal(sig, prev)
+
+    return restore

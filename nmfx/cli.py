@@ -170,8 +170,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "this many devices — sequence parallelism for huge "
                         "n (default 1 = off)")
     p.add_argument("--checkpoint-dir", default=None,
-                   help="persist per-rank results here and resume an "
-                        "interrupted sweep from completed ranks")
+                   help="durable sweep ledger (docs/serving.md "
+                        "'Durability model'): persist per-(rank, "
+                        "restart-chunk) completion records here — a "
+                        "preempted/killed run loses at most the chunk "
+                        "in flight, and a re-run resumes bit-identical "
+                        "to an uninterrupted checkpointed run, "
+                        "recomputing only the missing chunks. A "
+                        "manifest mismatch (different data/config/"
+                        "environment) cold-starts cleanly, never a "
+                        "wrong resume")
+    p.add_argument("--checkpoint-every", type=int, default=None,
+                   metavar="N",
+                   help="restarts per completion record (the durability "
+                        "granularity; default: one record per rank). "
+                        "Requires --checkpoint-dir")
+    p.add_argument("--resume", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="with --checkpoint-dir: resume from records "
+                        "already in the ledger (the default); "
+                        "--no-resume clears them and recomputes from "
+                        "scratch")
     p.add_argument("--keep-factors", action="store_true",
                    help="retain every restart's (W, H) in the result "
                         "(the reference registry's per-job retention); "
@@ -379,6 +398,38 @@ def main(argv: list[str] | None = None) -> int:
                             backend=args.backend,
                             restart_chunk=args.restart_chunk,
                             check_block=args.check_block)
+    ckpt_cfg = None
+    if args.checkpoint_every is not None and args.checkpoint_every < 1:
+        parser.error("--checkpoint-every must be >= 1")
+    if args.checkpoint_dir is not None:
+        # compose-guards mirror the --cache-dir discipline: reject
+        # combinations the durable engine cannot honor instead of
+        # silently dropping a flag
+        if args.keep_factors:
+            parser.error("--checkpoint-dir does not compose with "
+                         "--keep-factors (the ledger persists per-"
+                         "restart stats and best candidates, not every "
+                         "factor stack; use nmfx.restart_factors to "
+                         "recompute any restart exactly)")
+        if mesh is not None:
+            parser.error("--checkpoint-dir does not compose with "
+                         "--feature-shards/--sample-shards (the chunk "
+                         "executor owns its execution plan; use "
+                         "nmfx.distributed's elastic shard runner for "
+                         "multi-device durable sweeps)")
+        from nmfx.config import CheckpointConfig
+
+        ckpt_cfg = CheckpointConfig(directory=args.checkpoint_dir,
+                                    every_n_restarts=args.checkpoint_every,
+                                    resume=(True if args.resume is None
+                                            else args.resume))
+    elif args.checkpoint_every is not None:
+        parser.error("--checkpoint-every requires --checkpoint-dir")
+    elif args.resume is not None:
+        # reject-don't-drop, like --checkpoint-every above: a silently
+        # ignored --no-resume would leave the user believing the ledger
+        # was cleared
+        parser.error("--resume/--no-resume require --checkpoint-dir")
     exec_cache = None
     warm_task = None
     if args.input_cache_bytes is not None:
@@ -400,7 +451,7 @@ def main(argv: list[str] | None = None) -> int:
             parser.error("--serve-smoke does not compose with "
                          "--checkpoint-dir (served requests dispatch "
                          "through the executable cache, which bypasses "
-                         "the registry resume path)")
+                         "the durable-ledger resume path)")
         if args.keep_factors:
             parser.error("--serve-smoke does not compose with "
                          "--keep-factors (served results carry the best "
@@ -429,8 +480,9 @@ def main(argv: list[str] | None = None) -> int:
             # sweep() routes checkpointed runs past the cache — erroring
             # here beats silently paying the warmup compile twice
             parser.error("--exec-cache/--warm-shapes do not compose with "
-                         "--checkpoint-dir (checkpointed sweeps resume "
-                         "through the registry path, which bypasses the "
+                         "--checkpoint-dir (checkpointed sweeps dispatch "
+                         "per (rank, restart-chunk) through the durable "
+                         "ledger, which bypasses the bucketed "
                          "executable cache)")
         ecfg = ExecCacheConfig(cache_dir=args.cache_dir,
                                pipeline_ranks=args.pipeline_ranks)
@@ -488,7 +540,7 @@ def main(argv: list[str] | None = None) -> int:
                 grid_slots=args.grid_slots,
                 grid_tail_slots=args.grid_tail_slots,
                 output=output,
-                checkpoint_dir=args.checkpoint_dir,
+                checkpoint=ckpt_cfg,
                 profiler=profiler,
                 exec_cache=exec_cache,
             )

@@ -369,6 +369,28 @@ class InitConfig:
 class ConsensusConfig:
     """Consensus sweep settings (reference ``nmf.r:106-119``)."""
 
+    #: AUTHORITATIVE declaration of the ConsensusConfig fields that may
+    #: legitimately be absent from the durable-sweep checkpoint manifest
+    #: (``nmfx.checkpoint.manifest_key_fields``) — the fields that
+    #: cannot change a persisted per-restart record's numbers. The
+    #: static analyzer (``nmfx.analysis`` rule NMFX007) cross-references
+    #: this list against ``checkpoint.MANIFEST_CONSENSUS_EXCLUDED``, so
+    #: a result-affecting field can never silently drop out of the
+    #: manifest (the stale-resume class). Rationale per field:
+    #: ``ks`` — records are keyed per rank, widening a sweep reuses
+    #: finished ranks by design (the SweepRegistry precedent);
+    #: ``linkage``/``min_restarts`` — finalize-time only: rank selection
+    #: and the quarantine floor are recomputed from the records at every
+    #: finalize, never persisted; ``keep_factors`` — checkpointed sweeps
+    #: refuse it (recompute via ``nmfx.restart_factors``);
+    #: ``grid_exec``/``grid_slots``/``grid_tail_slots`` — inert under
+    #: checkpointing (the chunk executor is its own per-(k, chunk)
+    #: execution plan; the manifest hashes the checkpoint engine family
+    #: instead).
+    CHECKPOINT_EXEMPT_FIELDS: ClassVar[tuple] = (
+        "ks", "linkage", "min_restarts", "keep_factors", "grid_exec",
+        "grid_slots", "grid_tail_slots")
+
     ks: Sequence[int] = (2, 3, 4, 5)
     restarts: int = 10
     seed: int = 123
@@ -552,6 +574,53 @@ class ExecCacheConfig:
             raise ValueError("max_disk_bytes must be >= 1")
         if self.compile_workers < 0:
             raise ValueError("compile_workers must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Durable-sweep checkpoint policy (``nmfx/checkpoint.py``).
+
+    A sweep run with a CheckpointConfig persists a content-addressed
+    manifest (input + config fingerprint + jax/device env) plus one
+    completion record per (rank, restart-chunk) under ``directory``,
+    with atomic tmp+rename writes — a preempted/killed process loses at
+    most the chunk in flight, and a re-run with ``resume=True``
+    recomputes ONLY the missing chunks, producing a result bit-identical
+    to an uninterrupted checkpointed run (the consensus is accumulated
+    from the per-restart records in canonical restart order at finalize
+    time, in exact integer arithmetic, so completion order can never
+    matter). See docs/serving.md "Durability model".
+    """
+
+    #: ledger directory (manifest + per-(k, chunk) records)
+    directory: str = "./nmfx_ckpt"
+    #: restarts per completion record — the durability granularity AND
+    #: the chunk execution plan (deterministic boundaries
+    #: ``[0,c), [c,2c), …`` per rank, recorded in the manifest so a
+    #: resume re-runs exactly the missing plan chunks with identical
+    #: batch composition). None = one chunk per rank (the SweepRegistry
+    #: granularity).
+    every_n_restarts: "int | None" = None
+    #: time-batched persistence: completed records are buffered in
+    #: memory and flushed to disk at most every this many seconds (and
+    #: always at rank boundaries, on ``flush()``, and from the
+    #: SIGTERM/SIGINT flush hook — ``nmfx.checkpoint
+    #: .install_signal_flush``). None = every record is written the
+    #: moment its chunk completes (maximum durability, the default).
+    every_s: "float | None" = None
+    #: resume from records already in ``directory`` (guarded by the
+    #: manifest: a fingerprint/env/plan mismatch triggers a clean cold
+    #: start — warn + recompute — never a wrong resume). False clears
+    #: the ledger and starts fresh.
+    resume: bool = True
+
+    def __post_init__(self):
+        if not self.directory:
+            raise ValueError("directory must be a non-empty path")
+        if self.every_n_restarts is not None and self.every_n_restarts < 1:
+            raise ValueError("every_n_restarts must be >= 1 or None")
+        if self.every_s is not None and self.every_s <= 0:
+            raise ValueError("every_s must be positive or None")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -28,6 +28,9 @@ CPU tests exercise this exact code path (SURVEY.md §4).
 
 from __future__ import annotations
 
+import collections
+import threading
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
@@ -153,3 +156,221 @@ def consensus(data, ks=(2, 3, 4, 5), restarts: int = 10,
     return nmfconsensus(data, ks=ks, restarts=restarts,
                         mesh=global_mesh(feature_shards, sample_shards),
                         **kwargs)
+
+
+# --------------------------------------------------------------------------
+# Elastic shard recovery (ISSUE 9): the durable-ledger counterpart of the
+# SPMD mesh above. The mesh path is fail-stop — one device/host dying
+# kills the collective and the whole job restarts. Here the restart grid
+# shards as independent (k, restart-chunk) WORK UNITS over the devices,
+# every unit's results come from the same canonical per-(seed, k,
+# restart) key chain regardless of which shard runs it, and completion
+# is recorded in the shared SweepCheckpoint ledger — so when a shard
+# dies mid-sweep, the survivors simply re-dispatch its incomplete units
+# (same keys => same results) and the sweep finishes with ZERO stranded
+# work. This is the MPI-FAUN restart-grid sharding (arxiv 1609.09154)
+# turned elastic, testable on forced host devices in a CPU container.
+# --------------------------------------------------------------------------
+class ElasticShardRunner:
+    """Restart-grid sharding with shard-loss recovery over a durable
+    ledger.
+
+    Each device is one shard, driven by a worker thread that pulls
+    (k, r0, r1) units from a shared queue (deterministically ordered:
+    ks-major, chunk-minor — the checkpoint plan order), solves the unit
+    on ITS device through the checkpoint chunk executor, and commits
+    the completion record to the shared :class:`~nmfx.checkpoint
+    .SweepCheckpoint`. Per-unit heartbeats land in the ledger
+    (``shard_<i>.json``), so a cross-process deployment can detect a
+    shard whose heartbeat went stale; in-process, a shard death (a
+    raised ``checkpoint.Preempted`` — the armed ``proc.preempt`` chaos
+    site — or any crash) returns its in-flight unit to the queue, where
+    a survivor picks it up.
+
+    Exactness: a unit's chunk executor draws the canonical
+    ``split(fold_in(key(seed), k), restarts)[r0:r1]`` keys and the
+    finalize step accumulates integer connectivity counts in canonical
+    restart order — so the result is bit-identical to a single-device
+    checkpointed run of the same plan, no matter how units were
+    distributed, re-dispatched, or interleaved
+    (tests/test_distributed.py pins it on forced CPU devices).
+    """
+
+    def __init__(self, ck, ccfg, scfg, icfg, arr, devices=None):
+        self.ck = ck
+        self.ccfg = ccfg
+        self.scfg = scfg
+        self.icfg = icfg
+        self.arr = np.asarray(arr)
+        self.devices = list(jax.local_devices()
+                            if devices is None else devices)
+        if not self.devices:
+            raise ValueError("need at least one device")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = collections.deque(
+            (k, r0, r1) for k in ccfg.ks for r0, r1 in ck.plan
+            if not ck.has(k, r0, r1))
+        self._inflight = 0  # units taken but not yet committed/returned
+        self._records: dict = {}
+        self.dead_shards: "list[int]" = []
+        self._errors: "list[BaseException]" = []
+
+    def _worker(self, idx: int, dev) -> None:
+        from nmfx import checkpoint as ckpt
+        from nmfx.sweep import place_input
+
+        done = 0
+        a_dev = jax.device_put(
+            place_input(self.arr, self.scfg, None), dev)
+        key_cache: dict = {}
+        while True:
+            with self._cond:
+                # an empty queue is NOT the end while units are still in
+                # flight: a dying shard hands its unit back via
+                # appendleft, and a survivor that already exited would
+                # strand it — wait instead (the late-sweep preemption
+                # case the elastic contract exists for)
+                while not self._pending and self._inflight > 0:
+                    self._cond.wait()
+                if not self._pending:
+                    self.ck.heartbeat(idx, alive=True, done=done,
+                                      unit=None)
+                    return
+                unit = self._pending.popleft()
+                self._inflight += 1
+            k, r0, r1 = unit
+            try:
+                if k not in key_cache:
+                    key_cache[k] = jax.device_put(jax.random.split(
+                        jax.random.fold_in(jax.random.key(self.ccfg.seed),
+                                           k),
+                        self.ccfg.restarts), dev)
+                rec = ckpt.solve_chunk_host(a_dev, k, r0, r1, self.ccfg,
+                                            self.scfg, self.icfg,
+                                            keys=key_cache[k])
+            except ckpt.Preempted:
+                # shard death: hand the in-flight unit back so a
+                # survivor re-runs it (same keys => same results), and
+                # leave a final not-alive heartbeat in the ledger
+                with self._cond:
+                    self._pending.appendleft(unit)
+                    self._inflight -= 1
+                    self.dead_shards.append(idx)
+                    self._cond.notify_all()
+                self.ck.heartbeat(idx, alive=False, done=done, unit=unit)
+                return
+            except BaseException as e:  # real crash: recorded (raised
+                from nmfx.faults import warn_once  # by run() only if
+                                                   # work STRANDS),
+                with self._cond:                   # unit returned,
+                    self._pending.appendleft(unit)  # shard retired
+                    self._inflight -= 1
+                    self.dead_shards.append(idx)
+                    self._errors.append(e)
+                    self._cond.notify_all()
+                self.ck.heartbeat(idx, alive=False, done=done, unit=unit)
+                warn_once(
+                    "elastic-shard-crash",
+                    f"elastic shard {idx} ({dev}) crashed on unit "
+                    f"{unit} ({e!r}); its incomplete units were "
+                    "returned to the queue for the surviving shards")
+                return
+            self.ck.save(k, r0, r1, rec)
+            done += 1
+            self.ck.heartbeat(idx, alive=True, done=done, unit=unit)
+            with self._cond:
+                self._records[unit] = rec
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    def run(self) -> dict:
+        """Dispatch until every unit is committed (or every shard died);
+        returns ``{(k, r0, r1): ChunkSweepOutput}`` for the units this
+        process solved. Units already committed in the ledger are
+        loaded at finalize, not re-run (zero stranded AND zero wasted
+        committed work)."""
+        threads = [threading.Thread(target=self._worker, args=(i, d),
+                                    daemon=True,
+                                    name=f"nmfx-elastic-{i}")
+                   for i, d in enumerate(self.devices)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every_s-buffered records land NOW — before the all-dead error
+        # below claims "the committed records remain", and before the
+        # process can exit with a 'durable' run that never touched disk
+        self.ck.flush()
+        if self._pending:
+            err = RuntimeError(
+                f"every shard died with {len(self._pending)} unit(s) "
+                "still pending; the committed records remain in "
+                f"{self.ck.directory!r} — re-run to resume from them")
+            if self._errors:
+                raise err from self._errors[0]
+            raise err
+        # shard crashes whose units the survivors absorbed are NOT
+        # re-raised: the result is complete and exact (the crash was
+        # already announced warn-once) — raising only when work strands
+        # is the documented elastic contract
+        return dict(self._records)
+
+
+def elastic_consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, *,
+                      checkpoint, seed: int = 123, solver_cfg=None,
+                      init_cfg=None, label_rule: str = "argmax",
+                      linkage: str = "average", min_restarts: int = 1,
+                      devices=None):
+    """Durable, elastic restart-grid consensus sweep: the (k x chunk)
+    units of ``checkpoint``'s plan are dispatched across ``devices``
+    (default: all local devices) by :class:`ElasticShardRunner`; a
+    shard lost mid-sweep is recovered by the survivors, and the result
+    is bit-identical to a single-device checkpointed run of the same
+    plan. ``checkpoint`` is an ``nmfx.CheckpointConfig`` or a directory
+    path; a partially-complete ledger resumes (only missing units
+    dispatch). Returns the same ``ConsensusResult`` as
+    ``nmfconsensus``."""
+    from nmfx import checkpoint as ckpt
+    from nmfx.api import ConsensusResult, _as_matrix, _build_k_result
+    from nmfx.config import (CheckpointConfig, ConsensusConfig,
+                             InitConfig, SolverConfig)
+
+    import os
+
+    if isinstance(checkpoint, (str, os.PathLike)):
+        checkpoint = CheckpointConfig(directory=os.fspath(checkpoint))
+    arr, col_names = _as_matrix(data)
+    if not np.isfinite(arr).all():
+        raise ValueError("input matrix contains non-finite values")
+    if (arr < 0).any():
+        raise ValueError("input matrix must be non-negative")
+    ccfg = ConsensusConfig(ks=tuple(ks), restarts=restarts, seed=seed,
+                           label_rule=label_rule, linkage=linkage,
+                           min_restarts=min_restarts)
+    scfg = solver_cfg if solver_cfg is not None else SolverConfig()
+    icfg = init_cfg if init_cfg is not None else InitConfig()
+    ck = ckpt.SweepCheckpoint.open(arr, ccfg, scfg, icfg, checkpoint)
+    runner = ElasticShardRunner(ck, ccfg, scfg, icfg, arr,
+                                devices=devices)
+    solved = runner.run()
+    per_k = {}
+    for k in ccfg.ks:
+        recs = {}
+        for r0, r1 in ck.plan:
+            rec = solved.get((k, r0, r1))
+            if rec is None:
+                rec = ck.try_load(k, r0, r1)
+            if rec is None:  # committed by a peer process mid-scan and
+                # then torn? — solve inline rather than fail the sweep
+                rec = ckpt.solve_chunk_host(
+                    jax.numpy.asarray(arr, scfg.dtype), k, r0, r1,
+                    ccfg, scfg, icfg)
+                ck.save(k, r0, r1, rec)
+            recs[(r0, r1)] = rec
+        out = ckpt._finalize_rank(k, recs, ccfg, arr.shape)
+        per_k[k] = _build_k_result(k, out, ccfg.linkage,
+                                   min_restarts=ccfg.min_restarts)
+    ck.flush()  # inline re-solves above may have buffered (every_s)
+    return ConsensusResult(ks=ccfg.ks, per_k=per_k,
+                           col_names=tuple(col_names))

@@ -47,6 +47,15 @@ Sites (see docs/serving.md "Failure model" for the recovery matrix):
 ``solve.nonfinite``   a restart lane's factors go non-finite in-kernel
 ``sched.stale_reload``  the slot scheduler's reload factor write (the
                       round-3 signature; ``bench.py --verify`` gate)
+``ckpt.write``        a durable-ledger record/spill write
+                      (``nmfx/checkpoint.py``; degrades warn-once)
+``ckpt.load``         reading a completion record back from the ledger
+                      (torn-record tolerance: skip + warn + re-run)
+``proc.preempt``      process preemption between a chunk's solve and
+                      its commit (raises ``checkpoint.Preempted`` —
+                      BaseException, unswallowable; kill-and-resume
+                      chaos for tests, bench ``detail.durability``, and
+                      the elastic shard runner)
 ==================== ====================================================
 """
 
@@ -66,7 +75,8 @@ __all__ = ["SITES", "FaultConfig", "FaultInjected", "InsufficientRestarts",
 #: typo'd chaos test fails loudly instead of silently testing nothing)
 SITES = ("h2d.transfer", "compile.build", "persist.deserialize",
          "harvest.worker", "serve.scheduler", "solve.nonfinite",
-         "sched.stale_reload")
+         "sched.stale_reload", "ckpt.write", "ckpt.load",
+         "proc.preempt")
 
 #: sites whose armed state changes TRACED code and therefore must key
 #: the builder/executable caches (see trace_token)

@@ -97,6 +97,12 @@ _packed_dispatches = 0  # dispatches whose lanes span >= 2 requests
 _total_lanes = 0
 _packed_lanes = 0  # lanes that rode a packed dispatch
 _counter_lock = threading.Lock()
+#: process-wide spill-record counter: per-SERVER request seqs restart
+#: at 0, so a restarted server in the same process would overwrite an
+#: earlier server's spill_{pid}_{seq}.npz — this counter keeps every
+#: spill filename unique within the process (pid keeps it unique
+#: across processes)
+_spill_seq = itertools.count()
 
 
 def dispatch_count() -> int:
@@ -232,6 +238,17 @@ class ServeConfig:
     #: scheduler's liveness/heartbeat (bounds crash-to-resolution
     #: latency)
     watchdog_interval_s: float = 0.25
+    #: spill-on-shutdown directory (docs/serving.md "Durability
+    #: model"): ``close(cancel_pending=True)`` persists each queued-but-
+    #: undispatched request's full submission payload here (atomic
+    #: writes, the checkpoint ledger's discipline) before resolving its
+    #: future with :class:`ServerClosed`, and a restarted server
+    #: re-admits them with :meth:`NMFXServer.readmit` — results are
+    #: bit-identical to direct submission (the serving exactness
+    #: contract; absolute deadlines do not survive the restart and are
+    #: dropped). None = shutdown discards queued requests (the
+    #: pre-ISSUE-9 behavior).
+    spill_dir: "str | None" = None
 
     def __post_init__(self):
         if self.max_queue_depth < 1:
@@ -549,7 +566,8 @@ class NMFXServer:
                          "rejected": 0, "dispatches": 0,
                          "packed_dispatches": 0, "packed_requests": 0,
                          "total_lanes": 0, "packed_lanes": 0,
-                         "budget_clamped": 0}
+                         "budget_clamped": 0, "spilled": 0,
+                         "readmitted": 0}
 
     # -- lifecycle ---------------------------------------------------------
     def __enter__(self) -> "NMFXServer":
@@ -573,22 +591,39 @@ class NMFXServer:
         """Stop accepting requests; drain the queue and in-flight work,
         then join the worker threads. ``cancel_pending=True`` instead
         fails queued (not yet dispatched) requests with
-        :class:`ServerClosed`."""
+        :class:`ServerClosed` — routed through the spill path first
+        when ``ServeConfig.spill_dir`` is set, so an operator shutdown
+        (or a supervisor's SIGTERM handler calling close) loses no
+        queued work: a restarted server re-admits the spilled requests
+        via :meth:`readmit`."""
+        cancelled: "list[_Request]" = []
         with self._cond:
             if not self._closed:
                 self._closed = True
                 if cancel_pending:
-                    for _, req in self._queue:
-                        if req.future.set_running_or_notify_cancel():
-                            req.future.set_exception(ServerClosed(
-                                "server closed before dispatch"))
-                            self.counters["failed"] += 1
+                    cancelled = [req for _, req in self._queue]
                     self._queue.clear()
                     self._queued = 0
                     self._pending_bytes = 0
                 self._paused = False  # a paused close must still drain
                 self._cond.notify_all()
             scheduler = self._scheduler
+        # spill + resolve OUTSIDE the lock: serializing up to the
+        # admission bound's worth of matrices under _cond would stall
+        # the watchdog and completion bookkeeping for the whole write;
+        # nothing reads _queue after _closed flipped under the lock
+        for req in cancelled:
+            if not req.future.set_running_or_notify_cancel():
+                continue  # caller already cancelled it: never spill —
+                # readmit() must not resurrect cancelled work
+            path = self._spill(req)
+            req.future.set_exception(ServerClosed(
+                "server closed before dispatch"
+                + (f"; request spilled to {path} — a restarted server "
+                   "re-admits it via NMFXServer.readmit()"
+                   if path else "")))
+            with self._lock:
+                self.counters["failed"] += 1
         if scheduler is not None:
             scheduler.join()
         with self._cond:
@@ -606,6 +641,139 @@ class NMFXServer:
             self._harvest_cond.notify_all()
         for t in self._harvesters:
             t.join()
+
+    # -- spill-on-shutdown / re-admission (ISSUE 9) ------------------------
+    def _spill(self, req: _Request) -> "str | None":
+        """Persist one queued request's submission payload under
+        ``ServeConfig.spill_dir`` (atomic tmp+rename via the checkpoint
+        ledger's writer, which also passes the ``ckpt.write`` chaos
+        site). Best-effort: a write failure degrades warn-once to the
+        plain discard (the pre-spill behavior), never blocks close()."""
+        if self.cfg.spill_dir is None:
+            return None
+        import json
+        import os
+
+        from nmfx.checkpoint import atomic_save_npz
+        from nmfx.faults import warn_once
+
+        meta = {
+            "ks": list(req.ks), "restarts": req.restarts,
+            "seed": req.seed, "label_rule": req.label_rule,
+            "linkage": req.linkage, "grid_slots": req.grid_slots,
+            "grid_tail_slots": (list(req.grid_tail_slots)
+                                if isinstance(req.grid_tail_slots,
+                                              (list, tuple))
+                                else req.grid_tail_slots),
+            "min_restarts": req.min_restarts, "priority": req.priority,
+            "col_names": list(req.col_names),
+            "solver_cfg": dataclasses.asdict(req.scfg),
+            "init_cfg": dataclasses.asdict(req.icfg),
+        }
+        try:
+            os.makedirs(self.cfg.spill_dir, exist_ok=True)
+            path = os.path.join(
+                self.cfg.spill_dir,
+                f"spill_{os.getpid()}_{next(_spill_seq)}.npz")
+            atomic_save_npz(path, {"a": req.a,
+                                   "meta": np.asarray(json.dumps(meta))})
+        except Exception as e:
+            warn_once(
+                "serve-spill-failed",
+                f"failed to spill queued request #{req.seq} to "
+                f"{self.cfg.spill_dir!r} ({e!r}); the request is "
+                "discarded like a spill-less shutdown")
+            return None
+        with self._lock:
+            self.counters["spilled"] += 1
+        return path
+
+    def readmit(self, spill_dir: "str | None" = None) -> list:
+        """Re-admit every request a previous server spilled on shutdown
+        (``spill_dir`` defaults to this server's
+        ``ServeConfig.spill_dir``): each spill record is resubmitted
+        through the normal :meth:`submit` path — bit-identical results
+        to the original submission by the serving exactness contract —
+        and its file is removed once admitted. Torn/corrupt spill
+        records are skipped warn-once (the ledger's torn-record
+        tolerance); an admission rejection (``QueueFull``) stops the
+        loop warn-once, leaving that file and the rest in place for a
+        later readmit. Returns the futures of everything admitted."""
+        import json
+        import os
+
+        from nmfx import faults
+        from nmfx.config import ExperimentalConfig
+        from nmfx.faults import warn_once
+        from nmfx.io import Dataset
+
+        d = spill_dir if spill_dir is not None else self.cfg.spill_dir
+        if d is None:
+            raise ValueError("no spill directory: pass spill_dir= or "
+                             "set ServeConfig.spill_dir")
+        futures = []
+        for name in sorted(os.listdir(d) if os.path.isdir(d) else ()):
+            if not (name.startswith("spill_") and name.endswith(".npz")):
+                continue
+            path = os.path.join(d, name)
+            try:
+                faults.inject("ckpt.load")
+                with np.load(path, allow_pickle=False) as z:
+                    a = z["a"]
+                    meta = json.loads(str(z["meta"]))
+                exp = meta["solver_cfg"].pop("experimental")
+                scfg = SolverConfig(**meta["solver_cfg"],
+                                    experimental=ExperimentalConfig(
+                                        **exp))
+                icfg = InitConfig(**meta["init_cfg"])
+                tail = meta["grid_tail_slots"]
+                if isinstance(tail, list):
+                    tail = tuple(tail)
+            except Exception as e:
+                warn_once(
+                    "serve-spill-corrupt",
+                    f"spilled request record {path!r} is torn/corrupt "
+                    f"({e!r}); skipping it — re-submit the request "
+                    "manually if it still matters")
+                continue
+            # a Dataset carries the spilled col_names back through
+            # submit's _as_matrix, so the re-admitted result is
+            # field-for-field what the original submission would have
+            # delivered (row names were never retained by _Request)
+            names = [str(c) for c in meta["col_names"]]
+            data = Dataset(values=a,
+                           row_names=[str(i + 1)
+                                      for i in range(a.shape[0])],
+                           col_names=names)
+            try:
+                fut = self.submit(data, ks=tuple(meta["ks"]),
+                                  restarts=meta["restarts"],
+                                  seed=meta["seed"], solver_cfg=scfg,
+                                  init_cfg=icfg,
+                                  label_rule=meta["label_rule"],
+                                  linkage=meta["linkage"],
+                                  grid_slots=meta["grid_slots"],
+                                  grid_tail_slots=tail,
+                                  min_restarts=meta["min_restarts"],
+                                  priority=meta["priority"])
+            except QueueFull as e:
+                warn_once(
+                    "serve-readmit-queue-full",
+                    f"re-admission stopped at {path!r}: {e}; this and "
+                    "the remaining spill records stay on disk — call "
+                    "readmit() again once the queue drains")
+                break
+            with self._lock:
+                self.counters["readmitted"] += 1
+            futures.append(fut)
+            try:
+                os.unlink(path)
+            except OSError as e:
+                warn_once("serve-spill-unlink",
+                          f"could not remove re-admitted spill record "
+                          f"{path!r} ({e}); remove it manually or the "
+                          "next readmit will submit it again")
+        return futures
 
     # -- submission --------------------------------------------------------
     def submit(self, data, ks: Sequence[int] = (2, 3, 4, 5),

@@ -74,6 +74,25 @@ class KSweepOutput(NamedTuple):
     all_h: jax.Array | None = None  # (restarts, k, n) or None
 
 
+class ChunkSweepOutput(NamedTuple):
+    """One restart-chunk's per-lane results — the durable-sweep ledger's
+    record payload (``nmfx/checkpoint.py``): everything the finalize
+    step needs to rebuild a rank's ``KSweepOutput`` from records alone,
+    in canonical restart order, regardless of completion order."""
+
+    labels: jax.Array  # (chunk, n); quarantined lanes masked to -1
+    iterations: jax.Array  # (chunk,)
+    dnorms: jax.Array  # (chunk,) raw final residuals
+    stop_reasons: jax.Array  # (chunk,)
+    #: chunk-local index of the lowest-dnorm SURVIVING lane (ties break
+    #: to the lowest index — the same first-min rule ``argmin`` applies
+    #: globally, so the chunk holding the global best always nominates
+    #: exactly that lane)
+    best_local: jax.Array  # () i32
+    best_w: jax.Array  # (m, k)
+    best_h: jax.Array  # (k, n)
+
+
 def _quarantine_lanes(labels, dnorm, stops):
     """Per-rank numeric-quarantine masking shared by every sweep
     epilogue: lanes that stopped with ``StopReason.NUMERIC_FAULT``
@@ -490,6 +509,62 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         a = jnp.asarray(a, dtype)
         keys = jax.random.split(key, padded)
         return sharded(a, keys)
+
+    return jax.jit(impl)
+
+
+@lru_cache(maxsize=64)
+def _build_chunk_sweep_fn(k: int, n_chunk: int, solver_cfg: SolverConfig,
+                          init_cfg: InitConfig, label_rule: str,
+                          poison: tuple = (), fault_token=None):
+    """Sweep builder for the durable-checkpoint chunk executor
+    (``nmfx/checkpoint.py``): solve ``n_chunk`` restarts of rank ``k``
+    from EXPLICIT per-restart keys (a slice of the canonical
+    ``split(fold_in(root, k), restarts)`` chain) and return the
+    per-lane :class:`ChunkSweepOutput` a completion record persists.
+
+    Keyed by the chunk SIZE, not its offset, so every same-sized chunk
+    of a rank shares one compiled executable; ``poison`` carries the
+    chunk-LOCAL ``solve.nonfinite`` lane indices (the global spec is
+    offset-dependent, so the checkpoint layer translates before the
+    build — ``fault_token`` keys the cache as everywhere else).
+
+    Engine routing: the packed-family mu backends run ``mu_packed``
+    (their per-k engine); everything else runs the vmapped generic
+    driver. Non-mu whole-grid opt-ins (hals "auto", neals/als/snmf/kl
+    ``backend="packed"``) therefore checkpoint through the vmapped
+    driver — the manifest hashes this resolution
+    (``checkpoint.engine_family``), so a ledger can never be resumed
+    under a different engine, and resumed-vs-uninterrupted parity holds
+    because BOTH checkpointed runs execute the identical chunk plan
+    through the identical engine (per-chunk batch composition included:
+    resume re-runs whole plan chunks, never partial ones).
+    """
+    dtype = jnp.dtype(solver_cfg.dtype)
+    packed = _use_packed(solver_cfg)
+    if packed:
+        from nmfx.ops.packed_mu import mu_packed, unpack_w
+
+    def impl(a: jax.Array, keys: jax.Array) -> ChunkSweepOutput:
+        a = jnp.asarray(a, dtype)
+        w0s, h0s = jax.vmap(
+            lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys)
+        w0s = _poison_restart_lanes(w0s, poison)
+        if packed:
+            res = mu_packed(a, w0s, h0s, solver_cfg)
+            hs = res.hp.reshape(n_chunk, k, -1)
+            ws = unpack_w(res.wp, n_chunk)
+        else:
+            res = jax.vmap(
+                lambda w0, h0: solve(a, w0, h0, solver_cfg))(w0s, h0s)
+            hs, ws = res.h, res.w
+        labels = jax.vmap(partial(labels_from_h, rule=label_rule))(hs)
+        labels, dnorm_best, _ = _quarantine_lanes(labels, res.dnorm,
+                                                  res.stop_reason)
+        best = jnp.argmin(dnorm_best)
+        return ChunkSweepOutput(labels, res.iterations, res.dnorm,
+                                res.stop_reason,
+                                best.astype(jnp.int32), ws[best], hs[best])
 
     return jax.jit(impl)
 
@@ -1388,7 +1463,8 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
           init_cfg: InitConfig = InitConfig(),
           mesh: Mesh | None = None,
           registry=None, profiler=None,
-          exec_cache=None, on_rank=None) -> dict[int, KSweepOutput]:
+          exec_cache=None, on_rank=None,
+          checkpoint=None) -> dict[int, KSweepOutput]:
     """Full (k × restart) grid — by default as ONE whole-grid solve.
 
     Under ``cfg.grid_exec`` "grid"/"auto" (and an eligible config, see
@@ -1420,13 +1496,39 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
     (``nmfx/harvest.py``) uses it to overlap per-rank device→host
     copies and host rank selection with the remaining ranks' device
     solve; checkpoint-loaded ranks are streamed too. The callback must
-    not block (it runs on the dispatching thread)."""
+    not block (it runs on the dispatching thread).
+
+    ``checkpoint`` (nmfx.config.CheckpointConfig): run through the
+    durable sweep ledger (``nmfx/checkpoint.py``) — per-(k,
+    restart-chunk) completion records with atomic writes, resume of
+    only the missing chunks, results bit-identical to an uninterrupted
+    checkpointed run. Mutually exclusive with ``registry`` (the legacy
+    per-rank path) and ``mesh`` (the chunk executor owns its execution
+    plan; see ``nmfx.distributed`` for elastic multi-device durable
+    sweeps)."""
     if profiler is None:
         from nmfx.profiling import NullProfiler
 
         profiler = NullProfiler()
     if on_rank is None:
         on_rank = _noop_rank
+    if checkpoint is not None:
+        if registry is not None:
+            raise ValueError(
+                "pass either checkpoint (the durable chunked ledger) or "
+                "registry (the legacy per-rank SweepRegistry), not both")
+        if mesh is not None and any(
+                mesh.shape[ax] > 1 for ax in mesh.axis_names):
+            raise ValueError(
+                "checkpointed sweeps execute per-(k, restart-chunk) on "
+                "the default device (the chunk plan is the durability "
+                "unit); drop the mesh, or use nmfx.distributed's "
+                "elastic shard runner for multi-device durable sweeps")
+        from nmfx.checkpoint import run_checkpointed_sweep
+
+        return run_checkpointed_sweep(a, cfg, solver_cfg, init_cfg,
+                                      checkpoint, profiler=profiler,
+                                      on_rank=on_rank)
     if (exec_cache is not None and registry is None
             and exec_cache.cacheable(cfg, solver_cfg, mesh)):
         return exec_cache.run_sweep(a, cfg, solver_cfg, init_cfg, mesh,

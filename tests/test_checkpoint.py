@@ -1,0 +1,430 @@
+"""Durable sweeps (ISSUE 9 tentpole): checkpoint/resume ledger,
+preemption tolerance, and the bit-identical resume contract.
+
+The acceptance property: killing a checkpointed sweep mid-run and
+resuming it produces a ``ConsensusResult`` BIT-IDENTICAL to an
+uninterrupted checkpointed run of the same (data, config, chunk plan) —
+consensus, rho, membership, order, iterations, stop_reasons, dnorms,
+best_w/best_h — on every engine family the chunk executor routes
+(packed mu, vmapped mu, and the non-mu vmapped family). The injected
+kill is the ``proc.preempt`` fault site, which fires between a chunk's
+solve and its commit — the worst realistic kill point (the in-flight
+chunk is lost, committed records survive). Heavy engine variants carry
+the ``slow`` marker; tier-1 keeps the smallest shapes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nmfx import checkpoint as ckpt
+from nmfx import faults
+from nmfx.api import nmfconsensus
+from nmfx.config import (CheckpointConfig, ConsensusConfig, InitConfig,
+                         SolverConfig)
+
+KW = dict(ks=(2, 3), restarts=4, seed=5)
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    from nmfx.datasets import two_group_matrix
+
+    return two_group_matrix(n_genes=60, n_per_group=10, seed=7)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warnings():
+    faults._reset_warned()
+    yield
+    faults.disarm()
+
+
+def _cfg(path, chunk=2, **kw):
+    return CheckpointConfig(directory=str(path), every_n_restarts=chunk,
+                            **kw)
+
+
+def assert_bit_identical(got, ref):
+    assert set(got.per_k) == set(ref.per_k)
+    for k in ref.per_k:
+        s, q = got.per_k[k], ref.per_k[k]
+        for field in ("consensus", "membership", "order", "iterations",
+                      "dnorms", "stop_reasons", "best_w", "best_h"):
+            sv = np.ascontiguousarray(np.asarray(getattr(s, field)))
+            qv = np.ascontiguousarray(np.asarray(getattr(q, field)))
+            assert sv.shape == qv.shape and sv.dtype == qv.dtype \
+                and sv.tobytes() == qv.tobytes(), f"{field} k={k}"
+        assert s.rho == q.rho, f"rho k={k}"
+
+
+def _run(data, path, scfg=None, chunk=2, **over):
+    kw = dict(KW, **over)
+    return nmfconsensus(data, solver_cfg=scfg, max_iter=None,
+                        checkpoint=_cfg(path, chunk=chunk), **kw)
+
+
+# ---------------------------------------------------------------------
+# plan + config basics
+# ---------------------------------------------------------------------
+
+def test_plan_chunks_deterministic_boundaries():
+    assert ckpt.plan_chunks(10, 4) == ((0, 4), (4, 8), (8, 10))
+    assert ckpt.plan_chunks(4, None) == ((0, 4),)
+    assert ckpt.plan_chunks(3, 8) == ((0, 3),)
+
+
+def test_checkpoint_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="every_n_restarts"):
+        CheckpointConfig(str(tmp_path), every_n_restarts=0)
+    with pytest.raises(ValueError, match="every_s"):
+        CheckpointConfig(str(tmp_path), every_s=0.0)
+    with pytest.raises(ValueError, match="directory"):
+        CheckpointConfig(directory="")
+
+
+def test_compose_guards(small_data, tmp_path):
+    from nmfx.sweep import default_mesh
+
+    with pytest.raises(ValueError, match="not both"):
+        nmfconsensus(small_data, checkpoint=str(tmp_path / "a"),
+                     checkpoint_dir=str(tmp_path / "b"), **KW)
+    with pytest.raises(ValueError, match="keep_factors"):
+        nmfconsensus(small_data, checkpoint=str(tmp_path / "a"),
+                     keep_factors=True, **KW)
+    mesh = default_mesh()
+    if mesh is not None:
+        with pytest.raises(ValueError, match="mesh"):
+            nmfconsensus(small_data, checkpoint=str(tmp_path / "a"),
+                         mesh=mesh, **KW)
+
+
+# ---------------------------------------------------------------------
+# resume semantics
+# ---------------------------------------------------------------------
+
+def test_fully_checkpointed_rerun_bit_identical(small_data, tmp_path):
+    """A fully-checkpointed re-run is bit-identical AND solves nothing
+    (counter-gated, the exec-cache discipline)."""
+    scfg = SolverConfig(algorithm="mu", max_iter=40)
+    r1 = _run(small_data, tmp_path / "c", scfg)
+    solved = ckpt.chunks_solved_count()
+    r2 = _run(small_data, tmp_path / "c", scfg)
+    assert ckpt.chunks_solved_count() == solved  # zero re-solves
+    assert_bit_identical(r2, r1)
+
+
+@pytest.mark.slow
+def test_checkpointed_close_to_plain_sweep(small_data, tmp_path):
+    """A checkpointed run agrees with the plain sweep to float
+    tolerance (different consensus reduction arithmetic: exact host
+    integer counts vs on-device f32 einsum)."""
+    scfg = SolverConfig(algorithm="mu", max_iter=40)
+    r1 = _run(small_data, tmp_path / "c", scfg)
+    plain = nmfconsensus(small_data, solver_cfg=scfg, use_mesh=False,
+                         **KW)
+    for k in KW["ks"]:
+        np.testing.assert_allclose(plain.per_k[k].consensus,
+                                   r1.per_k[k].consensus, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_widening_ks_reuses_completed_ranks(small_data, tmp_path):
+    scfg = SolverConfig(algorithm="mu", max_iter=40)
+    r1 = _run(small_data, tmp_path / "c", scfg, ks=(2,))
+    solved = ckpt.chunks_solved_count()
+    r2 = _run(small_data, tmp_path / "c", scfg, ks=(2, 3))
+    # rank 2's chunks loaded, only rank 3's solved (2 chunks of 2)
+    assert ckpt.chunks_solved_count() == solved + 2
+    assert np.asarray(r1.per_k[2].consensus).tobytes() == \
+        np.asarray(r2.per_k[2].consensus).tobytes()
+
+
+#: tier-1 keeps ONE engine representative (packed mu — the default
+#: family); the other chunk-executor routes ride the slow tier to
+#: respect the ~870 s budget (tests/conftest discipline from PR 2)
+ENGINES = [
+    pytest.param(SolverConfig(algorithm="mu", max_iter=30),
+                 id="mu-packed"),
+]
+
+ENGINES_SLOW = [
+    pytest.param(SolverConfig(algorithm="mu", max_iter=30,
+                              backend="vmap"), id="mu-vmap"),
+    pytest.param(SolverConfig(algorithm="hals", max_iter=30),
+                 id="hals-grid-family"),
+    pytest.param(SolverConfig(algorithm="als", max_iter=30), id="als"),
+    pytest.param(SolverConfig(algorithm="kl", max_iter=30), id="kl"),
+]
+
+
+def _kill_resume_roundtrip(small_data, tmp_path, scfg):
+    """Reference uninterrupted run, killed-at-~50% run (proc.preempt),
+    resume, bit-compare — the acceptance criterion's body."""
+    ref = _run(small_data, tmp_path / "ref", scfg)
+    faults.arm("proc.preempt", every=3, max_fires=1)  # ~50% of 4 chunks
+    try:
+        with pytest.raises(ckpt.Preempted):
+            _run(small_data, tmp_path / "kill", scfg)
+    finally:
+        faults.disarm("proc.preempt")
+    persisted = [n for n in os.listdir(tmp_path / "kill")
+                 if n.endswith(".npz")]
+    assert 0 < len(persisted) < 4  # really mid-run: partial ledger
+    res = _run(small_data, tmp_path / "kill", scfg)
+    assert_bit_identical(res, ref)
+
+
+@pytest.mark.parametrize("scfg", ENGINES)
+def test_kill_at_half_then_resume_bit_identical(small_data, tmp_path,
+                                                scfg):
+    _kill_resume_roundtrip(small_data, tmp_path, scfg)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scfg", ENGINES_SLOW)
+def test_kill_resume_bit_identical_slow_engines(small_data, tmp_path,
+                                                scfg):
+    _kill_resume_roundtrip(small_data, tmp_path, scfg)
+
+
+@pytest.mark.slow
+def test_grid_exec_knobs_inert_under_checkpointing(small_data,
+                                                   tmp_path):
+    """grid_exec/grid_slots are execution strategy the chunk plan
+    replaces: runs differing only in them share one ledger (manifest
+    unchanged — CHECKPOINT_EXEMPT_FIELDS) and stay bit-identical."""
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    r1 = _run(small_data, tmp_path / "c", scfg, grid_exec="grid")
+    solved = ckpt.chunks_solved_count()
+    r2 = _run(small_data, tmp_path / "c", scfg, grid_exec="per_k",
+              grid_slots=16)
+    assert ckpt.chunks_solved_count() == solved  # same manifest: resume
+    assert_bit_identical(r2, r1)
+
+
+# ---------------------------------------------------------------------
+# manifest guard: never a wrong resume, never a crash
+# ---------------------------------------------------------------------
+
+def test_manifest_mismatch_is_clean_cold_start(small_data, tmp_path):
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    _run(small_data, tmp_path / "c", scfg, seed=5)
+    with pytest.warns(RuntimeWarning, match="COLD START"):
+        r_new = _run(small_data, tmp_path / "c", scfg, seed=6)
+    ref = _run(small_data, tmp_path / "fresh", scfg, seed=6)
+    assert_bit_identical(r_new, ref)  # never the stale seed's numbers
+
+
+@pytest.mark.slow
+def test_manifest_covers_solver_numerics(small_data, tmp_path):
+    """A numerics-affecting SolverConfig change cold-starts; the
+    declared non-numerics knob (restart_chunk) resumes."""
+    _run(small_data, tmp_path / "c",
+         SolverConfig(algorithm="mu", max_iter=30))
+    with pytest.warns(RuntimeWarning, match="COLD START"):
+        _run(small_data, tmp_path / "c",
+             SolverConfig(algorithm="mu", max_iter=30, tol_x=1e-6))
+    faults._reset_warned()
+    solved = ckpt.chunks_solved_count()
+    _run(small_data, tmp_path / "c",
+         SolverConfig(algorithm="mu", max_iter=30, tol_x=1e-6,
+                      restart_chunk=2))
+    assert ckpt.chunks_solved_count() == solved  # resumed, no warning
+
+
+@pytest.mark.slow
+def test_chunk_plan_change_is_cold_start(small_data, tmp_path):
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    _run(small_data, tmp_path / "c", scfg, chunk=2)
+    with pytest.warns(RuntimeWarning, match="COLD START"):
+        r = _run(small_data, tmp_path / "c", scfg, chunk=4)
+    ref = _run(small_data, tmp_path / "f", scfg, chunk=4)
+    assert_bit_identical(r, ref)
+
+
+@pytest.mark.slow
+def test_resume_false_recomputes(small_data, tmp_path):
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    r1 = _run(small_data, tmp_path / "c", scfg)
+    solved = ckpt.chunks_solved_count()
+    with pytest.warns(RuntimeWarning, match="resume=False"):
+        r2 = nmfconsensus(small_data, solver_cfg=scfg,
+                          checkpoint=_cfg(tmp_path / "c", resume=False),
+                          **KW)
+    assert ckpt.chunks_solved_count() == solved + 4
+    assert_bit_identical(r2, r1)  # recompute, same numbers
+
+
+def test_torn_record_skipped_and_rerun(small_data, tmp_path):
+    """A truncated record (the crash class predating atomic writes,
+    or external corruption) is skipped warn-once and its chunk re-runs
+    — bit-identical result, never a crash."""
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    ref = _run(small_data, tmp_path / "c", scfg)
+    with open(tmp_path / "c" / "k2_r0-2.npz", "r+b") as fh:
+        fh.truncate(32)
+    with pytest.warns(RuntimeWarning, match="torn/corrupt"):
+        res = _run(small_data, tmp_path / "c", scfg)
+    assert_bit_identical(res, ref)
+
+
+def test_keep_factors_refused(small_data, tmp_path):
+    with pytest.raises(ValueError, match="keep_factors"):
+        _run(small_data, tmp_path / "c", keep_factors=True)
+
+
+# ---------------------------------------------------------------------
+# chaos sites + buffered (every_s) persistence
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_ckpt_write_fault_degrades_not_crashes(small_data, tmp_path):
+    """An armed ckpt.write fault (disk-full rehearsal) costs durability
+    only: the run completes warn-once with identical results and an
+    empty ledger; the next (unarmed) run recomputes."""
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    ref = _run(small_data, tmp_path / "ref", scfg)
+    faults.arm("ckpt.write", every=1)
+    try:
+        with pytest.warns(RuntimeWarning, match="persist"):
+            res = _run(small_data, tmp_path / "c", scfg)
+    finally:
+        faults.disarm("ckpt.write")
+    assert_bit_identical(res, ref)
+    assert not [n for n in os.listdir(tmp_path / "c")
+                if n.endswith(".npz")]
+
+
+@pytest.mark.slow
+def test_ckpt_load_fault_forces_recompute_exact(small_data, tmp_path):
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    ref = _run(small_data, tmp_path / "c", scfg)
+    solved = ckpt.chunks_solved_count()
+    faults.arm("ckpt.load", every=1)
+    try:
+        with pytest.warns(RuntimeWarning, match="torn/corrupt"):
+            res = _run(small_data, tmp_path / "c", scfg)
+    finally:
+        faults.disarm("ckpt.load")
+    assert ckpt.chunks_solved_count() == solved + 4  # all re-ran
+    assert_bit_identical(res, ref)
+
+
+def _dummy_record(m=3, n=4, k=2, c=2):
+    from nmfx.sweep import ChunkSweepOutput
+
+    return ChunkSweepOutput(
+        labels=np.zeros((c, n), np.int32),
+        iterations=np.zeros((c,), np.int32),
+        dnorms=np.zeros((c,), np.float32),
+        stop_reasons=np.zeros((c,), np.int32),
+        best_local=np.int32(0),
+        best_w=np.zeros((m, k), np.float32),
+        best_h=np.zeros((k, n), np.float32))
+
+
+def _open_buffered(tmp_path, every_s=3600.0):
+    ccfg = ConsensusConfig(ks=(2,), restarts=4, seed=0)
+    scfg = SolverConfig(algorithm="mu", max_iter=10)
+    a = np.ones((3, 4), np.float32)
+    cp = CheckpointConfig(str(tmp_path / "buf"), every_n_restarts=2,
+                          every_s=every_s)
+    return ckpt.SweepCheckpoint.open(a, ccfg, scfg, InitConfig(), cp)
+
+
+def test_every_s_buffers_until_flush(tmp_path):
+    ck = _open_buffered(tmp_path)
+    ck.save(2, 0, 2, _dummy_record())
+    assert not ck.has(2, 0, 2)  # buffered, not yet durable
+    ck.flush()
+    assert ck.has(2, 0, 2)
+    assert ck.try_load(2, 0, 2) is not None
+
+
+def test_signal_flush_hook_flushes_then_defers(tmp_path):
+    """The SIGTERM flush hook writes the buffered tail before the
+    process dies, then re-raises the default disposition — the
+    graceful-preemption guarantee every_s durability rests on."""
+    import signal
+
+    ck = _open_buffered(tmp_path)
+    restore = ckpt.install_signal_flush(ck)
+    try:
+        ck.save(2, 0, 2, _dummy_record())
+        assert not ck.has(2, 0, 2)
+        handler = signal.getsignal(signal.SIGTERM)
+        with pytest.raises(SystemExit) as exc:
+            handler(signal.SIGTERM, None)
+        assert exc.value.code == 128 + signal.SIGTERM
+        assert ck.has(2, 0, 2)  # flushed before dying
+    finally:
+        restore()
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+@pytest.mark.slow
+def test_quarantine_composes_with_checkpointing(small_data, tmp_path):
+    """A solve.nonfinite-poisoned lane is quarantined inside the chunk
+    executor (trace_token keys the builder cache) and the record
+    carries NUMERIC_FAULT; the survivor consensus finalizes exactly."""
+    from nmfx.solvers.base import StopReason
+
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    faults.arm("solve.nonfinite", lanes=((2, 1),))
+    try:
+        res = _run(small_data, tmp_path / "c", scfg)
+    finally:
+        faults.disarm("solve.nonfinite")
+    stops = np.asarray(res.per_k[2].stop_reasons)
+    assert stops[1] == int(StopReason.NUMERIC_FAULT)
+    assert (stops != int(StopReason.NUMERIC_FAULT)).sum() == 3
+    assert np.isfinite(res.per_k[2].consensus).all()
+
+
+@pytest.mark.slow
+def test_cold_start_spares_foreign_files(small_data, tmp_path):
+    """A cold start clears ONLY the ledger's own completion records —
+    user files, serve spill records, and a legacy SweepRegistry's
+    per-rank k<k>.npz parked in the same directory survive."""
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    _run(small_data, tmp_path / "c", scfg, seed=5)
+    (tmp_path / "c" / "notes.txt").write_text("keep me")
+    (tmp_path / "c" / "k2.npz").write_bytes(b"legacy registry record")
+    (tmp_path / "c" / "spill_1_0.npz").write_bytes(b"serve spill")
+    with pytest.warns(RuntimeWarning, match="COLD START"):
+        _run(small_data, tmp_path / "c", scfg, seed=6)
+    for name in ("notes.txt", "k2.npz", "spill_1_0.npz"):
+        assert (tmp_path / "c" / name).exists(), name
+
+
+@pytest.mark.slow
+def test_legacy_registry_dir_warns_not_resumes(small_data, tmp_path):
+    """Pointing the durable ledger at a legacy SweepRegistry directory
+    warns that its records are a different format (left untouched)
+    instead of silently recomputing next to them."""
+    nmfconsensus(small_data, max_iter=30, use_mesh=False,
+                 checkpoint_dir=str(tmp_path / "c"), **KW)
+    assert (tmp_path / "c" / "registry.json").exists()
+    with pytest.warns(RuntimeWarning, match="legacy per-rank"):
+        _run(small_data, tmp_path / "c",
+             SolverConfig(algorithm="mu", max_iter=30))
+    assert (tmp_path / "c" / "k2.npz").exists()  # untouched
+
+
+def test_close_never_spills_cancelled_requests(tmp_path):
+    """A future the caller cancelled before shutdown is not spilled:
+    readmit() must not resurrect explicitly-cancelled work."""
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    spill = str(tmp_path / "spill")
+    srv = NMFXServer(ServeConfig(spill_dir=spill), start=False)
+    f1 = srv.submit(np.abs(np.random.default_rng(0).random((8, 6))),
+                    ks=(2,), restarts=2)
+    assert f1.cancel()
+    srv.close(cancel_pending=True)
+    assert srv.counters["spilled"] == 0
+    import os
+
+    assert not os.path.isdir(spill) or os.listdir(spill) == []

@@ -115,3 +115,169 @@ def test_global_mesh_grid_axes():
     assert mesh.shape[SAMPLE_AXIS] == 2
     with pytest.raises(ValueError, match="divide"):
         dist.global_mesh(feature_shards=3)  # 8 % 3 != 0
+
+
+# ---------------------------------------------------------------------
+# Elastic shard recovery (ISSUE 9): the durable-ledger counterpart of
+# the fail-stop SPMD mesh — a shard (device) lost mid-sweep has its
+# incomplete restart-chunks re-dispatched to the survivors (same key
+# chains => same results), with zero stranded work.
+# ---------------------------------------------------------------------
+
+def _bit_identical(got, ref):
+    assert set(got.per_k) == set(ref.per_k)
+    for k in ref.per_k:
+        for field in ("consensus", "membership", "order", "iterations",
+                      "dnorms", "stop_reasons", "best_w", "best_h"):
+            sv = np.ascontiguousarray(
+                np.asarray(getattr(got.per_k[k], field)))
+            qv = np.ascontiguousarray(
+                np.asarray(getattr(ref.per_k[k], field)))
+            assert sv.tobytes() == qv.tobytes(), f"{field} k={k}"
+        assert got.per_k[k].rho == ref.per_k[k].rho
+
+
+def test_elastic_shard_loss_recovers_exact(two_group_data, tmp_path):
+    """Kill one of three shards mid-sweep (armed proc.preempt): the
+    survivors re-dispatch its incomplete chunks and the result is
+    bit-identical to the single-device checkpointed reference — zero
+    stranded work, a complete ledger, and a dead heartbeat on record."""
+    from nmfx import checkpoint as ckpt
+    from nmfx import faults
+    from nmfx.api import nmfconsensus
+    from nmfx.config import CheckpointConfig, SolverConfig
+
+    scfg = SolverConfig(algorithm="mu", max_iter=40)
+    kw = dict(ks=(2, 3), restarts=6, seed=5)
+    ref = nmfconsensus(two_group_data, solver_cfg=scfg,
+                       checkpoint=CheckpointConfig(
+                           str(tmp_path / "ref"), every_n_restarts=2),
+                       **kw)
+    el_cfg = CheckpointConfig(str(tmp_path / "el"), every_n_restarts=2)
+    faults.arm("proc.preempt", every=2, max_fires=1)
+    try:
+        res = dist.elastic_consensus(
+            two_group_data, solver_cfg=scfg, checkpoint=el_cfg,
+            devices=jax.devices()[:3], **kw)
+    finally:
+        faults.disarm("proc.preempt")
+    _bit_identical(res, ref)
+    # zero stranded work: every (k, chunk) unit committed a record
+    import os
+
+    assert len([n for n in os.listdir(tmp_path / "el")
+                if n.startswith("k") and n.endswith(".npz")]) == 6
+    # exactly one shard died (max_fires=1) and its heartbeat says so
+    from nmfx.config import ConsensusConfig, InitConfig
+
+    ck = ckpt.SweepCheckpoint.open(
+        np.asarray(two_group_data),
+        ConsensusConfig(ks=kw["ks"], restarts=kw["restarts"],
+                        seed=kw["seed"]),
+        scfg, InitConfig(), el_cfg)
+    status = ck.shard_status()
+    assert sum(1 for v in status.values() if not v["alive"]) == 1
+    assert sum(1 for v in status.values() if v["alive"]) == 2
+
+
+@pytest.mark.slow
+def test_elastic_resumes_preempted_single_device_ledger(two_group_data,
+                                                        tmp_path):
+    """Cross-layer resume: a single-device checkpointed run killed
+    mid-sweep leaves a partial ledger; the elastic runner opens the
+    SAME ledger, dispatches only the missing units, and the final
+    result is bit-identical to the uninterrupted reference."""
+    from nmfx import checkpoint as ckpt
+    from nmfx import faults
+    from nmfx.api import nmfconsensus
+    from nmfx.config import CheckpointConfig, SolverConfig
+
+    scfg = SolverConfig(algorithm="mu", max_iter=40)
+    kw = dict(ks=(2, 3), restarts=6, seed=5)
+    ref = nmfconsensus(two_group_data, solver_cfg=scfg,
+                       checkpoint=CheckpointConfig(
+                           str(tmp_path / "ref"), every_n_restarts=2),
+                       **kw)
+    cfg = CheckpointConfig(str(tmp_path / "c"), every_n_restarts=2)
+    faults.arm("proc.preempt", every=3, max_fires=1)
+    try:
+        with pytest.raises(ckpt.Preempted):
+            nmfconsensus(two_group_data, solver_cfg=scfg,
+                         checkpoint=cfg, **kw)
+    finally:
+        faults.disarm("proc.preempt")
+    before = ckpt.chunks_solved_count()
+    res = dist.elastic_consensus(two_group_data, solver_cfg=scfg,
+                                 checkpoint=cfg,
+                                 devices=jax.devices()[:2], **kw)
+    assert ckpt.chunks_solved_count() - before == 4  # 6 units - 2 kept
+    _bit_identical(res, ref)
+
+
+@pytest.mark.slow
+def test_elastic_all_shards_dead_raises_then_resumes(two_group_data,
+                                                     tmp_path):
+    """Every shard dying leaves a typed error pointing at the ledger;
+    a later (unarmed) run resumes it to the exact reference result —
+    stranded work is a transient state, never a terminal one."""
+    from nmfx import faults
+    from nmfx.api import nmfconsensus
+    from nmfx.config import CheckpointConfig, SolverConfig
+
+    scfg = SolverConfig(algorithm="mu", max_iter=40)
+    kw = dict(ks=(2,), restarts=4, seed=5)
+    cfg = CheckpointConfig(str(tmp_path / "c"), every_n_restarts=2)
+    faults.arm("proc.preempt", every=1)  # every unit attempt preempts
+    try:
+        with pytest.raises(RuntimeError, match="re-run to resume"):
+            dist.elastic_consensus(two_group_data, solver_cfg=scfg,
+                                   checkpoint=cfg,
+                                   devices=jax.devices()[:2], **kw)
+    finally:
+        faults.disarm("proc.preempt")
+    ref = nmfconsensus(two_group_data, solver_cfg=scfg,
+                       checkpoint=CheckpointConfig(
+                           str(tmp_path / "ref"), every_n_restarts=2),
+                       **kw)
+    res = dist.elastic_consensus(two_group_data, solver_cfg=scfg,
+                                 checkpoint=cfg,
+                                 devices=jax.devices()[:2], **kw)
+    _bit_identical(res, ref)
+
+
+@pytest.mark.slow
+def test_elastic_absorbed_crash_does_not_raise(two_group_data, tmp_path,
+                                               monkeypatch):
+    """A non-Preempted shard crash whose units the survivors absorbed
+    is announced warn-once but NOT re-raised: the result is complete
+    and exact (raising only when work strands is the elastic
+    contract)."""
+    from nmfx import checkpoint as ckpt
+    from nmfx.api import nmfconsensus
+    from nmfx.config import CheckpointConfig, SolverConfig
+    from nmfx.faults import _reset_warned
+
+    _reset_warned()
+    scfg = SolverConfig(algorithm="mu", max_iter=40)
+    kw = dict(ks=(2,), restarts=4, seed=5)
+    ref = nmfconsensus(two_group_data, solver_cfg=scfg,
+                       checkpoint=CheckpointConfig(
+                           str(tmp_path / "ref"), every_n_restarts=2),
+                       **kw)
+    real = ckpt.solve_chunk_host
+    state = {"crashed": False}
+
+    def crash_once(*args, **kwargs):
+        if not state["crashed"]:
+            state["crashed"] = True
+            raise RuntimeError("transient device error")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(ckpt, "solve_chunk_host", crash_once)
+    with pytest.warns(RuntimeWarning, match="crashed"):
+        res = dist.elastic_consensus(
+            two_group_data, solver_cfg=scfg,
+            checkpoint=CheckpointConfig(str(tmp_path / "el"),
+                                        every_n_restarts=2),
+            devices=jax.devices()[:2], **kw)
+    _bit_identical(res, ref)

@@ -174,6 +174,46 @@ def test_trace_token_fences_trace_affecting_sites():
     assert faults.trace_token() is None
 
 
+def test_checkpoint_sites_registered_and_trace_inert():
+    """ISSUE 9: the durability site family exists, and arming any of it
+    never perturbs the trace token — the checkpoint sites are host-side
+    only, so an armed process keeps byte-identical builder/executable
+    cache keys and can never be served (or produce) a stale executable
+    through them. A checkpoint site that DID alter traced code would
+    have to join faults._TRACE_SITES and this test."""
+    for site in ("ckpt.write", "ckpt.load", "proc.preempt"):
+        assert site in faults.SITES
+        assert site not in faults._TRACE_SITES
+        with faults.scoped(site, every=1):
+            assert faults.trace_token() is None
+            # hit-counted like every host-side site
+            assert faults.fire(site)
+        assert faults.armed(site) is None
+
+
+def test_proc_preempt_raises_preempted_from_chunk_executor(small_data):
+    """An armed proc.preempt fires between a chunk's solve and its
+    commit and surfaces as the typed checkpoint.Preempted — a
+    BaseException, so no broad except-Exception recovery layer can
+    swallow a preemption and keep computing."""
+    import jax
+
+    from nmfx import checkpoint as ckpt
+    from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+
+    assert not issubclass(ckpt.Preempted, Exception)
+    ccfg = ConsensusConfig(ks=(2,), restarts=2, seed=0)
+    scfg = SolverConfig(algorithm="mu", max_iter=10)
+    a_dev = jax.numpy.asarray(small_data, jax.numpy.float32)
+    with faults.scoped("proc.preempt", every=1):
+        with pytest.raises(ckpt.Preempted):
+            ckpt.solve_chunk_host(a_dev, 2, 0, 2, ccfg, scfg,
+                                  InitConfig())
+    # unarmed: the same call commits normally
+    rec = ckpt.solve_chunk_host(a_dev, 2, 0, 2, ccfg, scfg, InitConfig())
+    assert rec.labels.shape == (2, small_data.shape[1])
+
+
 def test_warn_once_per_category():
     with pytest.warns(RuntimeWarning, match="first"):
         faults.warn_once("chaos-test-cat", "first")

@@ -915,3 +915,83 @@ def test_cli_write_baseline_refresh_keeps_records(tmp_path):
         capture_output=True, text=True, timeout=240, env=env)
     assert proc.returncode == 0
     assert json.loads(baseline.read_text()) == first
+
+
+# ---------------------------------------------------------------- NMFX007
+
+def _manifest_universe(**overrides):
+    """A minimal healthy checkpoint-manifest universe (the NMFX001
+    bad-universe pattern); overrides inject the defect."""
+    base = dict(
+        solver_fields=frozenset({"algorithm", "tol_x", "restart_chunk"}),
+        consensus_fields=frozenset({"restarts", "seed", "label_rule",
+                                    "ks", "linkage"}),
+        manifest_solver=frozenset({"algorithm", "tol_x"}),
+        manifest_consensus=frozenset({"restarts", "seed", "label_rule"}),
+        declared_non_numerics=("restart_chunk",),
+        manifest_consensus_excluded=("ks", "linkage"),
+        declared_checkpoint_exempt=("ks", "linkage"),
+    )
+    base.update(overrides)
+    return base
+
+
+def test_nmfx007_clean_universe_quiet():
+    from nmfx.analysis.rules_config import check_manifest_coverage
+
+    assert check_manifest_coverage(**_manifest_universe()) == []
+
+
+def test_nmfx007_live_tree_clean():
+    """The shipped tree must satisfy its own manifest-coverage
+    contract (the tier-1 zero-findings gate covers the Rule wrapper;
+    this pins the pure check on the live universe directly)."""
+    from nmfx.analysis.rules_config import (_live_manifest_universe,
+                                            check_manifest_coverage)
+
+    assert check_manifest_coverage(**_live_manifest_universe()) == []
+
+
+def test_nmfx007_solver_field_dropped_from_manifest_fires():
+    """A result-affecting SolverConfig field missing from the manifest
+    is the stale-resume hazard the rule exists for."""
+    from nmfx.analysis.rules_config import check_manifest_coverage
+
+    problems = check_manifest_coverage(**_manifest_universe(
+        manifest_solver=frozenset({"algorithm"})))
+    assert any("SolverConfig.tol_x" in p and "checkpoint manifest" in p
+               for p in problems)
+
+
+def test_nmfx007_consensus_field_dropped_from_manifest_fires():
+    from nmfx.analysis.rules_config import check_manifest_coverage
+
+    problems = check_manifest_coverage(**_manifest_universe(
+        manifest_consensus=frozenset({"restarts", "label_rule"})))
+    assert any("ConsensusConfig.seed" in p for p in problems)
+
+
+def test_nmfx007_undeclared_exclusion_fires():
+    """Excluding a ConsensusConfig field from the manifest without the
+    CHECKPOINT_EXEMPT_FIELDS declaration (and its rationale) fires."""
+    from nmfx.analysis.rules_config import check_manifest_coverage
+
+    problems = check_manifest_coverage(**_manifest_universe(
+        manifest_consensus=frozenset({"restarts", "label_rule"}),
+        manifest_consensus_excluded=("ks", "linkage", "seed")))
+    assert any("ConsensusConfig.seed" in p
+               and "CHECKPOINT_EXEMPT_FIELDS" in p for p in problems)
+
+
+def test_nmfx007_stale_exempt_declaration_fires():
+    from nmfx.analysis.rules_config import check_manifest_coverage
+
+    problems = check_manifest_coverage(**_manifest_universe(
+        declared_checkpoint_exempt=("ks", "linkage", "not_a_field")))
+    assert any("not_a_field" in p and "stale" in p for p in problems)
+
+
+def test_nmfx007_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX007" in RULES

@@ -622,3 +622,113 @@ def test_packing_efficiency_counter():
     assert s["packing_efficiency"] == 1.0
     assert serve.packing_efficiency() is None \
         or 0.0 <= serve.packing_efficiency() <= 1.0
+
+
+# ---------------------------------------------------------------------
+# spill-on-shutdown + re-admission (ISSUE 9 — docs/serving.md
+# "Durability model"): close() no longer discards queued-but-
+# undispatched requests when ServeConfig.spill_dir is set
+# ---------------------------------------------------------------------
+
+def test_close_drains_queued_not_dispatched():
+    """Pin the default drain semantics: close() WITHOUT cancel_pending
+    completes requests that were queued but not yet dispatched (a
+    paused server holds them in the queue until close unpauses it)."""
+    eng = FakeEngine()
+    srv = NMFXServer(ServeConfig(), engine=eng, start=False)
+    f = srv.submit(_mat(), ks=(2,), restarts=2)
+    srv.close()  # unpauses and drains — never abandons queued work
+    assert f.result(timeout=5) is not None
+    assert srv.counters["spilled"] == 0
+
+
+def test_close_cancel_pending_spills_queued(tmp_path):
+    """close(cancel_pending=True) with a spill_dir persists each
+    queued request's payload before failing its future, so shutdown
+    loses no work."""
+    import os
+
+    spill = str(tmp_path / "spill")
+    eng = FakeEngine()
+    srv = NMFXServer(ServeConfig(spill_dir=spill), engine=eng,
+                     start=False)
+    f1 = srv.submit(_mat(), ks=(2,), restarts=2, priority=1)
+    f2 = srv.submit(_mat(), ks=(2, 3), restarts=3, seed=7)
+    srv.close(cancel_pending=True)
+    for f in (f1, f2):
+        with pytest.raises(ServerClosed, match="spilled"):
+            f.result(timeout=5)
+    assert srv.counters["spilled"] == 2
+    assert len([n for n in os.listdir(spill)
+                if n.startswith("spill_")]) == 2
+    # a fresh server re-admits them through the normal submit path
+    eng2 = FakeEngine()
+    with NMFXServer(ServeConfig(spill_dir=spill), engine=eng2) as srv2:
+        futs = srv2.readmit()
+        assert len(futs) == 2
+        for f in futs:
+            assert f.result(timeout=10) is not None
+    assert srv2.counters["readmitted"] == 2
+    assert [n for n in os.listdir(spill)
+            if n.startswith("spill_")] == []  # consumed once admitted
+
+
+def test_close_cancel_pending_without_spill_dir_discards():
+    """Without a spill_dir the pre-ISSUE-9 semantics are unchanged:
+    queued requests fail with ServerClosed and nothing lands on disk."""
+    eng = FakeEngine()
+    srv = NMFXServer(ServeConfig(), engine=eng, start=False)
+    f = srv.submit(_mat(), ks=(2,), restarts=2)
+    srv.close(cancel_pending=True)
+    with pytest.raises(ServerClosed) as exc:
+        f.result(timeout=5)
+    assert "spilled" not in str(exc.value)
+    assert srv.counters["spilled"] == 0
+
+
+def test_readmit_skips_corrupt_spill_record(tmp_path):
+    """Torn spill records get the ledger's torn-record tolerance:
+    warn-once + skip, never a crash, and healthy records still admit."""
+    import os
+
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    (spill / "spill_0_0.npz").write_bytes(b"not a zip file")
+    from nmfx.faults import _reset_warned
+
+    _reset_warned()
+    eng = FakeEngine()
+    with NMFXServer(ServeConfig(spill_dir=str(spill)),
+                    engine=eng) as srv:
+        with pytest.warns(RuntimeWarning, match="torn/corrupt"):
+            futs = srv.readmit()
+    assert futs == []
+    assert os.path.exists(spill / "spill_0_0.npz")  # left for forensics
+
+
+def test_spill_readmit_bit_identical_real_engine(small_data, scfg):
+    """The re-admitted request's result is bit-identical to direct
+    submission — the serving exactness contract survives the spill
+    round-trip (real ExecCacheEngine, smallest shapes)."""
+    import os
+    import tempfile
+
+    from nmfx.exec_cache import ExecCache
+
+    spill = tempfile.mkdtemp()
+    cache = ExecCache()
+    srv = NMFXServer(ServeConfig(spill_dir=spill), exec_cache=cache,
+                     start=False)
+    f = srv.submit(small_data, ks=KS, restarts=RESTARTS, seed=11,
+                   solver_cfg=scfg)
+    srv.close(cancel_pending=True)
+    with pytest.raises(ServerClosed):
+        f.result(timeout=5)
+    assert len(os.listdir(spill)) == 1
+    with NMFXServer(ServeConfig(spill_dir=spill),
+                    exec_cache=cache) as srv2:
+        futs = srv2.readmit()
+        assert len(futs) == 1
+        got = futs[0].result(timeout=300)
+    ref = _solo(small_data, cache, scfg=scfg)
+    assert_result_bit_equal(got, ref)
