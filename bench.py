@@ -1285,6 +1285,68 @@ def main():
             shutil.rmtree(ref_dir, ignore_errors=True)
             shutil.rmtree(kill_dir, ignore_errors=True)
 
+    # --- observability stage (ISSUE 10, detail.obs) --------------------
+    # The telemetry layer's own cost, tracked across BENCH rounds so it
+    # can never silently grow: warm-path reps with the structured
+    # tracer enabled vs disabled (the metrics registry is ALWAYS on —
+    # it IS the module counters every gate above reads — so the
+    # toggleable cost is span recording), gated at < 3% of the warm
+    # e2e wall (exit 2). Also records the per-sweep trace event count
+    # and the flight-recorder postmortem size, so a span-explosion or
+    # event-flood regression shows up as a number, not a vibe.
+    def run_obs_stage():
+        from nmfx.obs import flight, metrics, trace
+
+        scfg_o = cfgs[args.backend]
+        tracer = trace.default_tracer()
+        walls = {False: [], True: []}
+        trace_events = 0
+        obs_reps = 3
+        for _ in range(obs_reps):
+            # interleaved off/on so session drift penalizes neither arm
+            for enabled in (False, True):
+                if enabled:
+                    tracer.clear()
+                    trace.enable()
+                try:
+                    _, e2e_wall_o, _, _, _ = timed_sweep(scfg_o, seed)
+                finally:
+                    if enabled:
+                        trace_events = tracer.event_count()
+                        trace.disable()
+                walls[enabled].append(e2e_wall_o)
+        off = min(walls[False])
+        on = min(walls[True])
+        overhead_frac = (on - off) / off
+        # the postmortem artifact as it would be written right now
+        # (built in-memory; no dump directory is configured in bench)
+        flight.dump("bench-obs-probe")
+        dump_bytes = len(json.dumps(flight.last_dump()))
+        snap = metrics.registry().snapshot()
+        series_count = sum(len(rec["series"]) for rec in snap.values())
+        # min-of-reps is the low-noise estimator, but single-digit-ms
+        # timer scatter on a loaded host can still exceed 3% of a short
+        # wall; the 50 ms absolute floor only matters when 3% of the
+        # wall is smaller than timer noise
+        budget = max(0.03 * off, 0.05)
+        if on - off >= budget:
+            print("bench OBS OVERHEAD FAILURE: warm e2e wall "
+                  f"{off:.3f}s untraced vs {on:.3f}s traced "
+                  f"({overhead_frac:.1%} overhead, gate < 3%) — span "
+                  "recording has crept into a hot path (per-iteration "
+                  "instead of per-phase?)", file=sys.stderr)
+            raise SystemExit(2)
+        return {
+            "wall_untraced_s": round(off, 3),
+            "wall_traced_s": round(on, 3),
+            "overhead_frac": round(overhead_frac, 4),
+            "overhead_gate": "ok",
+            "reps": obs_reps,
+            "trace_events_per_sweep": trace_events,
+            "flight_dump_bytes": dump_bytes,
+            "metric_series": series_count,
+        }
+
     # --- serve traffic stage (nmfx.serve) ------------------------------
     # Multi-tenant serving under load: Poisson arrivals over an
     # offered-load ladder into ONE NMFXServer (async request queue +
@@ -1604,6 +1666,10 @@ def main():
     print(f"bench: durability stage: {json.dumps(durability)}",
           file=sys.stderr)
 
+    obs_detail = run_obs_stage()
+    print(f"bench: observability stage: {json.dumps(obs_detail)}",
+          file=sys.stderr)
+
     # regression tracking: compare against the best prior round's record
     # (the warm metric drifted 1.384 s → 2.041/1.848 s across r03-r05
     # with nothing in the record to flag it) and stamp this run's
@@ -1655,6 +1721,7 @@ def main():
             "exec_cache": serving,
             "serve": traffic,
             "durability": durability,
+            "obs": obs_detail,
             # cold_wall_s/compile_wall_s are first-session numbers; with
             # a persistent cache dir a second session's cold run re-loads
             # these programs from disk instead of recompiling

@@ -19,6 +19,8 @@ NMFX006    silent degradation: broad except must re-raise, resolve a
            Future, or route through nmfx.faults.warn_once
 NMFX007    checkpoint-manifest coverage (the durable sweep ledger's
            resume-safety fingerprint, nmfx/checkpoint.py)
+NMFX008    fault-site flight-recorder coverage (every registered fault
+           site reaches the crash postmortem, nmfx/obs/flight.py)
 NMFX101    engine jaxpr stays f32 under x64 parity (jaxpr layer)
 NMFX102    no device_put inside engine loop bodies (jaxpr layer)
 =========  ==============================================================
@@ -47,6 +49,7 @@ from nmfx.analysis import rules_config  # noqa: F401  (NMFX001)
 from nmfx.analysis import rules_traced  # noqa: F401  (NMFX002/004/005)
 from nmfx.analysis import rules_alias   # noqa: F401  (NMFX003)
 from nmfx.analysis import rules_handlers  # noqa: F401  (NMFX006)
+from nmfx.analysis import rules_obs     # noqa: F401  (NMFX008)
 from nmfx.analysis import jaxpr_rules   # noqa: F401  (NMFX101/102)
 
 __all__ = ["run", "RULES", "Finding", "Rule", "register", "active",

@@ -1,0 +1,115 @@
+"""NMFX008 — fault-site flight-recorder coverage.
+
+The failure class: a chaos rehearsal whose postmortem is silent about
+its own injected failure. ISSUE 10's flight recorder
+(``nmfx/obs/flight.py``) turns "the watchdog resolved 14 stranded
+Futures" from a warn-once line into an inspectable artifact — but only
+for events that actually reach the ring. Fault-site fires reach it
+through ONE central emission (``nmfx.faults.fire`` routes every fire
+through ``flight.FAULT_EVENTS``), which makes the mapping the
+authoritative coverage declaration: a site registered in
+``nmfx.faults.SITES`` but missing from ``FAULT_EVENTS`` would fire
+with a made-up fallback category no dashboard or postmortem query
+knows to look for, and a mapping entry for an unregistered site is a
+stale declaration that can mask a rename (the site fires under its
+new name, the mapping still "covers" the old one).
+
+The rule cross-references the two AUTHORITATIVE declarations — the
+``SITES`` tuple in ``nmfx/faults.py`` and the
+``fault_event_categories()`` introspection hook over ``FAULT_EVENTS``
+— the same hook-vs-universe shape as NMFX001 (config-fingerprint
+coverage) and NMFX007 (checkpoint-manifest coverage). The check itself
+is a pure function over the two sets (``check_fault_event_coverage``)
+so the per-rule tests can inject a mutated universe (a dropped site, a
+stale mapping entry) and watch the rule fire; the Rule wrapper reads
+the live modules and anchors findings at the ``SITES`` declaration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from nmfx.analysis.core import Finding, Rule, register
+
+
+def check_fault_event_coverage(
+    sites: "frozenset[str]",
+    event_covered: "frozenset[str]",
+) -> "list[str]":
+    """The pure contract check: every registered fault site must have
+    a flight-recorder event category, and every mapped category must
+    correspond to a registered site (no stale declarations). Tests
+    inject mutated universes; the Rule wrapper reads the live
+    modules."""
+    problems: "list[str]" = []
+    for name in sorted(sites - event_covered):
+        problems.append(
+            f"fault site {name!r} is registered in nmfx.faults.SITES "
+            "but has no flight-recorder event category "
+            "(nmfx.obs.flight.FAULT_EVENTS) — an armed fire of it "
+            "would reach the postmortem only under an ad-hoc fallback "
+            "category no query knows to look for; add the site to "
+            "FAULT_EVENTS")
+    for name in sorted(event_covered - sites):
+        problems.append(
+            f"nmfx.obs.flight.FAULT_EVENTS maps {name!r}, which is not "
+            "a registered fault site (nmfx.faults.SITES) — stale "
+            "declaration; a renamed site would fire uncovered while "
+            "the mapping still claims the old name")
+    return problems
+
+
+def _sites_decl_line(tree: ast.Module) -> int:
+    """Line of the module-level ``SITES = (...)`` assignment, best
+    effort (findings anchor there — the declaration a new site lands
+    on)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "SITES":
+                    return node.lineno
+    return 1
+
+
+def _live_universe() -> dict:
+    from nmfx import faults
+    from nmfx.obs import flight
+
+    return dict(sites=frozenset(faults.SITES),
+                event_covered=flight.fault_event_categories())
+
+
+@register
+class FaultFlightCoverage(Rule):
+    """NMFX008: every fault site registered in nmfx/faults.py must have
+    a matching flight-recorder event emission
+    (nmfx.obs.flight.FAULT_EVENTS), and no mapping entry may go
+    stale."""
+
+    rule_id = "NMFX008"
+    title = "fault-site flight-recorder coverage"
+
+    def check(self, project) -> "Iterable[Finding]":
+        # semantic whole-package rule (the NMFX001/NMFX007 gating):
+        # runs only when the real package is the analyzed set, and only
+        # against the checkout the import machinery resolves
+        import inspect
+        import os
+
+        analyzed = next(
+            (m for m in project.modules
+             if m.path.replace("\\", "/").endswith("nmfx/faults.py")),
+            None)
+        if analyzed is None:
+            return []
+        from nmfx import faults
+
+        live_file = inspect.getsourcefile(faults) or analyzed.path
+        if os.path.abspath(live_file) != os.path.abspath(analyzed.path):
+            # NMFX001 already reports the wrong-tree condition loudly;
+            # don't double-report it per rule
+            return []
+        line = _sites_decl_line(analyzed.tree)
+        return [self.finding(analyzed.path, line, msg)
+                for msg in check_fault_event_coverage(**_live_universe())]

@@ -70,6 +70,9 @@ import numpy as np
 
 from nmfx.config import (CheckpointConfig, ConsensusConfig, InitConfig,
                          SolverConfig)
+from nmfx.obs import flight as _flight
+from nmfx.obs import metrics as _metrics
+from nmfx.obs import trace as _trace
 
 __all__ = ["MANIFEST_CONSENSUS_EXCLUDED", "Preempted", "SweepCheckpoint",
            "chunks_loaded_count", "chunks_solved_count", "engine_family",
@@ -112,29 +115,37 @@ class Preempted(BaseException):
 
 
 # -- honesty counters ------------------------------------------------------
-_counter_lock = threading.Lock()
-_chunks_solved = 0
-_chunks_loaded = 0
+# registry instruments (nmfx.obs.metrics); the *_count() functions
+# below are the back-compat read shims the resume-contract gates keep
+# using (ISSUE 10)
+_chunks_solved_total = _metrics.counter(
+    "nmfx_ckpt_chunks_solved_total",
+    "restart-chunks actually solved on device through the checkpoint "
+    "engine (loaded records do not count)")
+_chunks_loaded_total = _metrics.counter(
+    "nmfx_ckpt_chunks_loaded_total",
+    "restart-chunks served from completion records on disk")
 
 
 def chunks_solved_count() -> int:
     """Restart-chunks this process actually SOLVED on device through the
     checkpoint engine (loaded records do not count) — the counter the
     resume contract is gated on: a fully-checkpointed re-run must leave
-    it untouched."""
-    return _chunks_solved
+    it untouched. Reads ``nmfx_ckpt_chunks_solved_total``."""
+    return int(_chunks_solved_total.total())
 
 
 def chunks_loaded_count() -> int:
-    """Restart-chunks served from completion records on disk."""
-    return _chunks_loaded
+    """Restart-chunks served from completion records on disk
+    (``nmfx_ckpt_chunks_loaded_total``)."""
+    return int(_chunks_loaded_total.total())
 
 
 def _note(solved: int = 0, loaded: int = 0) -> None:
-    global _chunks_solved, _chunks_loaded
-    with _counter_lock:
-        _chunks_solved += solved
-        _chunks_loaded += loaded
+    if solved:
+        _chunks_solved_total.inc(solved)
+    if loaded:
+        _chunks_loaded_total.inc(loaded)
 
 
 # -- manifest --------------------------------------------------------------
@@ -408,7 +419,11 @@ class SweepCheckpoint:
                   for name, v in zip(rec._fields, rec)}
         arrays["record_fingerprint"] = np.asarray(self.fingerprint)
         try:
-            atomic_save_npz(self._path(k, r0, r1), arrays)
+            with _trace.default_tracer().span(
+                    "ckpt.commit", cat="ckpt",
+                    args={"k": k, "r0": r0, "r1": r1}):
+                atomic_save_npz(self._path(k, r0, r1), arrays)
+            _flight.record("ckpt.commit", k=k, r0=r0, r1=r1)
         except Exception as e:
             warn_once(
                 "ckpt-write-failed",

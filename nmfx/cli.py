@@ -301,10 +301,54 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default=None,
                    help="with --profile: also capture a jax.profiler device "
                         "trace (TensorBoard/Perfetto) into this directory")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="record the run through the structured span "
+                        "tracer (nmfx.obs.trace — every profiler phase "
+                        "plus the serving spans, per thread) and write "
+                        "Chrome trace-event JSON here; load it in "
+                        "Perfetto (ui.perfetto.dev) or chrome://tracing "
+                        "(docs/observability.md). Independent of "
+                        "--trace-dir, which captures XLA's op-level "
+                        "device trace")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write the process-wide metrics registry "
+                        "(nmfx.obs.metrics — compile/transfer/dispatch "
+                        "counters, serve latency histograms) as "
+                        "Prometheus text exposition after the run; the "
+                        "serving engine exposes the same payload live "
+                        "via NMFXServer.metrics_text()")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the crash flight recorder's disk dump: on "
+                        "a serve scheduler crash or SIGTERM the last "
+                        "~4096 structured events (dispatches, retries, "
+                        "degradations, fault fires, evictions, "
+                        "checkpoint commits) are written here as a "
+                        "redacted JSON postmortem "
+                        "(docs/observability.md). Recording is always "
+                        "on in-process; this only enables writing")
     return p
 
 
+#: one SIGTERM flight-dump hook per process: repeated in-process
+#: main() calls with --flight-dir must not chain a handler per run
+_signal_dump_installed = False
+
+
 def main(argv: list[str] | None = None) -> int:
+    """CLI entry. Wraps the run so the process-wide structured tracer
+    can never outlive this invocation's ``--trace-out`` — a usage
+    error or failing sweep after enable() would otherwise leave every
+    later in-process caller silently recording spans."""
+    from nmfx.obs import trace as obs_trace
+
+    enabled_before = obs_trace.default_tracer().enabled
+    try:
+        return _run_cli(argv)
+    finally:
+        obs_trace.default_tracer().enabled = enabled_before
+
+
+def _run_cli(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if not os.path.isfile(args.dataset):
@@ -363,6 +407,21 @@ def main(argv: list[str] | None = None) -> int:
 
     profiler = (Profiler(trace_dir=args.trace_dir) if args.profile
                 else NullProfiler())
+    if args.flight_dir:
+        from nmfx.obs import flight
+
+        flight.configure(args.flight_dir)
+        global _signal_dump_installed
+        if not _signal_dump_installed:
+            flight.install_signal_dump()
+            _signal_dump_installed = True
+    if args.trace_out:
+        from nmfx.obs import trace as obs_trace
+
+        # fresh ring: an earlier in-process run's spans must not leak
+        # into this run's exported trace
+        obs_trace.default_tracer().clear()
+        obs_trace.enable()
     if args.feature_shards < 1 or args.sample_shards < 1:
         parser.error("--feature-shards/--sample-shards must be >= 1")
     mesh = None
@@ -564,6 +623,22 @@ def main(argv: list[str] | None = None) -> int:
     print(result.summary())
     if args.profile:
         print(profiler.report())
+    if args.trace_out:
+        tracer = obs_trace.default_tracer()
+        obs_trace.disable()  # also restored on error paths by main()
+        tracer.export(args.trace_out)
+        print(f"nmfx: structured trace ({tracer.event_count()} events"
+              + (f", {tracer.dropped} dropped" if tracer.dropped
+                 else "")
+              + f") written to {args.trace_out} — load in Perfetto "
+              "(ui.perfetto.dev) or chrome://tracing", file=sys.stderr)
+    if args.metrics_out:
+        from nmfx.obs import metrics as obs_metrics
+
+        with open(args.metrics_out, "w") as f:
+            f.write(obs_metrics.registry().prometheus_text())
+        print(f"nmfx: metrics written to {args.metrics_out} "
+              "(Prometheus text exposition)", file=sys.stderr)
     return 0
 
 

@@ -60,15 +60,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nmfx.obs import flight as _flight
+from nmfx.obs import metrics as _metrics
+
 __all__ = ["DataCache", "DataKey", "data_key_fields", "default_cache",
            "h2d_bytes", "place_resilient", "transfer_count"]
 
-# module-wide counters of ACTUAL input host->device transfers — the
+# registry instruments of ACTUAL input host->device transfers — the
 # honesty counters behind the zero-transfer warm-path contract (a cached
-# placement must not touch them), mirroring exec_cache.compile_count()
-_h2d_bytes = 0
-_h2d_transfers = 0
-_counter_lock = threading.Lock()
+# placement must not touch them), mirroring exec_cache.compile_count().
+# transfer_count()/h2d_bytes() below are the back-compat read shims the
+# counter-gated tests and bench gates keep using (ISSUE 10)
+_h2d_transfers_total = _metrics.counter(
+    "nmfx_data_h2d_transfers_total",
+    "input-matrix host-to-device transfers actually paid (cache hits "
+    "do not count)")
+_h2d_bytes_total = _metrics.counter(
+    "nmfx_data_h2d_bytes_total",
+    "bytes of input-matrix host-to-device transfers actually paid")
+_data_evictions_total = _metrics.counter(
+    "nmfx_data_cache_evictions_total",
+    "device-resident input-cache entries evicted (LRU bound)")
 
 #: below this many bytes a chunked transfer costs more in dispatch
 #: overhead than it overlaps; single device_put instead
@@ -79,21 +91,22 @@ _CHUNK_BYTES = 4 << 20
 
 def transfer_count() -> int:
     """How many input matrices this process ACTUALLY transferred to
-    device through the data cache (cache hits do not count)."""
-    return _h2d_transfers
+    device through the data cache (cache hits do not count). Reads the
+    registry counter ``nmfx_data_h2d_transfers_total`` (back-compat
+    shim — the gated contracts are unchanged)."""
+    return int(_h2d_transfers_total.total())
 
 
 def h2d_bytes() -> int:
     """Total bytes of input-matrix host→device transfers this process
-    actually paid through the data cache."""
-    return _h2d_bytes
+    actually paid through the data cache (registry counter
+    ``nmfx_data_h2d_bytes_total``)."""
+    return int(_h2d_bytes_total.total())
 
 
 def _note_transfer(nbytes: int) -> None:
-    global _h2d_bytes, _h2d_transfers
-    with _counter_lock:
-        _h2d_bytes += nbytes
-        _h2d_transfers += 1
+    _h2d_transfers_total.inc()
+    _h2d_bytes_total.inc(nbytes)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,9 +230,13 @@ class DataCache:
         total = sum(e.nbytes for e in self._entries.values())
         while self._entries and (len(self._entries) > self.max_entries
                                  or total > self.max_bytes):
-            _, dropped = self._entries.popitem(last=False)
+            key, dropped = self._entries.popitem(last=False)
             total -= dropped.nbytes
             self.evictions += 1
+            _data_evictions_total.inc()
+            _flight.record("cache.evict", cache="data",
+                           nbytes=dropped.nbytes,
+                           fingerprint=key.fingerprint[:12])
 
     @property
     def stats(self) -> dict:

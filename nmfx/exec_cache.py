@@ -99,6 +99,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from nmfx.config import (ConsensusConfig, ExecCacheConfig, InitConfig,
                          SolverConfig)
+from nmfx.obs import flight as _flight
+from nmfx.obs import metrics as _metrics
 from nmfx.sweep import (KSweepOutput, _noop_rank, _pad_count,
                         _build_bucketed_sweep_fn, bucketed_lane_init_fn,
                         grid_axes_active, grid_exec_ok)
@@ -120,25 +122,31 @@ _DISK_SUFFIX = ".nmfxexec"
 #: beyond any real compile+serialize, so a live writer is never raced
 _PART_MAX_AGE_S = 3600.0
 
-# module-wide count of actual .lower().compile() calls — the honesty
+# registry counter of actual .lower().compile() calls — the honesty
 # counter behind the zero-compile cold-start contract: a fresh process
 # serving from a warm disk cache must leave it at ZERO
-# (tests/test_exec_cache.py, bench.py cold_persist stage)
-_compile_count = 0
-_compile_count_lock = threading.Lock()
+# (tests/test_exec_cache.py, bench.py cold_persist stage).
+# compile_count() below is the back-compat read shim (ISSUE 10)
+_compile_total = _metrics.counter(
+    "nmfx_exec_compile_total",
+    "executables actually compiled through the serving layer "
+    "(.lower().compile() calls; deserialized disk hits do not count)")
+_exec_evictions_total = _metrics.counter(
+    "nmfx_exec_cache_evictions_total",
+    "in-memory executable-cache entries evicted (LRU bound; the disk "
+    "record, if any, survives)")
 
 
 def compile_count() -> int:
     """How many executables this process has ACTUALLY compiled through
     the serving layer (``.lower().compile()`` calls; deserialized disk
-    hits do not count)."""
-    return _compile_count
+    hits do not count). Reads the registry counter
+    ``nmfx_exec_compile_total`` (back-compat shim)."""
+    return int(_compile_total.total())
 
 
 def _note_compile() -> None:
-    global _compile_count
-    with _compile_count_lock:
-        _compile_count += 1
+    _compile_total.inc()
 
 
 def solver_key_fields() -> frozenset:
@@ -643,8 +651,11 @@ class ExecCache:
                 # any) stays — a later request re-admits it as a persist
                 # hit instead of recompiling
                 while len(self._entries) > self._entries_cap:
-                    self._entries.popitem(last=False)
+                    evicted_key, _ = self._entries.popitem(last=False)
                     self.evictions += 1
+                    _exec_evictions_total.inc()
+                    _flight.record("cache.evict", cache="exec",
+                                   bucket=str(evicted_key[0]))
                 self._inflight.pop(key, None)
             fut.set_result(entry)
             return entry, served

@@ -67,6 +67,8 @@ import logging
 import threading
 import warnings
 
+from nmfx.obs import flight as _flight
+
 __all__ = ["SITES", "FaultConfig", "FaultInjected", "InsufficientRestarts",
            "arm", "disarm", "armed", "fire", "fires", "hits", "inject",
            "poison_restarts", "scoped", "trace_token", "warn_once"]
@@ -173,6 +175,7 @@ def arm(site: str, **kw) -> FaultConfig:
         "fault site %r ARMED (%s): failures are being injected "
         "deliberately — results from this process are a chaos "
         "rehearsal", site, spec)
+    _flight.record("fault.armed", site=site, spec=spec)
     return spec
 
 
@@ -237,7 +240,14 @@ def fire(site: str) -> bool:
         if _hits[site] % spec.every != 0:
             return False
         _fires[site] += 1
-        return True
+        hit = _hits[site]
+    # flight-recorder event per FIRE (outside the lock; the recorder
+    # has its own): the postmortem of a chaos run must show which
+    # injected failures actually landed, not just what was armed —
+    # lint rule NMFX008 keeps FAULT_EVENTS covering every site
+    _flight.record(_flight.FAULT_EVENTS.get(site, f"fault.{site}"),
+                   site=site, hit=hit)
+    return True
 
 
 def inject(site: str) -> None:
@@ -319,7 +329,11 @@ def warn_once(category: str, msg: str) -> None:
     (lint rule NMFX006 enforces that broad handlers either re-raise,
     resolve a Future, or call this): the FIRST fallback of a kind is
     loud, steady-state degradation doesn't flood the logs, and nothing
-    is ever silently swallowed."""
+    is ever silently swallowed. EVERY call (not just the first of a
+    category) also lands a structured ``degradation`` event in the
+    flight recorder — the warning dedups for log hygiene, but a crash
+    postmortem needs the full degradation sequence."""
+    _flight.record("degradation", degradation=category, msg=msg)
     with _warned_lock:
         if category in _warned:
             return

@@ -1,0 +1,39 @@
+"""nmfx.obs — unified observability: tracing, metrics, flight recorder.
+
+One coherent telemetry layer over the serving stack (ISSUE 10), three
+pillars, all stdlib-only (importable without jax — safe from signal
+handlers and test harness hooks):
+
+* :mod:`nmfx.obs.trace` — thread-aware structured span tracer with
+  Chrome trace-event export (Perfetto / ``chrome://tracing``). The
+  ``Profiler`` (``nmfx/profiling.py``) is a thin aggregating view over
+  it: every phase it books is also a span on the tracer's timeline,
+  so enabling the tracer turns the existing phase instrumentation —
+  serve queue/pack/dispatch, exec-cache compile/persist/deserialize,
+  data-cache h2d, sweep solve, streamed harvest, checkpoint commit —
+  into one nested per-thread timeline per request.
+* :mod:`nmfx.obs.metrics` — typed counters/gauges/histograms behind
+  one process-wide registry with labeled series, atomic
+  ``snapshot()``/``delta()``, and Prometheus text exposition
+  (``NMFXServer.metrics_text()``, CLI ``--metrics-out``). The
+  scattered module counters (``exec_cache.compile_count`` etc.) now
+  live here behind back-compat shims.
+* :mod:`nmfx.obs.flight` — bounded ring of recent structured events
+  (dispatches, retries, degradations, fault fires, evictions,
+  checkpoint commits, watchdog actions) dumped as a redacted JSON
+  postmortem on scheduler crash, test hang, or SIGTERM.
+
+See docs/observability.md for the API tour, the metric naming scheme,
+and the dump format.
+"""
+
+from __future__ import annotations
+
+from nmfx.obs import flight, metrics, trace
+from nmfx.obs.flight import FlightRecorder
+from nmfx.obs.metrics import MetricsRegistry, registry
+from nmfx.obs.trace import Tracer, default_tracer, traced
+
+__all__ = ["FlightRecorder", "MetricsRegistry", "Tracer",
+           "default_tracer", "flight", "metrics", "registry", "trace",
+           "traced"]

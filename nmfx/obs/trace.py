@@ -1,0 +1,222 @@
+"""Structured tracing: thread-aware spans exported as Chrome trace JSON.
+
+The serve stack's wall time is spent across threads — the scheduler
+packs and dispatches, completion workers block on the device and run
+rank selection, the compile pool builds executables — and a per-phase
+seconds table (``nmfx/profiling.py``) cannot show WHERE inside one
+request's life the time went. This tracer records every phase/span as a
+timestamped interval on the thread that ran it, bounded in memory, and
+exports the Chrome trace-event format (``chrome://tracing`` /
+`Perfetto <https://ui.perfetto.dev>`_), so one served request renders
+as a nested timeline: queue-wait → pack → dispatch on the scheduler
+thread, solve/fetch/rank-selection on the harvest workers. MPI-FAUN
+(arxiv 1609.09154) attributes wall time to compute vs communication at
+exit; this is the same accounting, live and per-span.
+
+Design rules:
+
+* **One process-wide tracer, off by default.** ``default_tracer()`` is
+  the sink every ``Profiler``/``NullProfiler`` phase and every serve
+  span writes through; while disabled a recording attempt costs one
+  attribute read (the < 3% overhead gate in bench ``detail.obs`` is on
+  the ENABLED path — the disabled path is free by construction).
+* **Bounded.** Events land in a ring of ``max_events``; overflow drops
+  the OLDEST events and counts them (``dropped``) — tracing can stay on
+  in a long-lived server without unbounded growth, like the flight
+  recorder (``nmfx/obs/flight.py``) but for spans.
+* **Retroactive spans.** ``complete(name, dur_s)`` books an interval
+  that just ENDED — the shape ``Profiler.add_seconds`` needs (harvest
+  workers measure first, record after) — with its start back-computed,
+  so worker-thread spans nest correctly without wrapping their code in
+  a context manager.
+
+Export: ``export(path)`` writes ``{"traceEvents": [...]}`` with "X"
+(complete) and "i" (instant) events in microseconds plus "M" metadata
+events naming each thread. Load it in Perfetto or ``chrome://tracing``
+(docs/observability.md "Reading a trace").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import threading
+import time
+from collections import deque
+
+__all__ = ["Tracer", "default_tracer", "disable", "enable", "traced"]
+
+#: default ring capacity — a served request is a few dozen spans, so
+#: this holds thousands of requests of history at ~100 B/event
+_DEFAULT_MAX_EVENTS = 100_000
+
+
+class Tracer:
+    """Thread-aware span recorder with Chrome trace-event export.
+
+    All mutation is lock-guarded (spans arrive concurrently from the
+    scheduler, harvest workers, and compile pool); the ``enabled``
+    check deliberately runs OUTSIDE the lock — a stale read can at
+    worst drop or admit one event at the enable/disable edge, and the
+    hot path must not serialize on a lock while tracing is off.
+    """
+
+    def __init__(self, max_events: int = _DEFAULT_MAX_EVENTS):
+        if max_events < 1:
+            raise ValueError("max_events must be >= 1")
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._events: "deque[dict]" = deque(maxlen=max_events)
+        self._recorded = 0  # total admitted, including since-dropped
+        self._thread_names: "dict[int, str]" = {}
+        #: perf_counter epoch all timestamps are relative to
+        self._t0 = time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _admit(self, ev: dict) -> None:
+        tid = threading.get_ident()
+        ev["tid"] = tid
+        with self._lock:
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            self._events.append(ev)
+            self._recorded += 1
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "phase",
+             args: "dict | None" = None):
+        """Record the enclosed region as one complete ("X") event on
+        the calling thread. Nesting is positional: Chrome/Perfetto nest
+        events on one thread by interval containment, so nested
+        ``span``/``phase`` calls render as a flame without explicit
+        parent links."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            self._admit({"name": name, "cat": cat, "ph": "X",
+                         "ts": (t0 - self._t0) * 1e6, "dur": dur * 1e6,
+                         "args": args})
+
+    def complete(self, name: str, dur_s: float, cat: str = "phase",
+                 args: "dict | None" = None) -> None:
+        """Book a span that just ENDED (start = now − ``dur_s``) — the
+        retroactive shape measured-then-recorded call sites need
+        (``Profiler.add_seconds``, the serve queue-wait span)."""
+        if not self.enabled:
+            return
+        end = self._now_us()
+        self._admit({"name": name, "cat": cat, "ph": "X",
+                     "ts": end - dur_s * 1e6, "dur": dur_s * 1e6,
+                     "args": args})
+
+    def instant(self, name: str, cat: str = "mark",
+                args: "dict | None" = None) -> None:
+        """Record a zero-duration event (a ``Profiler.mark``, a cache
+        hit, a watchdog action) — "i" in the Chrome format."""
+        if not self.enabled:
+            return
+        self._admit({"name": name, "cat": cat, "ph": "i", "s": "t",
+                     "ts": self._now_us(), "args": args})
+
+    # -- lifecycle ---------------------------------------------------------
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._recorded = 0
+
+    @property
+    def dropped(self) -> int:
+        """Events lost to the ring bound since the last clear()."""
+        with self._lock:
+            return self._recorded - len(self._events)
+
+    def event_count(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # -- export ------------------------------------------------------------
+    def events(self) -> "list[dict]":
+        """Snapshot of the retained events (oldest first)."""
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON object: retained events plus "M"
+        metadata naming each thread, all on one pid (this process)."""
+        import os
+
+        pid = os.getpid()
+        with self._lock:
+            events = [dict(ev) for ev in self._events]
+            names = dict(self._thread_names)
+        out = []
+        for tid, tname in sorted(names.items()):
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": tname}})
+        for ev in events:
+            ev["pid"] = pid
+            if ev.get("args") is None:
+                ev.pop("args", None)
+            out.append(ev)
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def export(self, path: str) -> str:
+        """Write :meth:`chrome_trace` to ``path``; returns ``path``.
+        Load in Perfetto (ui.perfetto.dev) or ``chrome://tracing``."""
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+_tracer = Tracer()
+
+
+def default_tracer() -> Tracer:
+    """The process-wide tracer every profiler phase and serve span
+    records through."""
+    return _tracer
+
+
+def enable(max_events: "int | None" = None) -> Tracer:
+    """Turn the process-wide tracer on (optionally re-bounding the
+    ring). Does NOT clear already-retained events — call ``clear()``
+    for a fresh window."""
+    if max_events is not None and max_events != _tracer._events.maxlen:
+        with _tracer._lock:
+            _tracer._events = deque(_tracer._events, maxlen=max_events)
+    _tracer.enabled = True
+    return _tracer
+
+
+def disable() -> None:
+    _tracer.enabled = False
+
+
+def traced(name_or_fn=None, cat: str = "fn"):
+    """Decorator form of :meth:`Tracer.span` — ``@traced`` uses the
+    function's qualname, ``@traced("custom.name")`` overrides it. Zero
+    overhead beyond one enabled check while tracing is off."""
+    def deco(fn, name=None):
+        span_name = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            tr = _tracer
+            if not tr.enabled:
+                return fn(*a, **kw)
+            with tr.span(span_name, cat=cat):
+                return fn(*a, **kw)
+        return wrapper
+
+    if callable(name_or_fn):
+        return deco(name_or_fn)
+    return lambda fn: deco(fn, name=name_or_fn)

@@ -22,6 +22,19 @@ while the main thread times solve phases. All ``phases`` mutation is
 lock-guarded, so concurrent recording neither drops nor double-counts
 time (tests/test_profiling.py pins this).
 
+Tracer integration (ISSUE 10): the profiler is the AGGREGATING view
+over the structured tracer (``nmfx.obs.trace``) — every recording
+funnels through :meth:`Profiler.add_seconds`, which both accumulates
+the per-phase books kept here (``report``/``audit`` semantics
+unchanged) and, while the process-wide tracer is enabled, books the
+same interval as a timestamped span on the recording THREAD's
+timeline (retroactive ``Tracer.complete`` — start back-computed from
+the measured duration, so worker-thread phases nest correctly in the
+exported Chrome trace). ``NullProfiler`` stays a no-op for the books
+but keeps the tracer emission, so a served request traces fully even
+where no profiler was passed. While the tracer is disabled the extra
+cost is one attribute read per recording.
+
 Overlap accounting: phases whose names start with an
 ``OVERLAP_PREFIXES`` prefix (``xfer.``, ``post.``) record work that runs
 CONCURRENTLY with the main-thread pipeline — async transfer dispatch,
@@ -40,6 +53,8 @@ import time
 from typing import Any
 
 import jax
+
+from nmfx.obs import trace as _trace
 
 #: phase-name prefixes recorded as OVERLAPPED work: async-transfer
 #: bookkeeping (``xfer.``) and post-solve host work streamed through
@@ -119,11 +134,14 @@ class Profiler:
         and it is lock-guarded: harvest workers and compile pools record
         from their own threads concurrently with the main thread's
         phases, and the accumulation must neither drop nor double-count
-        a contribution."""
+        a contribution. Also books the interval on the structured
+        tracer's timeline when tracing is enabled (see the module
+        docstring)."""
         with self._lock:
             rec = self.phases.setdefault(name, PhaseRecord(name))
             rec.seconds += seconds
             rec.count += count
+        _emit_span(name, seconds)
 
     # -- reporting ---------------------------------------------------------
     def total_seconds(self) -> float:
@@ -195,8 +213,29 @@ class Profiler:
         return "\n".join(lines)
 
 
+def _emit_span(name: str, seconds: float) -> None:
+    """Mirror one phase recording onto the structured tracer: a
+    retroactive span for a measured interval, an instant event for a
+    zero-duration mark. One enabled check while tracing is off."""
+    tracer = _trace.default_tracer()
+    if not tracer.enabled:
+        return
+    if seconds > 0.0:
+        tracer.complete(name, seconds, cat="phase")
+    else:
+        tracer.instant(name, cat="phase")
+
+
 class NullProfiler(Profiler):
-    """No-op drop-in so call sites need no ``if profiler`` branching."""
+    """No-op drop-in so call sites need no ``if profiler`` branching.
+
+    No-op for the per-phase BOOKS only: the structured-tracer emission
+    is kept (enabled-gated, see ``_emit_span``), so the serving stack —
+    which defaults to a NullProfiler per server/engine — still traces
+    every phase of a request once ``nmfx.obs.trace`` is enabled. The
+    phase() region is timed only while tracing is on; the sync callable
+    stays a passthrough either way (a NullProfiler must never add
+    device blocking the unprofiled path didn't have)."""
 
     def __enter__(self) -> "NullProfiler":
         return self
@@ -206,13 +245,18 @@ class NullProfiler(Profiler):
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        yield lambda x: x
+        tracer = _trace.default_tracer()
+        if not tracer.enabled:
+            yield lambda x: x
+            return
+        with tracer.span(name, cat="phase"):
+            yield lambda x: x
 
     def mark(self, name: str) -> None:
-        pass
+        _emit_span(name, 0.0)
 
     def add_seconds(self, name: str, seconds: float, count: int = 1) -> None:
-        pass
+        _emit_span(name, seconds)
 
     def report(self) -> str:
         return "profiling disabled"

@@ -75,6 +75,9 @@ from typing import TYPE_CHECKING, Mapping, Protocol, Sequence
 import numpy as np
 
 from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.obs import flight as _flight
+from nmfx.obs import metrics as _metrics
+from nmfx.obs import trace as _trace
 
 if TYPE_CHECKING:
     from nmfx.api import ConsensusResult
@@ -91,12 +94,34 @@ __all__ = ["DeadlineExceeded", "Engine", "ExecCacheEngine", "NMFXServer",
 # module counters — the honesty-counter discipline of
 # exec_cache.compile_count() / data_cache.transfer_count(): the
 # cross-request-packing contract is gated on these, not on log lines
-# (tests/test_serve.py, bench.py traffic stage)
-_dispatches = 0
-_packed_dispatches = 0  # dispatches whose lanes span >= 2 requests
-_total_lanes = 0
-_packed_lanes = 0  # lanes that rode a packed dispatch
-_counter_lock = threading.Lock()
+# (tests/test_serve.py, bench.py traffic stage). Since ISSUE 10 the
+# numbers live as labeled series on the process-wide metrics registry
+# (nmfx.obs.metrics); dispatch_count()/packed_dispatch_count()/
+# packing_efficiency() are the back-compat read shims the gated
+# contracts keep using
+_dispatch_total = _metrics.counter(
+    "nmfx_serve_dispatches_total",
+    "executable dispatches issued by serve schedulers",
+    labelnames=("packed",))
+_lanes_total = _metrics.counter(
+    "nmfx_serve_lanes_total",
+    "restart lanes dispatched by serve schedulers",
+    labelnames=("packed",))
+#: serve latency surfaces (docs/observability.md): streaming-quantile
+#: histograms per request — queue residency, the dispatch step, the
+#: device-blocked fetch, and submit→resolved end-to-end
+_queue_wait_hist = _metrics.histogram(
+    "nmfx_serve_queue_wait_seconds", "submit-to-dispatch queue residency")
+_pack_hist = _metrics.histogram(
+    "nmfx_serve_pack_seconds",
+    "placement + lane packing + executable lookup + async dispatch")
+_solve_hist = _metrics.histogram(
+    "nmfx_serve_solve_seconds",
+    "per-request device-blocked fetch wall (solve + queueing behind "
+    "dispatch-mates)")
+_e2e_hist = _metrics.histogram(
+    "nmfx_serve_e2e_seconds",
+    "submit-to-resolution request latency", labelnames=("outcome",))
 #: process-wide spill-record counter: per-SERVER request seqs restart
 #: at 0, so a restarted server in the same process would overwrite an
 #: earlier server's spill_{pid}_{seq}.npz — this counter keeps every
@@ -107,34 +132,33 @@ _spill_seq = itertools.count()
 
 def dispatch_count() -> int:
     """Executable dispatches issued by serve schedulers in this process
-    (packed and solo)."""
-    return _dispatches
+    (packed and solo). Reads the registry counter
+    ``nmfx_serve_dispatches_total`` summed over its ``packed`` label
+    (back-compat shim)."""
+    return int(_dispatch_total.total())
 
 
 def packed_dispatch_count() -> int:
     """Dispatches that ACTUALLY contained lanes from >= 2 distinct
     requests — the counter the cross-request packing contract is gated
     on (a test asserting packing must watch this, not wall clocks)."""
-    return _packed_dispatches
+    return int(_dispatch_total.value(packed="true"))
 
 
 def packing_efficiency() -> "float | None":
     """Fraction of all dispatched lanes that rode a packed (multi-
     request) dispatch; None before the first dispatch."""
-    with _counter_lock:
-        if _total_lanes == 0:
-            return None
-        return _packed_lanes / _total_lanes
+    series = _lanes_total.series()  # one atomic cut of both labels
+    total = sum(series.values())
+    if total == 0:
+        return None
+    return series.get(("true",), 0.0) / total
 
 
 def _note_dispatch(n_requests: int, lanes: int) -> None:
-    global _dispatches, _packed_dispatches, _total_lanes, _packed_lanes
-    with _counter_lock:
-        _dispatches += 1
-        _total_lanes += lanes
-        if n_requests >= 2:
-            _packed_dispatches += 1
-            _packed_lanes += lanes
+    packed = "true" if n_requests >= 2 else "false"
+    _dispatch_total.inc(packed=packed)
+    _lanes_total.inc(lanes, packed=packed)
 
 
 # --------------------------------------------------------------------------
@@ -294,6 +318,11 @@ class RequestStats:
     (``future.stats``) once the request resolves; partial values are
     visible earlier (queue_wait_s lands at dispatch)."""
 
+    #: the request's server-assigned id (the submission sequence
+    #: number) — the SAME id every structured-tracer span of this
+    #: request carries in its ``args`` (``request_id``), so a span in
+    #: an exported Chrome trace joins back to this stats record
+    request_id: "int | None" = None
     #: seconds between submit and dispatch (queue residency)
     queue_wait_s: "float | None" = None
     #: seconds of the dispatch step itself: placement, lane packing,
@@ -561,6 +590,10 @@ class NMFXServer:
         self._down: "BaseException | None" = None  # crashed, no restart
         self._watchdog: "threading.Thread | None" = None
         self._heartbeat = 0.0  # scheduler loop progress (introspection)
+        # baseline registry cut for stats_snapshot(): the delta since
+        # SERVER START, not process start (several servers may share
+        # one process across a test session)
+        self._metrics_t0 = _metrics.registry().snapshot()
         self.counters = {"submitted": 0, "completed": 0, "failed": 0,
                          "cancelled": 0, "deadline_expired": 0,
                          "rejected": 0, "dispatches": 0,
@@ -832,8 +865,9 @@ class NMFXServer:
             deadline = time.monotonic() + timeout
         scfg = solver_cfg if solver_cfg is not None else SolverConfig()
         icfg = init_cfg if init_cfg is not None else InitConfig()
-        stats = RequestStats(lanes=len(ks) * restarts)
-        req = _Request(seq=next(self._seq), a=arr,
+        seq = next(self._seq)
+        stats = RequestStats(request_id=seq, lanes=len(ks) * restarts)
+        req = _Request(seq=seq, a=arr,
                        col_names=tuple(col_names), ks=ks,
                        restarts=restarts, seed=seed, scfg=scfg,
                        icfg=icfg, label_rule=label_rule, linkage=linkage,
@@ -904,6 +938,27 @@ class NMFXServer:
                          if c["total_lanes"] else None))
             return c
 
+    def stats_snapshot(self) -> dict:
+        """The process-wide metrics registry's DELTA since this server
+        was constructed (``nmfx.obs.metrics.MetricsRegistry.delta``):
+        counters and histogram counts/sums are windowed to this
+        server's lifetime, gauges report their current level — the
+        structured successor to :meth:`stats` (docs/serving.md
+        "Observability"). Plain data; each metric's ``series`` dict is
+        keyed by label-value TUPLES (``()`` for unlabeled series), so
+        stringify the keys before ``json.dumps`` — for wire formats
+        use :meth:`metrics_text` instead."""
+        return _metrics.registry().delta(self._metrics_t0)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the process-wide registry —
+        the ``/metrics`` payload an operator's scraper ingests (serve
+        latency histograms, dispatch/lane counters, cache and compile
+        counters; docs/observability.md "Metric naming"). Process-wide
+        and cumulative by Prometheus convention; for this server's
+        window use :meth:`stats_snapshot`."""
+        return _metrics.registry().prometheus_text()
+
     # -- scheduler ---------------------------------------------------------
     def _ensure_workers(self) -> None:
         # caller holds the lock
@@ -937,6 +992,8 @@ class NMFXServer:
                 if req.future.set_running_or_notify_cancel():
                     req.stats.queue_wait_s = now - req.submitted
                     req.stats.latency_s = now - req.submitted
+                    _e2e_hist.observe(req.stats.latency_s,
+                                      outcome="deadline")
                     req.future.set_exception(DeadlineExceeded(
                         "deadline expired after "
                         f"{now - req.submitted:.3f}s in queue; the "
@@ -1134,6 +1191,9 @@ class NMFXServer:
                 err.__cause__ = cause
                 fut.set_exception(err)
                 failed += 1
+                _flight.record("serve.watchdog",
+                               action="resolve_stranded",
+                               request_id=req.seq)
             with self._lock:
                 self.counters["failed"] += failed
             warn_once(
@@ -1142,6 +1202,19 @@ class NMFXServer:
                 "request(s) resolved with ServerCrashed"
                 + (", scheduler restarted" if restart
                    else ", server is down (restart_scheduler=False)"))
+            _flight.record("serve.watchdog", action="scheduler_crash",
+                           error=cause, resolved=failed,
+                           restarted=restart)
+            # the crash postmortem (docs/observability.md "Flight
+            # recorder"): the retained event ring — armed/fired fault
+            # sites, the dispatches and degradations leading up to the
+            # crash, and the stray resolutions just booked — written as
+            # one artifact (when a dump directory is configured; always
+            # retained in-process via nmfx.obs.flight.last_dump)
+            _flight.dump("serve-scheduler-crash",
+                         extra={"error": cause,
+                                "resolved_requests": failed,
+                                "scheduler_restarted": restart})
             if restart:
                 with self._cond:
                     if not self._closed:
@@ -1199,6 +1272,10 @@ class NMFXServer:
             return
         if not mid_solve and not req.future.set_running_or_notify_cancel():
             return
+        # observed only when the future actually resolves as a
+        # deadline — a cancelled request must not skew the
+        # outcome-labeled latency series
+        _e2e_hist.observe(req.stats.latency_s, outcome="deadline")
         msg = ("deadline expired mid-solve; the request's lanes were "
                "stopped by the per-lane iteration budget and its "
                "results discarded" if mid_solve else
@@ -1215,11 +1292,23 @@ class NMFXServer:
             self.counters["cancelled"] += len(batch) - len(live)
         if not live:
             return
+        tracer = _trace.default_tracer()
         for req in live:
             req.stats.queue_wait_s = t0 - req.submitted
+            # retroactive span: the queue residency that just ended at
+            # this dispatch — carries the request id (RequestStats ids
+            # in span args, ISSUE 10 satellite)
+            tracer.complete("serve.queue_wait", req.stats.queue_wait_s,
+                            cat="serve", args={"request_id": req.seq})
+            _queue_wait_hist.observe(req.stats.queue_wait_s)
         if len(live) >= 2:
             try:
-                with self._prof.phase("serve.pack"):
+                with tracer.span(
+                        "serve.dispatch", cat="serve",
+                        args={"request_ids": [r.seq for r in live],
+                              "packed": True,
+                              "lanes": sum(r.lanes for r in live)}), \
+                        self._prof.phase("serve.pack"):
                     placed = self.engine.place(live[0])
                     raws = self.engine.dispatch_packed(live, placed)
             except BaseException as e:
@@ -1246,12 +1335,19 @@ class NMFXServer:
                 with self._lock:
                     self.counters["budget_clamped"] += 1
             try:
-                with self._prof.phase("serve.pack"):
+                with tracer.span(
+                        "serve.dispatch", cat="serve",
+                        args={"request_ids": [req.seq],
+                              "packed": False, "lanes": req.lanes}), \
+                        self._prof.phase("serve.pack"):
                     raw = self._dispatch_solo_retrying(req, scfg)
             except BaseException as e:
                 with self._lock:
                     self.counters["failed"] += 1
+                req.stats.latency_s = time.monotonic() - req.submitted
                 if not req.future.done():
+                    _e2e_hist.observe(req.stats.latency_s,
+                                      outcome="failed")
                     req.future.set_exception(e)
             else:
                 self._handoff([req], [raw], t0, packed=False)
@@ -1273,6 +1369,12 @@ class NMFXServer:
                 return self.engine.dispatch_solo(req, placed, scfg)
             except BaseException as e:  # retried; typed RequestFailed
                 last = e                # below when exhausted
+                # flight event per ATTEMPT (warn_once dedups the log
+                # line; the postmortem needs every retry)
+                _flight.record("serve.retry", request_id=req.seq,
+                               attempt=attempt + 1,
+                               retries=self.cfg.dispatch_retries,
+                               error=e)
                 warn_once(
                     "solo-dispatch-retry",
                     f"solo dispatch attempt {attempt + 1} failed "
@@ -1295,6 +1397,11 @@ class NMFXServer:
         t1 = time.monotonic()
         lanes = sum(r.lanes for r in live)
         _note_dispatch(len(live), lanes)
+        _flight.record("serve.dispatch",
+                       request_ids=[r.seq for r in live],
+                       packed=packed, lanes=lanes,
+                       pack_s=round(t1 - t0, 6))
+        _pack_hist.observe(t1 - t0)
         with self._lock:
             self.counters["dispatches"] += 1
             self.counters["total_lanes"] += lanes
@@ -1327,6 +1434,7 @@ class NMFXServer:
                 return
             req, raw, t_disp = item
             try:
+                t_h0 = time.perf_counter()
                 fetch_s = select_s = 0.0
                 per_k = {}
                 for k in req.ks:
@@ -1356,8 +1464,17 @@ class NMFXServer:
                     per_k[k] = kres
                     fetch_s += f_s
                     select_s += s_s
+                # retroactive span over this request's whole harvest
+                # (device-blocked fetch + rank selection, every rank):
+                # the per-rank xfer.d2h_overlap / post.rank_selection
+                # spans harvest_rank booked nest inside it on this
+                # worker thread
+                _trace.default_tracer().complete(
+                    "serve.harvest", time.perf_counter() - t_h0,
+                    cat="serve", args={"request_id": req.seq})
                 req.stats.solve_s = fetch_s
                 req.stats.harvest_s = select_s
+                _solve_hist.observe(fetch_s)
                 now = time.monotonic()
                 req.stats.latency_s = now - req.submitted
                 if req.deadline is not None and now >= req.deadline:
@@ -1366,12 +1483,16 @@ class NMFXServer:
                     result = ConsensusResult(ks=req.ks, per_k=per_k,
                                              col_names=req.col_names)
                     req.future.set_result(result)
+                    _e2e_hist.observe(req.stats.latency_s,
+                                      outcome="completed")
                     with self._lock:
                         self.counters["completed"] += 1
             except BaseException as e:  # resolves the request's Future
                 with self._lock:
                     self.counters["failed"] += 1
                 if not req.future.done():
+                    _e2e_hist.observe(time.monotonic() - req.submitted,
+                                      outcome="failed")
                     req.future.set_exception(e)
             finally:
                 with self._harvest_cond:

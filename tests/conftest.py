@@ -140,11 +140,54 @@ def _threaded_hang_guard(request):
     if budget <= 0:
         yield
         return
+    # flight-recorder postmortem BEFORE the kill (ISSUE 10): the
+    # faulthandler exit below is C-level — no Python runs after it —
+    # so a timer slightly inside the budget writes the structured
+    # event ring (dispatches, degradations, fault fires, watchdog
+    # actions leading up to the hang) next to the thread dump. Armed
+    # only for the threaded suites this guard already covers.
+    import threading
+
+    from nmfx.obs import flight
+
+    safe = "".join(c if c.isalnum() or c in "-._" else "-"
+                   for c in request.node.name)[:60]
+    dump_path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"),
+        f"nmfx_flight_hang_{os.getpid()}_{safe}.json")
+    timer = threading.Timer(
+        max(budget - 5.0, budget * 0.5),
+        lambda: flight.dump(f"test-hang-guard:{request.node.name}",
+                            path=dump_path))
+    timer.daemon = True
+    timer.start()
     faulthandler.dump_traceback_later(budget, exit=True)
     try:
         yield
     finally:
         faulthandler.cancel_dump_traceback_later()
+        timer.cancel()
+        try:
+            # a slow-but-passing test that tripped the timer must not
+            # leave a false hang postmortem; a genuine hang never
+            # reaches this finally (faulthandler killed the process)
+            os.unlink(dump_path)
+        except OSError:
+            pass
+
+
+@pytest.fixture(autouse=True)
+def _tracer_state_isolated():
+    """A test that enables the process-wide structured tracer
+    (nmfx.obs.trace) must not leave it on for every later test — the
+    enabled path records spans on each profiler phase, and span
+    content from one test bleeding into another's export would make
+    the trace round-trip tests order-dependent."""
+    from nmfx.obs import trace
+
+    was = trace.default_tracer().enabled
+    yield
+    trace.default_tracer().enabled = was
 
 
 @pytest.fixture(scope="session")

@@ -295,3 +295,36 @@ def test_cli_serve_smoke_rejects_bad_combos(gct_path, tmp_path):
     ):
         with pytest.raises(SystemExit):
             main(argv)
+
+
+def test_cli_observability_flags(gct_path, tmp_path, capsys):
+    """ISSUE 10: --trace-out writes a loadable Chrome trace of the run,
+    --metrics-out writes Prometheus text exposition, --flight-dir arms
+    the crash-dump directory — and the process-wide tracer is disabled
+    again after the run (in-process callers must not inherit it)."""
+    import json
+
+    from nmfx.obs import flight, trace
+
+    trace.default_tracer().clear()
+    trace_path = tmp_path / "trace.json"
+    metrics_path = tmp_path / "metrics.prom"
+    try:
+        rc = main([gct_path, "--ks", "2", "--restarts", "2",
+                   "--maxiter", "60", "--no-files",
+                   "--trace-out", str(trace_path),
+                   "--metrics-out", str(metrics_path),
+                   "--flight-dir", str(tmp_path)])
+    finally:
+        flight.configure(None)
+    assert rc == 0
+    assert not trace.default_tracer().enabled
+    err = capsys.readouterr().err
+    assert "structured trace" in err and "metrics written" in err
+    chrome = json.loads(trace_path.read_text())
+    names = {e["name"] for e in chrome["traceEvents"]
+             if e.get("ph") == "X"}
+    assert any(n.startswith("solve.") for n in names)
+    text = metrics_path.read_text()
+    assert "# TYPE nmfx_exec_compile_total counter" in text \
+        or "nmfx_data_h2d_transfers_total" in text

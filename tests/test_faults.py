@@ -583,3 +583,70 @@ def test_serve_harvest_worker_fault_recovers_inline():
     assert res.per_k[2] is not None
     assert faults.fires("harvest.worker") == 1
     assert srv.stats()["completed"] == 1
+
+
+def test_scheduler_crash_emits_flight_recorder_dump(tmp_path):
+    """ISSUE 10 acceptance: a forced scheduler crash dumps the flight
+    recorder — the postmortem artifact names the armed fault site (the
+    injected serve.scheduler fire) and the watchdog's resolution
+    events, turning the warn-once line into inspectable JSON."""
+    import json
+    import os
+
+    from nmfx.obs import flight
+    from nmfx.serve import NMFXServer, ServeConfig, ServerCrashed
+
+    flight.configure(str(tmp_path))
+    # fresh event ring: the recorder is process-global and the earlier
+    # watchdog tests in this module left their own crash events on it
+    flight.default_recorder().clear()
+    try:
+        faults.arm("serve.scheduler", every=1)
+        cfg = ServeConfig(restart_scheduler=False,
+                          watchdog_interval_s=0.05, pack=False)
+        srv = NMFXServer(cfg, engine=_FakeEngine(compat=None),
+                         start=False)
+        with pytest.warns(RuntimeWarning, match="scheduler-crash"):
+            futs = [srv.submit(_mat(), ks=(2,), restarts=2)
+                    for _ in range(2)]
+            srv.resume()
+            for f in futs:
+                with pytest.raises(ServerCrashed):
+                    f.result(timeout=30)
+        # the dump is written by the watchdog thread right after it
+        # resolves the strays; bounded wait for the artifact
+        deadline = time.monotonic() + 10
+        dump_path = None
+        while time.monotonic() < deadline and dump_path is None:
+            hits = [f for f in os.listdir(tmp_path)
+                    if f.startswith("flight_")
+                    and "serve-scheduler-crash" in f]
+            if hits:
+                dump_path = os.path.join(tmp_path, hits[0])
+            else:
+                time.sleep(0.05)
+        srv.close()
+        assert dump_path is not None, "no flight dump written"
+        art = json.loads(open(dump_path).read())
+        assert art["reason"] == "serve-scheduler-crash"
+        # the armed fault site is in the postmortem twice over: still
+        # armed at dump time, and its FIRE is on the event ring
+        assert "serve.scheduler" in art["armed_fault_sites"]
+        fires = [e for e in art["events"]
+                 if e["category"] == "fault.serve.scheduler"]
+        assert fires and fires[0]["site"] == "serve.scheduler"
+        # ... as are the watchdog's resolution actions, one per
+        # stranded future plus the crash summary
+        wd = [e for e in art["events"]
+              if e["category"] == "serve.watchdog"]
+        assert sum(1 for e in wd
+                   if e["action"] == "resolve_stranded") == 2
+        crash = next(e for e in wd
+                     if e["action"] == "scheduler_crash")
+        assert crash["resolved"] == 2
+        assert "FaultInjected" in crash["error"] \
+            or "injected fault" in crash["error"]
+        # in-process artifact mirrors the file
+        assert flight.last_dump()["reason"] == "serve-scheduler-crash"
+    finally:
+        flight.configure(None)

@@ -995,3 +995,99 @@ def test_nmfx007_rule_registered():
     from nmfx.analysis import RULES
 
     assert "NMFX007" in RULES
+
+
+# ---------------------------------------------------------------- NMFX008
+# fault-site flight-recorder coverage (ISSUE 10): every registered
+# fault site must map to a flight-recorder event category, and no
+# mapping entry may go stale. Same pure-check + mutated-universe shape
+# as NMFX001/NMFX007; the bad universes below are the fixture pair
+# (bad universe fires, clean twin quiet), and the live tree is pinned
+# compliant directly.
+
+def _obs_universe(**over):
+    base = dict(sites=frozenset({"h2d.transfer", "serve.scheduler"}),
+                event_covered=frozenset({"h2d.transfer",
+                                         "serve.scheduler"}))
+    base.update(over)
+    return base
+
+
+def test_nmfx008_clean_universe_quiet():
+    from nmfx.analysis.rules_obs import check_fault_event_coverage
+
+    assert check_fault_event_coverage(**_obs_universe()) == []
+
+
+def test_nmfx008_live_tree_clean():
+    """The shipped tree must satisfy its own coverage contract: every
+    site in nmfx.faults.SITES reaches nmfx.obs.flight.FAULT_EVENTS
+    (the tier-1 zero-findings gate covers the Rule wrapper; this pins
+    the pure check on the live universe directly)."""
+    from nmfx.analysis.rules_obs import (_live_universe,
+                                         check_fault_event_coverage)
+
+    assert check_fault_event_coverage(**_live_universe()) == []
+
+
+def test_nmfx008_missing_site_fires():
+    """A registered site with no flight-recorder category is the
+    silent-postmortem hazard the rule exists for (bad universe)."""
+    from nmfx.analysis.rules_obs import check_fault_event_coverage
+
+    problems = check_fault_event_coverage(**_obs_universe(
+        event_covered=frozenset({"h2d.transfer"})))
+    assert len(problems) == 1
+    assert "serve.scheduler" in problems[0]
+    assert "FAULT_EVENTS" in problems[0]
+
+
+def test_nmfx008_stale_mapping_fires():
+    """A FAULT_EVENTS entry for an unregistered site is a stale
+    declaration (it would mask a site rename)."""
+    from nmfx.analysis.rules_obs import check_fault_event_coverage
+
+    problems = check_fault_event_coverage(**_obs_universe(
+        event_covered=frozenset({"h2d.transfer", "serve.scheduler",
+                                 "old.renamed_site"})))
+    assert len(problems) == 1
+    assert "old.renamed_site" in problems[0]
+    assert "stale" in problems[0]
+
+
+def test_nmfx008_rule_fires_through_run_on_mutated_mapping(tmp_path,
+                                                           monkeypatch):
+    """Acceptance mutation: drop a live site's mapping entry and the
+    REGISTERED rule (through the real run() path over the real
+    faults.py) goes red at the SITES declaration; restore it and the
+    run is quiet again."""
+    from nmfx import faults as faults_mod
+    from nmfx.analysis import run
+    from nmfx.obs import flight
+
+    findings = [f for f in run(["nmfx/faults.py"], jaxpr=False,
+                               rule_ids=["NMFX008"])
+                if f.rule_id == "NMFX008"]
+    assert findings == []  # live tree compliant
+    broken = dict(flight.FAULT_EVENTS)
+    broken.pop("proc.preempt")
+    monkeypatch.setattr(flight, "FAULT_EVENTS", broken)
+    findings = [f for f in run(["nmfx/faults.py"], jaxpr=False,
+                               rule_ids=["NMFX008"])
+                if f.rule_id == "NMFX008"]
+    assert len(findings) == 1
+    assert "proc.preempt" in findings[0].message
+    # anchored at the SITES declaration in the analyzed faults.py
+    import inspect
+
+    src_lines, decl = inspect.getsourcelines(faults_mod)
+    sites_line = next(i for i, line
+                      in enumerate(src_lines, start=decl or 1)
+                      if line.startswith("SITES ="))
+    assert findings[0].line == sites_line
+
+
+def test_nmfx008_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX008" in RULES

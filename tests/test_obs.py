@@ -1,0 +1,418 @@
+"""Unified observability subsystem (ISSUE 10): structured tracer
+round-trip, metrics-registry exactness under concurrent writers,
+Prometheus exposition, flight-recorder ring/redaction/dump, and the
+fault-site / degradation event plumbing.
+
+Everything here is host-only (no device dispatch, no compiles) — the
+serve-path trace acceptance test lives in tests/test_serve.py where it
+shares that module's compiled executables, and the crash-dump chaos
+test in tests/test_faults.py next to its watchdog siblings."""
+
+import json
+import threading
+
+import pytest
+
+from nmfx import faults
+from nmfx.obs import flight, metrics, trace
+from nmfx.obs.trace import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _pristine_faults():
+    faults.disarm()
+    faults._reset_warned()
+    yield
+    faults.disarm()
+    faults._reset_warned()
+
+
+# ---------------------------------------------------------------------
+# tracer: recording, export round-trip, per-thread nesting
+# ---------------------------------------------------------------------
+
+def _x_events_by_tid(chrome: dict) -> dict:
+    out: dict = {}
+    for ev in chrome["traceEvents"]:
+        if ev.get("ph") == "X":
+            out.setdefault(ev["tid"], []).append(ev)
+    return out
+
+
+def _assert_properly_nested(events: list) -> None:
+    """On one thread, complete events must form a forest: any two
+    intervals are either disjoint or one contains the other (that is
+    what renders as a flame in Perfetto)."""
+    stack = []
+    for ev in sorted(events, key=lambda e: (e["ts"], -e["dur"])):
+        start, end = ev["ts"], ev["ts"] + ev["dur"]
+        while stack and start >= stack[-1] - 1e-6:
+            stack.pop()
+        if stack:
+            assert end <= stack[-1] + 1e-6, \
+                f"span {ev['name']} overlaps its sibling/parent"
+        stack.append(end)
+
+
+def test_trace_export_round_trip_nested_per_thread(tmp_path):
+    """ISSUE 10 satellite: N threads of nested spans export as VALID
+    Chrome trace JSON with per-thread proper nesting and thread-name
+    metadata."""
+    tr = Tracer()
+    tr.enabled = True
+    n_threads, m = 4, 25
+    # all workers alive at once: thread idents are reused once a
+    # thread exits, which would merge two workers onto one trace track
+    barrier = threading.Barrier(n_threads)
+
+    def work(i):
+        import time
+
+        barrier.wait()
+        for j in range(m):
+            with tr.span("outer", args={"i": i, "j": j}):
+                with tr.span("inner"):
+                    pass
+                # retroactive span sized INSIDE the post-inner gap: a
+                # fixed duration could back-compute a start before the
+                # parent opened (or inside the inner sibling)
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < 2e-6:
+                    pass
+                tr.complete("retro", (time.perf_counter() - t0) / 2)
+
+    threads = [threading.Thread(target=work, args=(i,),
+                                name=f"obs-w{i}")
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    path = tmp_path / "trace.json"
+    tr.export(str(path))
+    chrome = json.loads(path.read_text())  # valid JSON round trip
+    by_tid = _x_events_by_tid(chrome)
+    assert len(by_tid) == n_threads
+    meta = {ev["tid"]: ev["args"]["name"]
+            for ev in chrome["traceEvents"] if ev.get("ph") == "M"}
+    for tid, events in by_tid.items():
+        assert meta[tid].startswith("obs-w")
+        names = [e["name"] for e in events]
+        assert names.count("outer") == m
+        assert names.count("inner") == m
+        assert names.count("retro") == m
+        _assert_properly_nested(events)
+        # every inner/retro interval is contained in SOME outer span
+        outers = [(e["ts"], e["ts"] + e["dur"]) for e in events
+                  if e["name"] == "outer"]
+        for e in events:
+            if e["name"] == "outer":
+                continue
+            assert any(lo - 1e-6 <= e["ts"]
+                       and e["ts"] + e["dur"] <= hi + 1e-6
+                       for lo, hi in outers), \
+                f"{e['name']} not contained in any outer span"
+
+
+def test_tracer_disabled_records_nothing():
+    tr = Tracer()
+    with tr.span("a"):
+        pass
+    tr.complete("b", 0.1)
+    tr.instant("c")
+    assert tr.event_count() == 0
+
+
+def test_tracer_ring_bound_drops_oldest():
+    tr = Tracer(max_events=10)
+    tr.enabled = True
+    for i in range(25):
+        tr.complete(f"s{i}", 1e-6)
+    assert tr.event_count() == 10
+    assert tr.dropped == 15
+    names = [e["name"] for e in tr.events()]
+    assert names == [f"s{i}" for i in range(15, 25)]  # oldest dropped
+
+
+def test_traced_decorator():
+    tr = trace.default_tracer()
+    tr.clear()
+    calls = []
+
+    @trace.traced
+    def plain(x):
+        calls.append(x)
+        return x + 1
+
+    @trace.traced("custom.name")
+    def named():
+        return 7
+
+    assert plain(1) == 2 and named() == 7  # disabled: passthrough
+    assert tr.event_count() == 0
+    trace.enable()
+    try:
+        assert plain(2) == 3 and named() == 7
+    finally:
+        trace.disable()
+    names = {e["name"] for e in tr.events()}
+    assert "custom.name" in names
+    assert any(n.endswith("plain") for n in names)
+    tr.clear()
+
+
+def test_profiler_phases_become_tracer_spans():
+    """The Profiler is a view over the tracer: phases, marks, and
+    worker-style add_seconds land on the process tracer's timeline —
+    and the NullProfiler keeps the emission (the serving default) while
+    staying a no-op for the books."""
+    from nmfx.profiling import NullProfiler, Profiler
+
+    tr = trace.default_tracer()
+    tr.clear()
+    trace.enable()
+    try:
+        prof = Profiler()
+        with prof.phase("real.phase"):
+            pass
+        prof.mark("real.mark")
+        prof.add_seconds("post.worker", 0.005)
+        null = NullProfiler()
+        with null.phase("null.phase"):
+            pass
+        null.add_seconds("null.retro", 0.003)
+        null.mark("null.mark")
+    finally:
+        trace.disable()
+    events = tr.events()
+    names = {e["name"] for e in events}
+    assert {"real.phase", "real.mark", "post.worker", "null.phase",
+            "null.retro", "null.mark"} <= names
+    by_name = {e["name"]: e for e in events}
+    assert by_name["real.phase"]["ph"] == "X"
+    assert by_name["real.mark"]["ph"] == "i"
+    assert by_name["null.retro"]["ph"] == "X"
+    assert by_name["null.retro"]["dur"] == pytest.approx(3000, rel=1e-6)
+    # the books stayed no-op on the null profiler
+    assert null.phases == {}
+    assert prof.phases["real.phase"].count == 1
+    tr.clear()
+
+
+# ---------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------
+
+def test_concurrent_writers_exact_counts():
+    """ISSUE 10 satellite: N threads x M increments across S labeled
+    series of one counter (plus a histogram) — the final counts are
+    EXACT, not approximate (single-lock registry)."""
+    c = metrics.counter("test_stress_total", "stress", ("series",))
+    h = metrics.histogram("test_stress_seconds", "stress", ("series",))
+    n_threads, m, n_series = 8, 250, 4
+
+    def work(i):
+        for j in range(m):
+            s = str((i + j) % n_series)
+            c.inc(series=s)
+            h.observe(0.01 * ((i + j) % 3), series=s)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total() == n_threads * m
+    total_obs = sum(
+        st["count"]
+        for st in h.series().values())
+    assert total_obs == n_threads * m
+    # per-series exactness: each (i+j) % n_series bucket got an equal
+    # share (m and n_series chosen so the shares are uniform)
+    for s in range(n_series):
+        assert c.value(series=str(s)) == n_threads * m // n_series
+
+
+def test_counter_is_monotonic_and_label_checked():
+    c = metrics.counter("test_mono_total", "", ("a",))
+    with pytest.raises(ValueError):
+        c.inc(-1, a="x")
+    with pytest.raises(ValueError):
+        c.inc(wrong="x")
+    with pytest.raises(ValueError):  # type conflict on redeclare
+        metrics.gauge("test_mono_total")
+    with pytest.raises(ValueError):  # label conflict on redeclare
+        metrics.counter("test_mono_total", "", ("b",))
+    assert metrics.counter("test_mono_total", "", ("a",)) is c
+
+
+def test_histogram_quantiles_and_extremes():
+    h = metrics.histogram("test_quant_seconds", "")
+    for v in [0.002, 0.004, 0.008, 0.02, 0.04, 0.08, 0.2, 0.4, 0.8,
+              2.0]:
+        h.observe(v)
+    st = h.series()[()]
+    assert st["count"] == 10
+    assert st["min"] == 0.002 and st["max"] == 2.0
+    assert h.quantile(0.0) == 0.002
+    assert h.quantile(1.0) == 2.0
+    p50 = h.quantile(0.5)
+    assert 0.01 <= p50 <= 0.1  # bucket-interpolated, bracketing the
+    assert h.quantile(0.99) <= 2.0  # true median of 0.03
+
+
+def test_snapshot_delta_windowing():
+    c = metrics.counter("test_delta_total", "", ("lab",))
+    g = metrics.gauge("test_delta_gauge", "")
+    h = metrics.histogram("test_delta_seconds", "")
+    c.inc(3, lab="a")
+    g.set(5)
+    h.observe(0.1)
+    snap = metrics.registry().snapshot()
+    c.inc(2, lab="a")
+    c.inc(1, lab="b")
+    g.set(9)
+    h.observe(0.2)
+    h.observe(0.3)
+    d = metrics.registry().delta(snap)
+    assert d["test_delta_total"]["series"][("a",)] == 2
+    assert d["test_delta_total"]["series"][("b",)] == 1
+    assert d["test_delta_gauge"]["series"][()] == 9  # gauge = level
+    hd = d["test_delta_seconds"]["series"][()]
+    assert hd["count"] == 2
+    assert hd["sum"] == pytest.approx(0.5)
+
+
+def test_prometheus_text_exposition():
+    c = metrics.counter("test_promtext_total", "a counter", ("lab",))
+    c.inc(2, lab="x")
+    h = metrics.histogram("test_promtext_seconds", "a histogram",
+                          buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = metrics.registry().prometheus_text()
+    assert '# TYPE test_promtext_total counter' in text
+    assert 'test_promtext_total{lab="x"} 2' in text
+    assert '# TYPE test_promtext_seconds histogram' in text
+    # cumulative buckets: 1 <= 0.1, 2 <= 1.0, 3 <= +Inf
+    assert 'test_promtext_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_promtext_seconds_bucket{le="1.0"} 2' in text
+    assert 'test_promtext_seconds_bucket{le="+Inf"} 3' in text
+    assert 'test_promtext_seconds_count 3' in text
+    assert 'test_promtext_seconds_sum' in text
+
+
+def test_shim_counters_are_registry_backed():
+    """The back-compat shims (exec_cache/data_cache/serve/checkpoint
+    module counters) read the SAME registry series the Prometheus
+    exposition exports — one source of truth."""
+    from nmfx import checkpoint, data_cache, exec_cache, serve
+
+    reg = metrics.registry()
+    pairs = [
+        (exec_cache.compile_count, "nmfx_exec_compile_total"),
+        (data_cache.transfer_count, "nmfx_data_h2d_transfers_total"),
+        (data_cache.h2d_bytes, "nmfx_data_h2d_bytes_total"),
+        (serve.dispatch_count, "nmfx_serve_dispatches_total"),
+        (checkpoint.chunks_solved_count, "nmfx_ckpt_chunks_solved_total"),
+        (checkpoint.chunks_loaded_count, "nmfx_ckpt_chunks_loaded_total"),
+    ]
+    for shim, name in pairs:
+        m = reg.get(name)
+        assert m is not None, name
+        assert shim() == int(sum(m.series().values())), name
+
+
+# ---------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------
+
+def test_flight_ring_bounded_and_redacted():
+    rec = flight.FlightRecorder(max_events=8)
+    rec.record("cat.small", x=1, ok=True)
+    rec.record("cat.big", blob="z" * 10_000,
+               **{f"k{i}": i for i in range(40)})
+    evs = rec.events()
+    big = next(e for e in evs if e["category"] == "cat.big")
+    assert len(big["blob"]) < 300 and "…" in big["blob"]
+    assert big["redacted_keys"] > 0
+    for i in range(20):
+        rec.record("cat.flood", i=i)
+    assert len(rec.events()) == 8
+    assert rec.dropped > 0
+
+
+def test_flight_dump_writes_only_when_configured(tmp_path):
+    rec = flight.FlightRecorder()
+    rec.record("ev.one", detail="x")
+    assert rec.dump("no-dir") is None  # never litters the cwd
+    assert rec.last_dump()["reason"] == "no-dir"
+    rec.configure(str(tmp_path))
+    path = rec.dump("unit test/reason", extra={"err": ValueError("b")})
+    assert path is not None
+    art = json.loads(open(path).read())
+    assert art["reason"] == "unit test/reason"
+    assert art["extra"]["err"] == "b"
+    assert any(e["category"] == "ev.one" for e in art["events"])
+    explicit = rec.dump("explicit", path=str(tmp_path / "here.json"))
+    assert explicit == str(tmp_path / "here.json")
+
+
+def test_fault_fire_lands_flight_event():
+    """Every armed fault FIRE books the site's FAULT_EVENTS category —
+    the mapping lint rule NMFX008 keeps total over faults.SITES."""
+    rec = flight.default_recorder()
+    before = len(rec.events("fault.compile.build"))
+    with faults.scoped("compile.build", every=2):
+        assert not faults.fire("compile.build")  # hit 1: no fire
+        assert faults.fire("compile.build")      # hit 2: fires
+    evs = rec.events("fault.compile.build")
+    assert len(evs) == before + 1
+    assert evs[-1]["site"] == "compile.build"
+    assert evs[-1]["hit"] == 2
+    # arming itself is also on the record (scoped re-arms count too)
+    assert any(e["site"] == "compile.build"
+               for e in rec.events("fault.armed"))
+
+
+def test_warn_once_records_every_degradation():
+    """The warning dedups per category; the flight record does NOT —
+    a postmortem needs the full degradation sequence."""
+    rec = flight.default_recorder()
+    before = len(rec.events("degradation"))
+    with pytest.warns(RuntimeWarning, match="first"):
+        faults.warn_once("test-obs-cat", "first")
+    faults.warn_once("test-obs-cat", "second (no warning)")
+    evs = rec.events("degradation")
+    assert len(evs) == before + 2
+    assert evs[-1]["degradation"] == "test-obs-cat"
+    assert evs[-1]["msg"].startswith("second")
+
+
+def test_armed_sites_appear_in_dump(tmp_path):
+    rec = flight.default_recorder()
+    with faults.scoped("h2d.transfer", every=3):
+        path = rec.dump("armed-check",
+                        path=str(tmp_path / "dump.json"))
+    art = json.loads(open(path).read())
+    assert "h2d.transfer" in art["armed_fault_sites"]
+
+
+# ---------------------------------------------------------------------
+# server-side metrics surfaces (no dispatch — cheap)
+# ---------------------------------------------------------------------
+
+def test_server_stats_snapshot_windows_to_server_start():
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    probe = metrics.counter("test_server_window_total", "")
+    probe.inc(5)  # before the server exists: outside its window
+    srv = NMFXServer(ServeConfig(), engine=object(), start=False)
+    probe.inc(2)
+    d = srv.stats_snapshot()
+    assert d["test_server_window_total"]["series"][()] == 2
+    text = srv.metrics_text()
+    assert "nmfx_serve" in text or "test_server_window_total" in text
+    srv.close()

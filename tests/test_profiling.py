@@ -136,6 +136,53 @@ def test_audit_overlap_split():
     assert "overlapped" in report
 
 
+def test_audit_zero_wall_division_guards():
+    """ISSUE 10 satellite: the zero-wall edge — an empty (or
+    instantaneous) region must audit to clean zeros, never a
+    ZeroDivisionError. Pins the explicit wall=0.0 path and the
+    no-phases default path (total_seconds() == 0)."""
+    prof = Profiler()
+    a = prof.audit(0.0)
+    assert a == {"wall_s": 0.0, "phase_sum_s": 0.0,
+                 "unattributed_s": 0.0, "coverage": 0.0,
+                 "overlap_s": 0.0, "overlap_ratio": 0.0}
+    # marks only: zero seconds everywhere, default wall is the (zero)
+    # sequential sum
+    prof.mark("compile.cache_hit")
+    a = prof.audit()
+    assert a["wall_s"] == 0.0
+    assert a["coverage"] == 0.0 and a["overlap_ratio"] == 0.0
+    # report renders without dividing by the zero total
+    report = prof.report()
+    assert "compile.cache_hit" in report
+
+
+def test_audit_all_overlap_edge():
+    """ISSUE 10 satellite: EVERY phase overlap-classed (a pure
+    worker-thread region — the streamed-harvest books when the main
+    thread recorded nothing). The sequential sum is zero, so the
+    default-wall audit divides by zero wall; both ratios must guard,
+    and the report must ``~``-tag every row with the share column
+    dashed."""
+    prof = Profiler()
+    prof.add_seconds("xfer.d2h_overlap", 0.4)
+    prof.add_seconds("post.rank_selection", 0.6)
+    assert prof.total_seconds() == 0.0
+    a = prof.audit()  # wall falls back to the zero sequential sum
+    assert a["wall_s"] == 0.0
+    assert a["phase_sum_s"] == 0.0
+    assert a["coverage"] == 0.0
+    assert a["overlap_s"] == pytest.approx(1.0)
+    assert a["overlap_ratio"] == 0.0  # guarded, not inf
+    # against a real wall the overlap ratio books normally
+    assert prof.audit(2.0)["overlap_ratio"] == pytest.approx(0.5)
+    report = prof.report()
+    for line in report.splitlines():
+        if "d2h_overlap" in line or "rank_selection" in line:
+            assert line.startswith("~")
+            assert line.rstrip().endswith("-")
+
+
 def test_phase_sum_audit_on_profiled_run(two_group_data):
     """The audit on a REAL profiled run: the sequential phases must
     explain the wall (no hidden async time migrating between phases —

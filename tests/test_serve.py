@@ -732,3 +732,76 @@ def test_spill_readmit_bit_identical_real_engine(small_data, scfg):
         got = futs[0].result(timeout=300)
     ref = _solo(small_data, cache, scfg=scfg)
     assert_result_bit_equal(got, ref)
+
+
+# ---------------------------------------------------------------------
+# unified telemetry (ISSUE 10): one served request = one nested
+# cross-thread timeline; metrics windowed to the server
+# ---------------------------------------------------------------------
+
+def test_served_request_traces_nested_spans_across_threads(
+        small_data, scfg, tmp_path):
+    """The ISSUE 10 acceptance: a served request exports Chrome-trace
+    JSON whose spans cover queue→pack/dispatch→solve→harvest, the
+    serve spans carry the request's RequestStats id in their args, and
+    the timeline spans >= 2 threads (scheduler + completion worker).
+    Also pins stats_snapshot()/metrics_text() on the same request."""
+    import json
+
+    from nmfx.exec_cache import ExecCache
+    from nmfx.obs import trace
+
+    tracer = trace.default_tracer()
+    tracer.clear()
+    trace.enable()
+    try:
+        with NMFXServer(ServeConfig(), exec_cache=ExecCache()) as srv:
+            fut = srv.submit(small_data, ks=KS, restarts=RESTARTS,
+                             seed=11, solver_cfg=scfg)
+            fut.result(timeout=600)
+            snap = srv.stats_snapshot()
+            text = srv.metrics_text()
+    finally:
+        trace.disable()
+    path = tmp_path / "serve_trace.json"
+    tracer.export(str(path))
+    chrome = json.loads(path.read_text())  # valid Chrome trace JSON
+    xs = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in xs}
+    # the request path end to end: queue residency, the dispatch step
+    # (serve.dispatch wrapping serve.pack), device solve, and the
+    # completion worker's harvest with its fetch/rank-selection
+    # children
+    assert "serve.queue_wait" in names
+    assert "serve.dispatch" in names and "serve.pack" in names
+    assert any(n.startswith("solve.") for n in names)
+    assert "serve.harvest" in names
+    assert "xfer.d2h_overlap" in names
+    assert "post.rank_selection" in names
+    # RequestStats ids ride in the span args (ISSUE 10 satellite)
+    rid = fut.stats.request_id
+    assert rid is not None
+    qw = next(e for e in xs if e["name"] == "serve.queue_wait")
+    assert qw["args"]["request_id"] == rid
+    disp = next(e for e in xs if e["name"] == "serve.dispatch")
+    assert rid in disp["args"]["request_ids"]
+    hv = next(e for e in xs if e["name"] == "serve.harvest")
+    assert hv["args"]["request_id"] == rid
+    # >= 2 threads: the scheduler dispatched, a completion worker
+    # harvested
+    assert disp["tid"] != hv["tid"]
+    # the harvest children nest inside the harvest span on its thread
+    lo, hi = hv["ts"], hv["ts"] + hv["dur"]
+    child = next(e for e in xs if e["name"] == "post.rank_selection"
+                 and e["tid"] == hv["tid"])
+    assert lo - 1 <= child["ts"] and child["ts"] + child["dur"] <= hi + 1
+    # metrics: the server-windowed delta saw this request's dispatch
+    # and latency observation; the exposition carries the histograms
+    disp_delta = sum(
+        snap["nmfx_serve_dispatches_total"]["series"].values())
+    assert disp_delta >= 1
+    e2e = snap["nmfx_serve_e2e_seconds"]["series"][("completed",)]
+    assert e2e["count"] >= 1
+    assert "nmfx_serve_e2e_seconds_bucket" in text
+    assert "nmfx_serve_queue_wait_seconds" in text
+    tracer.clear()
