@@ -17,7 +17,14 @@ from nmfx.config import (
     ExperimentalConfig,
     InitConfig,
     OutputConfig,
+    SketchConfig,
     SolverConfig,
+)
+from nmfx.agreement import (
+    adjusted_rand_index,
+    consensus_agreement,
+    cophenetic_gap,
+    membership_agreement,
 )
 from nmfx.exec_cache import ExecCache
 from nmfx.io import read_dataset, read_gct, read_res, write_gct
@@ -50,8 +57,13 @@ __all__ = [
     "InitConfig",
     "OutputConfig",
     "RestartResult",
+    "SketchConfig",
     "SolverConfig",
+    "adjusted_rand_index",
+    "consensus_agreement",
     "consensus_from_cells",
+    "cophenetic_gap",
+    "membership_agreement",
     "default_mesh",
     "feature_mesh",
     "grid_cells",

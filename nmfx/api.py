@@ -67,6 +67,16 @@ class ConsensusResult:
     ks: tuple[int, ...]
     per_k: Mapping[int, KResult]
     col_names: tuple[str, ...]
+    #: solver-quality tag (ISSUE 12): "exact" for the bit-exact engine
+    #: families, "sketched" when the factorizations ran the random-
+    #: projection compressed engine (``backend="sketched"`` — including
+    #: a serve request DEGRADED there by quality-elastic scheduling,
+    #: ``ServeConfig.quality_elastic``). The tag is set by every
+    #: producing path (``nmfconsensus``, the serve completion workers —
+    #: a lint fixture in tests/test_serve_quality.py pins that no
+    #: construction site can omit it), so an approximate result can
+    #: never reach a caller untyped.
+    quality: str = "exact"
 
     @property
     def rhos(self) -> np.ndarray:
@@ -98,6 +108,9 @@ class ConsensusResult:
             lines.append(f"{k}\t{r.rho:.4f}\t{r.dispersion:.4f}"
                          f"\t{r.iterations.mean():.1f}")
         lines.append(f"best k = {self.best_k}")
+        if self.quality != "exact":
+            lines.append(f"quality = {self.quality} (approximate engine; "
+                         "statistical accuracy contract)")
         return "\n".join(lines)
 
     def save(self, path: str) -> None:
@@ -108,6 +121,7 @@ class ConsensusResult:
         arrays: dict[str, np.ndarray] = {
             "ks": np.asarray(self.ks, np.int64),
             "col_names": np.asarray(self.col_names, np.str_),
+            "quality": np.asarray(self.quality, np.str_),
         }
         for k in self.ks:
             r = self.per_k[k]
@@ -145,7 +159,12 @@ class ConsensusResult:
                 per_k[k] = KResult(**kwargs)
             return ConsensusResult(ks=ks, per_k=per_k,
                                    col_names=tuple(str(c)
-                                                   for c in z["col_names"]))
+                                                   for c in z["col_names"]),
+                                   # absent in pre-ISSUE-12 files, which
+                                   # could only have been exact
+                                   quality=(str(z["quality"])
+                                            if "quality" in z.files
+                                            else "exact"))
 
 
 def _build_k_result(k: int, out, linkage: str,
@@ -168,16 +187,21 @@ def _build_k_result(k: int, out, linkage: str,
     from nmfx.solvers.base import StopReason
 
     stops = np.asarray(out.stop_reasons)
-    survivors = int((stops != int(StopReason.NUMERIC_FAULT)).sum())
+    masked = ((stops == int(StopReason.NUMERIC_FAULT))
+              | (stops == int(StopReason.SCREENED)))
+    survivors = int((~masked).sum())
     if survivors < min_restarts:
+        n_fault = int((stops == int(StopReason.NUMERIC_FAULT)).sum())
+        n_screen = int((stops == int(StopReason.SCREENED)).sum())
         raise InsufficientRestarts(
             f"rank k={k}: only {survivors} of {stops.size} restarts "
-            "survived the numeric quarantine (stop reason "
-            f"NUMERIC_FAULT on {stops.size - survivors}), below the "
-            f"configured floor min_restarts={min_restarts} — the "
-            "consensus for this rank is not trustworthy. Inspect the "
-            "input conditioning / solver settings, or lower "
-            "min_restarts to accept thinner consensus")
+            "survived the numeric quarantine / screening cut "
+            f"(NUMERIC_FAULT on {n_fault}, SCREENED on {n_screen}), "
+            f"below the configured floor min_restarts={min_restarts} — "
+            "the consensus for this rank is not trustworthy. Inspect "
+            "the input conditioning / solver settings (or raise "
+            "screen_keep), or lower min_restarts to accept thinner "
+            "consensus")
     cons = np.asarray(out.consensus, dtype=np.float64)
     if selection is not None:
         rho, membership, order = selection
@@ -288,6 +312,19 @@ def nmf(a, k: int, *, seed: int = 0, algorithm: str | None = None,
             raise ValueError("initial factors contain non-finite values")
         if (w0 < 0).any() or (h0 < 0).any():
             raise ValueError("initial factors must be non-negative")
+    if scfg.screen:
+        raise ValueError(
+            "screen=True is a sweep-pool concept (it ranks RESTARTS); "
+            "a single factorization has no pool to screen")
+    if scfg.backend == "sketched":
+        # the compressed engine: projections fold off the same seed key
+        # the init drew from, so nmf(seed=s) is deterministic end to end
+        from nmfx.solvers.sketched import solve_sketched
+
+        return solve_sketched(jnp.asarray(arr, dtype),
+                              jnp.asarray(w0, dtype),
+                              jnp.asarray(h0, dtype),
+                              jax.random.key(seed), scfg)
     return solve(arr, w0, h0, scfg)
 
 
@@ -328,6 +365,23 @@ def restart_factors(a, k: int, restart: int, *, restarts: int,
     key = jax.random.fold_in(jax.random.key(seed), k)
     kk = jax.random.split(key, restarts)[restart]
     w0, h0 = initialize(kk, jnp.asarray(arr, dtype), k, icfg, dtype)
+    if scfg.backend == "sketched":
+        # the sketched sweep's projections fold off this same canonical
+        # restart key, so the recompute reproduces the sweep lane —
+        # same draws, same trajectory, equivalent within float
+        # tolerance (solo vs vmapped GEMM tilings reorder reductions;
+        # the whole-grid/per-k equivalence class). The engine's
+        # contract is statistical anyway — bit-exact recompute is an
+        # exact-engine property.
+        from nmfx.solvers.sketched import solve_sketched
+
+        return solve_sketched(jnp.asarray(arr, dtype), w0, h0, kk, scfg)
+    if scfg.screen:
+        # a screened sweep's SURVIVOR lanes ran the plain exact solve
+        # from these keys; recomputing with the screening fields
+        # stripped reproduces them bit-for-bit (and yields the
+        # would-have-been exact result for screened-out lanes)
+        scfg = dataclasses.replace(scfg, screen=False, screen_keep=None)
     return solve(arr, w0, h0, scfg)
 
 
@@ -570,7 +624,12 @@ def nmfconsensus(
                     min_restarts=ccfg.min_restarts)
 
     result = ConsensusResult(ks=ccfg.ks, per_k=per_k,
-                             col_names=tuple(col_names))
+                             col_names=tuple(col_names),
+                             # an approximate engine's result is typed,
+                             # never silently exact-shaped (ISSUE 12)
+                             quality=("sketched"
+                                      if scfg.backend == "sketched"
+                                      else "exact"))
     if output is not None:
         with profiler.phase("write_outputs"):
             save_results(result, output)

@@ -536,6 +536,17 @@ def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
     from nmfx import faults
     from nmfx.sweep import _build_chunk_sweep_fn
 
+    if scfg.backend == "sketched" or scfg.screen:
+        # the common funnel of BOTH the checkpointed sweep and the
+        # elastic shard runner — guarded here so no durable path can
+        # silently execute the exact vmapped driver for a config that
+        # asked for the statistical/whole-pool engines (see
+        # run_checkpointed_sweep's matching guard for the rationale)
+        raise ValueError(
+            "durable chunk execution does not support "
+            "backend='sketched' or screen=True (bit-identical replay "
+            "vs statistical/whole-pool contracts); use an exact "
+            "unscreened engine")
     if keys is None:
         keys = jax.random.split(
             jax.random.fold_in(jax.random.key(ccfg.seed), k),
@@ -635,6 +646,19 @@ def run_checkpointed_sweep(a, cfg: ConsensusConfig,
             "ledger persists per-restart stats and best candidates, not "
             "every factor stack); recompute any restart exactly with "
             "nmfx.restart_factors")
+    if solver_cfg.backend == "sketched" or solver_cfg.screen:
+        # the ledger's resume contract is BIT-IDENTICAL replay of plan
+        # chunks; the sketched engine's contract is statistical and the
+        # screening pass ranks across the WHOLE restart pool (a chunk
+        # cannot know its lanes' survivor status) — neither has a valid
+        # chunk form, and the chunk executor would otherwise silently
+        # run the exact vmapped driver instead
+        raise ValueError(
+            "checkpointed sweeps do not support backend='sketched' or "
+            "screen=True (the durable ledger replays per-(k, chunk) "
+            "records bit-identically; the sketched/screened paths are "
+            "whole-pool and statistical) — drop the checkpoint or use "
+            "an exact unscreened engine")
     arr = np.asarray(a)
     ck = SweepCheckpoint.open(arr, cfg, solver_cfg, init_cfg, cp_cfg)
     restore = install_signal_flush(ck)

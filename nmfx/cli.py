@@ -119,9 +119,33 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("default", "bfloat16", "highest"),
                    help="TPU matmul precision for solver dots")
     p.add_argument("--backend", default="auto",
-                   choices=("auto", "vmap", "packed", "pallas"),
+                   choices=("auto", "vmap", "packed", "pallas",
+                            "sketched"),
                    help="restart-batch execution strategy (auto = packed "
-                        "GEMMs for mu, vmapped driver otherwise)")
+                        "GEMMs for mu, vmapped driver otherwise; "
+                        "'sketched' = the random-projection compressed "
+                        "engine — approximate, statistical accuracy "
+                        "contract at the consensus level, result tagged "
+                        "quality='sketched'; mu/hals only)")
+    p.add_argument("--sketch-dim", type=int, default=None, metavar="R",
+                   help="sketch dimension of the compressed engine / "
+                        "screening pass (SketchConfig.dim; default "
+                        "'auto' = 4k+8 per rank, clamped to the matrix "
+                        "dims). Requires --backend sketched or --screen")
+    p.add_argument("--screen", action="store_true",
+                   help="restart screening (SolverConfig.screen): a "
+                        "cheap sketched pass scores the full restart "
+                        "pool and only the --screen-keep best lanes "
+                        "get exact iterations — survivor results are "
+                        "bit-identical to solo exact runs; screened-out "
+                        "lanes are masked from the consensus like pad "
+                        "lanes (the min_restarts floor counts them as "
+                        "non-survivors). mu/hals with --backend "
+                        "auto/vmap")
+    p.add_argument("--screen-keep", type=int, default=None, metavar="K",
+                   help="survivors of the screening pass per rank "
+                        "(required with --screen; must be <= "
+                        "--restarts)")
     p.add_argument("--restart-chunk", type=int, default=None,
                    help="cap on restarts solved concurrently in the vmapped "
                         "driver (bounds peak memory for kl's m*n "
@@ -374,6 +398,77 @@ def _run_cli(argv: list[str] | None = None) -> int:
         parser.error("--backend packed is only implemented for "
                      f"--algorithm {'/'.join(PACKED_ALGORITHMS)} "
                      "(use auto)")
+    from nmfx.config import SKETCHED_ALGORITHMS
+
+    if (args.backend == "sketched"
+            and args.algorithm not in SKETCHED_ALGORITHMS):
+        parser.error("--backend sketched is only implemented for "
+                     f"--algorithm {'/'.join(SKETCHED_ALGORITHMS)} "
+                     "(the Gram-family updates the projections "
+                     "compress)")
+    if args.screen:
+        if args.algorithm not in SKETCHED_ALGORITHMS:
+            parser.error("--screen needs a sketched screening pass, "
+                         "which only --algorithm "
+                         f"{'/'.join(SKETCHED_ALGORITHMS)} has")
+        if args.backend not in ("auto", "vmap"):
+            parser.error("--screen runs its exact phase through the "
+                         "vmapped driver (the survivor bit-identity "
+                         "contract); use --backend auto or vmap")
+        if args.screen_keep is None:
+            parser.error("--screen requires --screen-keep (how many "
+                         "survivors get exact iterations)")
+        if not 1 <= args.screen_keep <= args.restarts:
+            parser.error(f"--screen-keep must be in [1, --restarts="
+                         f"{args.restarts}], got {args.screen_keep}")
+        if args.keep_factors:
+            parser.error("--screen does not compose with "
+                         "--keep-factors (screened-out lanes never "
+                         "receive exact iterations, so there is no "
+                         "full factor grid to keep)")
+    elif args.screen_keep is not None:
+        parser.error("--screen-keep requires --screen")
+    if args.sketch_dim is not None:
+        if args.sketch_dim < 1:
+            parser.error("--sketch-dim must be >= 1")
+        if args.backend != "sketched" and not args.screen:
+            parser.error("--sketch-dim only applies to the compressed "
+                         "paths; pass --backend sketched or --screen")
+    if args.backend == "sketched" or args.screen:
+        # compose-guards for the statistical-contract paths: every
+        # surface whose contract is BIT-EXACT (or whose resume replays
+        # exact records) refuses the approximate engine loudly instead
+        # of silently serving it
+        if args.rank_selection == "device":
+            parser.error("--backend sketched/--screen carry a "
+                         "STATISTICAL accuracy contract; "
+                         "--rank-selection device exists for bit-exact "
+                         "pipelines — use the host path")
+        if args.checkpoint_dir is not None:
+            parser.error("--backend sketched/--screen do not compose "
+                         "with --checkpoint-dir (the durable ledger "
+                         "replays per-chunk records bit-identically; "
+                         "the sketched/screened paths are whole-pool "
+                         "and statistical)")
+        if args.serve_smoke:
+            parser.error("--serve-smoke gates served results "
+                         "bit-identical to the direct path; the "
+                         "sketched/screened engines are statistical — "
+                         "drop --backend sketched/--screen")
+        if (args.exec_cache or args.warm_shapes or args.cache_dir
+                or args.pipeline_ranks):
+            parser.error("--backend sketched/--screen are not exec-"
+                         "cacheable (no slot-scheduled form; see "
+                         "ExecCache.cacheable) — drop --exec-cache/"
+                         "--warm-shapes/--cache-dir/--pipeline-ranks")
+        if args.grid_exec == "grid":
+            parser.error("--grid-exec grid demands the whole-grid slot "
+                         "scheduler, which has no sketched/screened "
+                         "form; use auto (falls back per-k)")
+        if args.feature_shards > 1 or args.sample_shards > 1:
+            parser.error("--backend sketched/--screen are restart-"
+                         "parallel only (per-restart projections have "
+                         "no feature/sample-sharded formulation)")
     if args.verbose:
         import logging
 
@@ -451,12 +546,19 @@ def _run_cli(argv: list[str] | None = None) -> int:
     # ONE SolverConfig for warmup and the run: the exec-cache key hashes
     # it, so warming with a copy that could drift from the run's config
     # would silently compile a never-hit executable
+    from nmfx.config import SketchConfig
+
     run_scfg = SolverConfig(algorithm=args.algorithm,
                             max_iter=args.maxiter,
                             matmul_precision=args.precision,
                             backend=args.backend,
                             restart_chunk=args.restart_chunk,
-                            check_block=args.check_block)
+                            check_block=args.check_block,
+                            sketch=(SketchConfig(dim=args.sketch_dim)
+                                    if args.sketch_dim is not None
+                                    else SketchConfig()),
+                            screen=args.screen,
+                            screen_keep=args.screen_keep)
     ckpt_cfg = None
     if args.checkpoint_every is not None and args.checkpoint_every < 1:
         parser.error("--checkpoint-every must be >= 1")

@@ -114,6 +114,74 @@ class ExperimentalConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SketchConfig:
+    """Random-projection compression of the solver updates
+    (``nmfx/solvers/sketched.py`` — the "Faster-than-fast NMF" engine,
+    arxiv 1812.04315).
+
+    The sketched engine keeps both factors at FULL size and compresses
+    only the update *computations*: per restart, two random projections
+    L (r_l × m) and R (n × r_c) are drawn from the canonical
+    per-(seed, k, restart) key chain, and every Gram-family term of the
+    MU/HALS updates contracts against the sketched matrices L·A / A·R
+    instead of A — cutting the per-iteration FLOPs from ~4mnk to
+    ~4rk(m+n) (the four m/n-sized sketched GEMMs; see
+    ``nmfx.solvers.sketched.sketched_model_flops``). Labels and the final residual are computed from the
+    full (uncompressed) factors, so the consensus layer consumes exact
+    labels of approximate factorizations — which is why the accuracy
+    contract is STATISTICAL at the consensus level (membership
+    agreement / ARI vs the exact engine, ``nmfx/agreement.py``), never
+    bit-exact. The same machinery powers restart screening
+    (``SolverConfig.screen``) and quality-elastic serving
+    (``ServeConfig.quality_elastic``).
+    """
+
+    #: sketch dimension r (both projections): "auto" resolves per rank
+    #: to ``max(4k + 8, 40)`` clamped to the matrix dims — the usual
+    #: randomized-sketching oversampling regime (r ≪ min(m, n), r > k)
+    #: with a measured absolute floor (see
+    #: ``nmfx.solvers.sketched.resolve_dim``); an int pins it (clamped
+    #: to the matrix dims at build time)
+    dim: "int | str" = "auto"
+    #: Nesterov momentum on the factor iterates (the acceleration half
+    #: of arxiv 1812.04315): updates evaluate at the extrapolated point
+    #: ``X + beta_t (X - X_prev)`` clamped to >= 0, with the standard
+    #: t-sequence beta. Off = plain compressed MU/HALS.
+    momentum: bool = True
+    #: iteration budget of the cheap screening pass
+    #: (``SolverConfig.screen``): each restart runs this many sketched
+    #: iterations before the compressed objective ranks the pool
+    screen_iters: int = 40
+    #: final UNCOMPRESSED polish: after the compressed loop stops, run
+    #: this many exact update iterations (the full mu/hals rule against
+    #: A itself) before the labels/residual are read — snaps the
+    #: sketch-noise-rattled factors to an exact-update neighborhood, so
+    #: long compressed budgets cannot wander the final labels (measured:
+    #: without it, consensus ARI vs exact dropped to ~0.34 on harsh
+    #: seeds at max_iter=3000; with 3 polish steps it holds >= 0.9).
+    #: O(polish · mnk) per restart — amortized over the hundreds of
+    #: compressed iterations it replaces
+    polish_iters: int = 3
+
+    def __post_init__(self):
+        d = self.dim
+        if not (d == "auto" or (isinstance(d, int)
+                                and not isinstance(d, bool) and d >= 1)):
+            raise ValueError(
+                f"sketch.dim must be 'auto' or an int >= 1, got {d!r}")
+        if self.screen_iters < 1:
+            raise ValueError("sketch.screen_iters must be >= 1")
+        if self.polish_iters < 0:
+            raise ValueError("sketch.polish_iters must be >= 0")
+
+
+#: algorithms with a compressed (sketched) update formulation —
+#: backend="sketched" and SolverConfig.screen are limited to these
+#: (their updates are Gram-family GEMM chains the projections contract)
+SKETCHED_ALGORITHMS = ("mu", "hals")
+
+
+@dataclasses.dataclass(frozen=True)
 class SolverConfig:
     """Per-factorization solver settings.
 
@@ -235,7 +303,10 @@ class SolverConfig:
     #: where it exists (mu), else the vmapped generic driver; "packed" forces
     #: it (error for other algorithms); "pallas" runs the packed iteration
     #: through the fused Pallas TPU kernels (nmfx.ops.pallas_mu); "vmap"
-    #: forces the generic driver. Measured ~3.5x faster per iteration at
+    #: forces the generic driver; "sketched" runs the random-projection
+    #: compressed engine (nmfx/solvers/sketched.py, SKETCHED_ALGORITHMS
+    #: only — see ``SketchConfig`` and the STATISTICAL accuracy contract
+    #: documented there). Measured ~3.5x faster per iteration at
     #: k=10 on the north-star config (packed vs vmap).
     #: Engine-parity note for kl + backend="packed" (the whole-grid
     #: opt-in): at high k relative to the data's structure (k=5/6 on the
@@ -249,6 +320,26 @@ class SolverConfig:
     #: tests/test_kl_drift.py pins the band. At k <= 4 the engines agree
     #: exactly.
     backend: str = "auto"
+    #: random-projection compression knobs for backend="sketched" and
+    #: the screening pass (``screen``); inert on the exact engines
+    sketch: SketchConfig = SketchConfig()
+    #: restart screening (ISSUE 12): run a cheap sketched pass
+    #: (``sketch.screen_iters`` compressed iterations) over the FULL
+    #: restart pool, rank restarts by compressed objective, and spend
+    #: exact iterations only on the top-``screen_keep`` survivors.
+    #: Screened-out lanes are masked from the consensus exactly like
+    #: pad/quarantined lanes (``StopReason.SCREENED``; the
+    #: ``ConsensusConfig.min_restarts`` floor counts them as
+    #: non-survivors), and survivor-lane results are bit-identical to
+    #: solo exact runs of those lanes (the exact phase runs the vmapped
+    #: generic driver — lane-independent batched GEMMs; pinned by
+    #: tests/test_screening.py). Requires an algorithm in
+    #: ``SKETCHED_ALGORITHMS`` and backend "auto"/"vmap".
+    screen: bool = False
+    #: survivors of the screening pass per rank (required with
+    #: ``screen=True``; must be <= the sweep's restart count — checked
+    #: where the restart count is known)
+    screen_keep: "int | None" = None
     #: measured-rejected / still-experimental opt-ins, grouped behind one
     #: documented surface (see ExperimentalConfig for the keep/remove
     #: policy): the ragged pool, evict hysteresis, slot-pool factor
@@ -285,14 +376,39 @@ class SolverConfig:
     restart_chunk: int | None = None
 
     def __post_init__(self):
-        if self.backend not in ("auto", "vmap", "packed", "pallas"):
+        if self.backend not in ("auto", "vmap", "packed", "pallas",
+                                "sketched"):
             raise ValueError(
-                f"backend must be 'auto', 'vmap', 'packed' or 'pallas', "
-                f"got {self.backend!r}")
+                f"backend must be 'auto', 'vmap', 'packed', 'pallas' or "
+                f"'sketched', got {self.backend!r}")
         if self.backend == "pallas" and self.algorithm != "mu":
             raise ValueError(
                 "backend='pallas' is only implemented for algorithm='mu'; "
                 "use 'auto' to fall back per algorithm")
+        if (self.backend == "sketched"
+                and self.algorithm not in SKETCHED_ALGORITHMS):
+            raise ValueError(
+                "backend='sketched' is only implemented for the Gram-"
+                f"family algorithms {SKETCHED_ALGORITHMS}; use 'auto' "
+                "for an exact engine")
+        if self.screen:
+            if self.algorithm not in SKETCHED_ALGORITHMS:
+                raise ValueError(
+                    "screen=True needs a sketched screening pass, which "
+                    f"only the algorithms {SKETCHED_ALGORITHMS} have")
+            if self.backend not in ("auto", "vmap"):
+                raise ValueError(
+                    "screen=True runs its exact phase through the "
+                    "vmapped generic driver (the lane-independent "
+                    "engine the survivor bit-identity contract rests "
+                    "on); use backend 'auto' or 'vmap', got "
+                    f"{self.backend!r}")
+            if self.screen_keep is None:
+                raise ValueError(
+                    "screen=True requires screen_keep (how many "
+                    "survivors get exact iterations)")
+        if self.screen_keep is not None and self.screen_keep < 1:
+            raise ValueError("screen_keep must be >= 1 or None")
         if (self.backend == "packed"
                 and self.algorithm not in PACKED_ALGORITHMS):
             raise ValueError(

@@ -42,7 +42,12 @@ _META_NAME = "registry.json"
 #: diverges, so checkpoints must not cross the setting; fault-free runs
 #: are bit-identical either way, but the v3 rule — any new field
 #: invalidates — applies)
-_FORMAT_VERSION = 7
+#: v8: ISSUE 12 — SolverConfig gained the sketched-engine surface
+#: (sketch: SketchConfig, screen, screen_keep) and backend grew the
+#: "sketched" family; every one of them changes the numbers a sweep
+#: records (a screened registry masks lanes an unscreened one solves),
+#: so the v3 rule applies
+_FORMAT_VERSION = 8
 
 #: AUTHORITATIVE list of SolverConfig fields excluded from the
 #: fingerprint payload. Every entry must be declared execution-strategy

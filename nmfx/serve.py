@@ -122,6 +122,15 @@ _solve_hist = _metrics.histogram(
 _e2e_hist = _metrics.histogram(
     "nmfx_serve_e2e_seconds",
     "submit-to-resolution request latency", labelnames=("outcome",))
+#: quality-elastic degradations (ISSUE 12): requests the scheduler
+#: served through the sketched engine instead of expiring (cause=
+#: "deadline") or rejecting (cause="overload") — every increment has a
+#: matching tagged result (ConsensusResult.quality == "sketched") and
+#: a serve.quality_degraded flight event; never a silent downgrade
+_quality_degraded_total = _metrics.counter(
+    "nmfx_serve_quality_degraded_total",
+    "requests degraded to the sketched engine by quality-elastic "
+    "scheduling", labelnames=("cause",))
 #: process-wide spill-record counter: per-SERVER request seqs restart
 #: at 0, so a restarted server in the same process would overwrite an
 #: earlier server's spill_{pid}_{seq}.npz — this counter keeps every
@@ -262,6 +271,26 @@ class ServeConfig:
     #: scheduler's liveness/heartbeat (bounds crash-to-resolution
     #: latency)
     watchdog_interval_s: float = 0.25
+    #: quality-elastic scheduling (ISSUE 12, docs/serving.md "Quality
+    #: elasticity"): let the scheduler DEGRADE a request to the
+    #: sketched engine (``backend="sketched"`` — the random-projection
+    #: compressed solver, statistical accuracy contract) instead of
+    #: failing it, in two situations: (a) a deadline that would clamp
+    #: the exact solve's iteration budget (``iter_rate_estimate``)
+    #: dispatches sketched at the full budget instead — cause
+    #: "deadline"; (b) a submit that admission control would reject on
+    #: queue DEPTH admits degraded while the depth stays under
+    #: 2×``max_queue_depth`` — cause "overload" (the pending-bytes
+    #: bound stays hard: it protects host memory, not latency). Only
+    #: requests whose algorithm has a sketched form
+    #: (``config.SKETCHED_ALGORITHMS``) and that did not opt into
+    #: screening are eligible; everything else keeps today's
+    #: expiry/rejection. A degraded result is ALWAYS typed and tagged:
+    #: ``ConsensusResult.quality = "sketched"``,
+    #: ``RequestStats.quality``/``degraded_cause``, the
+    #: ``nmfx_serve_quality_degraded_total{cause=…}`` counter, and a
+    #: ``serve.quality_degraded`` flight event.
+    quality_elastic: bool = False
     #: spill-on-shutdown directory (docs/serving.md "Durability
     #: model"): ``close(cancel_pending=True)`` persists each queued-but-
     #: undispatched request's full submission payload here (atomic
@@ -346,6 +375,15 @@ class RequestStats:
     #: max_iter); the exactness contract is then against a solo run at
     #: this max_iter
     budget_iters: "int | None" = None
+    #: solver quality the request was actually served at: "exact", or
+    #: "sketched" when the request ran the compressed engine — by its
+    #: own config, or degraded there by quality-elastic scheduling
+    #: (then ``degraded_cause`` names why). Mirrors
+    #: ``ConsensusResult.quality`` on the resolved future.
+    quality: str = "exact"
+    #: why quality-elastic scheduling degraded this request
+    #: ("deadline" | "overload"), None when it ran as requested
+    degraded_cause: "str | None" = None
 
 
 class _ServeFuture(Future):
@@ -378,6 +416,14 @@ class _Request:
     submitted: float = 0.0
     #: numeric-quarantine survivor floor (ConsensusConfig.min_restarts)
     min_restarts: int = 1
+    #: quality-elastic degradation verdict ("deadline" | "overload");
+    #: None = serve as requested. Set at admission (overload) or
+    #: dispatch (deadline); the harvester tags the result from
+    #: ``quality`` below, so no path can return an untagged sketched
+    #: result
+    degrade_cause: "str | None" = None
+    #: the quality the request will actually be served at
+    quality: str = "exact"
 
     @property
     def lanes(self) -> int:
@@ -600,7 +646,7 @@ class NMFXServer:
                          "packed_dispatches": 0, "packed_requests": 0,
                          "total_lanes": 0, "packed_lanes": 0,
                          "budget_clamped": 0, "spilled": 0,
-                         "readmitted": 0}
+                         "readmitted": 0, "quality_degraded": 0}
 
     # -- lifecycle ---------------------------------------------------------
     def __enter__(self) -> "NMFXServer":
@@ -755,9 +801,17 @@ class NMFXServer:
                     a = z["a"]
                     meta = json.loads(str(z["meta"]))
                 exp = meta["solver_cfg"].pop("experimental")
+                # nested configs were asdict()-flattened by _spill;
+                # sketch may be absent in pre-ISSUE-12 spill records
+                sk = meta["solver_cfg"].pop("sketch", None)
+                from nmfx.config import SketchConfig
+
                 scfg = SolverConfig(**meta["solver_cfg"],
                                     experimental=ExperimentalConfig(
-                                        **exp))
+                                        **exp),
+                                    sketch=(SketchConfig(**sk)
+                                            if sk is not None
+                                            else SketchConfig()))
                 icfg = InitConfig(**meta["init_cfg"])
                 tail = meta["grid_tail_slots"]
                 if isinstance(tail, list):
@@ -877,17 +931,31 @@ class NMFXServer:
                        future=_ServeFuture(stats), stats=stats,
                        compat=None, submitted=time.monotonic(),
                        min_restarts=min_restarts)
+        if scfg.backend == "sketched":
+            # the caller ASKED for the compressed engine: the result is
+            # sketched-quality by request, tagged but not a degradation
+            req.quality = "sketched"
+            stats.quality = "sketched"
+        degradable = self._sketch_eligible(scfg)
         # admission pre-check BEFORE the O(bytes) fingerprint: under
         # overload QueueFull is the hot path, and rejecting must stay
         # cheap; the authoritative (race-free) check re-runs at enqueue
         with self._cond:
-            self._admit_locked(arr.nbytes)
+            self._admit_locked(arr.nbytes, degradable=degradable)
         # the compatibility fingerprint (one sha256 pass over the host
         # bytes) is computed HERE on the caller's thread, keeping the
         # scheduler thread's pop-to-dispatch path hash-free
         req.compat = self.engine.compatibility_key(req)
         with self._cond:
-            self._admit_locked(arr.nbytes)
+            cause = self._admit_locked(arr.nbytes, degradable=degradable)
+            if cause is not None:
+                # quality-elastic soft admission: the request admission
+                # control would have SHED is served degraded instead —
+                # solo (a degraded request must not share lanes with
+                # exact mates), tagged at dispatch
+                req.degrade_cause = cause
+                req.quality = "sketched"
+                req.compat = None
             heapq.heappush(self._queue, (req.order_key(), req))
             self._queued += 1
             self._pending_bytes += arr.nbytes
@@ -907,25 +975,49 @@ class NMFXServer:
         with self._tracked_lock:
             self._tracked.pop(seq, None)
 
-    def _admit_locked(self, nbytes: int) -> None:
+    @staticmethod
+    def _sketch_eligible(scfg: SolverConfig) -> bool:
+        """Whether quality-elastic scheduling CAN degrade a request
+        with this config to the sketched engine: the algorithm needs a
+        compressed form, a screening config already owns its own
+        sketched pass, and a request that ASKED for sketched has
+        nothing to degrade to."""
+        from nmfx.config import SKETCHED_ALGORITHMS
+
+        return (scfg.algorithm in SKETCHED_ALGORITHMS
+                and not scfg.screen and scfg.backend != "sketched")
+
+    def _admit_locked(self, nbytes: int,
+                      degradable: bool = False) -> "str | None":
         """Admission control (caller holds the lock): typed rejection
-        when the queue is over its depth or pending-byte bound."""
+        when the queue is over its depth or pending-byte bound. Under
+        ``ServeConfig.quality_elastic``, a DEPTH overrun on a
+        ``degradable`` request soft-admits instead (returns
+        "overload" — the quality-elastic degradation cause) while the
+        depth stays under 2× the bound; the pending-bytes bound stays
+        hard (it protects host memory, not latency)."""
         if self._closed:
             raise ServerClosed("server is closed")
         if self._down is not None:
             raise ServerCrashed(
                 "the scheduler crashed and ServeConfig.restart_scheduler "
                 "is False — the server is down") from self._down
+        cause = None
         if self._queued >= self.cfg.max_queue_depth:
-            self.counters["rejected"] += 1
-            raise QueueFull(
-                f"queue depth {self._queued} at the configured bound "
-                f"({self.cfg.max_queue_depth})")
+            if (self.cfg.quality_elastic and degradable
+                    and self._queued < 2 * self.cfg.max_queue_depth):
+                cause = "overload"
+            else:
+                self.counters["rejected"] += 1
+                raise QueueFull(
+                    f"queue depth {self._queued} at the configured bound "
+                    f"({self.cfg.max_queue_depth})")
         if self._pending_bytes + nbytes > self.cfg.max_pending_bytes:
             self.counters["rejected"] += 1
             raise QueueFull(
                 f"pending input bytes would exceed the "
                 f"{self.cfg.max_pending_bytes}-byte admission bound")
+        return cause
 
     # -- introspection -----------------------------------------------------
     def stats(self) -> dict:
@@ -1329,7 +1421,28 @@ class NMFXServer:
         for req in live:
             scfg = req.scfg
             budget = self._budget_iters(req)
-            if budget is not None and budget < scfg.max_iter:
+            cause = req.degrade_cause
+            if (cause is None and budget is not None
+                    and budget < scfg.max_iter
+                    and self.cfg.quality_elastic
+                    and self._sketch_eligible(scfg)):
+                # quality elasticity, cause "deadline": the deadline
+                # would clamp the exact solve's iteration budget —
+                # serve the CHEAPER engine at its full budget instead
+                # of a truncated exact solve
+                cause = "deadline"
+            if cause is not None:
+                req.degrade_cause = cause
+                req.quality = "sketched"
+                scfg = dataclasses.replace(req.scfg, backend="sketched")
+                req.stats.quality = "sketched"
+                req.stats.degraded_cause = cause
+                _quality_degraded_total.inc(cause=cause)
+                _flight.record("serve.quality_degraded",
+                               request_id=req.seq, cause=cause)
+                with self._lock:
+                    self.counters["quality_degraded"] += 1
+            elif budget is not None and budget < scfg.max_iter:
                 scfg = dataclasses.replace(scfg, max_iter=budget)
                 req.stats.budget_iters = budget
                 with self._lock:
@@ -1365,7 +1478,13 @@ class NMFXServer:
             if attempt:
                 time.sleep(self.cfg.retry_backoff_s * 2 ** (attempt - 1))
             try:
-                placed = self.engine.place(req)
+                # a quality-degraded dispatch runs the sketched engine,
+                # which the exec-cache path cannot serve — place() would
+                # key off the ORIGINAL exact config and pad+transfer a
+                # device buffer the dispatch then ignores (a wasted full
+                # H2D exactly when the server is overloaded)
+                placed = (None if scfg.backend == "sketched"
+                          else self.engine.place(req))
                 return self.engine.dispatch_solo(req, placed, scfg)
             except BaseException as e:  # retried; typed RequestFailed
                 last = e                # below when exhausted
@@ -1480,8 +1599,15 @@ class NMFXServer:
                 if req.deadline is not None and now >= req.deadline:
                     self._resolve_expired(req, mid_solve=True)
                 else:
+                    # req.quality is the ONE quality funnel: "sketched"
+                    # whenever the request was served by the compressed
+                    # engine (by its own config, or degraded there) —
+                    # the tagging invariant the lint fixture in
+                    # tests/test_serve_quality.py pins (every
+                    # ConsensusResult construction here must set it)
                     result = ConsensusResult(ks=req.ks, per_k=per_k,
-                                             col_names=req.col_names)
+                                             col_names=req.col_names,
+                                             quality=req.quality)
                     req.future.set_result(result)
                     _e2e_hist.observe(req.stats.latency_s,
                                       outcome="completed")

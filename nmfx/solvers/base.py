@@ -91,6 +91,13 @@ class StopReason(enum.IntEnum):
     #: the consensus/labels/best-restart reductions exactly like a pad
     #: lane — its recorded factors/dnorm are diagnostic only
     NUMERIC_FAULT = 5
+    #: restart screening (``SolverConfig.screen``): the lane's cheap
+    #: sketched pass ranked below the ``screen_keep`` cut, so it never
+    #: received exact iterations — masked from the consensus/labels/
+    #: best-restart reductions exactly like a pad or quarantined lane
+    #: (the ``min_restarts`` floor counts it as a non-survivor); its
+    #: recorded iteration count is the screening budget spent
+    SCREENED = 6
 
 
 class State(NamedTuple):
@@ -381,6 +388,17 @@ def solve(a: jax.Array, w0: jax.Array, h0: jax.Array,
     """
     from nmfx.solvers import SOLVERS  # local import to avoid cycle
 
+    if cfg.backend == "sketched" or cfg.screen:
+        # the compressed engine draws per-restart projections from a
+        # KEY this signature doesn't carry, and screening is a sweep-
+        # pool concept — silently running the exact rule here would be
+        # a quality mismatch against the sweep's recorded lanes
+        raise ValueError(
+            "solve() runs the exact engines; backend='sketched' needs "
+            "a per-restart key (use nmfx.solvers.sketched."
+            "solve_sketched — nmf()/restart_factors() route there "
+            "automatically) and screen=True only exists at the sweep "
+            "layer")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0 = jnp.asarray(w0, dtype)

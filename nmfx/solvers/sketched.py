@@ -1,0 +1,307 @@
+"""Random-projection compressed NMF updates — the ``backend="sketched"``
+engine (ISSUE 12; "Faster-than-fast NMF", arxiv 1812.04315).
+
+Every restarts/s win since the seed came from overhead removal; this
+engine is the first to cut the per-iteration FLOPs themselves. Both
+factors stay FULL size — only the update *computations* compress: per
+restart, two random projections
+
+    L : (r_l, m)   row sketch      R : (n, r_c)   column sketch
+
+are drawn once from the canonical per-(seed, k, restart) key chain
+(``fold_in`` of the restart key — deterministic, mesh/pad independent,
+the ``restart_factors`` reproducibility contract extended to sketches),
+and the Gram-family terms of the MU/HALS updates contract against the
+pre-sketched matrices instead of A:
+
+    H update:   WᵀA  →  (LW)ᵀ(LA)        WᵀW  →  (LW)ᵀ(LW)
+    W update:   AHᵀ  →  (AR)(HR)ᵀ        HHᵀ  →  (HR)(HR)ᵀ
+
+L·A (r_l × n) and A·R (m × r_c) are computed ONCE per restart outside
+the iteration loop; per iteration the m/n-sized contractions are the
+four sketched GEMMs — L·W (2rmk), (LW)ᵀ(LA) (2krn), H·R (2knr) and
+(AR)(HR)ᵀ (2mkr) — so the per-iteration cost drops from mu's
+4mnk + 4k²(m+n) to ~4rk(m+n) plus O(rk²)/O(k²(m+n)) small terms — a
+~n/r / ~m/r compression of the two data-sized GEMMs
+(:func:`sketched_model_flops` is the shape-derived accounting the bench
+stage records).
+
+Nesterov acceleration (``SketchConfig.momentum``) evaluates each update
+at the extrapolated point ``X̄ = max(X + beta_t (X − X_prev), 0)`` with
+the standard t-sequence ``t⁺ = (1 + √(1+4t²))/2``,
+``beta = (t − 1)/t⁺`` — the momentum half of the paper.
+
+Accuracy contract: labels come from the full H and the final residual
+is computed UNCOMPRESSED (``base.run_loop``'s epilogue — the "final
+uncompressed pass"), but the factor trajectories are approximate, so
+the contract is STATISTICAL at the consensus level: membership
+agreement / ARI vs the exact engine over seeds (``nmfx/agreement.py``),
+pinned by tests/test_sketched.py and gated by the bench
+``detail.sketched`` stage. Never bit-exact — every surface that
+promises bit-exactness (checkpoint ledgers, exec-cache serving,
+``--verify``) refuses this backend loudly.
+
+The same machinery powers restart screening (``SolverConfig.screen`` —
+:func:`screen_pass` ranks the restart pool by the doubly
+compressed objective ‖(LA)R − (LW)(HR)‖²) and quality-elastic serving
+(``ServeConfig.quality_elastic`` degrades deadline-pressured /
+overload-shed requests to this engine, result tagged
+``ConsensusResult.quality = "sketched"``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from nmfx.config import SKETCHED_ALGORITHMS, SolverConfig
+from nmfx.solvers import base
+
+#: fold_in constants deriving the sketch keys from a restart's
+#: canonical key — distinct from the (kw, kh) init split, so arming the
+#: sketched engine never perturbs the exact engines' init draws
+_FOLD_L = 0x5E7C
+_FOLD_R = 0x5E7D
+
+
+def resolve_dim(cfg: SolverConfig, m: int, n: int, k: int) -> int:
+    """The sketch dimension r actually used at shape (m, n), rank k:
+    ``SketchConfig.dim`` ("auto" → ``max(4k + 8, 40)`` — the rank-
+    proportional JL oversampling with an absolute floor; measured on
+    the 4-group 1000×200 design at k=4, r=24 left consensus ARI vs
+    exact at 0.5–0.7 while r≥40 restored 1.0 across seeds), clamped
+    into [k+1, min(m, n)] so the sketch always oversamples the rank
+    and never exceeds the data (at which point it would be a permuted
+    exact engine paying extra FLOPs)."""
+    d = cfg.sketch.dim
+    r = max(4 * k + 8, 40) if d == "auto" else int(d)
+    return max(k + 1, min(r, m, n))
+
+
+def sketched_model_flops(m: int, n: int, k: int, r: int) -> float:
+    """Shape-derived model FLOPs of ONE sketched iteration for ONE
+    restart — the bench ``detail.sketched`` stage's analytic accounting
+    (CPU containers cannot produce meaningful wall-clock compression,
+    the FLOP ratio vs ``bench._mu_model_flops`` is hardware-independent).
+    Per iteration: L·W (2rmk) + (LW)ᵀ(LA) (2krn) + (LW)ᵀ(LW) (2rk²) +
+    (WᵀW)H (2nk²) for H; H·R (2knr) + (AR)(HR)ᵀ (2mkr) + (HR)(HRᵀ)
+    (2rk²) + W(HHᵀ) (2mk²) for W. The one-time L·A / A·R sketches
+    (2·r·m·n each) amortize over the iterations and are excluded, as
+    the exact model excludes its O(mk+kn) elementwise terms."""
+    return 4.0 * r * k * (m + n) + 4.0 * r * k * k + 2.0 * k * k * (m + n)
+
+
+def sketch_operators(key: jax.Array, m: int, n: int, r: int,
+                     dtype) -> tuple[jax.Array, jax.Array]:
+    """Per-restart projections (L, R) from the restart's canonical key:
+    scaled i.i.d. Gaussians L ~ N(0, 1/r)^(r×m), R ~ N(0, 1/r)^(n×r) —
+    the classic Johnson-Lindenstrauss sketch (the paper's structured
+    variants trade constants, not asymptotics; Gaussians keep the draw
+    one fused op on every backend)."""
+    kl_, kr_ = (jax.random.fold_in(key, _FOLD_L),
+                jax.random.fold_in(key, _FOLD_R))
+    scale = jnp.asarray(1.0, dtype) / jnp.sqrt(jnp.asarray(r, dtype))
+    left = jax.random.normal(kl_, (r, m), dtype) * scale
+    right = jax.random.normal(kr_, (n, r), dtype) * scale
+    return left, right
+
+
+def _h_gram_terms(w, la, left):
+    lw = left @ w  # (r, k)
+    return lw.T @ la, lw.T @ lw  # (k, n), (k, k)
+
+
+def _w_gram_terms(h, ar, right):
+    hr = h @ right  # (k, r)
+    return ar @ hr.T, hr @ hr.T  # (m, k), (k, k)
+
+
+def _apply_mu(w, h, la, ar, left, right, cfg):
+    """One projected-gradient step per factor on the SKETCHED least-
+    squares objectives — the Nesterov-iteration form of the paper.
+
+    The exact engine's multiplicative ratio is NOT transplantable here:
+    a Gaussian sketch does not preserve non-negativity, so the sketched
+    numerator (LW)ᵀ(LA) goes transiently negative, and the mu rule's
+    exact-zero short-circuit would then kill that factor entry
+    PERMANENTLY (a zero entry never revives under a multiplicative
+    update) — measured as lanes stalling at ~10× the exact residual.
+    The additive projected step max(X − ∇/L̂, 0) recovers from a
+    negative gradient sample the next iteration. L̂ = ‖Gram‖_F + ε is a
+    cheap upper bound on the Lipschitz constant (Frobenius ≥ spectral),
+    so the step is always stable, merely conservative."""
+    wta, wtw = _h_gram_terms(w, la, left)
+    lh = jnp.sqrt(jnp.sum(wtw * wtw)) + cfg.div_eps
+    h = base.clamp(jnp.maximum(h - (wtw @ h - wta) / lh, 0.0),
+                   cfg.zero_threshold)
+    aht, hht = _w_gram_terms(h, ar, right)
+    lw_ = jnp.sqrt(jnp.sum(hht * hht)) + cfg.div_eps
+    w = base.clamp(jnp.maximum(w - (w @ hht - aht) / lw_, 0.0),
+                   cfg.zero_threshold)
+    return w, h
+
+
+def _apply_hals(w, h, la, ar, left, right, cfg):
+    """Compressed HALS: the coordinate updates of solvers/hals.py with
+    every Gram term contracted through the sketches; the per-component
+    AXPYs are identical (they never touch A)."""
+    k = w.shape[1]
+    eps = cfg.div_eps
+    wta, wtw = _h_gram_terms(w, la, left)
+    for j in range(k):
+        hj = h[j] + (wta[j] - wtw[j] @ h) / (wtw[j, j] + eps)
+        h = h.at[j].set(base.clamp(jnp.maximum(hj, 0.0),
+                                   cfg.zero_threshold))
+    aht, hht = _w_gram_terms(h, ar, right)
+    for j in range(k):
+        wj = w[:, j] + (aht[:, j] - w @ hht[:, j]) / (hht[j, j] + eps)
+        w = w.at[:, j].set(base.clamp(jnp.maximum(wj, 0.0),
+                                      cfg.zero_threshold))
+    return w, h
+
+
+_APPLY = {"mu": _apply_mu, "hals": _apply_hals}
+
+
+def init_aux(a, w0, h0, cfg: SolverConfig, key: jax.Array):
+    """Solver-specific carry: the one-time sketches L·A / A·R, the
+    projections, and the Nesterov state (previous accepted iterates +
+    the t-sequence scalar)."""
+    m, n = a.shape
+    k = w0.shape[1]
+    r = resolve_dim(cfg, m, n, k)
+    left, right = sketch_operators(key, m, n, r, a.dtype)
+    la = left @ a  # (r, n), once per restart
+    ar = a @ right  # (m, r), once per restart
+    return (la, ar, left, right, w0, h0,
+            jnp.asarray(1.0, a.dtype))
+
+
+def step(a, state: base.State, cfg: SolverConfig,
+         check: bool = True) -> base.State:
+    """One compressed iteration with optional Nesterov extrapolation.
+
+    ``state.aux = (la, ar, left, right, w_acc, h_acc, t)`` where
+    (w_acc, h_acc) are the PREVIOUS accepted iterates the momentum
+    extrapolates against (distinct from ``state.w_prev``, which
+    ``run_loop`` overwrites every iteration for TolX)."""
+    la, ar, left, right, w_acc, h_acc, t = state.aux
+    w0, h0 = state.w, state.h
+    apply_fn = _APPLY[cfg.algorithm]
+    if cfg.sketch.momentum:
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        beta = (t - 1.0) / t_next
+        wb = jnp.maximum(w0 + beta * (w0 - w_acc), 0.0)
+        hb = jnp.maximum(h0 + beta * (h0 - h_acc), 0.0)
+        w, h = apply_fn(wb, hb, la, ar, left, right, cfg)
+    else:
+        t_next = t
+        w, h = apply_fn(w0, h0, la, ar, left, right, cfg)
+    state = state._replace(w=w, h=h,
+                           aux=(la, ar, left, right, w0, h0, t_next))
+    if not check:
+        return state
+    # class-stability + TolX only: both are O(kn + mk) on the full
+    # factors; TolFun would need the uncompressed m×n residual every
+    # check — the one cost the compression exists to avoid (the final
+    # dnorm in run_loop's epilogue stays uncompressed)
+    return base.check_convergence(state, cfg, use_class=cfg.use_class_stop,
+                                  use_tolx=True)
+
+
+def solve_sketched(a: jax.Array, w0: jax.Array, h0: jax.Array,
+                   key: jax.Array,
+                   cfg: SolverConfig) -> base.SolverResult:
+    """One compressed factorization from a restart's canonical key.
+    Vmappable over (w0, h0, key) exactly like the exact driver.
+
+    The final UNCOMPRESSED pass: after the compressed loop stops,
+    ``SketchConfig.polish_iters`` exact update iterations (the full
+    mu/hals rule against A itself) run before the result is read, and
+    the final ``dnorm`` is the true uncompressed RMS residual — so the
+    labels the consensus layer consumes come from an exact-update
+    neighborhood, not a sketch-noise-rattled iterate (without this,
+    long compressed budgets measurably wander the final labels; see
+    ``SketchConfig.polish_iters``)."""
+    if cfg.algorithm not in SKETCHED_ALGORITHMS:
+        raise ValueError(
+            f"sketched engine supports {SKETCHED_ALGORITHMS}, got "
+            f"{cfg.algorithm!r}")
+    from nmfx.solvers import SOLVERS
+
+    polish = cfg.sketch.polish_iters
+    with base.matmul_precision_ctx(cfg.matmul_precision):
+        res = base.run_loop(a, w0, h0, cfg, step,
+                            init_aux(a, w0, h0, cfg, key))
+        if polish == 0:
+            return res
+        mod = SOLVERS[cfg.algorithm]
+        state = base.init_state(a, res.w, res.h,
+                                mod.init_aux(a, res.w, res.h, cfg))
+        for _ in range(polish):
+            state = state._replace(w_prev=state.w, h_prev=state.h,
+                                   iteration=state.iteration + 1)
+            state = mod.step(a, state, cfg, check=False)
+        return base.SolverResult(
+            w=state.w, h=state.h,
+            iterations=res.iterations + polish,
+            dnorm=base.residual_norm(a, state.w, state.h),
+            stop_reason=res.stop_reason)
+
+
+def compressed_objective(a: jax.Array, w: jax.Array, h: jax.Array,
+                         key: jax.Array, cfg: SolverConfig) -> jax.Array:
+    """Doubly compressed objective ‖(LA)R − (LW)(HR)‖²_F — an
+    O(r²·(k + n/m share)) proxy for the true residual, used by the
+    screening pass to RANK restarts (only the ordering matters, so no
+    normalizer). Uses the restart's own (L, R), drawn from the same
+    key chain as the solve."""
+    m, n = a.shape
+    k = w.shape[1]
+    r = resolve_dim(cfg, m, n, k)
+    left, right = sketch_operators(key, m, n, r, a.dtype)
+    lar = (left @ a) @ right  # (r, r)
+    d = lar - (left @ w) @ (h @ right)
+    return jnp.sum(d * d)
+
+
+def screen_pass(a: jax.Array, w0: jax.Array, h0: jax.Array,
+                key: jax.Array, cfg: SolverConfig) -> jax.Array:
+    """One restart's cheap screening pass: ``sketch.screen_iters``
+    compressed iterations (no convergence checks — the budget IS the
+    point), then the compressed objective. Returns a scalar score;
+    lower = more promising."""
+    iters = cfg.sketch.screen_iters
+    apply_fn = _APPLY[cfg.algorithm]
+    aux = init_aux(a, w0, h0, cfg, key)
+    la, ar, left, right = aux[0], aux[1], aux[2], aux[3]
+
+    def body(carry, _):
+        w, h, w_acc, h_acc, t = carry
+        if cfg.sketch.momentum:
+            t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+            beta = (t - 1.0) / t_next
+            wb = jnp.maximum(w + beta * (w - w_acc), 0.0)
+            hb = jnp.maximum(h + beta * (h - h_acc), 0.0)
+            w2, h2 = apply_fn(wb, hb, la, ar, left, right, cfg)
+        else:
+            t_next = t
+            w2, h2 = apply_fn(w, h, la, ar, left, right, cfg)
+        return (w2, h2, w, h, t_next), None
+
+    with base.matmul_precision_ctx(cfg.matmul_precision):
+        (w, h, _, _, _), _ = jax.lax.scan(
+            body, (w0, h0, w0, h0, jnp.asarray(1.0, a.dtype)),
+            None, length=iters)
+        lar = (left @ a) @ right
+        d = lar - (left @ w) @ (h @ right)
+        return jnp.sum(d * d)
+
+
+def sweep_lanes(a: jax.Array, w0s: jax.Array, h0s: jax.Array,
+                keys: jax.Array, cfg: SolverConfig) -> base.SolverResult:
+    """Vmapped batch of compressed solves — the sketched engine's
+    restart-batch form the sweep builder consumes."""
+    return jax.vmap(partial(solve_sketched, a, cfg=cfg))(w0s, h0s, keys)

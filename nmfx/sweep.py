@@ -96,13 +96,16 @@ class ChunkSweepOutput(NamedTuple):
 def _quarantine_lanes(labels, dnorm, stops):
     """Per-rank numeric-quarantine masking shared by every sweep
     epilogue: lanes that stopped with ``StopReason.NUMERIC_FAULT``
-    (``SolverConfig.nonfinite_guard``) get their labels masked to -1 —
-    ``one_hot`` then drops them from the consensus reduction exactly
-    like pad lanes/columns — and their (possibly non-finite) dnorm
-    masked to +inf so the best-restart argmin never selects them.
-    Fault-free ranks pass through bit-identically (all-False selects).
+    (``SolverConfig.nonfinite_guard``) — or were screened out of the
+    exact phase (``StopReason.SCREENED``, ``SolverConfig.screen``) —
+    get their labels masked to -1 — ``one_hot`` then drops them from
+    the consensus reduction exactly like pad lanes/columns — and their
+    (possibly non-finite) dnorm masked to +inf so the best-restart
+    argmin never selects them. Fault-free unscreened ranks pass through
+    bit-identically (all-False selects).
     Returns ``(labels, dnorm_for_best, faulted)``."""
-    faulted = stops == jnp.int32(StopReason.NUMERIC_FAULT)
+    faulted = ((stops == jnp.int32(StopReason.NUMERIC_FAULT))
+               | (stops == jnp.int32(StopReason.SCREENED)))
     labels = jnp.where(faulted[:, None], -1, labels)
     dnorm_best = jnp.where(faulted, jnp.array(jnp.inf, dnorm.dtype), dnorm)
     return labels, dnorm_best, faulted
@@ -147,7 +150,10 @@ def _pad_count(restarts: int, mesh: Mesh | None) -> int:
 
 
 def _use_packed(solver_cfg: SolverConfig) -> bool:
-    return (solver_cfg.algorithm == "mu"
+    # a screened config's exact phase runs the vmapped generic driver
+    # (the lane-independent engine its bit-identity contract rests on),
+    # never the packed family; backend="sketched" is not in the tuple
+    return (solver_cfg.algorithm == "mu" and not solver_cfg.screen
             and solver_cfg.backend in ("auto", "packed", "pallas"))
 
 
@@ -201,7 +207,16 @@ def resolve_engine_family(solver_cfg: SolverConfig,
     right registries. hals auto/packed resolves to the packed family on
     restart-only meshes but to the grid-sharded generic driver when
     feature/sample axes are active (the GRID_SOLVERS branch of
-    ``_build_sweep_fn``)."""
+    ``_build_sweep_fn``). backend="sketched" is its own family (the
+    compressed engine is approximate by construction — see
+    nmfx/solvers/sketched.py); a screened config resolves to "vmap",
+    the engine its exact phase actually runs (the ``screen``/
+    ``screen_keep`` fields themselves are hashed separately, so a
+    screened registry never aliases an unscreened one)."""
+    if solver_cfg.backend == "sketched":
+        return "sketched"
+    if solver_cfg.screen:
+        return "vmap"
     if solver_cfg.backend == "pallas":
         return "pallas"
     if _use_packed(solver_cfg):
@@ -229,6 +244,20 @@ def _build_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
     # silently serve a previously built clean function; None (nothing
     # armed) keys identically to the pre-fault-registry world
     grid = grid_axes_active(mesh)
+    if solver_cfg.backend == "sketched" or solver_cfg.screen:
+        if grid:
+            raise ValueError(
+                "the sketched engine and restart screening are restart-"
+                "parallel only (their per-restart projections have no "
+                "feature/sample-sharded formulation); drop the grid "
+                "mesh axes")
+        if solver_cfg.backend == "sketched":
+            return _build_sketched_sweep_fn(k, restarts, solver_cfg,
+                                            init_cfg, label_rule,
+                                            keep_factors)
+        return _build_screened_sweep_fn(k, restarts, solver_cfg,
+                                        init_cfg, label_rule,
+                                        keep_factors)
     if grid:
         grid_ok = ((_use_packed(solver_cfg)
                     and solver_cfg.backend != "pallas")
@@ -509,6 +538,142 @@ def _build_packed_sweep_fn(k: int, restarts: int, solver_cfg: SolverConfig,
         a = jnp.asarray(a, dtype)
         keys = jax.random.split(key, padded)
         return sharded(a, keys)
+
+    return jax.jit(impl)
+
+
+def _build_sketched_sweep_fn(k: int, restarts: int,
+                             solver_cfg: SolverConfig,
+                             init_cfg: InitConfig, label_rule: str,
+                             keep_factors: bool = False):
+    """Sweep builder for ``backend="sketched"`` (ISSUE 12): the
+    random-projection compressed engine (``nmfx/solvers/sketched.py``),
+    vmapped over the restart axis like the generic driver — so it rides
+    the per-k sweep path, the streamed harvest, and the serve solo
+    dispatch unchanged. Init draws the canonical per-(seed, k, restart)
+    key chain; each lane's projections fold deterministically off its
+    restart key, so a given (seed, k, restart) factorizes identically
+    on every batch composition. Restart-parallel only (no mesh
+    sharding — the sweep layer routes grid meshes away upstream);
+    quarantine/labels/best-restart epilogue identical to the vmap
+    path's."""
+    from nmfx import faults
+    from nmfx.solvers import sketched as sk
+
+    dtype = jnp.dtype(solver_cfg.dtype)
+    poison = faults.poison_restarts(k, restarts)
+
+    def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
+        a = jnp.asarray(a, dtype)
+        keys = jax.random.split(key, restarts)
+        w0s, h0s = jax.vmap(
+            lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys)
+        w0s = _poison_restart_lanes(w0s, poison)
+        res = sk.sweep_lanes(a, w0s, h0s, keys, solver_cfg)
+        labels = jax.vmap(partial(labels_from_h, rule=label_rule))(res.h)
+        labels, dnorm_best, faulted = _quarantine_lanes(
+            labels, res.dnorm, res.stop_reason)
+        cons = _quarantined_consensus(labels, k, restarts, faulted)
+        best = jnp.argmin(dnorm_best)
+        extra = (res.w, res.h) if keep_factors else (None, None)
+        return KSweepOutput(cons, res.iterations, res.dnorm,
+                            res.stop_reason, labels,
+                            res.w[best], res.h[best], *extra)
+
+    return jax.jit(impl)
+
+
+def _build_screened_sweep_fn(k: int, restarts: int,
+                             solver_cfg: SolverConfig,
+                             init_cfg: InitConfig, label_rule: str,
+                             keep_factors: bool = False):
+    """Sweep builder for restart screening (``SolverConfig.screen``):
+    a cheap sketched pass (``sketch.screen_iters`` compressed
+    iterations, ``nmfx.solvers.sketched.screen_pass``) scores the FULL
+    restart pool by compressed objective; only the ``screen_keep``
+    best-scoring lanes then receive exact iterations, through the
+    vmapped generic driver from their canonical per-restart keys.
+
+    Exactness contract (pinned by tests/test_screening.py): batched
+    dot_generals evaluate each lane independently, so a survivor
+    lane's results are BIT-IDENTICAL to a solo exact run of that lane
+    (``initialize(key_i)`` + ``solve``) — screening changes which lanes
+    are solved, never their numbers. Survivor indices are sorted
+    ascending so the exact batch composition is a deterministic
+    function of the survivor set. Screened-out lanes are masked from
+    the consensus exactly like pad lanes (labels -1,
+    ``StopReason.SCREENED``, dnorm +inf) and count as non-survivors
+    under the ``min_restarts`` floor; ``keep_factors`` is refused (a
+    screened-out lane has no exact factors to keep)."""
+    from nmfx import faults
+    from nmfx.solvers import sketched as sk
+
+    keep = solver_cfg.screen_keep
+    if keep is None or not 1 <= keep <= restarts:
+        raise ValueError(
+            f"screen_keep must be in [1, restarts={restarts}], got "
+            f"{keep!r}")
+    if keep_factors:
+        raise ValueError(
+            "keep_factors does not compose with screening: screened-out "
+            "lanes never receive exact iterations, so there is no full "
+            "factor grid to keep (use nmfx.restart_factors on survivor "
+            "lanes instead)")
+    if faults.poison_restarts(k, restarts):
+        raise ValueError(
+            "solve.nonfinite fault injection does not compose with "
+            "screening (the screening pass reorders which lanes the "
+            "exact engine sees); disarm the site for screened sweeps")
+    import dataclasses as _dc
+
+    # the exact phase runs the PLAIN exact solve — the same config a
+    # solo run of a survivor lane uses (solve() refuses screening
+    # fields by design; restart_factors strips them identically, which
+    # is what keeps the survivor bit-identity contract one-config-deep)
+    exact_cfg = _dc.replace(solver_cfg, screen=False, screen_keep=None)
+    dtype = jnp.dtype(solver_cfg.dtype)
+
+    def impl(a: jax.Array, key: jax.Array) -> KSweepOutput:
+        a = jnp.asarray(a, dtype)
+        n = a.shape[1]
+        keys = jax.random.split(key, restarts)
+        w0s, h0s = jax.vmap(
+            lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys)
+        scores = jax.vmap(
+            lambda w0, h0, kk: sk.screen_pass(a, w0, h0, kk,
+                                              solver_cfg))(w0s, h0s,
+                                                           keys)
+        # lowest compressed objective wins; jnp.argsort is stable, so
+        # ties break to the lower restart index — deterministic. The
+        # survivor set is re-sorted ascending so the exact batch's lane
+        # order is index order regardless of the scores' permutation.
+        surv = jnp.sort(jnp.argsort(scores)[:keep])
+        res = jax.vmap(lambda w0, h0: solve(a, w0, h0, exact_cfg))(
+            w0s[surv], h0s[surv])
+        labels_s = jax.vmap(partial(labels_from_h,
+                                    rule=label_rule))(res.h)
+        # scatter survivors back to full (restarts,)-shaped records;
+        # screened-out lanes read exactly like pad lanes downstream
+        labels = jnp.full((restarts, n), -1, jnp.int32).at[surv].set(
+            labels_s)
+        iters = jnp.full((restarts,),
+                         solver_cfg.sketch.screen_iters,
+                         jnp.int32).at[surv].set(res.iterations)
+        dnorms = jnp.full((restarts,), jnp.inf,
+                          res.dnorm.dtype).at[surv].set(res.dnorm)
+        stops = jnp.full((restarts,), int(StopReason.SCREENED),
+                         jnp.int32).at[surv].set(res.stop_reason)
+        labels, dnorm_best, faulted = _quarantine_lanes(labels, dnorms,
+                                                        stops)
+        cons = _quarantined_consensus(labels, k, restarts, faulted)
+        # best restart among the survivors (their own numeric faults
+        # masked); index into the survivor batch, where factors exist
+        surv_masked = jnp.where(
+            res.stop_reason == jnp.int32(StopReason.NUMERIC_FAULT),
+            jnp.array(jnp.inf, res.dnorm.dtype), res.dnorm)
+        bi = jnp.argmin(surv_masked)
+        return KSweepOutput(cons, iters, dnorms, stops, labels,
+                            res.w[bi], res.h[bi])
 
     return jax.jit(impl)
 
@@ -821,6 +986,12 @@ def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
     column layout those kernels consume) — with no feature/sample mesh
     axes (those shard single ranks; the grid layout composes with the
     restart axis only)."""
+    if solver_cfg.backend == "sketched" or solver_cfg.screen:
+        # the compressed engine and the screening two-phase dispatch
+        # have no slot-scheduled form (and the exec-cache's bit-exact
+        # serving contract excludes them by construction — cacheable()
+        # reads this predicate)
+        return False
     backends = _GRID_EXEC_BACKENDS.get(solver_cfg.algorithm, ())
     if solver_cfg.backend not in backends:
         return False

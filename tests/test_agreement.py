@@ -1,0 +1,135 @@
+"""nmfx/agreement.py — the sketched engine's consensus-level accuracy
+yardstick (ISSUE 12): ARI and pairwise co-membership agreement pinned
+against hand-computed small cases, permutation invariance, and the
+degenerate single-cluster conventions."""
+
+import numpy as np
+import pytest
+
+from nmfx.agreement import (adjusted_rand_index, consensus_agreement,
+                            cophenetic_gap, membership_agreement)
+
+
+# -- membership (pairwise) agreement: hand-computed ---------------------
+def test_pair_agreement_identical():
+    assert membership_agreement([1, 1, 2, 2], [1, 1, 2, 2]) == 1.0
+
+
+def test_pair_agreement_relabeled_is_identical():
+    # co-membership structure only — label VALUES must not matter
+    assert membership_agreement([1, 1, 2, 2], [7, 7, 3, 3]) == 1.0
+
+
+def test_pair_agreement_hand_computed():
+    # a=[1,1,2,2], b=[1,2,2,2]: pairs (6 total):
+    # (0,1): a together, b apart  -> disagree
+    # (0,2): apart, apart         -> agree
+    # (0,3): apart, apart         -> agree
+    # (1,2): apart, together      -> disagree
+    # (1,3): apart, together      -> disagree
+    # (2,3): together, together   -> agree
+    assert membership_agreement([1, 1, 2, 2],
+                                [1, 2, 2, 2]) == pytest.approx(3 / 6)
+
+
+def test_pair_agreement_single_sample_vacuous():
+    assert membership_agreement([1], [2]) == 1.0
+
+
+def test_pair_agreement_length_mismatch():
+    with pytest.raises(ValueError, match="equal length"):
+        membership_agreement([1, 2], [1, 2, 3])
+
+
+# -- adjusted Rand index: hand-computed ---------------------------------
+def test_ari_identical_partition():
+    assert adjusted_rand_index([1, 1, 2, 2], [1, 1, 2, 2]) == 1.0
+
+
+def test_ari_permutation_invariance():
+    a = [0, 0, 1, 1, 2, 2]
+    for perm in (
+            [2, 2, 0, 0, 1, 1],
+            [5, 5, 9, 9, 1, 1],
+    ):
+        assert adjusted_rand_index(a, perm) == 1.0
+        assert membership_agreement(a, perm) == 1.0
+
+
+def test_ari_hand_computed():
+    """a=[1,1,1,2,2,2], b=[1,1,2,2,2,2]: contingency [[2,1],[0,3]].
+    sum_idx = C(2,2)+C(1,2)+C(3,2) = 1+0+3 = 4; sum_a = 2*C(3,2) = 6;
+    sum_b = C(2,2)+C(4,2) = 1+6 = 7; total = C(6,2) = 15;
+    expected = 6*7/15 = 2.8; max = 6.5;
+    ARI = (4-2.8)/(6.5-2.8) = 1.2/3.7."""
+    got = adjusted_rand_index([1, 1, 1, 2, 2, 2], [1, 1, 2, 2, 2, 2])
+    assert got == pytest.approx(1.2 / 3.7)
+
+
+def test_ari_symmetry():
+    a = [1, 1, 1, 2, 2, 2]
+    b = [1, 1, 2, 2, 2, 2]
+    assert adjusted_rand_index(a, b) == pytest.approx(
+        adjusted_rand_index(b, a))
+
+
+def test_ari_opposed_partitions_nonpositive():
+    # maximally crossed 2x2 design: each cluster of a splits evenly
+    # over b's clusters — chance-level agreement, ARI ~ 0 (<= 0 here)
+    a = [1, 1, 2, 2]
+    b = [1, 2, 1, 2]
+    assert adjusted_rand_index(a, b) <= 0.0
+
+
+# -- degenerate partitions ----------------------------------------------
+def test_ari_both_single_cluster():
+    assert adjusted_rand_index([3, 3, 3], [8, 8, 8]) == 1.0
+
+
+def test_ari_both_all_singletons():
+    assert adjusted_rand_index([1, 2, 3], [5, 6, 7]) == 1.0
+
+
+def test_ari_single_cluster_vs_singletons():
+    # "no structure" in two INCOMPATIBLE senses: zero agreement
+    assert adjusted_rand_index([1, 1, 1], [1, 2, 3]) == 0.0
+
+
+def test_empty_labelings_rejected():
+    with pytest.raises(ValueError, match="non-empty"):
+        adjusted_rand_index([], [])
+
+
+# -- result-level report ------------------------------------------------
+class _FakeK:
+    def __init__(self, membership, rho):
+        self.membership = np.asarray(membership)
+        self.rho = rho
+
+
+class _FakeResult:
+    def __init__(self, per_k):
+        self.per_k = per_k
+        self.ks = tuple(per_k)
+
+
+def test_consensus_agreement_report():
+    ra = _FakeResult({2: _FakeK([1, 1, 2, 2], 0.99),
+                      3: _FakeK([1, 2, 3, 3], 0.90)})
+    rb = _FakeResult({2: _FakeK([2, 2, 1, 1], 1.00),
+                      3: _FakeK([1, 2, 3, 3], 0.80)})
+    rep = consensus_agreement(ra, rb)
+    assert rep["per_k"][2]["ari"] == 1.0
+    assert rep["per_k"][3]["ari"] == 1.0
+    assert rep["min_ari"] == 1.0
+    assert rep["max_rho_gap"] == pytest.approx(0.10)
+    assert cophenetic_gap(ra, rb) == pytest.approx(0.10)
+
+
+def test_consensus_agreement_rejects_disjoint_ranks():
+    ra = _FakeResult({2: _FakeK([1, 1], 1.0)})
+    rb = _FakeResult({3: _FakeK([1, 1], 1.0)})
+    with pytest.raises(ValueError, match="share no ranks"):
+        consensus_agreement(ra, rb)
+    with pytest.raises(ValueError, match="not present in both"):
+        consensus_agreement(ra, ra, ks=(5,))

@@ -328,3 +328,62 @@ def test_cli_observability_flags(gct_path, tmp_path, capsys):
     text = metrics_path.read_text()
     assert "# TYPE nmfx_exec_compile_total counter" in text \
         or "nmfx_data_h2d_transfers_total" in text
+
+
+def test_cli_sketched_backend(gct_path, capsys):
+    """--backend sketched runs end to end and announces the quality
+    tag in the summary (ISSUE 12)."""
+    rc = main([gct_path, "--ks", "2", "--restarts", "4",
+               "--maxiter", "150", "--no-files",
+               "--backend", "sketched", "--sketch-dim", "12"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "quality = sketched" in out
+
+
+def test_cli_screening(gct_path, capsys):
+    rc = main([gct_path, "--ks", "2", "--restarts", "6",
+               "--maxiter", "150", "--no-files",
+               "--screen", "--screen-keep", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # screening's exact phase IS exact: no quality downgrade announced
+    assert "quality = sketched" not in out
+    assert "best k = 2" in out
+
+
+def test_cli_sketched_screen_compose_guards(gct_path, capsys):
+    """The ISSUE 12 compose-guards: bit-exact surfaces and the
+    statistical engines refuse each other with clear usage errors."""
+    cases = [
+        # flag plumbing
+        (["--backend", "sketched", "--algorithm", "als"],
+         "only implemented for"),
+        (["--screen"], "requires --screen-keep"),
+        (["--screen-keep", "3"], "requires --screen"),
+        (["--screen", "--screen-keep", "9", "--restarts", "4"],
+         "--screen-keep must be in"),
+        (["--sketch-dim", "8"], "only applies to the compressed"),
+        (["--screen", "--screen-keep", "2", "--backend", "packed"],
+         "vmapped driver"),
+        # bit-exact surfaces refuse the statistical contract
+        (["--backend", "sketched", "--rank-selection", "device"],
+         "STATISTICAL"),
+        (["--backend", "sketched", "--checkpoint-dir", "/tmp/nope"],
+         "durable ledger"),
+        (["--backend", "sketched", "--serve-smoke"], "bit-identical"),
+        (["--backend", "sketched", "--exec-cache"], "exec-cacheable"),
+        (["--screen", "--screen-keep", "2", "--cache-dir", "/tmp/nope"],
+         "exec-cacheable"),
+        (["--backend", "sketched", "--grid-exec", "grid"],
+         "whole-grid"),
+        (["--backend", "sketched", "--feature-shards", "2"],
+         "restart-parallel"),
+        (["--screen", "--screen-keep", "2", "--keep-factors"],
+         "keep-factors"),
+    ]
+    for extra, needle in cases:
+        with pytest.raises(SystemExit):
+            main([gct_path, "--no-files"] + extra)
+        err = capsys.readouterr().err
+        assert needle in err, (extra, needle, err[-500:])

@@ -1,0 +1,208 @@
+"""Restart screening (``SolverConfig.screen`` — ISSUE 12): the cheap
+sketched pass ranks the restart pool, exact iterations go only to the
+top-``screen_keep`` survivors, and three contracts hold:
+
+* survivor-lane results are BIT-IDENTICAL to solo exact runs of those
+  lanes (the acceptance criterion — init from the canonical key +
+  ``solve``, compared bitwise);
+* screened-out lanes behave exactly like pad lanes (labels -1,
+  ``StopReason.SCREENED``, masked from the consensus reduction, never
+  selected as best restart);
+* the ``min_restarts`` floor counts screened lanes as non-survivors
+  (typed ``InsufficientRestarts`` below it).
+
+Smallest shapes only (<= 60x24, restarts <= 8) per the tier-1 budget.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.api import nmfconsensus
+from nmfx.config import InitConfig, SolverConfig
+from nmfx.datasets import two_group_matrix
+from nmfx.faults import InsufficientRestarts
+from nmfx.init import initialize
+from nmfx.solvers.base import StopReason, solve
+from nmfx.sweep import sweep_one_k
+
+RESTARTS = 8
+KEEP = 3
+
+
+def small_matrix():
+    return two_group_matrix(n_genes=60, n_per_group=12, seed=0)
+
+
+def screened_cfg(**kw):
+    base = dict(algorithm="mu", max_iter=200, screen=True,
+                screen_keep=KEEP)
+    base.update(kw)
+    return SolverConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def screened_out():
+    a = small_matrix()
+    key = jax.random.fold_in(jax.random.key(123), 2)
+    out = sweep_one_k(a, key, 2, RESTARTS, screened_cfg(), InitConfig())
+    return a, key, out
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="screen_keep"):
+        SolverConfig(screen=True)
+    with pytest.raises(ValueError, match="vmapped"):
+        SolverConfig(screen=True, screen_keep=2, backend="packed")
+    with pytest.raises(ValueError, match="sketched screening"):
+        SolverConfig(algorithm="als", screen=True, screen_keep=2)
+    with pytest.raises(ValueError, match="screen_keep"):
+        SolverConfig(screen_keep=0)
+    # screen_keep > restarts is a sweep-time error (config doesn't
+    # know the restart count)
+    with pytest.raises(ValueError, match=r"screen_keep must be in"):
+        sweep_one_k(small_matrix(), jax.random.key(0), 2, 4,
+                    screened_cfg(screen_keep=9), InitConfig())
+
+
+def test_exactly_keep_survivors(screened_out):
+    _, _, out = screened_out
+    stops = np.asarray(out.stop_reasons)
+    surv = stops != int(StopReason.SCREENED)
+    assert int(surv.sum()) == KEEP
+    # screened lanes record the screening budget spent, -1 labels, inf
+    # dnorm — the pad-lane shape
+    labels = np.asarray(out.labels)
+    dn = np.asarray(out.dnorms)
+    iters = np.asarray(out.iterations)
+    cfg = screened_cfg()
+    for i in np.nonzero(~surv)[0]:
+        assert np.all(labels[i] == -1)
+        assert np.isinf(dn[i])
+        assert iters[i] == cfg.sketch.screen_iters
+
+
+def test_survivors_bit_identical_to_solo_exact_runs(screened_out):
+    """THE acceptance criterion: each survivor lane's results equal a
+    SOLO exact run of that lane — same canonical key, plain
+    ``initialize`` + ``solve`` — bit for bit."""
+    a, key, out = screened_out
+    stops = np.asarray(out.stop_reasons)
+    surv = np.nonzero(stops != int(StopReason.SCREENED))[0]
+    keys = jax.random.split(key, RESTARTS)
+    exact = SolverConfig(algorithm="mu", max_iter=200)
+    aj = jnp.asarray(a, jnp.float32)
+    for i in surv:
+        w0, h0 = initialize(keys[i], aj, 2, InitConfig(), jnp.float32)
+        r = solve(a, w0, h0, exact)
+        assert np.asarray(r.dnorm).tobytes() == \
+            np.asarray(out.dnorms)[i].tobytes()
+        assert int(r.iterations) == int(np.asarray(out.iterations)[i])
+        assert int(r.stop_reason) == int(stops[i])
+        solo_labels = np.asarray(jnp.argmax(r.h, axis=0))
+        assert np.array_equal(solo_labels, np.asarray(out.labels)[i])
+        # and the best-restart factors come verbatim from a survivor
+    best = surv[np.argmin(np.asarray(out.dnorms)[surv])]
+    w0, h0 = initialize(keys[best], aj, 2, InitConfig(), jnp.float32)
+    r = solve(a, w0, h0, exact)
+    assert np.asarray(r.w).tobytes() == np.asarray(out.best_w).tobytes()
+    assert np.asarray(r.h).tobytes() == np.asarray(out.best_h).tobytes()
+
+
+def test_masked_lanes_behave_like_pad_lanes(screened_out):
+    """The consensus is the mean connectivity over SURVIVORS only —
+    exactly the quarantine/pad reduction: recompute it from the
+    survivor labels and compare."""
+    _, _, out = screened_out
+    stops = np.asarray(out.stop_reasons)
+    surv = np.nonzero(stops != int(StopReason.SCREENED))[0]
+    labels = np.asarray(out.labels)[surv]
+    conn = (labels[:, :, None] == labels[:, None, :]).astype(np.float64)
+    expected = conn.mean(axis=0)
+    np.testing.assert_allclose(np.asarray(out.consensus, np.float64),
+                               expected, atol=1e-6)
+
+
+def test_screening_deterministic(screened_out):
+    a, key, out = screened_out
+    out2 = sweep_one_k(a, key, 2, RESTARTS, screened_cfg(),
+                       InitConfig())
+    assert np.array_equal(np.asarray(out.stop_reasons),
+                          np.asarray(out2.stop_reasons))
+    assert np.array_equal(np.asarray(out.dnorms),
+                          np.asarray(out2.dnorms))
+
+
+def test_min_restarts_floor_counts_screened_as_nonsurvivors():
+    a = small_matrix()
+    # keep=2 survivors < min_restarts=4 -> typed floor error on every
+    # harvest path (the same funnel quarantined lanes hit)
+    with pytest.raises(InsufficientRestarts, match="SCREENED"):
+        nmfconsensus(a, ks=(2,), restarts=6, seed=1,
+                     solver_cfg=screened_cfg(screen_keep=2),
+                     min_restarts=4, use_mesh=False)
+    # at the floor: passes
+    res = nmfconsensus(a, ks=(2,), restarts=6, seed=1,
+                       solver_cfg=screened_cfg(screen_keep=4),
+                       min_restarts=4, use_mesh=False)
+    assert res.quality == "exact"  # screening's exact phase IS exact
+
+
+def test_keep_factors_refused():
+    a = small_matrix()
+    with pytest.raises(ValueError, match="keep_factors"):
+        sweep_one_k(a, jax.random.key(0), 2, 6, screened_cfg(),
+                    InitConfig(), keep_factors=True)
+
+
+def test_screen_keep_equal_restarts_solves_everything():
+    """keep == restarts: nothing screened out; every lane's results
+    equal the plain vmap-engine sweep bit for bit (the screening layer
+    reduces to a no-op reordering)."""
+    a = small_matrix()
+    key = jax.random.fold_in(jax.random.key(5), 2)
+    out_s = sweep_one_k(a, key, 2, 6, screened_cfg(screen_keep=6),
+                        InitConfig())
+    out_v = sweep_one_k(a, key, 2, 6,
+                        SolverConfig(algorithm="mu", max_iter=200,
+                                     backend="vmap"), InitConfig())
+    assert not np.any(np.asarray(out_s.stop_reasons)
+                      == int(StopReason.SCREENED))
+    assert np.array_equal(np.asarray(out_s.dnorms),
+                          np.asarray(out_v.dnorms))
+    assert np.array_equal(np.asarray(out_s.labels),
+                          np.asarray(out_v.labels))
+    assert np.array_equal(np.asarray(out_s.consensus),
+                          np.asarray(out_v.consensus))
+
+
+def test_restart_factors_reproduces_screened_survivor():
+    """restart_factors strips the screening fields (solve() refuses
+    them), so a survivor lane recomputes bit-identically from its
+    canonical key — the recompute-by-key contract under screening."""
+    from nmfx import restart_factors
+
+    a = small_matrix()
+    key = jax.random.fold_in(jax.random.key(123), 2)
+    out = sweep_one_k(a, key, 2, RESTARTS, screened_cfg(), InitConfig())
+    surv = np.nonzero(np.asarray(out.stop_reasons)
+                      != int(StopReason.SCREENED))[0]
+    i = int(surv[0])
+    r = restart_factors(a, 2, i, restarts=RESTARTS, seed=123,
+                        solver_cfg=screened_cfg())
+    assert np.asarray(r.dnorm).tobytes() == \
+        np.asarray(out.dnorms)[i].tobytes()
+
+
+def test_screened_sweep_through_nmfconsensus_and_grid_exec_guard():
+    a = small_matrix()
+    res = nmfconsensus(a, ks=(2, 3), restarts=6, seed=2,
+                       solver_cfg=screened_cfg(), use_mesh=False)
+    assert set(res.per_k) == {2, 3}
+    with pytest.raises(ValueError, match="grid_exec='grid'"):
+        nmfconsensus(a, ks=(2, 3), restarts=6, seed=2,
+                     solver_cfg=screened_cfg(), grid_exec="grid",
+                     use_mesh=False)

@@ -1,0 +1,192 @@
+"""Quality-elastic serving (``ServeConfig.quality_elastic`` — ISSUE 12):
+the scheduler degrades deadline-pressured and admission-shed requests to
+the sketched engine instead of expiring/rejecting them, and the result
+is ALWAYS typed and tagged — ``ConsensusResult.quality``,
+``RequestStats.quality``/``degraded_cause``, the
+``nmfx_serve_quality_degraded_total{cause=…}`` counter, and a
+``serve.quality_degraded`` flight event. The lint fixture at the bottom
+pins the structural half: no ``ConsensusResult`` construction in
+``nmfx/serve.py`` may omit the quality tag (the NMFX006-style
+machine-checked invariant the ISSUE asks for)."""
+
+import ast
+import inspect
+import time
+
+import numpy as np
+import pytest
+
+import nmfx.serve as serve_mod
+from nmfx.config import SolverConfig
+from nmfx.datasets import two_group_matrix
+from nmfx.obs import flight, metrics
+from nmfx.serve import NMFXServer, QueueFull, ServeConfig
+
+
+@pytest.fixture(scope="module")
+def matrix():
+    return two_group_matrix(n_genes=60, n_per_group=8, seed=1)
+
+
+SCFG = SolverConfig(algorithm="mu", max_iter=150)
+
+
+def _degraded_metric(cause):
+    c = metrics.registry().get("nmfx_serve_quality_degraded_total")
+    return 0.0 if c is None else c.value(cause=cause)
+
+
+# -- deadline degradation -----------------------------------------------
+def test_deadline_pressure_degrades_tagged(matrix):
+    before = _degraded_metric("deadline")
+    cfg = ServeConfig(quality_elastic=True, iter_rate_estimate=1.0)
+    with NMFXServer(cfg) as srv:
+        # remaining budget ~60 iters << max_iter: without elasticity
+        # this request would dispatch CLAMPED; with it, it dispatches
+        # sketched at the full budget
+        fut = srv.submit(matrix, ks=(2,), restarts=4, solver_cfg=SCFG,
+                         timeout=60)
+        res = fut.result(timeout=300)
+    assert res.quality == "sketched"
+    assert fut.stats.quality == "sketched"
+    assert fut.stats.degraded_cause == "deadline"
+    assert fut.stats.budget_iters is None  # degraded, not clamped
+    assert srv.stats()["quality_degraded"] == 1
+    assert _degraded_metric("deadline") == before + 1
+    events = flight.default_recorder().events("serve.quality_degraded")
+    assert any(e.get("cause") == "deadline" for e in events)
+
+
+def test_deadline_without_elastic_still_clamps(matrix):
+    cfg = ServeConfig(iter_rate_estimate=1.0)
+    with NMFXServer(cfg) as srv:
+        fut = srv.submit(matrix, ks=(2,), restarts=4, solver_cfg=SCFG,
+                         timeout=60)
+        res = fut.result(timeout=300)
+    assert res.quality == "exact"
+    assert fut.stats.degraded_cause is None
+    assert fut.stats.budget_iters is not None  # the pre-existing clamp
+
+
+def test_ineligible_algorithm_not_degraded(matrix):
+    # als has no sketched form: the deadline clamp applies as before
+    cfg = ServeConfig(quality_elastic=True, iter_rate_estimate=1.0)
+    with NMFXServer(cfg) as srv:
+        fut = srv.submit(matrix, ks=(2,), restarts=3,
+                         solver_cfg=SolverConfig(algorithm="als",
+                                                 max_iter=150),
+                         timeout=60)
+        res = fut.result(timeout=300)
+    assert res.quality == "exact"
+    assert fut.stats.degraded_cause is None
+
+
+# -- overload degradation -----------------------------------------------
+def test_overload_soft_admission_degrades_tagged(matrix):
+    before = _degraded_metric("overload")
+    cfg = ServeConfig(quality_elastic=True, max_queue_depth=1)
+    with NMFXServer(cfg, start=False) as srv:  # paused: deterministic
+        f1 = srv.submit(matrix, ks=(2,), restarts=4, solver_cfg=SCFG)
+        # over the depth bound: soft-admitted degraded, not rejected
+        f2 = srv.submit(matrix, ks=(2,), restarts=4, solver_cfg=SCFG)
+        # the 2x hard bound still sheds
+        with pytest.raises(QueueFull):
+            srv.submit(matrix, ks=(2,), restarts=4, solver_cfg=SCFG)
+        srv.resume()
+        r1 = f1.result(timeout=300)
+        r2 = f2.result(timeout=300)
+    assert r1.quality == "exact"
+    assert r2.quality == "sketched"
+    assert f2.stats.degraded_cause == "overload"
+    assert f2.stats.quality == "sketched"
+    assert srv.stats()["quality_degraded"] == 1
+    assert _degraded_metric("overload") == before + 1
+
+
+def test_overload_without_elastic_rejects(matrix):
+    cfg = ServeConfig(max_queue_depth=1)
+    with NMFXServer(cfg, start=False) as srv:
+        f1 = srv.submit(matrix, ks=(2,), restarts=3, solver_cfg=SCFG)
+        with pytest.raises(QueueFull):
+            srv.submit(matrix, ks=(2,), restarts=3, solver_cfg=SCFG)
+        srv.resume()
+        f1.result(timeout=300)
+
+
+def test_pending_bytes_bound_stays_hard(matrix):
+    cfg = ServeConfig(quality_elastic=True, max_queue_depth=8,
+                      max_pending_bytes=matrix.nbytes + 1)
+    with NMFXServer(cfg, start=False) as srv:
+        f1 = srv.submit(matrix, ks=(2,), restarts=3, solver_cfg=SCFG)
+        with pytest.raises(QueueFull, match="bytes"):
+            srv.submit(matrix, ks=(2,), restarts=3, solver_cfg=SCFG)
+        srv.resume()
+        f1.result(timeout=300)
+
+
+def test_degraded_request_never_packs(matrix):
+    """A degraded request must dispatch SOLO: its lanes run a different
+    engine than exact dispatch-mates would."""
+    cfg = ServeConfig(quality_elastic=True, max_queue_depth=1)
+    with NMFXServer(cfg, start=False) as srv:
+        f1 = srv.submit(matrix, ks=(2,), restarts=4, solver_cfg=SCFG)
+        f2 = srv.submit(matrix, ks=(2,), restarts=4, solver_cfg=SCFG)
+        srv.resume()
+        r1, r2 = f1.result(timeout=300), f2.result(timeout=300)
+    assert f2.stats.packed_requests == 1  # solo by construction
+    assert r2.quality == "sketched"
+    assert r1.quality == "exact"
+
+
+# -- native sketched requests -------------------------------------------
+def test_native_sketched_request_tagged_not_degraded(matrix):
+    with NMFXServer(ServeConfig()) as srv:
+        fut = srv.submit(matrix, ks=(2,), restarts=4,
+                         solver_cfg=SolverConfig(algorithm="mu",
+                                                 max_iter=150,
+                                                 backend="sketched"))
+        res = fut.result(timeout=300)
+    assert res.quality == "sketched"
+    assert fut.stats.quality == "sketched"
+    assert fut.stats.degraded_cause is None
+    assert srv.stats()["quality_degraded"] == 0
+
+
+# -- config/key coverage ------------------------------------------------
+def test_quality_elastic_in_serve_key_fields():
+    from nmfx.serve import serve_key_fields
+
+    assert "quality_elastic" in serve_key_fields()
+
+
+# -- the lint fixture (NMFX006-style machine check) ---------------------
+def test_every_serve_consensusresult_sets_quality():
+    """Structural gate: EVERY ``ConsensusResult(...)`` construction in
+    nmfx/serve.py must pass an explicit ``quality=`` keyword — the
+    "no path may return an untagged sketched result to a caller who
+    requested exact" invariant, checked against the source so a new
+    construction site cannot ship untagged."""
+    src = inspect.getsource(serve_mod)
+    tree = ast.parse(src)
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name == "ConsensusResult":
+                sites.append(node)
+    assert sites, "expected at least one ConsensusResult site in serve"
+    for node in sites:
+        kwargs = {kw.arg for kw in node.keywords}
+        assert "quality" in kwargs, (
+            f"nmfx/serve.py line {node.lineno}: ConsensusResult "
+            "constructed without quality= — a sketched-served request "
+            "could reach its caller untagged")
+
+
+def test_degradation_requires_opt_in(matrix):
+    """quality_elastic defaults OFF: no degradation machinery fires on
+    a default server (the flag is load-bearing for the contract)."""
+    assert ServeConfig().quality_elastic is False
