@@ -49,42 +49,17 @@ import os
 import sys
 import time
 
-#: per-chip dense bf16 matmul peak (FLOP/s) by jax device_kind — the MFU
-#: denominator. bf16 is both the bench default and what "default" matmul
-#: precision runs on TPU, so MFU is reported against the bf16 peak even for
-#: --precision highest (which burns multiple MXU passes per matmul: its
-#: lower MFU is real, not an accounting artifact).
-_BF16_PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v4": 275e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-}
-
-
-def _mu_model_flops(m: int, n: int, k: int) -> float:
-    """Model FLOPs of ONE mu iteration for ONE restart: the six-GEMM update
-    (reference nmf_mu.c:174-216) — H: WᵀA (2mnk) + WᵀW (2mk²) + (WᵀW)H
-    (2nk²); W: AHᵀ (2mnk) + HHᵀ (2nk²) + W(HHᵀ) (2mk²). Total
-    4mnk + 4k²(m+n); elementwise terms (O(mk + kn)) are omitted —
-    sub-percent at bench shapes."""
-    return 4.0 * m * n * k + 4.0 * k * k * (m + n)
-
-
-def _kl_model_flops(m: int, n: int, k: int) -> float:
-    """One kl (Brunet) iteration per restart (solvers/kl.py): two quotient
-    reconstructions W@H (2·2mnk), the two quotient contractions WᵀQ and QHᵀ
-    (2·2mnk), and the two elementwise quotient passes (one add + one divide
-    over m×n each: 4mn); the remaining elementwise work is O(kn + mk) —
-    8mnk + 4mn to leading order."""
-    return 8.0 * m * n * k + 4.0 * m * n
-
-
-#: hals' per-iteration FLOPs match mu's to leading order: the same two big
-#: GEMMs + two Grams, with the coordinate passes summing to the same
-#: 2k²(m+n) as mu's Gram-product terms (solvers/hals.py)
-_MODEL_FLOPS = {"mu": _mu_model_flops, "kl": _kl_model_flops,
-                "hals": _mu_model_flops}
+# Model FLOPs and device peaks now live in nmfx.obs.costmodel (ISSUE 13):
+# one registry-keyed table covering EVERY engine family and algorithm
+# (mfu below is no longer None for als/neals/snmf), cross-validated
+# against compiled.cost_analysis() by tests/test_costmodel.py, with the
+# per-device-kind bf16 peak + HBM bandwidth table (the MFU denominator;
+# bf16 is both the bench default and what "default" matmul precision
+# runs on TPU, so MFU is reported against the bf16 peak even for
+# --precision highest, whose lower MFU is real, not an accounting
+# artifact). pg/alspg stay unmodeled by declaration
+# (costmodel.COSTMODEL_EXEMPT: data-dependent line-search/subproblem
+# inner work).
 
 
 def _integrity_problems(scfg, its, stops) -> list[str]:
@@ -685,6 +660,15 @@ def main():
                    help=argparse.SUPPRESS)
     p.add_argument("--durability-chunk", type=int, default=None,
                    help=argparse.SUPPRESS)
+    p.add_argument("--regress", action="store_true",
+                   help="after recording, judge this run's metrics "
+                        "against the best prior BENCH_r*.json round "
+                        "with the noise-aware trajectory rules "
+                        "(nmfx.obs.regress — min-of-reps values, "
+                        "per-metric relative thresholds) and exit 2 "
+                        "on any regression: the self-judging gate for "
+                        "hardware rounds (docs/observability.md "
+                        "'Regression observatory')")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache directory: a "
                         "SECOND bench session re-loads this session's "
@@ -1291,36 +1275,54 @@ def main():
             shutil.rmtree(ref_dir, ignore_errors=True)
             shutil.rmtree(kill_dir, ignore_errors=True)
 
-    # --- observability stage (ISSUE 10, detail.obs) --------------------
+    # --- observability stage (ISSUE 10/13, detail.obs) -----------------
     # The telemetry layer's own cost, tracked across BENCH rounds so it
     # can never silently grow: warm-path reps with the structured
-    # tracer enabled vs disabled (the metrics registry is ALWAYS on —
-    # it IS the module counters every gate above reads — so the
-    # toggleable cost is span recording), gated at < 3% of the warm
-    # e2e wall (exit 2). Also records the per-sweep trace event count
+    # tracer AND per-dispatch roofline attribution enabled vs both
+    # disabled (the metrics registry is ALWAYS on — it IS the module
+    # counters every gate above reads — so the toggleable cost is span
+    # recording plus the costmodel arithmetic/histograms each dispatch
+    # books), gated at < 3% of the warm e2e wall (exit 2). Also records
+    # the per-sweep trace event count, the attributed-dispatch count,
     # and the flight-recorder postmortem size, so a span-explosion or
     # event-flood regression shows up as a number, not a vibe.
     def run_obs_stage():
-        from nmfx.obs import flight, metrics, trace
+        from nmfx.obs import costmodel, flight, metrics, trace
 
         scfg_o = cfgs[args.backend]
         tracer = trace.default_tracer()
         walls = {False: [], True: []}
         trace_events = 0
+        attributed = 0
         obs_reps = 3
-        for _ in range(obs_reps):
-            # interleaved off/on so session drift penalizes neither arm
-            for enabled in (False, True):
-                if enabled:
-                    tracer.clear()
-                    trace.enable()
-                try:
-                    _, e2e_wall_o, _, _, _ = timed_sweep(scfg_o, seed)
-                finally:
+        try:
+            for _ in range(obs_reps):
+                # interleaved off/on so session drift penalizes
+                # neither arm
+                for enabled in (False, True):
                     if enabled:
-                        trace_events = tracer.event_count()
-                        trace.disable()
-                walls[enabled].append(e2e_wall_o)
+                        tracer.clear()
+                        trace.enable()
+                        costmodel.reset_perf()
+                        costmodel.enable_attribution()
+                    else:
+                        costmodel.disable_attribution()
+                    try:
+                        _, e2e_wall_o, _, _, _ = timed_sweep(scfg_o,
+                                                             seed)
+                    finally:
+                        if enabled:
+                            trace_events = tracer.event_count()
+                            attributed = sum(
+                                rec["dispatches"] for rec in
+                                costmodel.perf_summary()
+                                ["kinds"].values())
+                            trace.disable()
+                    walls[enabled].append(e2e_wall_o)
+        finally:
+            # attribution is ON by default — the off arm's disable
+            # must never leak past this stage
+            costmodel.enable_attribution()
         off = min(walls[False])
         on = min(walls[True])
         overhead_frac = (on - off) / off
@@ -1337,10 +1339,17 @@ def main():
         budget = max(0.03 * off, 0.05)
         if on - off >= budget:
             print("bench OBS OVERHEAD FAILURE: warm e2e wall "
-                  f"{off:.3f}s untraced vs {on:.3f}s traced "
+                  f"{off:.3f}s untraced vs {on:.3f}s traced+attributed "
                   f"({overhead_frac:.1%} overhead, gate < 3%) — span "
-                  "recording has crept into a hot path (per-iteration "
-                  "instead of per-phase?)", file=sys.stderr)
+                  "recording or dispatch attribution has crept into a "
+                  "hot path (per-iteration instead of per-phase/"
+                  "per-dispatch?)", file=sys.stderr)
+            raise SystemExit(2)
+        if attributed < 1:
+            print("bench OBS FAILURE: the attributed arm recorded no "
+                  "perf-attributed dispatches — the per-dispatch "
+                  "attribution wiring is dead (sweep/exec_cache "
+                  "_attribute_dispatch)", file=sys.stderr)
             raise SystemExit(2)
         return {
             "wall_untraced_s": round(off, 3),
@@ -1349,6 +1358,7 @@ def main():
             "overhead_gate": "ok",
             "reps": obs_reps,
             "trace_events_per_sweep": trace_events,
+            "perf_attributed_dispatches": attributed,
             "flight_dump_bytes": dump_bytes,
             "metric_series": series_count,
         }
@@ -1364,8 +1374,8 @@ def main():
         container's GEMM throughput bears no relation to the MXU's the
         engine targets), so FLOPs-per-restart are recorded
         ANALYTICALLY — model FLOPs/iteration are exact shape-derived
-        functions for both engines (``bench._mu_model_flops`` /
-        ``nmfx.solvers.sketched.sketched_model_flops``), multiplied by
+        functions for both engines (``nmfx.obs.costmodel``'s mu and
+        sketched-family entries), multiplied by
         the iteration counts each arm actually ran — which makes
         ``flops_compression_per_restart`` meaningful on every host.
         The restarts/s walls ride along as hardware-host measurements;
@@ -1382,7 +1392,7 @@ def main():
         from nmfx.agreement import consensus_agreement
         from nmfx.api import nmfconsensus
         from nmfx.config import SKETCHED_ALGORITHMS
-        from nmfx.solvers.sketched import resolve_dim, sketched_model_flops
+        from nmfx.solvers.sketched import resolve_dim
 
         scfg_e = cfgs[args.backend]
         if scfg_e.algorithm != "mu":
@@ -1472,17 +1482,19 @@ def main():
         total = len(seeds_sk) * len(ks_sk) * restarts_sk
 
         def flops_per_restart(scfg_a, res_by_seed, sketch):
+            from nmfx.obs import costmodel
+
             tot = 0.0
             for s, res_s in res_by_seed.items():
                 for k in ks_sk:
                     iters_k = float(
                         np.asarray(res_s.per_k[k].iterations).sum())
-                    per_iter = (sketched_model_flops(
-                        args.genes, args.samples, k,
-                        resolve_dim(scfg_a, args.genes, args.samples,
-                                    k)) if sketch
-                        else _mu_model_flops(args.genes, args.samples,
-                                             k))
+                    # the shared costmodel table (ISSUE 13): the
+                    # "sketched" family entry routes through
+                    # sketched_model_flops/resolve_dim itself
+                    per_iter = costmodel.iteration_flops(
+                        "mu", "sketched" if sketch else "vmap",
+                        args.genes, args.samples, k, scfg_a)
                     tot += per_iter * iters_k
             return tot / total
 
@@ -1884,23 +1896,32 @@ def main():
     its = {k: host[k][1] for k in ks}
     iters = {k: float(v.mean()) for k, v in its.items()}
 
-    # MFU accounting for the algorithms in _MODEL_FLOPS (the pg/alspg
-    # families' per-iteration FLOPs differ per line-search trial /
-    # subproblem and are not modeled):
-    # model FLOPs = Σ_k Σ_restart iters · flops_per_iter(k), achieved rate
-    # over the measured wall, utilization vs the devices' bf16 peak.
-    # Computed per backend from its fastest rep.
-    peak = _BF16_PEAK_FLOPS.get(jax.devices()[0].device_kind)
-    flops_fn = _MODEL_FLOPS.get(args.algorithm)
+    # MFU accounting through the costmodel registry (every modeled
+    # engine family × algorithm — als/neals/snmf included since
+    # ISSUE 13; only the COSTMODEL_EXEMPT pg/alspg report None):
+    # model FLOPs = Σ_k Σ_restart iters · flops_per_iter(k), achieved
+    # rate over the measured wall, utilization vs the devices' bf16
+    # peak. Computed per backend from its fastest rep, under the engine
+    # FAMILY that backend actually resolves to.
+    from nmfx.obs import costmodel
+    from nmfx.sweep import resolve_engine_family
+
+    peak_rec = costmodel.device_peak()
+    peak = None if peak_rec is None else peak_rec["flops"]
 
     def mfu_block(b):
         wall_b, _, prof_b, host_b = best[b]
-        if flops_fn is None:
+        family = resolve_engine_family(cfgs[b], mesh)
+        flops_per_iter = {
+            k: costmodel.iteration_flops(args.algorithm, family,
+                                         args.genes, args.samples, k,
+                                         cfgs[b]) for k in ks}
+        if any(v is None for v in flops_per_iter.values()):
             return {"model_tflop": None, "achieved_tflop_per_s": None,
                     "mfu": None, "mfu_solve": None}
         its_b = {k: host_b[k][1] for k in ks}
-        model_flops = sum(flops_fn(args.genes, args.samples, k)
-                          * float(its_b[k].sum()) for k in ks)
+        model_flops = sum(flops_per_iter[k] * float(its_b[k].sum())
+                          for k in ks)
         achieved = model_flops / wall_b
         mfu = mfu_solve = None
         solve_s = sum(rec.seconds for name, rec in prof_b.phases.items()
@@ -2025,6 +2046,30 @@ def main():
         },
     }
     print(json.dumps(record))
+
+    if args.regress:
+        # self-judging round: compare what was just measured against
+        # the best prior round per metric (the record is already
+        # printed above, so the artifact survives the gate either way)
+        from nmfx.obs import regress as obs_regress
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        rounds = obs_regress.load_rounds(here)
+        verdict = obs_regress.compare(
+            rounds, {"file": "<this run>",
+                     "metrics": obs_regress.extract_metrics(record)})
+        print(f"bench: regression verdict: {json.dumps(verdict)}",
+              file=sys.stderr)
+        if verdict["status"] == "regression":
+            for row in verdict["regressions"]:
+                print(
+                    "bench REGRESSION: "
+                    f"{row['metric']} = {row['value']:g} is "
+                    f"{row['worse_by']:.1%} worse than the best prior "
+                    f"round ({row['best']:g} in {row['best_round']}; "
+                    f"threshold {row['threshold']:.0%})",
+                    file=sys.stderr)
+            raise SystemExit(2)
 
 
 if __name__ == "__main__":

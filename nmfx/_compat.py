@@ -32,7 +32,8 @@ import jax
 from jax import lax
 
 __all__ = ["shard_map", "pcast", "force_cpu_devices",
-           "serialize_compiled", "deserialize_compiled"]
+           "serialize_compiled", "deserialize_compiled",
+           "compiled_cost_analysis"]
 
 
 # The sweep's key-chain contracts — restart r's key is independent of mesh
@@ -117,6 +118,25 @@ def deserialize_compiled(blob: bytes):
 
     payload, in_tree, out_tree = pickle.loads(blob)
     return deserialize_and_load(payload, in_tree, out_tree)
+
+
+def compiled_cost_analysis(compiled) -> "dict | None":
+    """``jax.stages.Compiled.cost_analysis()`` normalized across the
+    releases this repo spans: 0.4.x returns a one-element LIST of
+    per-device-program dicts, newer jax returns the dict itself, and
+    backends without a cost model return None/empty or raise. Returns
+    one flat ``{"flops": ..., "bytes accessed": ..., ...}`` dict, or
+    None when no analysis is available — callers (the
+    ``nmfx.obs.costmodel`` cross-check) degrade to analytic-only."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # nmfx: ignore[NMFX006] -- capability probe: None = unavailable
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    return dict(ca)
 
 
 def force_cpu_devices(n: int) -> None:

@@ -50,6 +50,7 @@ from nmfx.analysis import rules_traced  # noqa: F401  (NMFX002/004/005)
 from nmfx.analysis import rules_alias   # noqa: F401  (NMFX003)
 from nmfx.analysis import rules_handlers  # noqa: F401  (NMFX006)
 from nmfx.analysis import rules_obs     # noqa: F401  (NMFX008)
+from nmfx.analysis import rules_perf    # noqa: F401  (NMFX009)
 from nmfx.analysis import jaxpr_rules   # noqa: F401  (NMFX101/102)
 
 __all__ = ["run", "RULES", "Finding", "Rule", "register", "active",

@@ -1,0 +1,98 @@
+"""NMFX009 — engine-family cost-model coverage.
+
+The failure class: an engine whose dispatches the performance
+observatory silently cannot see. ISSUE 13 promoted the bench's three
+coarse per-algorithm FLOP formulas (mu/kl/hals — als/neals/snmf
+reported ``mfu: None`` for five rounds and nothing flagged it) into the
+registry-keyed table ``nmfx.obs.costmodel._FLOPS``/``_BYTES``, and
+every dispatch-attribution surface (bench MFU, the ``nmfx_perf_*``
+histograms, ``Profiler.report()``'s roofline verdicts) reads it. A new
+algorithm, or a new engine-family routing for an existing one, that
+lands without a model entry would ship with exactly the old blind spot
+— dispatches run, ``mfu: None``, no roofline verdict, and no error
+anywhere; a model entry for a REMOVED engine is a stale declaration
+that can mask a rename (the successor engine ships unmodeled while the
+table still "covers" the old name).
+
+The rule cross-references the two AUTHORITATIVE declarations — the
+reachable engine universe derived from the live routing tables
+(``costmodel.engine_universe()``: the solver registry ×
+``PACKED_ALGORITHMS``/``SKETCHED_ALGORITHMS``/the slot-scheduler
+backend table) and the literal model-table coverage
+(``costmodel.covered_engines()``) — plus the ``COSTMODEL_EXEMPT``
+honesty conditions (an exempt algorithm must not also be modeled; an
+exemption must name a registered algorithm). Same hook-vs-universe
+shape as NMFX001 (config-fingerprint coverage), NMFX007
+(checkpoint-manifest coverage), and NMFX008 (fault-event coverage); the
+check itself is the pure function ``costmodel.
+check_costmodel_coverage`` so the per-rule tests inject mutated
+universes, and this wrapper reads the live modules and anchors findings
+at the ``_FLOPS`` declaration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from nmfx.analysis.core import Finding, Rule, register
+
+
+def _flops_decl_line(tree: ast.Module) -> int:
+    """Line of the module-level ``_FLOPS = {...}`` assignment, best
+    effort (findings anchor there — the table a new engine's entry
+    belongs in)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "_FLOPS":
+                    return node.lineno
+    return 1
+
+
+def _live_universe() -> dict:
+    from nmfx.obs import costmodel
+    from nmfx.solvers import SOLVERS
+
+    return dict(universe=costmodel.engine_universe(),
+                covered=costmodel.covered_engines(),
+                exempt=tuple(costmodel.COSTMODEL_EXEMPT),
+                algorithms=frozenset(SOLVERS))
+
+
+@register
+class CostModelCoverage(Rule):
+    """NMFX009: every reachable (algorithm, engine-family) pair must
+    have a FLOPs+bytes cost model in nmfx.obs.costmodel (or an honest
+    COSTMODEL_EXEMPT rationale), and no model/exemption entry may go
+    stale."""
+
+    rule_id = "NMFX009"
+    title = "engine-family cost-model coverage"
+
+    def check(self, project) -> "Iterable[Finding]":
+        # semantic whole-package rule (the NMFX001/NMFX007/NMFX008
+        # gating): runs only when the real package is the analyzed
+        # set, and only against the checkout the import machinery
+        # resolves
+        import inspect
+        import os
+
+        analyzed = next(
+            (m for m in project.modules
+             if m.path.replace("\\", "/").endswith(
+                 "nmfx/obs/costmodel.py")),
+            None)
+        if analyzed is None:
+            return []
+        from nmfx.obs import costmodel
+        from nmfx.obs.costmodel import check_costmodel_coverage
+
+        live_file = inspect.getsourcefile(costmodel) or analyzed.path
+        if os.path.abspath(live_file) != os.path.abspath(analyzed.path):
+            # NMFX001 already reports the wrong-tree condition loudly;
+            # don't double-report it per rule
+            return []
+        line = _flops_decl_line(analyzed.tree)
+        return [self.finding(analyzed.path, line, msg)
+                for msg in check_costmodel_coverage(**_live_universe())]

@@ -341,6 +341,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "Prometheus text exposition after the run; the "
                         "serving engine exposes the same payload live "
                         "via NMFXServer.metrics_text()")
+    p.add_argument("--perf-report", action="store_true",
+                   help="print the per-dispatch roofline attribution "
+                        "report after the run (nmfx.obs.costmodel): "
+                        "model FLOPs and bytes moved per solve "
+                        "dispatch, achieved FLOP/s, MFU vs the device "
+                        "peak, arithmetic intensity, and the "
+                        "compute-bound vs bandwidth-bound verdict "
+                        "(docs/observability.md 'Performance "
+                        "attribution'). Runs the sweep with phase "
+                        "timing enabled (the --profile discipline) so "
+                        "the attributed walls are honest")
     p.add_argument("--flight-dir", default=None, metavar="DIR",
                    help="arm the crash flight recorder's disk dump: on "
                         "a serve scheduler crash or SIGTERM the last "
@@ -500,8 +511,10 @@ def _run_cli(argv: list[str] | None = None) -> int:
     from nmfx.config import SolverConfig
     from nmfx.profiling import NullProfiler, Profiler
 
-    profiler = (Profiler(trace_dir=args.trace_dir) if args.profile
-                else NullProfiler())
+    # --perf-report needs the profiled (phase-synced) run: attribution
+    # only annotates dispatches whose walls a real Profiler measured
+    profiler = (Profiler(trace_dir=args.trace_dir)
+                if args.profile or args.perf_report else NullProfiler())
     if args.flight_dir:
         from nmfx.obs import flight
 
@@ -725,6 +738,13 @@ def _run_cli(argv: list[str] | None = None) -> int:
     print(result.summary())
     if args.profile:
         print(profiler.report())
+    if args.perf_report:
+        from nmfx.obs import costmodel as obs_costmodel
+
+        # --profile already embeds the same table in its report; avoid
+        # printing it twice
+        if not args.profile:
+            print(obs_costmodel.perf_report())
     if args.trace_out:
         tracer = obs_trace.default_tracer()
         obs_trace.disable()  # also restored on error paths by main()

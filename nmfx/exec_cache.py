@@ -101,18 +101,25 @@ from nmfx.config import (ConsensusConfig, ExecCacheConfig, InitConfig,
                          SolverConfig)
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
-from nmfx.sweep import (KSweepOutput, _noop_rank, _pad_count,
-                        _build_bucketed_sweep_fn, bucketed_lane_init_fn,
-                        grid_axes_active, grid_exec_ok)
+from nmfx.sweep import (KSweepOutput, _attribute_dispatch, _noop_rank,
+                        _pad_count, _build_bucketed_sweep_fn,
+                        bucketed_lane_init_fn, grid_axes_active,
+                        grid_exec_ok)
 
 __all__ = ["ExecCache", "PlacedMatrix", "WarmTask", "start_host_fetch",
            "bucket_dim", "solver_key_fields", "persist_key_fields",
            "compile_count"]
 
-#: on-disk record format version; bumped on any layout change so old
-#: entries fail the format check (one warning, clean recompile) instead
-#: of deserializing garbage
-_DISK_FORMAT = 1
+#: on-disk record format version; bumped on any layout OR compiled-
+#: numerics change so old entries fail the format check (one warning,
+#: clean recompile) instead of deserializing garbage. v2: ISSUE 13 —
+#: the bucketed builder's pool geometry became composition-independent
+#: (padded to the full slot width, tail cascade pinned off; the
+#: packed==solo bit-identity fix in sweep._pad_pool_lanes), so a v1
+#: executable deserialized next to freshly-compiled v2 ones would
+#: re-introduce exactly the cross-geometry drift the fix removes —
+#: and make warm- and cold-cache processes disagree bitwise.
+_DISK_FORMAT = 2
 #: suffix of persisted executable entries (the eviction scan and the
 #: tests key on it; atomic-write temp files use a different suffix so a
 #: crashed writer's leftovers are never mistaken for entries)
@@ -976,13 +983,20 @@ class ExecCache:
         entry, _ = self.executable(placed.true_shape, ccfg, scfg, icfg,
                                    mesh, prof)
         solve_args = self._solve_args(placed, ccfg, scfg, icfg, mesh, prof)
+        t0 = time.perf_counter()
         with prof.phase("solve.grid") as sync:
             raw = sync(entry.compiled(*solve_args))
+        solve_wall = time.perf_counter() - t0
         out = {k: _unpad(v, m_true, n_true) for k, v in raw.items()}
         with prof.phase("xfer.overlap"):
             start_host_fetch(out)
         for k in out:
             on_rank(k, out[k])
+        # per-dispatch roofline attribution (profiled runs only — the
+        # wall is the compile-free executable call, so exec.* kinds are
+        # the cleanest MFU surface; see sweep._attribute_dispatch)
+        _attribute_dispatch("exec.grid", scfg, placed.true_shape, out,
+                            solve_wall, mesh, prof)
         return out
 
     def _run_sweep_ranks(self, placed: PlacedMatrix, ccfg: ConsensusConfig,
@@ -1038,8 +1052,10 @@ class ExecCache:
                                            icfg, mesh, prof)
             solve_args = self._solve_args(placed, ck, scfg, icfg, mesh,
                                           prof)
+            t0 = time.perf_counter()
             with prof.phase(f"solve.k={k}") as sync:
                 raw = sync(entry.compiled(*solve_args))
+            solve_wall = time.perf_counter() - t0
             out[k] = _unpad(raw[k], m_true, n_true)
             with prof.phase("xfer.overlap"):
                 start_host_fetch(out[k])
@@ -1047,6 +1063,8 @@ class ExecCache:
             # still compiling/solving — the moment the ISSUE-5 warm
             # path converges on: harvest overlaps the device pipeline
             on_rank(k, out[k])
+            _attribute_dispatch("exec.k", scfg, placed.true_shape,
+                                {k: out[k]}, solve_wall, mesh, prof)
         return {k: out[k] for k in ccfg.ks}
 
 

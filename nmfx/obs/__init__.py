@@ -23,17 +23,29 @@ handlers and test harness hooks):
   checkpoint commits, watchdog actions) dumped as a redacted JSON
   postmortem on scheduler crash, test hang, or SIGTERM.
 
+The performance observatory (ISSUE 13) rides the same substrate:
+
+* :mod:`nmfx.obs.costmodel` — analytic per-engine FLOPs/bytes cost
+  models (NMFX009-enforced coverage, cross-checked against
+  ``compiled.cost_analysis()``), a per-device-kind peak table, and
+  per-dispatch roofline attribution exporting the ``nmfx_perf_*``
+  histograms with a compute- vs bandwidth-bound verdict per dispatch.
+* :mod:`nmfx.obs.regress` — the ``nmfx-perf`` bench-trajectory judge:
+  loads every ``BENCH_r*.json``, normalizes schema drift, compares
+  the newest round against the best prior one under noise-aware
+  per-metric thresholds, and renders the trend report.
+
 See docs/observability.md for the API tour, the metric naming scheme,
 and the dump format.
 """
 
 from __future__ import annotations
 
-from nmfx.obs import flight, metrics, trace
+from nmfx.obs import costmodel, flight, metrics, regress, trace
 from nmfx.obs.flight import FlightRecorder
 from nmfx.obs.metrics import MetricsRegistry, registry
 from nmfx.obs.trace import Tracer, default_tracer, traced
 
-__all__ = ["FlightRecorder", "MetricsRegistry", "Tracer",
-           "default_tracer", "flight", "metrics", "registry", "trace",
-           "traced"]
+__all__ = ["FlightRecorder", "MetricsRegistry", "Tracer", "costmodel",
+           "default_tracer", "flight", "metrics", "regress",
+           "registry", "trace", "traced"]

@@ -1,0 +1,814 @@
+"""Analytic cost models + per-dispatch roofline attribution (ISSUE 13).
+
+The ROADMAP's kernel item ("locality-tuned Pallas kernels toward >= 18%
+solve-MFU") is blocked on measurement, not code: nothing in the tree
+could say whether an update is compute- or bandwidth-bound. PL-NMF
+(arxiv 1904.07935) frames NMF update performance as a locality/roofline
+question — attribution (FLOPs, bytes moved, arithmetic intensity per
+dispatch) must be first-class before kernel work can be steered — and
+MPI-FAUN (arxiv 1609.09154) reasons from per-phase flop/word counts the
+same way. This module is that instrument:
+
+* **Cost models** — analytic per-iteration-per-lane FLOPs *and*
+  bytes-moved models for every registered (algorithm, engine-family)
+  pair, promoted out of ``bench.py``'s three coarse per-algorithm
+  formulas into ONE literal registry-keyed table (``_FLOPS``/``_BYTES``)
+  that the lint rule NMFX009 cross-references against the live engine
+  routing tables (``engine_universe``), so a new algorithm or family
+  can never ship without a model. Models cover the UPDATE math only;
+  convergence-check costs (cadence-amortized, O(model/check_every))
+  are deliberately excluded, like the original ``bench._mu_model_flops``
+  excluded elementwise terms — and the exclusion is what the XLA
+  cross-check below is calibrated against.
+* **XLA cross-check** — :func:`xla_iteration_cost` compiles unrolled
+  update steps per engine and differences ``compiled.cost_analysis()``
+  (via ``nmfx._compat.compiled_cost_analysis``) between two unroll
+  depths, so fixed setup cost cancels and the per-iteration analytic
+  model is validated against what XLA actually emits
+  (tests/test_costmodel.py pins per-engine tolerances).
+* **Per-dispatch attribution** — sweep/exec-cache/serve dispatches call
+  :func:`attribute_dispatch` with their measured solve wall and the
+  per-lane iteration counts; achieved FLOP/s, model-FLOP utilization
+  (MFU) against a per-device-kind peak table, and arithmetic intensity
+  export as the ``nmfx_perf_*`` histograms, and a roofline verdict
+  ("compute-bound at 0.16 MFU" vs "bandwidth-bound at 0.71 of peak
+  BW") surfaces in ``Profiler.report()``,
+  ``NMFXServer.stats_snapshot()``, and the CLI ``--perf-report``.
+
+Import discipline: like the rest of ``nmfx.obs`` this module is
+importable without jax — everything touching jax or the solver registry
+imports lazily inside functions.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from nmfx.obs import metrics as _metrics
+
+__all__ = [
+    "COSTMODEL_EXEMPT", "DEVICE_PEAKS", "attribute_dispatch",
+    "attribution_enabled", "check_costmodel_coverage", "covered_engines",
+    "device_peak", "disable_attribution", "dispatch_cost",
+    "enable_attribution", "engine_universe", "iteration_bytes",
+    "iteration_flops", "perf_report", "perf_summary",
+    "recent_attributions", "reset_perf", "set_device_peak",
+    "xla_iteration_cost",
+]
+
+#: algorithms deliberately WITHOUT a cost model, with the rationale the
+#: NMFX009 rule preserves: pg/alspg spend data-dependent inner work per
+#: outer iteration (projected-gradient line-search trials, alspg
+#: subproblem iterations capped by ``sub_max_iter``), so no
+#: shape-derived per-iteration FLOP count exists — any constant would
+#: be wrong by an unbounded, data-dependent factor. The lint rule
+#: checks this tuple both ways: an exempt algorithm must not silently
+#: gain a model entry (the exemption would rot), and every exemption
+#: must name a registered algorithm.
+COSTMODEL_EXEMPT = ("pg", "alspg")
+
+#: defaults mirrored from SolverConfig for cfg=None callers — read from
+#: the cfg whenever one is provided, so these literals only matter for
+#: model queries made without a config in hand
+_DEFAULT_CHECK_EVERY = 2
+_DEFAULT_PALLAS_CHECK_BLOCK = 4
+
+
+# --------------------------------------------------------------------------
+# analytic models: FLOPs per iteration per lane
+# --------------------------------------------------------------------------
+
+def _mu_flops(m, n, k, cfg=None):
+    """The six-GEMM mu update (reference nmf_mu.c:174-216) — H: WᵀA
+    (2mnk) + WᵀW (2mk²) + (WᵀW)H (2nk²); W: AHᵀ (2mnk) + HHᵀ (2nk²) +
+    W(HHᵀ) (2mk²). Elementwise terms (O(mk + kn)) omitted —
+    sub-percent at bench shapes."""
+    return 4.0 * m * n * k + 4.0 * k * k * (m + n)
+
+
+def _hals_flops(m, n, k, cfg=None):
+    """hals matches mu to leading order: the same two big data GEMMs +
+    two Grams, with the per-component coordinate passes summing to the
+    same 2k²(m+n) as mu's Gram-product terms (solvers/hals.py)."""
+    return 4.0 * m * n * k + 4.0 * k * k * (m + n)
+
+
+def _kl_flops(m, n, k, cfg=None):
+    """One kl (Brunet) iteration (solvers/kl.py): two quotient
+    reconstructions W@H (2·2mnk), two quotient contractions WᵀQ / QHᵀ
+    (2·2mnk), and the two elementwise quotient passes (one add + one
+    divide over m×n each: 4mn) — 8mnk + 4mn to leading order."""
+    return 8.0 * m * n * k + 4.0 * m * n
+
+
+def _neals_flops(m, n, k, cfg=None):
+    """Normal-equation ALS (solvers/neals.py): per half-step one Gram
+    (2mk² / 2nk²), one data GEMM WᵀA / HAᵀ (2mnk each), and the
+    jittered-Cholesky k×k solve (k³/3 factor + 2k² per rhs column →
+    2nk² / 2mk²) — 4mnk + 4k²(m+n) + (2/3)k³."""
+    return (4.0 * m * n * k + 4.0 * k * k * (m + n)
+            + (2.0 / 3.0) * k ** 3)
+
+
+def _snmf_flops(m, n, k, cfg=None):
+    """snmf = neals with the β-coupling/ridge additions on the k×k Grams
+    (solvers/snmf.py) — O(k²), invisible at model precision."""
+    return _neals_flops(m, n, k, cfg)
+
+
+def _als_flops(m, n, k, cfg=None):
+    """QR-free ALS (solvers/als.py): each half-step is an SVD-based
+    min-norm lstsq. The data-sized work is the pseudo-inverse
+    application x = V·S⁻¹·(Uᵀ·A): 2mnk + 2nk² per half-step (and the
+    transposed twin), plus the (m, k)/(n, k) SVD itself — O(k²(m+n))
+    with a LAPACK constant taken as 8 (Golub–Van Loan R-SVD flop count
+    ~ 6mk² + 20k³ ≈ 8mk² at bench k ≪ m). NOTE: the SVD lowers to a
+    LAPACK custom call whose FLOPs XLA's cost analysis does NOT count,
+    so the cross-check gates this model against the GEMM share only
+    (tests/test_costmodel.py documents the one-sided band)."""
+    return 4.0 * m * n * k + 10.0 * k * k * (m + n)
+
+
+def _sketched_flops(m, n, k, cfg=None):
+    """Compressed mu/hals iteration — delegates to the engine's own
+    shape-derived accounting (``nmfx.solvers.sketched.
+    sketched_model_flops``: 4rk(m+n) + 4rk² + 2k²(m+n)), the single
+    source the bench ``detail.sketched`` stage already records. The
+    once-per-restart L·A / A·R sketches and the trailing
+    ``polish_iters`` exact iterations amortize over the compressed loop
+    and are excluded, as the exact models exclude their own
+    fixed/elementwise terms."""
+    from nmfx.solvers.sketched import resolve_dim, sketched_model_flops
+
+    cfg = _resolve_cfg(cfg)
+    r = resolve_dim(cfg, int(m), int(n), int(k))
+    return sketched_model_flops(m, n, k, r)
+
+
+# --------------------------------------------------------------------------
+# analytic models: bytes moved per iteration per lane
+# --------------------------------------------------------------------------
+#
+# Byte models count the HBM traffic of the major arrays under the
+# steady-state fusion XLA actually achieves: the m×n data operand per
+# read/materialization, and a small constant number of factor-sized
+# (mk + kn) passes per update (reads for GEMM operands and the
+# elementwise epilogue, one write each). k×k Grams and O(k) scalars are
+# noise at model precision. The point of the model is ARITHMETIC
+# INTENSITY (flops/bytes) for the roofline verdict — a few-10s-percent
+# constant error moves a dispatch along the roofline, it does not move
+# it across the ridge at real shapes (AI ≈ k/2 for mu at f32: orders of
+# magnitude from the ridge on every TPU in the peak table).
+
+def _a_itemsize(cfg, family, algorithm) -> float:
+    """Bytes per element of the A operand as the iteration loop reads
+    it: the packed/pallas engines stream A pre-truncated to bf16 under
+    matmul_precision='bfloat16' (``sched_mu._streams_bf16_a`` — kl
+    excluded: its quotient is elementwise, not MXU-rounded), everything
+    else reads the solve dtype."""
+    s = _itemsize(cfg)
+    if (family in ("packed", "pallas") and algorithm != "kl"
+            and cfg is not None
+            and getattr(cfg, "matmul_precision", "default") == "bfloat16"):
+        return 2.0
+    return s
+
+
+def _itemsize(cfg) -> float:
+    dt = getattr(cfg, "dtype", "float32") if cfg is not None else "float32"
+    return 2.0 if "16" in str(dt) else 4.0
+
+
+def _dense_bytes(m, n, k, cfg, family, algorithm, a_reads=2.0,
+                 factor_passes=8.0, mn_passes=0.0):
+    """Shared dense-update byte model: ``a_reads`` passes over the m×n
+    operand, ``mn_passes`` extra m×n materializations (kl's quotients),
+    ``factor_passes`` factor-sized (mk + kn) passes."""
+    s = _itemsize(cfg)
+    sa = _a_itemsize(cfg, family, algorithm)
+    return (a_reads * m * n * sa + mn_passes * m * n * s
+            + factor_passes * (m * k + k * n) * s)
+
+
+def _mu_bytes(m, n, k, cfg=None, family="vmap"):
+    # WᵀA + AHᵀ read A once each; W/H each: GEMM-operand reads (~2),
+    # prev read + update write in the fused elementwise epilogue (~2)
+    return _dense_bytes(m, n, k, cfg, family, "mu")
+
+
+def _hals_bytes(m, n, k, cfg=None, family="vmap"):
+    # the k unrolled coordinate passes each re-touch the updating
+    # factor (the einsum over the full H/W per component plus the
+    # row/column rewrite), so factor traffic scales with k — measured
+    # against cost_analysis at small shapes: ~8 + 5k factor passes
+    return _dense_bytes(m, n, k, cfg, family, "hals",
+                        factor_passes=8.0 + 5.0 * k)
+
+
+def _kl_bytes(m, n, k, cfg=None, family="vmap"):
+    # per half-step over m×n: reconstruction write + read, quotient
+    # write + read, and the A read (5 passes; ×2 halves = 10 m×n
+    # passes — within 4% of cost_analysis at the checked shapes)
+    return _dense_bytes(m, n, k, cfg, family, "kl", a_reads=2.0,
+                        mn_passes=8.0, factor_passes=6.0)
+
+
+def _neals_bytes(m, n, k, cfg=None, family="vmap"):
+    return _dense_bytes(m, n, k, cfg, family, "neals", factor_passes=8.0)
+
+
+def _snmf_bytes(m, n, k, cfg=None, family="vmap"):
+    return _dense_bytes(m, n, k, cfg, family, "snmf", factor_passes=8.0)
+
+
+def _als_bytes(m, n, k, cfg=None, family="vmap"):
+    # lstsq touches A twice (Uᵀ·A and the transposed half-step) plus
+    # SVD workspace passes over the (m, k)/(n, k) factors
+    return _dense_bytes(m, n, k, cfg, family, "als", factor_passes=10.0)
+
+
+def _pallas_mu_bytes(m, n, k, cfg=None, family="pallas"):
+    """The fused block kernels stream A per iteration but keep the
+    factors VMEM-resident for a whole launch: the W/H HBM round-trip
+    amortizes over the ``check_every × check_block`` in-launch
+    iterations (the PR-2 check-cadence contract) — the locality story
+    PL-NMF's blocking is about, and the reason the pallas engine's
+    arithmetic intensity reads higher than the XLA engines' at the same
+    shape."""
+    cfg_ce = (getattr(cfg, "check_every", _DEFAULT_CHECK_EVERY)
+              if cfg is not None else _DEFAULT_CHECK_EVERY)
+    cb = (getattr(cfg, "check_block", "auto")
+          if cfg is not None else "auto")
+    if cb == "auto":
+        cb = _DEFAULT_PALLAS_CHECK_BLOCK
+    launch_iters = max(cfg_ce * int(cb), 1)
+    s = _itemsize(cfg)
+    sa = _a_itemsize(cfg, "pallas", "mu")
+    return (2.0 * m * n * sa
+            + 2.0 * (m * k + k * n) * s / launch_iters)
+
+
+def _sketched_bytes(m, n, k, cfg=None, family="sketched"):
+    """Per compressed iteration: the r-sized sketches L·A (r×n), A·R
+    (m×r) and the projections L (r×m), R (n×r) are read once each —
+    there is NO m×n traffic, which is the engine's entire point — plus
+    the factor passes (the Nesterov extrapolation reads both the
+    current and the previous accepted iterates, so ~10 factor-sized
+    passes measured against cost_analysis)."""
+    from nmfx.solvers.sketched import resolve_dim
+
+    cfg = _resolve_cfg(cfg)
+    r = resolve_dim(cfg, int(m), int(n), int(k))
+    s = _itemsize(cfg)
+    return (2.0 * r * (m + n) * s + 10.0 * (m * k + k * n) * s)
+
+
+def _resolve_cfg(cfg):
+    if cfg is not None:
+        return cfg
+    from nmfx.config import SolverConfig
+
+    return SolverConfig()
+
+
+#: THE coverage declaration NMFX009 cross-references: one literal entry
+#: per registered (algorithm, engine-family) pair. Deliberately spelled
+#: out rather than generated from the routing tables — a generated
+#: table would vacuously "cover" any new engine, which is exactly the
+#: silent drift the rule exists to catch.
+_FLOPS = {
+    ("mu", "vmap"): _mu_flops,
+    ("mu", "packed"): _mu_flops,
+    ("mu", "pallas"): _mu_flops,
+    ("mu", "sketched"): _sketched_flops,
+    ("hals", "vmap"): _hals_flops,
+    ("hals", "packed"): _hals_flops,
+    ("hals", "sketched"): _sketched_flops,
+    ("kl", "vmap"): _kl_flops,
+    ("kl", "packed"): _kl_flops,
+    ("als", "vmap"): _als_flops,
+    ("als", "packed"): _als_flops,
+    ("neals", "vmap"): _neals_flops,
+    ("neals", "packed"): _neals_flops,
+    ("snmf", "vmap"): _snmf_flops,
+    ("snmf", "packed"): _snmf_flops,
+}
+
+_BYTES = {
+    ("mu", "vmap"): _mu_bytes,
+    ("mu", "packed"): _mu_bytes,
+    ("mu", "pallas"): _pallas_mu_bytes,
+    ("mu", "sketched"): _sketched_bytes,
+    ("hals", "vmap"): _hals_bytes,
+    ("hals", "packed"): _hals_bytes,
+    ("hals", "sketched"): _sketched_bytes,
+    ("kl", "vmap"): _kl_bytes,
+    ("kl", "packed"): _kl_bytes,
+    ("als", "vmap"): _als_bytes,
+    ("als", "packed"): _als_bytes,
+    ("neals", "vmap"): _neals_bytes,
+    ("neals", "packed"): _neals_bytes,
+    ("snmf", "vmap"): _snmf_bytes,
+    ("snmf", "packed"): _snmf_bytes,
+}
+
+assert set(_FLOPS) == set(_BYTES), \
+    "every modeled engine needs BOTH a FLOPs and a bytes model"
+
+
+def covered_engines() -> "frozenset[tuple[str, str]]":
+    """The (algorithm, family) pairs the model table covers — the
+    introspection hook NMFX009 reads (the FAULT_EVENTS/
+    fault_event_categories pattern of NMFX008)."""
+    return frozenset(_FLOPS)
+
+
+def engine_universe() -> "frozenset[tuple[str, str]]":
+    """Every (algorithm, engine-family) pair a SolverConfig can actually
+    execute, derived from the AUTHORITATIVE routing declarations — the
+    solver registry (``nmfx.solvers.SOLVERS``), the packed/sketched
+    algorithm tuples (``nmfx.config``), and the slot-scheduler backend
+    table (``sweep._GRID_EXEC_BACKENDS``, whose 'pallas' entries mark
+    the kernel-capable algorithms) — minus :data:`COSTMODEL_EXEMPT`.
+    A new algorithm or a new family routing expands this set while the
+    literal model table stays behind, which is the NMFX009 finding."""
+    from nmfx.config import PACKED_ALGORITHMS, SKETCHED_ALGORITHMS
+    from nmfx.solvers import SOLVERS
+    from nmfx.sweep import _GRID_EXEC_BACKENDS
+
+    pairs = set()
+    for algo in SOLVERS:
+        if algo in COSTMODEL_EXEMPT:
+            continue
+        pairs.add((algo, "vmap"))
+        if algo in PACKED_ALGORITHMS:
+            pairs.add((algo, "packed"))
+        if "pallas" in _GRID_EXEC_BACKENDS.get(algo, ()):
+            pairs.add((algo, "pallas"))
+        if algo in SKETCHED_ALGORITHMS:
+            pairs.add((algo, "sketched"))
+    return frozenset(pairs)
+
+
+def check_costmodel_coverage(
+    universe: "frozenset[tuple[str, str]]",
+    covered: "frozenset[tuple[str, str]]",
+    exempt: "tuple[str, ...]",
+    algorithms: "frozenset[str]",
+) -> "list[str]":
+    """The pure NMFX009 contract check (tests inject mutated universes;
+    the Rule wrapper passes the live declarations): registry engine
+    families and costmodel coverage must match exactly, and the
+    exemption list must stay honest."""
+    problems: "list[str]" = []
+    for algo, family in sorted(universe - covered):
+        problems.append(
+            f"engine ({algo!r}, {family!r}) is reachable from the "
+            "routing tables but has no cost model in "
+            "nmfx.obs.costmodel — its dispatches would report no "
+            "FLOPs/bytes (mfu: None, no roofline verdict); add "
+            "_FLOPS/_BYTES entries (or a COSTMODEL_EXEMPT rationale)")
+    for algo, family in sorted(covered - universe):
+        problems.append(
+            f"nmfx.obs.costmodel models ({algo!r}, {family!r}), which "
+            "no routing table can reach — stale entry; a renamed or "
+            "removed engine would keep 'covered' status while its "
+            "replacement ships unmodeled")
+    for algo in sorted(set(exempt) & {a for a, _ in covered}):
+        problems.append(
+            f"algorithm {algo!r} is declared COSTMODEL_EXEMPT but has "
+            "model entries — the exemption rationale no longer holds "
+            "or the entries are wrong; keep exactly one of the two")
+    for algo in sorted(set(exempt) - set(algorithms)):
+        problems.append(
+            f"COSTMODEL_EXEMPT names {algo!r}, which is not a "
+            "registered solver algorithm — stale exemption")
+    return problems
+
+
+def iteration_flops(algorithm: str, family: str, m: int, n: int, k: int,
+                    cfg=None) -> "float | None":
+    """Model FLOPs of ONE iteration of ONE lane, or None for engines
+    outside the model table (the exempt algorithms)."""
+    fn = _FLOPS.get((algorithm, family))
+    return None if fn is None else float(fn(m, n, k, cfg))
+
+
+def iteration_bytes(algorithm: str, family: str, m: int, n: int, k: int,
+                    cfg=None) -> "float | None":
+    """Model HBM bytes moved by ONE iteration of ONE lane (see the byte
+    model notes above), or None for unmodeled engines."""
+    fn = _BYTES.get((algorithm, family))
+    if fn is None:
+        return None
+    return float(fn(m, n, k, cfg, family))
+
+
+def dispatch_cost(scfg, m: int, n: int, iters_by_k: dict,
+                  mesh=None) -> "dict | None":
+    """Total model FLOPs/bytes of one dispatch: Σ_k Σ_lane iterations ×
+    per-iteration model, under the engine family ``scfg`` actually
+    resolves to (``sweep.resolve_engine_family``). ``iters_by_k`` maps
+    rank -> per-lane iteration counts (host ints/arrays). Returns
+    ``{"flops", "bytes", "family", "arithmetic_intensity"}`` or None
+    for unmodeled engines."""
+    from nmfx.sweep import resolve_engine_family
+
+    family = resolve_engine_family(scfg, mesh)
+    flops = bytes_ = 0.0
+    for k, iters in iters_by_k.items():
+        fi = iteration_flops(scfg.algorithm, family, m, n, k, scfg)
+        bi = iteration_bytes(scfg.algorithm, family, m, n, k, scfg)
+        if fi is None or bi is None:
+            return None
+        total_iters = float(sum(int(i) for i in iters))
+        flops += fi * total_iters
+        bytes_ += bi * total_iters
+    return {"flops": flops, "bytes": bytes_, "family": family,
+            "arithmetic_intensity": (flops / bytes_ if bytes_ > 0
+                                     else None)}
+
+
+# --------------------------------------------------------------------------
+# device peak table
+# --------------------------------------------------------------------------
+
+#: per-chip peaks by jax ``device_kind``: dense bf16 matmul FLOP/s (the
+#: MFU denominator — bf16 is the bench default and what "default"
+#: matmul precision runs on TPU; --precision highest burns multiple MXU
+#: passes per matmul, so its lower MFU is real, not an accounting
+#: artifact) and HBM bandwidth in bytes/s (the roofline's other axis).
+#: Extend/override at runtime with :func:`set_device_peak` — e.g. for a
+#: CPU container or a device kind newer than this table.
+DEVICE_PEAKS = {
+    "TPU v5 lite": {"flops": 197e12, "hbm_bytes_per_s": 819e9},  # v5e
+    "TPU v4": {"flops": 275e12, "hbm_bytes_per_s": 1228e9},
+    "TPU v5p": {"flops": 459e12, "hbm_bytes_per_s": 2765e9},
+    "TPU v6 lite": {"flops": 918e12,  # v6e / Trillium
+                    "hbm_bytes_per_s": 1640e9},
+}
+
+_peaks_lock = threading.Lock()
+
+
+def set_device_peak(kind: str, flops: float,
+                    hbm_bytes_per_s: float) -> None:
+    """Override/extend the peak table for a device kind (the
+    ``device-peak override`` knob in docs/observability.md)."""
+    if flops <= 0 or hbm_bytes_per_s <= 0:
+        raise ValueError("peaks must be positive")
+    with _peaks_lock:
+        DEVICE_PEAKS[kind] = {"flops": float(flops),
+                              "hbm_bytes_per_s": float(hbm_bytes_per_s)}
+
+
+def device_peak(kind: "str | None" = None) -> "dict | None":
+    """Peak record for ``kind`` (default: the current jax default
+    device's kind), or None when the kind is not in the table."""
+    if kind is None:
+        try:
+            import jax
+
+            kind = str(getattr(jax.devices()[0], "device_kind", "?"))
+        except Exception:  # nmfx: ignore[NMFX006] -- returns None: no device, no peak
+            return None
+    with _peaks_lock:
+        rec = DEVICE_PEAKS.get(kind)
+    return None if rec is None else {**rec, "kind": kind}
+
+
+# --------------------------------------------------------------------------
+# per-dispatch attribution
+# --------------------------------------------------------------------------
+
+#: attribution histograms — the per-dispatch export surface
+#: (docs/observability.md "Performance attribution"). Bucket choices:
+#: MFU lives in [0, 1] (the 0.18 kernel target sits mid-scale);
+#: achieved FLOP/s spans CPU containers (~1e9) through pod slices
+#: (~1e15); arithmetic intensity spans bandwidth-bound small-k (~1)
+#: through compressed-engine compute-dense (~1e3).
+_mfu_hist = _metrics.histogram(
+    "nmfx_perf_mfu",
+    "model-FLOP utilization per dispatch vs the device-kind peak",
+    labelnames=("kind",),
+    buckets=(0.01, 0.02, 0.05, 0.08, 0.12, 0.16, 0.2, 0.25, 0.35, 0.5,
+             0.75, 1.0))
+_flops_hist = _metrics.histogram(
+    "nmfx_perf_achieved_flops",
+    "achieved model FLOP/s per dispatch (model FLOPs / solve wall)",
+    labelnames=("kind",),
+    buckets=(1e9, 1e10, 1e11, 5e11, 1e12, 5e12, 1e13, 5e13, 1e14,
+             5e14, 1e15))
+_ai_hist = _metrics.histogram(
+    "nmfx_perf_arithmetic_intensity",
+    "model arithmetic intensity (FLOPs / HBM bytes) per dispatch",
+    labelnames=("kind",),
+    buckets=(0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+             512.0, 1024.0))
+
+_attrib_enabled = True
+_agg_lock = threading.Lock()
+#: per-dispatch-kind aggregates behind perf_report()/perf_summary()
+_agg: "dict[str, dict]" = {}
+#: bounded ring of recent attribution records (postmortem/report tail)
+_recent: "deque[dict]" = deque(maxlen=256)
+
+
+def enable_attribution() -> None:
+    """Turn per-dispatch attribution on (the default). The cost while
+    enabled is host-side model arithmetic on iteration counts that are
+    already on host (or already being fetched) at every call site —
+    the bench ``detail.obs`` stage gates it, together with span
+    recording, under the < 3% warm-wall budget."""
+    global _attrib_enabled
+    _attrib_enabled = True
+
+
+def disable_attribution() -> None:
+    global _attrib_enabled
+    _attrib_enabled = False
+
+
+def attribution_enabled() -> bool:
+    return _attrib_enabled
+
+
+def reset_perf() -> None:
+    """Drop the report aggregates (tests / bench arms). The registry
+    histograms are monotonic and stay — windowed reads go through
+    ``MetricsRegistry.delta``."""
+    with _agg_lock:
+        _agg.clear()
+        _recent.clear()
+
+
+def attribute_dispatch(kind: str, scfg, m: int, n: int,
+                       iters_by_k: dict, solve_s: float,
+                       mesh=None, devices: int = 1) -> "dict | None":
+    """Attribute ONE dispatch: model FLOPs/bytes from the per-lane
+    iteration counts, achieved FLOP/s over the measured ``solve_s``,
+    MFU and bandwidth fraction against the device peak, and the
+    roofline verdict. Records the ``nmfx_perf_*`` histograms (labeled
+    by dispatch ``kind``) and feeds the report aggregates; returns the
+    record (None when disabled, unmodeled, or unmeasurable).
+
+    Call sites pass a wall that covers the device solve they measured
+    (the profiled ``solve.*`` phase; the serve path passes the
+    device-blocked fetch wall) and iteration counts that are already
+    host-resident — attribution itself never forces a device sync."""
+    if not _attrib_enabled or solve_s is None or solve_s <= 0.0:
+        return None
+    cost = dispatch_cost(scfg, m, n, iters_by_k, mesh)
+    if cost is None:
+        return None
+    achieved = cost["flops"] / solve_s
+    ai = cost["arithmetic_intensity"]
+    peak = device_peak()
+    mfu = bw_frac = ridge = None
+    if peak is not None:
+        mfu = achieved / (peak["flops"] * max(devices, 1))
+        bw_frac = (cost["bytes"] / solve_s
+                   / (peak["hbm_bytes_per_s"] * max(devices, 1)))
+        ridge = peak["flops"] / peak["hbm_bytes_per_s"]
+    rec = {
+        "kind": kind,
+        "algorithm": scfg.algorithm,
+        "family": cost["family"],
+        "shape": [int(m), int(n)],
+        "model_flops": cost["flops"],
+        "model_bytes": cost["bytes"],
+        "solve_s": float(solve_s),
+        "achieved_flops_per_s": achieved,
+        "arithmetic_intensity": ai,
+        "mfu": mfu,
+        "hbm_bw_fraction": bw_frac,
+        "verdict": _verdict(ai, ridge, mfu, bw_frac),
+        "device_peak": peak,
+    }
+    _flops_hist.observe(achieved, kind=kind)
+    if ai is not None:
+        _ai_hist.observe(ai, kind=kind)
+    if mfu is not None:
+        _mfu_hist.observe(mfu, kind=kind)
+    with _agg_lock:
+        agg = _agg.setdefault(kind, {
+            "dispatches": 0, "flops": 0.0, "bytes": 0.0, "seconds": 0.0,
+            "device_seconds": 0.0,
+            "algorithm": scfg.algorithm, "family": cost["family"]})
+        agg["dispatches"] += 1
+        agg["flops"] += cost["flops"]
+        agg["bytes"] += cost["bytes"]
+        agg["seconds"] += float(solve_s)
+        # device-seconds weight the aggregate MFU/BW fractions: a
+        # dispatch over N devices had N x peak available for its wall
+        agg["device_seconds"] += float(solve_s) * max(devices, 1)
+        _recent.append(rec)
+    return rec
+
+
+def _verdict(ai, ridge, mfu, bw_frac) -> str:
+    """The roofline verdict string: which wall the dispatch sits under,
+    and how far up it reaches."""
+    if ai is None:
+        return "no byte model"
+    if ridge is None:
+        return (f"unknown device peak (AI {ai:.1f} FLOP/B; "
+                "set_device_peak() to get a verdict)")
+    if ai >= ridge:
+        return (f"compute-bound (AI {ai:.1f} >= ridge {ridge:.1f} "
+                f"FLOP/B) at {mfu:.2f} MFU")
+    return (f"bandwidth-bound (AI {ai:.1f} < ridge {ridge:.1f} "
+            f"FLOP/B) at {bw_frac:.2f} of peak HBM BW")
+
+
+def recent_attributions(limit: "int | None" = None) -> "list[dict]":
+    """The most recent per-dispatch attribution records (bounded ring
+    of 256, oldest first) — the per-dispatch drill-down behind
+    :func:`perf_summary`'s aggregates: each record carries the shape,
+    engine family, model FLOPs/bytes, measured wall, MFU/AI and the
+    roofline verdict of ONE dispatch, so a low aggregate MFU can be
+    attributed to the specific dispatches (e.g. the cold compile-wall
+    outliers) that dragged it down."""
+    with _agg_lock:
+        recs = list(_recent)
+    return recs if limit is None else recs[-limit:]
+
+
+def perf_summary() -> dict:
+    """Aggregated attribution per dispatch kind — the structured form
+    behind ``NMFXServer.stats_snapshot()['perf']`` and the CLI
+    ``--perf-report``."""
+    peak = device_peak()
+    ridge = (peak["flops"] / peak["hbm_bytes_per_s"]
+             if peak is not None else None)
+    out = {"device_peak": peak, "ridge_flops_per_byte": ridge,
+           "kinds": {}}
+    with _agg_lock:
+        items = [(kind, dict(agg)) for kind, agg in _agg.items()]
+    for kind, agg in items:
+        secs = agg["seconds"]
+        dev_secs = agg["device_seconds"]
+        achieved = agg["flops"] / secs if secs > 0 else None
+        ai = agg["flops"] / agg["bytes"] if agg["bytes"] > 0 else None
+        # utilization fractions divide by DEVICE-seconds (each
+        # dispatch's wall weighted by its device count) — the same
+        # peak*devices denominator the per-dispatch records use
+        mfu = (agg["flops"] / (peak["flops"] * dev_secs)
+               if dev_secs > 0 and peak is not None else None)
+        bw = (agg["bytes"] / dev_secs / peak["hbm_bytes_per_s"]
+              if dev_secs > 0 and peak is not None else None)
+        out["kinds"][kind] = {
+            **agg,
+            "achieved_flops_per_s": achieved,
+            "arithmetic_intensity": ai,
+            "mfu": mfu,
+            "hbm_bw_fraction": bw,
+            "verdict": _verdict(ai, ridge, mfu, bw),
+        }
+    return out
+
+
+def perf_report() -> str:
+    """Human-readable roofline table over every attributed dispatch
+    kind — appended to ``Profiler.report()`` and printed by the CLI
+    ``--perf-report``."""
+    summary = perf_summary()
+    if not summary["kinds"]:
+        return ("perf attribution: no attributed dispatches "
+                "(attribution disabled, or no modeled engine ran)")
+    peak = summary["device_peak"]
+    lines = []
+    if peak is not None:
+        lines.append(
+            f"perf attribution — device {peak['kind']!r}: peak "
+            f"{peak['flops'] / 1e12:.4g} TFLOP/s, "
+            f"{peak['hbm_bytes_per_s'] / 1e9:.4g} GB/s HBM, ridge "
+            f"{summary['ridge_flops_per_byte']:.4g} FLOP/B")
+    else:
+        lines.append(
+            "perf attribution — device peak unknown "
+            "(nmfx.obs.costmodel.set_device_peak() enables "
+            "MFU/roofline verdicts)")
+    lines.append(f"{'kind':<16}{'disp':>5}{'model GFLOP':>13}"
+                 f"{'GB moved':>10}{'AI':>7}{'GFLOP/s':>9}{'MFU':>7}"
+                 "  verdict")
+    for kind in sorted(summary["kinds"]):
+        rec = summary["kinds"][kind]
+        mfu = "-" if rec["mfu"] is None else f"{rec['mfu']:.3f}"
+        ai = ("-" if rec["arithmetic_intensity"] is None
+              else f"{rec['arithmetic_intensity']:.1f}")
+        ach = ("-" if rec["achieved_flops_per_s"] is None
+               else f"{rec['achieved_flops_per_s'] / 1e9:.1f}")
+        lines.append(
+            f"{kind:<16}{rec['dispatches']:>5}"
+            f"{rec['flops'] / 1e9:>13.2f}{rec['bytes'] / 1e9:>10.2f}"
+            f"{ai:>7}{ach:>9}{mfu:>7}  {rec['verdict']}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# XLA cross-check
+# --------------------------------------------------------------------------
+
+def xla_iteration_cost(algorithm: str, family: str, m: int, n: int,
+                       k: int, cfg=None,
+                       unrolls: "tuple[int, int]" = (2, 4)
+                       ) -> "dict | None":
+    """Per-iteration cost as XLA's own cost analysis sees it: compile
+    the engine's update step unrolled ``unrolls[0]`` and ``unrolls[1]``
+    times and difference ``compiled.cost_analysis()`` — fixed setup
+    cost (init, the sketched engine's one-time L·A/A·R, constants)
+    cancels, leaving the marginal per-iteration cost the analytic
+    models claim to describe. Returns ``{"flops", "bytes"}`` per
+    iteration, or None when the backend exposes no cost analysis or
+    the family has no CPU-compilable form (pallas: Mosaic does not
+    compile on CPU; its flop model is mu's — the same update math —
+    and is cross-checked through the packed family).
+
+    tests/test_costmodel.py gates the analytic table against this per
+    engine with pinned tolerances on the smallest shapes."""
+    from nmfx._compat import compiled_cost_analysis
+
+    t1, t2 = unrolls
+    if not (0 < t1 < t2):
+        raise ValueError("unrolls must be increasing and positive")
+    costs = []
+    for t in (t1, t2):
+        compiled = _compile_unrolled(algorithm, family, m, n, k, cfg, t)
+        if compiled is None:
+            return None
+        ca = compiled_cost_analysis(compiled)
+        if ca is None or "flops" not in ca:
+            return None
+        costs.append(ca)
+    span = t2 - t1
+    out = {"flops": (costs[1]["flops"] - costs[0]["flops"]) / span}
+    b1, b2 = (c.get("bytes accessed") for c in costs)
+    out["bytes"] = ((b2 - b1) / span
+                    if b1 is not None and b2 is not None else None)
+    return out
+
+
+def _compile_unrolled(algorithm, family, m, n, k, cfg, t):
+    """A compiled function running exactly ``t`` update iterations of
+    the requested engine (no convergence checks — the models cover the
+    update math; see the module docstring), Python-unrolled so XLA's
+    while-body-counted-once ambiguity never enters the differencing."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _resolve_cfg(cfg)
+    if family == "pallas":
+        return None
+    key = jax.random.key(0)
+    kw, kh, ka = jax.random.split(key, 3)
+    a = jax.random.uniform(ka, (m, n), jnp.float32, 0.1, 1.0)
+    w0 = jax.random.uniform(kw, (m, k), jnp.float32, 0.1, 1.0)
+    h0 = jax.random.uniform(kh, (k, n), jnp.float32, 0.1, 1.0)
+
+    if family == "vmap":
+        from nmfx.solvers import SOLVERS
+        from nmfx.solvers import base as sbase
+
+        mod = SOLVERS[algorithm]
+
+        def run(a, w0, h0):
+            state = sbase.init_state(a, w0, h0,
+                                     mod.init_aux(a, w0, h0, cfg))
+            for _ in range(t):
+                state = mod.step(a, state, cfg, check=False)
+            return state.w, state.h
+
+        return jax.jit(run).lower(a, w0, h0).compile()
+
+    if family == "sketched":
+        from nmfx.solvers import base as sbase
+        from nmfx.solvers import sketched
+
+        def run(a, w0, h0):
+            state = sbase.init_state(
+                a, w0, h0, sketched.init_aux(a, w0, h0, cfg, key))
+            for _ in range(t):
+                state = sketched.step(a, state, cfg, check=False)
+            return state.w, state.h
+
+        return jax.jit(run).lower(a, w0, h0).compile()
+
+    if family == "packed":
+        from nmfx.ops.grid_mu import make_block
+
+        block = make_block(cfg, a)
+        done = jnp.zeros((1,), bool)
+        wb, hb = w0[None], h0[None]
+        kwargs = ({"pad_live": jnp.ones((1, k), bool)}
+                  if algorithm == "snmf" else {})
+
+        def run(a, wp, hp):
+            for _ in range(t):
+                wp, hp = block(a, wp, hp, done, cfg, **kwargs)
+            return wp, hp
+
+        return jax.jit(run).lower(a, wb, hb).compile()
+
+    raise ValueError(f"unknown engine family {family!r}")

@@ -1,0 +1,416 @@
+"""Bench-trajectory regression observatory (ISSUE 13, ``nmfx-perf``).
+
+The repo records one ``BENCH_r<NN>.json`` per hardware round, but until
+now the only cross-round signal was the headline ``vs_best`` scalar —
+the r03→r04 warm-wall drift (1.384 s → 2.041 s) sat in plain sight for
+two rounds because nothing compared the rest of the record. This module
+is the noise-aware trajectory judge:
+
+* **Load + normalize** every ``BENCH_r*.json`` in a directory —
+  accepting both the driver's wrapper form (``{"parsed": record}``)
+  and bare records — and extract a curated metric set through
+  schema-drift-tolerant paths (r01 had only ``value``/
+  ``restarts_per_s``; ``mfu_solve`` appears in r04; per-backend reps
+  in r05; the serving/chaos/durability/obs stages have never produced
+  hardware numbers and will first appear in r06, where they self-judge
+  as ``new`` rather than crash the comparison).
+* **Noise-aware comparison**: every wall metric is already the
+  min-of-same-session-reps (the bench's recorded protocol — the only
+  statistic comparable across this environment's ±50% session swings),
+  and each metric carries a RELATIVE regression threshold sized to its
+  observed noise (wall metrics 25–35%, utilization metrics 15%);
+  ``--threshold-scale`` widens or tightens the whole set.
+* **Verdict + trend report**: :func:`compare` returns a
+  machine-readable verdict (regressions vs the best prior round, with
+  margins and which round set the bar) and :func:`markdown_report`
+  renders the full metric×round trend table. The ``nmfx-perf``
+  entrypoint prints both; ``bench.py --regress`` runs the same
+  comparison on the record it just produced and exits 2 on regression
+  — the gate that makes the eventual hardware r06 run self-judging.
+
+Stdlib-only, like the rest of ``nmfx.obs``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import NamedTuple
+
+__all__ = ["METRICS", "MetricSpec", "compare", "extract_metrics",
+           "load_rounds", "main", "markdown_report"]
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+class MetricSpec(NamedTuple):
+    """One tracked bench metric: where it lives across schema
+    generations (``paths`` tried in order — dotted keys, with
+    ``list[key=value]`` selectors for the serve ladder), which
+    direction is better, and the relative change vs the best prior
+    round that counts as a regression."""
+
+    name: str
+    paths: tuple
+    direction: str  # "lower" | "higher"
+    threshold: float  # relative regression threshold
+    note: str = ""
+
+
+#: the tracked trajectory. Thresholds are sized to the metric's
+#: observed cross-round noise under the min-of-reps protocol: warm
+#: walls swing ~±20% between sessions even at their minima (r03-r05),
+#: cold/compile walls more, MFU is a ratio of same-session numbers and
+#: moves little. Serving-stack metrics (exec_cache/serve/durability/
+#: obs) have no prior hardware rounds yet — they enter the trajectory
+#: as "new" at r06 and gate from r07 on.
+METRICS = (
+    MetricSpec("consensus_sweep_wall_s", ("value",), "lower", 0.25,
+               "headline warm wall (min of same-session reps)"),
+    MetricSpec("consensus_e2e_wall_s",
+               ("detail.consensus_e2e_wall_s",), "lower", 0.25,
+               "warm wall incl. rank selection (r07+ protocol)"),
+    MetricSpec("restarts_per_s", ("detail.restarts_per_s",), "higher",
+               0.25),
+    MetricSpec("cold_wall_s", ("detail.cold_wall_s",), "lower", 0.35,
+               "from-nothing first-request wall (compile included)"),
+    MetricSpec("compile_wall_s", ("detail.compile_wall_s",), "lower",
+               0.40),
+    MetricSpec("mfu", ("detail.mfu",), "higher", 0.15),
+    MetricSpec("mfu_solve", ("detail.mfu_solve",), "higher", 0.15,
+               "solve-phase utilization — the kernel-work steering "
+               "metric"),
+    MetricSpec("pallas_min_s", ("detail.backends.pallas.min_s",),
+               "lower", 0.25),
+    MetricSpec("pallas_mfu_solve",
+               ("detail.backends.pallas.mfu_solve",), "higher", 0.15),
+    # --- serving stack (first hardware numbers land at r06) ---------
+    MetricSpec("exec_hit_dispatch_s",
+               ("detail.exec_cache.hit_dispatch_s",), "lower", 0.35,
+               "warm-bucket compile-free dispatch"),
+    MetricSpec("exec_miss_compile_s",
+               ("detail.exec_cache.miss_compile_s",), "lower", 0.50),
+    MetricSpec("cold_persist_wall_s",
+               ("detail.exec_cache.cold_persist_wall_s",), "lower",
+               0.35, "fresh-process deserialize-and-dispatch wall"),
+    MetricSpec("serve_p50_latency_s",
+               ("detail.serve.ladder[offered_load=1.0].p50_latency_s",),
+               "lower", 0.35),
+    MetricSpec("serve_p99_latency_s",
+               ("detail.serve.ladder[offered_load=1.0].p99_latency_s",),
+               "lower", 0.50, "tail latency is the noisiest surface"),
+    MetricSpec("serve_burst_goodput_req_per_s",
+               ("detail.serve.ladder[offered_load=burst]"
+                ".goodput_req_per_s",), "higher", 0.35),
+    MetricSpec("serve_chaos_goodput_retention",
+               ("detail.serve.chaos.goodput_retention",), "higher",
+               0.25),
+    MetricSpec("durability_resume_overhead_s",
+               ("detail.durability.resume_overhead_s",), "lower", 0.50),
+    MetricSpec("obs_overhead_frac", ("detail.obs.overhead_frac",),
+               "lower", 1.0,
+               "telemetry overhead; the bench's own gate is the hard "
+               "3% bound, this only tracks drift round-over-round"),
+    MetricSpec("sketched_flops_compression",
+               ("detail.sketched.flops_compression_per_restart",),
+               "higher", 0.20,
+               "analytic, shape-derived — hardware-independent"),
+)
+
+
+# --------------------------------------------------------------------------
+# record loading / metric extraction
+# --------------------------------------------------------------------------
+
+def _resolve_path(obj, path: str):
+    """Walk one dotted path; ``seg[key=value]`` selects the first
+    element of a list whose ``key`` stringifies to ``value``. Returns
+    None on any miss."""
+    cur = obj
+    # split on dots OUTSIDE bracket selectors only ("[offered_load=1.0]"
+    # keeps its dot)
+    for seg in re.split(r"\.(?![^\[\]]*\])", path):
+        m = re.fullmatch(r"([^\[]+)\[([^=\]]+)=([^\]]+)\]", seg)
+        sel = None
+        if m:
+            seg, sel = m.group(1), (m.group(2), m.group(3))
+        if not isinstance(cur, dict) or seg not in cur:
+            return None
+        cur = cur[seg]
+        if sel is not None:
+            if not isinstance(cur, list):
+                return None
+            key, want = sel
+            cur = next((e for e in cur
+                        if isinstance(e, dict)
+                        and str(e.get(key)) == want), None)
+            if cur is None:
+                return None
+    return cur
+
+
+def extract_metrics(record: dict) -> "dict[str, float]":
+    """Normalize one bench record (wrapper or bare form) into the
+    tracked metric set; metrics a round's schema predates are simply
+    absent."""
+    parsed = record.get("parsed", record)
+    if not isinstance(parsed, dict):
+        return {}
+    out = {}
+    for spec in METRICS:
+        for path in spec.paths:
+            val = _resolve_path(parsed, path)
+            if isinstance(val, (int, float)) and not isinstance(val,
+                                                                bool):
+                out[spec.name] = float(val)
+                break
+    return out
+
+
+def load_rounds(directory: str) -> "list[dict]":
+    """Every readable ``BENCH_r*.json`` in ``directory`` as
+    ``{"round", "file", "metrics"}``, sorted by round number;
+    unreadable or non-record files are skipped (the ``_best_prior_
+    record`` discipline — a corrupt round must not kill the judge)."""
+    rounds = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    for name in names:
+        m = _ROUND_RE.fullmatch(name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        metrics = extract_metrics(rec)
+        if metrics:
+            rounds.append({"round": int(m.group(1)), "file": name,
+                           "metrics": metrics})
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+# --------------------------------------------------------------------------
+# comparison
+# --------------------------------------------------------------------------
+
+def compare(rounds: "list[dict]", candidate: "dict | None" = None,
+            threshold_scale: float = 1.0) -> dict:
+    """Judge ``candidate`` (default: the newest loaded round) against
+    the BEST prior value of every tracked metric.
+
+    Rules (the min-of-reps / relative-threshold protocol): the
+    candidate's value is compared against the best over ALL prior
+    rounds (min for lower-better, max for higher-better — the same
+    best-ever bar ``vs_best`` uses, so one lucky round permanently
+    raises it), the margin is relative to that bar, and a metric
+    regresses when it is worse by more than ``threshold ×
+    threshold_scale``. Metrics with no prior round report as ``new``;
+    metrics the candidate lacks but priors had report as ``missing``
+    (a stage that silently stopped producing numbers is itself a
+    finding)."""
+    if candidate is None:
+        if not rounds:
+            return {"status": "no-data", "regressions": [],
+                    "improvements": [], "new": [], "missing": [],
+                    "ok": [], "candidate": None}
+        candidate, rounds = rounds[-1], rounds[:-1]
+    cand_metrics = candidate["metrics"]
+    verdict = {"candidate": {k: candidate[k]
+                             for k in ("round", "file")
+                             if k in candidate},
+               "prior_rounds": [r["file"] for r in rounds],
+               "regressions": [], "improvements": [], "ok": [],
+               "new": [], "missing": []}
+    for spec in METRICS:
+        cand = cand_metrics.get(spec.name)
+        priors = [(r["metrics"][spec.name], r["file"]) for r in rounds
+                  if spec.name in r["metrics"]]
+        if cand is None:
+            if priors:
+                verdict["missing"].append({
+                    "metric": spec.name,
+                    "note": "prior rounds recorded this metric but "
+                            "the candidate does not"})
+            continue
+        if not priors:
+            verdict["new"].append({"metric": spec.name, "value": cand})
+            continue
+        best, best_file = (min(priors) if spec.direction == "lower"
+                           else max(priors))
+        # the margin denominator gets an absolute floor so a zero (or
+        # rounded-to-zero) bar neither makes the metric permanently
+        # unjudgeable (rel forced to 0) nor explodes the margin: with
+        # best == 0 any nonzero worse candidate is a maximal regression
+        # and any equal-or-better one is clean — which is what a tiny
+        # floor yields
+        denom = max(abs(best), 1e-9)
+        if spec.direction == "lower":
+            rel = (cand - best) / denom
+        else:
+            rel = (best - cand) / denom
+        entry = {"metric": spec.name, "value": cand, "best": best,
+                 "best_round": best_file,
+                 "worse_by": round(rel, 4),
+                 "threshold": round(spec.threshold * threshold_scale,
+                                    4),
+                 "direction": spec.direction}
+        if spec.note:
+            entry["note"] = spec.note
+        if rel > spec.threshold * threshold_scale:
+            verdict["regressions"].append(entry)
+        elif rel < 0:
+            verdict["improvements"].append(entry)
+        else:
+            verdict["ok"].append(entry)
+    verdict["status"] = ("regression" if verdict["regressions"]
+                         else "ok")
+    return verdict
+
+
+# --------------------------------------------------------------------------
+# reporting
+# --------------------------------------------------------------------------
+
+def markdown_report(rounds: "list[dict]",
+                    verdict: "dict | None" = None) -> str:
+    """Metric × round trend table plus the verdict summary, as
+    markdown (written by ``nmfx-perf --markdown``)."""
+    lines = ["# nmfx bench trajectory", ""]
+    if not rounds:
+        lines.append("_no BENCH_r*.json rounds found_")
+        return "\n".join(lines)
+    names = [spec.name for spec in METRICS
+             if any(spec.name in r["metrics"] for r in rounds)]
+    header = "| metric | " + " | ".join(r["file"]
+                                        .removeprefix("BENCH_")
+                                        .removesuffix(".json")
+                                        for r in rounds) + " |"
+    lines.append(header)
+    lines.append("|" + "---|" * (len(rounds) + 1))
+    by_name = {spec.name: spec for spec in METRICS}
+    for name in names:
+        cells = []
+        for r in rounds:
+            v = r["metrics"].get(name)
+            cells.append("-" if v is None else f"{v:g}")
+        arrow = "↓" if by_name[name].direction == "lower" else "↑"
+        lines.append(f"| {name} {arrow} | " + " | ".join(cells) + " |")
+    lines.append("")
+    if verdict is not None:
+        lines.append(f"**Verdict: {verdict['status']}**")
+        for kind, rows in (("Regressions", verdict["regressions"]),
+                           ("Improvements", verdict["improvements"]),
+                           ("New (no prior)", verdict["new"]),
+                           ("Missing", verdict["missing"])):
+            if not rows:
+                continue
+            lines.append("")
+            lines.append(f"## {kind}")
+            for row in rows:
+                if "worse_by" in row:
+                    lines.append(
+                        f"- `{row['metric']}`: {row['value']:g} vs "
+                        f"best {row['best']:g} ({row['best_round']}) — "
+                        f"{'worse' if row['worse_by'] > 0 else 'better'}"
+                        f" by {abs(row['worse_by']):.1%} "
+                        f"(threshold {row['threshold']:.0%})")
+                else:
+                    lines.append(
+                        f"- `{row['metric']}`"
+                        + (f": {row['value']:g}" if "value" in row
+                           else "")
+                        + (f" — {row['note']}" if "note" in row
+                           else ""))
+    return "\n".join(lines)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    """``nmfx-perf`` — judge the bench trajectory. Exit codes: 0 = no
+    regression, 2 = regression vs the best prior round, 1 = no usable
+    rounds."""
+    p = argparse.ArgumentParser(
+        prog="nmfx-perf",
+        description="Noise-aware BENCH_r*.json trajectory judge: "
+                    "compares the newest (or --candidate) round's "
+                    "tracked metrics against the best prior round "
+                    "under per-metric relative thresholds; prints a "
+                    "trend report and exits 2 on regression "
+                    "(docs/observability.md 'Regression "
+                    "observatory').")
+    p.add_argument("--dir", default=None,
+                   help="directory holding BENCH_r*.json (default: "
+                        "the repo root this package sits in)")
+    p.add_argument("--candidate", default=None, metavar="FILE",
+                   help="judge this record (wrapper or bare JSON) "
+                        "against ALL loaded rounds instead of "
+                        "treating the newest round as the candidate")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write the machine-readable verdict here "
+                        "('-' = stdout)")
+    p.add_argument("--markdown", default=None, metavar="PATH",
+                   help="write the markdown trend report here")
+    p.add_argument("--threshold-scale", type=float, default=1.0,
+                   help="multiply every per-metric regression "
+                        "threshold (default 1.0; e.g. 0.5 = stricter)")
+    args = p.parse_args(argv)
+    directory = args.dir
+    if directory is None:
+        directory = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    rounds = load_rounds(directory)
+    candidate = None
+    if args.candidate is not None:
+        try:
+            with open(args.candidate) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"nmfx-perf: unreadable candidate {args.candidate}: "
+                  f"{e}", file=sys.stderr)
+            return 1
+        candidate = {"file": os.path.basename(args.candidate),
+                     "metrics": extract_metrics(rec)}
+    if not rounds and candidate is None:
+        print(f"nmfx-perf: no BENCH_r*.json rounds under {directory}",
+              file=sys.stderr)
+        return 1
+    verdict = compare(rounds, candidate,
+                      threshold_scale=args.threshold_scale)
+    trend_rounds = rounds + ([candidate] if candidate is not None
+                             else [])
+    report = markdown_report(trend_rounds, verdict)
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write(report + "\n")
+    else:
+        print(report)
+    if args.json == "-":
+        print(json.dumps(verdict))
+    elif args.json:
+        with open(args.json, "w") as f:
+            json.dump(verdict, f, indent=1)
+    if verdict["status"] == "regression":
+        print(f"nmfx-perf: REGRESSION — "
+              f"{len(verdict['regressions'])} metric(s) worse than "
+              "their best prior round beyond threshold",
+              file=sys.stderr)
+        return 2
+    print(f"nmfx-perf: {verdict['status']} "
+          f"({len(verdict['improvements'])} improved, "
+          f"{len(verdict['ok'])} within threshold, "
+          f"{len(verdict['new'])} new)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,6 +207,14 @@ class Profiler:
         lines.append(f"(~ = overlapped with the phases above; "
                      f"{a['overlap_s']:.3f}s overlapped, ratio "
                      f"{a['overlap_ratio']:.0%} of wall)")
+        # per-dispatch roofline attribution (ISSUE 13): the profiled
+        # solve dispatches annotated themselves with model FLOPs/bytes
+        # (sweep/exec_cache → nmfx.obs.costmodel); surface the verdict
+        # table whenever any dispatch was attributed this process
+        from nmfx.obs import costmodel as _costmodel
+
+        if _costmodel.perf_summary()["kinds"]:
+            lines.append(_costmodel.perf_report())
         if self.trace_dir is not None:
             lines.append(f"device trace written to {self.trace_dir} "
                          "(tensorboard --logdir, or load in Perfetto)")

@@ -75,6 +75,7 @@ from typing import TYPE_CHECKING, Mapping, Protocol, Sequence
 import numpy as np
 
 from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.obs import costmodel as _costmodel
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
 from nmfx.obs import trace as _trace
@@ -1039,8 +1040,16 @@ class NMFXServer:
         "Observability"). Plain data; each metric's ``series`` dict is
         keyed by label-value TUPLES (``()`` for unlabeled series), so
         stringify the keys before ``json.dumps`` — for wire formats
-        use :meth:`metrics_text` instead."""
-        return _metrics.registry().delta(self._metrics_t0)
+        use :meth:`metrics_text` instead.
+
+        The ``"perf"`` key carries the per-dispatch roofline
+        attribution summary (``nmfx.obs.costmodel.perf_summary`` —
+        model FLOPs/bytes, achieved FLOP/s, MFU, arithmetic intensity
+        and the compute-vs-bandwidth verdict per dispatch kind;
+        docs/observability.md "Performance attribution")."""
+        snap = _metrics.registry().delta(self._metrics_t0)
+        snap["perf"] = _costmodel.perf_summary()
+        return snap
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the process-wide registry —
@@ -1595,6 +1604,23 @@ class NMFXServer:
                 req.stats.harvest_s = select_s
                 _solve_hist.observe(fetch_s)
                 now = time.monotonic()
+                # per-REQUEST roofline attribution (ISSUE 13): model
+                # FLOPs of the lanes this request actually ran over its
+                # dispatch→harvested wall. Packed mates' walls overlap
+                # (each counts the shared device solve), so the serve
+                # kind reads as request-level throughput — the
+                # dispatch-level kernel MFU lives under the exec.*/
+                # sweep.* kinds (docs/observability.md)
+                if _costmodel.attribution_enabled():
+                    scfg_served = (
+                        dataclasses.replace(req.scfg, backend="sketched")
+                        if req.quality == "sketched" else req.scfg)
+                    _costmodel.attribute_dispatch(
+                        "serve", scfg_served, req.a.shape[0],
+                        req.a.shape[1],
+                        {k: np.asarray(r.iterations)
+                         for k, r in per_k.items()},
+                        now - t_disp)
                 req.stats.latency_s = now - req.submitted
                 if req.deadline is not None and now >= req.deadline:
                     self._resolve_expired(req, mid_solve=True)

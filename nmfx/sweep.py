@@ -140,6 +140,50 @@ def _poison_restart_lanes(w0, lane_idx: tuple) -> jax.Array:
         jnp.asarray(jnp.nan, w0.dtype))
 
 
+def _pad_pool_lanes(w0, h0, job_ks: tuple, slots: int):
+    """Pad a serving-tier job batch with inert all-zero lanes up to the
+    full ``slots`` pool width — the composition-independent-geometry
+    half of the packed==solo bit-identity contract (the other half is
+    the fixed single-stage pool in the same builders).
+
+    Why: the slot scheduler's data GEMMs fold the lane axis into one
+    GEMM's free dimension (``grid_mu`` module docstring), and XLA's CPU
+    backend picks its reduction partitioning per GEMM *shape* — under a
+    constrained thread pool (the 8-virtual-device test platform) a
+    36-lane pool's per-lane reductions differ from a 12-lane pool's by
+    ~1 ulp/iteration. That drift is irrelevant inside one executable
+    but violated the serve exactness contract: a ≥3-request packed
+    dispatch (wider pool) drifted bitwise from each request's solo
+    bucketed run (narrower pool) in dnorms/best_w/best_h while
+    labels/consensus agreed (the PR-12-flagged pre-existing bug,
+    reproduced at 120×48/maxiter 400). Padding every serving-tier
+    dispatch to the same ``slots``-wide pool makes the GEMM shapes —
+    and hence each lane's reduction order — independent of what else
+    was packed alongside.
+
+    The pad lanes are all-zero factors, which every packed-family block
+    maps to zero with no non-finite intermediates (mu/hals: zero
+    numerators; neals/snmf: zero Grams + the absolute-tiny jitter, zero
+    rhs; als: min-norm lstsq of a zero matrix; kl: zero numerator
+    contraction), so they TolX-stop at the first check and sit frozen in
+    the pool thereafter. Their rows land past the real jobs and are
+    sliced off by the epilogues. Cost: dispatches with fewer than
+    ``slots`` lanes pay the full-width GEMMs (zero extra cost once a
+    dispatch fills the pool, which the north-star shapes always do);
+    see docs/serving.md "Serving front-end".
+
+    No-op when the batch already fills the pool."""
+    j = w0.shape[0]
+    pad = slots - j
+    if pad <= 0:
+        return w0, h0, job_ks
+    k_max = w0.shape[2]
+    zw = jnp.zeros((pad,) + w0.shape[1:], w0.dtype)
+    zh = jnp.zeros((pad,) + h0.shape[1:], h0.dtype)
+    return (jnp.concatenate([w0, zw]), jnp.concatenate([h0, zh]),
+            tuple(job_ks) + (k_max,) * pad)
+
+
 def _pad_count(restarts: int, mesh: Mesh | None) -> int:
     """Round restarts up to a multiple of the mesh's restart-axis size so the
     batch shards evenly; surplus lanes are computed and discarded."""
@@ -1311,8 +1355,17 @@ def _build_bucketed_sweep_fn(ks: tuple[int, ...], restarts: int,
         def run(a_pad, w0, h0, m_true, n_true,
                 flip_floor) -> dict[int, KSweepOutput]:
             a_pad = jnp.asarray(a_pad, dtype)
-            res = mu_sched(a_pad, w0, h0, solver_cfg, slots=grid_slots,
-                           tail_slots=grid_tail_slots, job_ks=job_ks,
+            # composition-independent pool geometry (the serve-layer
+            # bit-identity contract): pad the batch to the full slot
+            # width and run ONE fixed-width stage — the straggler-tail
+            # cascade would move surviving lanes into narrower pools at
+            # composition-dependent times, re-introducing exactly the
+            # shape-dependent reduction drift _pad_pool_lanes exists to
+            # remove, so the serving-tier builders pin it off
+            # (grid_tail_slots is honored everywhere else)
+            w0p, h0p, jks = _pad_pool_lanes(w0, h0, job_ks, grid_slots)
+            res = mu_sched(a_pad, w0p, h0p, solver_cfg, slots=grid_slots,
+                           tail_slots=0, job_ks=jks,
                            flip_floor=flip_floor)
             scale = _true_scale(m_true, n_true, res.dnorm.dtype)
             valid = jnp.arange(n_pad) < n_true
@@ -1455,7 +1508,14 @@ def _build_packed_serve_fn(layout: tuple, solver_cfg: SolverConfig,
     its solo bucketed sweep on the XLA engines, the same class as the
     whole-grid/per-k and streamed/sequential parities. The epilogue
     below mirrors ``_build_bucketed_sweep_fn``'s per-rank block
-    field-for-field for the same reason.
+    field-for-field for the same reason. Lane independence additionally
+    requires composition-independent GEMM *shapes*: XLA picks reduction
+    partitionings per shape, and on a thread-constrained CPU platform a
+    wider pool's per-lane reductions drift ~1 ulp/iteration from a
+    narrower one's (the PR-12-flagged ≥3-request violation) — so this
+    builder and the solo bucketed builder both pad their batch to the
+    full ``grid_slots``-wide pool and pin the straggler-tail cascade
+    off (``_pad_pool_lanes``).
 
     Packing therefore REQUIRES (enforced by the serve scheduler's
     compatibility key, never here): one shared padded matrix, one true
@@ -1508,8 +1568,15 @@ def _build_packed_serve_fn(layout: tuple, solver_cfg: SolverConfig,
                      for g, (k, r) in enumerate(layout)]
         w0, h0 = dyn_init(rank_keys, m_true, n_true)
         w0 = _poison_restart_lanes(w0, poison)
+        # same fixed pool geometry as the solo bucketed builder (padded
+        # to the full slot width, tail cascade pinned off): per-lane
+        # GEMM shapes — and so each lane's reduction order — must not
+        # depend on what else packed into this dispatch, or packed
+        # results drift bitwise from the solo runs they are contracted
+        # to equal (see _pad_pool_lanes)
+        w0, h0, jks = _pad_pool_lanes(w0, h0, job_ks, grid_slots)
         res = mu_sched(a_pad, w0, h0, solver_cfg, slots=grid_slots,
-                       tail_slots=grid_tail_slots, job_ks=job_ks,
+                       tail_slots=0, job_ks=jks,
                        flip_floor=flip_floor)
         # pad-masking epilogue: identical math to the solo bucketed
         # executable's per-rank block (labels -> -1 pad columns ->
@@ -1786,6 +1853,9 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             # stream: one executable produced every rank, but each
             # rank's arrays complete (and harvest) independently
             on_rank(k, solved[k])
+        _attribute_dispatch("sweep.grid", solver_cfg, a_dev.shape,
+                            solved, time.perf_counter() - t0, mesh,
+                            profiler)
         if 0 < _log.level <= logging.INFO and coord:
             iters = {k: float(np.asarray(v.iterations).mean())
                      for k, v in solved.items()}
@@ -1816,6 +1886,9 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
             # barrier at the pipeline's device_get
             start_host_fetch(out[k])
         on_rank(k, out[k])
+        _attribute_dispatch("sweep.k", solver_cfg, a_dev.shape,
+                            {k: out[k]}, time.perf_counter() - t0,
+                            mesh, profiler)
         if 0 < _log.level <= logging.INFO and coord:
             # reading the stats forces a device sync, trading the k-grid's
             # async dispatch pipelining for live progress. Gated on a level
@@ -1834,6 +1907,36 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
 
 def _noop_rank(k: int, out: KSweepOutput) -> None:
     """Default ``on_rank`` hook: no streaming consumer attached."""
+
+
+def _attribute_dispatch(kind: str, solver_cfg: SolverConfig,
+                        shape: tuple, outs: dict, wall_s: float,
+                        mesh, profiler) -> None:
+    """Per-dispatch roofline attribution (``nmfx.obs.costmodel``,
+    ISSUE 13): annotate a just-measured solve dispatch with its model
+    FLOPs/bytes and export the ``nmfx_perf_*`` gauges. Runs only on
+    PROFILED dispatches — a real ``Profiler`` already blocked on the
+    phase (so the wall is honest and the iteration counts are
+    computed), while the NullProfiler paths (the serve scheduler, fully
+    async callers) must never gain a device sync they didn't have; the
+    serving engine attributes its own requests at harvest time instead
+    (``nmfx/serve.py``). Note a cold ``sweep()`` dispatch's phase wall
+    includes trace+compile — its attribution lands in the histograms'
+    low-MFU tail (the exec-cache path's dispatches are compile-free by
+    construction and attribute cleanly)."""
+    from nmfx.profiling import NullProfiler
+
+    if isinstance(profiler, NullProfiler):
+        return
+    from nmfx.obs import costmodel
+
+    if not costmodel.attribution_enabled() or not outs:
+        return
+    devices = int(mesh.size) if mesh is not None else 1
+    iters = {k: np.asarray(v.iterations) for k, v in outs.items()}
+    costmodel.attribute_dispatch(kind, solver_cfg, shape[0], shape[1],
+                                 iters, wall_s, mesh=mesh,
+                                 devices=devices)
 
 
 def place_input(a, solver_cfg: SolverConfig, mesh: Mesh | None) -> jax.Array:

@@ -330,6 +330,23 @@ def test_cli_observability_flags(gct_path, tmp_path, capsys):
         or "nmfx_data_h2d_transfers_total" in text
 
 
+def test_cli_perf_report(gct_path, capsys):
+    """ISSUE 13: --perf-report runs the sweep with phase timing and
+    prints the per-dispatch roofline attribution table (model GFLOP,
+    arithmetic intensity, verdict) after the summary."""
+    from nmfx.obs import costmodel
+
+    costmodel.reset_perf()
+    rc = main([gct_path, "--ks", "2", "--restarts", "2",
+               "--maxiter", "60", "--no-files", "--perf-report"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "perf attribution" in out
+    assert "verdict" in out
+    # attribution ran on the dispatch path (not just an empty table)
+    assert costmodel.perf_summary()["kinds"]
+
+
 def test_cli_sketched_backend(gct_path, capsys):
     """--backend sketched runs end to end and announces the quality
     tag in the summary (ISSUE 12)."""

@@ -1,0 +1,440 @@
+"""Performance observatory (ISSUE 13): analytic cost models vs XLA's
+own cost analysis, per-dispatch roofline attribution, and the
+bench-trajectory regression judge.
+
+Budget discipline: the cross-check compiles Python-unrolled update
+steps at the smallest viable shape (48×24, k=3 — two tiny compiles per
+engine); the serving integration test reuses the smallest serve
+config; the regress tests are pure-host JSON work.
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from nmfx.config import SolverConfig
+from nmfx.obs import costmodel as cm
+
+M, N, K = 48, 24, 3
+
+
+# ---------------------------------------------------------------------
+# model table / coverage
+# ---------------------------------------------------------------------
+
+def test_universe_matches_coverage_live():
+    """The acceptance invariant NMFX009 enforces, pinned directly:
+    reachable engines == modeled engines, exactly."""
+    assert cm.engine_universe() == cm.covered_engines()
+
+
+def test_exempt_algorithms_report_none():
+    for algo in cm.COSTMODEL_EXEMPT:
+        assert cm.iteration_flops(algo, "vmap", M, N, K) is None
+        assert cm.iteration_bytes(algo, "vmap", M, N, K) is None
+
+
+def test_models_positive_and_rank_monotonic():
+    for algo, fam in sorted(cm.covered_engines()):
+        cfg = SolverConfig(algorithm=algo,
+                           backend="sketched" if fam == "sketched"
+                           else "auto")
+        f3 = cm.iteration_flops(algo, fam, M, N, 3, cfg)
+        f5 = cm.iteration_flops(algo, fam, M, N, 5, cfg)
+        b3 = cm.iteration_bytes(algo, fam, M, N, 3, cfg)
+        assert f3 > 0 and b3 > 0, (algo, fam)
+        assert f5 > f3, f"{algo}/{fam}: FLOPs must grow with rank"
+
+
+def test_pallas_bytes_below_packed():
+    """The locality story the attribution exists to surface: the
+    VMEM-resident kernel family moves fewer HBM bytes per iteration
+    than the XLA dense family at the same shape (factor round-trips
+    amortized over the in-launch iterations), so its modeled
+    arithmetic intensity is strictly higher."""
+    cfg = SolverConfig(algorithm="mu", backend="pallas")
+    assert (cm.iteration_bytes("mu", "pallas", 5000, 500, 10, cfg)
+            < cm.iteration_bytes("mu", "packed", 5000, 500, 10, cfg))
+    assert (cm.iteration_flops("mu", "pallas", 5000, 500, 10, cfg)
+            == cm.iteration_flops("mu", "packed", 5000, 500, 10, cfg))
+
+
+def test_dispatch_cost_resolves_family_and_sums():
+    scfg = SolverConfig(algorithm="mu", max_iter=50)
+    cost = cm.dispatch_cost(scfg, M, N, {2: [10, 20], 3: [5]})
+    assert cost["family"] == "packed"  # mu auto resolves packed
+    expect = (cm.iteration_flops("mu", "packed", M, N, 2, scfg) * 30
+              + cm.iteration_flops("mu", "packed", M, N, 3, scfg) * 5)
+    assert cost["flops"] == pytest.approx(expect)
+    assert cost["arithmetic_intensity"] == pytest.approx(
+        cost["flops"] / cost["bytes"])
+
+
+def test_dispatch_cost_none_for_exempt():
+    scfg = SolverConfig(algorithm="pg", max_iter=50)
+    assert cm.dispatch_cost(scfg, M, N, {2: [10]}) is None
+
+
+# ---------------------------------------------------------------------
+# the XLA cross-check: analytic vs compiled.cost_analysis(), per engine
+# ---------------------------------------------------------------------
+
+#: pinned tolerance bands — analytic/XLA ratio per engine at the
+#: smallest shape, measured on this image's jax 0.4.37 CPU backend and
+#: given ~±0.1 headroom. The models are leading-order (k² terms and
+#: fusion decisions move the ratio at tiny shapes), so the bands are
+#: per-engine rather than one global epsilon — but they are BANDS, so
+#: an extra GEMM slipping into an update (flops +33% for mu) or a model
+#: constant edited without re-calibration fails here instead of
+#: silently drifting the bench MFU record. als' flop band sits above
+#: 1.0 by construction: its SVD lowers to a LAPACK custom call whose
+#: FLOPs cost_analysis cannot see, so the analytic model (which prices
+#: the SVD) necessarily exceeds the XLA count.
+_FLOP_BANDS = {
+    ("mu", "vmap"): (0.80, 1.00), ("mu", "packed"): (0.80, 1.00),
+    ("mu", "sketched"): (0.75, 1.00),
+    ("hals", "vmap"): (0.75, 1.00), ("hals", "packed"): (0.75, 1.00),
+    ("hals", "sketched"): (0.65, 0.95),
+    ("kl", "vmap"): (0.85, 1.10), ("kl", "packed"): (0.85, 1.10),
+    ("als", "vmap"): (1.05, 1.45), ("als", "packed"): (1.05, 1.45),
+    ("neals", "vmap"): (0.90, 1.20), ("neals", "packed"): (0.70, 1.00),
+    ("snmf", "vmap"): (0.90, 1.20), ("snmf", "packed"): (0.70, 1.00),
+}
+
+_BYTE_BANDS = {
+    ("mu", "vmap"): (0.75, 1.05), ("mu", "packed"): (0.70, 1.00),
+    ("mu", "sketched"): (0.70, 1.00),
+    ("hals", "vmap"): (0.85, 1.20), ("hals", "packed"): (0.70, 1.05),
+    ("hals", "sketched"): (0.50, 0.80),
+    ("kl", "vmap"): (0.80, 1.10), ("kl", "packed"): (0.80, 1.10),
+    ("als", "vmap"): (0.80, 1.15), ("als", "packed"): (0.80, 1.15),
+    ("neals", "vmap"): (0.75, 1.05), ("neals", "packed"): (0.60, 0.90),
+    ("snmf", "vmap"): (0.75, 1.05), ("snmf", "packed"): (0.60, 0.90),
+}
+
+
+@pytest.mark.parametrize("algo,fam", sorted(
+    e for e in _FLOP_BANDS))
+def test_analytic_model_vs_xla_cost_analysis(algo, fam):
+    """The pinned-tolerance gate: the analytic per-iteration model must
+    track what XLA actually compiled for the engine's update step
+    (differenced between unroll depths so setup cost cancels) — the
+    guarantee that the table can never silently drift from the emitted
+    program (ISSUE 13 acceptance)."""
+    cfg = SolverConfig(algorithm=algo,
+                       backend="sketched" if fam == "sketched"
+                       else "auto")
+    xla = cm.xla_iteration_cost(algo, fam, M, N, K, cfg)
+    if xla is None:
+        pytest.skip("no cost analysis on this backend")
+    flops = cm.iteration_flops(algo, fam, M, N, K, cfg)
+    lo, hi = _FLOP_BANDS[(algo, fam)]
+    ratio = flops / xla["flops"]
+    assert lo <= ratio <= hi, \
+        f"{algo}/{fam}: analytic/XLA flops ratio {ratio:.3f} " \
+        f"outside pinned [{lo}, {hi}]"
+    if xla["bytes"] is not None:
+        blo, bhi = _BYTE_BANDS[(algo, fam)]
+        bratio = cm.iteration_bytes(algo, fam, M, N, K, cfg) \
+            / xla["bytes"]
+        assert blo <= bratio <= bhi, \
+            f"{algo}/{fam}: analytic/XLA bytes ratio {bratio:.3f} " \
+            f"outside pinned [{blo}, {bhi}]"
+
+
+def test_pallas_crosscheck_unavailable_on_cpu():
+    """Mosaic does not compile on CPU: the pallas family reports None
+    (its FLOPs model is mu's — the same update math — and is
+    cross-checked through the packed family above)."""
+    cfg = SolverConfig(algorithm="mu", backend="pallas")
+    assert cm.xla_iteration_cost("mu", "pallas", M, N, K, cfg) is None
+
+
+# ---------------------------------------------------------------------
+# per-dispatch attribution
+# ---------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _attrib_state_isolated():
+    was = cm.attribution_enabled()
+    yield
+    cm.reset_perf()
+    if was:
+        cm.enable_attribution()
+    else:
+        cm.disable_attribution()
+
+
+def test_attribute_dispatch_records_and_verdicts():
+    cm.reset_perf()
+    scfg = SolverConfig(algorithm="mu", max_iter=50)
+    rec = cm.attribute_dispatch("test.kind", scfg, M, N,
+                                {2: [10, 10], 3: [10]}, solve_s=0.25)
+    assert rec is not None
+    cost = cm.dispatch_cost(scfg, M, N, {2: [10, 10], 3: [10]})
+    assert rec["model_flops"] == pytest.approx(cost["flops"])
+    assert rec["achieved_flops_per_s"] == pytest.approx(
+        cost["flops"] / 0.25)
+    summary = cm.perf_summary()
+    assert summary["kinds"]["test.kind"]["dispatches"] == 1
+    assert "verdict" in summary["kinds"]["test.kind"]
+    # the per-dispatch drill-down ring retains the record
+    tail = cm.recent_attributions(limit=1)
+    assert tail and tail[-1]["kind"] == "test.kind"
+    assert tail[-1]["model_flops"] == pytest.approx(cost["flops"])
+    # histograms landed on the registry under the kind label
+    from nmfx.obs import metrics
+
+    hist = metrics.registry().get("nmfx_perf_achieved_flops")
+    assert hist.series()[("test.kind",)]["count"] >= 1
+    ai = metrics.registry().get("nmfx_perf_arithmetic_intensity")
+    assert ai.series()[("test.kind",)]["count"] >= 1
+
+
+def test_attribution_verdict_sides_of_the_ridge():
+    """With an explicit peak the verdict names the binding wall: mu at
+    tiny k is bandwidth-bound (AI ≈ k/2 FLOP/B, far under any TPU
+    ridge); against a fictional low-bandwidth device the same dispatch
+    flips compute-bound."""
+    cm.reset_perf()
+    scfg = SolverConfig(algorithm="mu", max_iter=50)
+    kind_args = dict(m=M, n=N, iters_by_k={3: [20]}, solve_s=0.1)
+    real_kind = None
+    try:
+        import jax
+
+        real_kind = str(jax.devices()[0].device_kind)
+        cm.set_device_peak(real_kind, 197e12, 819e9)
+        rec = cm.attribute_dispatch("ridge.low", scfg, **kind_args)
+        assert rec["mfu"] is not None
+        assert "bandwidth-bound" in rec["verdict"]
+        # a tiny FLOP peak with abundant bandwidth drops the ridge
+        # below mu's AI — the same dispatch flips compute-bound
+        cm.set_device_peak(real_kind, 1e6, 1e15)
+        rec = cm.attribute_dispatch("ridge.high", scfg, **kind_args)
+        assert "compute-bound" in rec["verdict"]
+    finally:
+        if real_kind is not None:
+            with cm._peaks_lock:
+                cm.DEVICE_PEAKS.pop(real_kind, None)
+
+
+def test_attribution_disabled_and_guards():
+    cm.disable_attribution()
+    scfg = SolverConfig(algorithm="mu")
+    assert cm.attribute_dispatch("x", scfg, M, N, {2: [5]}, 0.1) is None
+    cm.enable_attribution()
+    # zero/None wall never divides
+    assert cm.attribute_dispatch("x", scfg, M, N, {2: [5]}, 0.0) is None
+    assert cm.attribute_dispatch("x", scfg, M, N, {2: [5]},
+                                 None) is None
+    # exempt algorithm: no model, no record
+    assert cm.attribute_dispatch(
+        "x", SolverConfig(algorithm="pg"), M, N, {2: [5]}, 0.1) is None
+    assert cm.perf_summary()["kinds"] == {}
+
+
+def test_profiled_sweep_attributes_and_reports():
+    """End-to-end on the default profiled path: a real sweep annotates
+    its dispatch, the perf table shows up in Profiler.report(), and
+    the histograms export through prometheus text."""
+    from nmfx.datasets import two_group_matrix
+    from nmfx.obs import metrics
+    from nmfx.profiling import Profiler
+    from nmfx.sweep import sweep
+    from nmfx.config import ConsensusConfig
+
+    cm.reset_perf()
+    a = two_group_matrix(n_genes=60, n_per_group=10, seed=3)
+    prof = Profiler()
+    with prof:
+        sweep(a, ConsensusConfig(ks=(2,), restarts=2, seed=5),
+              SolverConfig(max_iter=20), profiler=prof)
+    kinds = cm.perf_summary()["kinds"]
+    assert any(k.startswith("sweep.") for k in kinds), kinds
+    report = prof.report()
+    assert "perf attribution" in report
+    text = metrics.registry().prometheus_text()
+    assert "nmfx_perf_achieved_flops_bucket" in text
+
+
+def test_served_request_exports_perf_metrics():
+    """ISSUE 13 satellite: perf metrics appear in ``metrics_text()``
+    (and the stats_snapshot perf summary) after a served request."""
+    from nmfx.datasets import two_group_matrix
+    from nmfx.exec_cache import ExecCache
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    cm.reset_perf()
+    a = two_group_matrix(n_genes=60, n_per_group=10, seed=3)
+    with NMFXServer(ServeConfig(), exec_cache=ExecCache()) as srv:
+        srv.submit(a, ks=(2,), restarts=2, seed=11,
+                   solver_cfg=SolverConfig(max_iter=30)).result(
+                       timeout=600)
+        snap = srv.stats_snapshot()
+        text = srv.metrics_text()
+    assert "serve" in snap["perf"]["kinds"]
+    assert snap["perf"]["kinds"]["serve"]["dispatches"] >= 1
+    assert 'nmfx_perf_achieved_flops_count{kind="serve"}' in text
+    assert 'nmfx_perf_arithmetic_intensity_count{kind="serve"}' in text
+
+
+# ---------------------------------------------------------------------
+# regression observatory (nmfx.obs.regress / nmfx-perf)
+# ---------------------------------------------------------------------
+
+def _repo_root():
+    import nmfx
+
+    import os
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(nmfx.__file__)))
+
+
+def test_regress_loads_all_shipped_rounds_and_reports():
+    from nmfx.obs import regress
+
+    rounds = regress.load_rounds(_repo_root())
+    assert [r["round"] for r in rounds] == [1, 2, 3, 4, 5]
+    # schema drift normalized: r01 predates mfu, r05 has it
+    assert "mfu" not in rounds[0]["metrics"]
+    assert "mfu" in rounds[4]["metrics"]
+    report = regress.markdown_report(rounds, regress.compare(rounds))
+    assert "consensus_sweep_wall_s" in report
+    assert "r01" in report and "r05" in report
+
+
+def test_regress_path_selectors_and_wrapper_forms():
+    from nmfx.obs import regress
+
+    rec = {"parsed": {"metric": "consensus_sweep_wall_s", "value": 2.0,
+                      "detail": {"serve": {"ladder": [
+                          {"offered_load": 0.5, "p50_latency_s": 9.0},
+                          {"offered_load": 1.0, "p50_latency_s": 3.0},
+                      ]}}}}
+    got = regress.extract_metrics(rec)
+    assert got["consensus_sweep_wall_s"] == 2.0
+    assert got["serve_p50_latency_s"] == 3.0
+    # bare (unwrapped) records normalize identically
+    assert regress.extract_metrics(rec["parsed"]) == got
+
+
+def test_regress_verdict_red_on_degraded_r05_copy(tmp_path):
+    """The acceptance scenario: a synthetically degraded copy of
+    BENCH_r05 as the newest round flips the verdict red (exit 2
+    through the nmfx-perf entrypoint), while a copy of the best round
+    stays green."""
+    import os
+
+    from nmfx.obs import regress
+
+    root = _repo_root()
+    for name in os.listdir(root):
+        if name.startswith("BENCH_r0") and name.endswith(".json"):
+            shutil.copy(os.path.join(root, name), tmp_path / name)
+    with open(tmp_path / "BENCH_r03.json") as f:
+        best = json.load(f)
+
+    # green control first: the best round re-measured as r06
+    shutil.copy(tmp_path / "BENCH_r03.json",
+                tmp_path / "BENCH_r06.json")
+    assert regress.main(["--dir", str(tmp_path)]) == 0
+
+    degraded = json.loads(json.dumps(best))
+    degraded["parsed"]["value"] *= 2.0
+    degraded["parsed"]["detail"]["restarts_per_s"] /= 2.0
+    with open(tmp_path / "BENCH_r06.json", "w") as f:
+        json.dump(degraded, f)
+    assert regress.main(["--dir", str(tmp_path),
+                         "--json", str(tmp_path / "verdict.json"),
+                         "--markdown",
+                         str(tmp_path / "trend.md")]) == 2
+    with open(tmp_path / "verdict.json") as f:
+        verdict = json.load(f)
+    assert verdict["status"] == "regression"
+    regressed = {r["metric"] for r in verdict["regressions"]}
+    assert "consensus_sweep_wall_s" in regressed
+    assert "restarts_per_s" in regressed
+    # every regression names the round that set the bar
+    assert all(r["best_round"] for r in verdict["regressions"])
+    trend = (tmp_path / "trend.md").read_text()
+    assert "Regressions" in trend
+
+
+def test_regress_candidate_mode_and_missing_metric(tmp_path):
+    """--candidate judges an out-of-tree record against all loaded
+    rounds; a metric priors had but the candidate lacks is reported
+    as missing, not silently dropped."""
+    from nmfx.obs import regress
+
+    rounds = regress.load_rounds(_repo_root())
+    cand = {"file": "x", "metrics": {"consensus_sweep_wall_s": 1.30}}
+    verdict = regress.compare(rounds, cand)
+    assert verdict["status"] == "ok"
+    assert any(m["metric"] == "restarts_per_s"
+               for m in verdict["missing"])
+    improved = {m["metric"] for m in verdict["improvements"]}
+    assert "consensus_sweep_wall_s" in improved  # beats r03's 1.384
+
+
+def test_regress_zero_bar_stays_judgeable():
+    """A best-prior bar of exactly 0 (a rounded-to-zero overhead
+    fraction) must not make the metric permanently unjudgeable: a
+    clearly-worse candidate still regresses, an equal one stays ok."""
+    from nmfx.obs import regress
+
+    rounds = [{"round": 1, "file": "BENCH_r01.json",
+               "metrics": {"obs_overhead_frac": 0.0}}]
+    bad = regress.compare(rounds, {"file": "x", "metrics":
+                                   {"obs_overhead_frac": 0.5}})
+    assert any(r["metric"] == "obs_overhead_frac"
+               for r in bad["regressions"])
+    same = regress.compare(rounds, {"file": "x", "metrics":
+                                    {"obs_overhead_frac": 0.0}})
+    assert same["status"] == "ok"
+
+
+def test_attribution_aggregate_mfu_uses_device_seconds():
+    """perf_summary's MFU divides by DEVICE-seconds: the same dispatch
+    attributed over 4 devices reports a quarter of the single-device
+    aggregate MFU (matching the per-record math)."""
+    import jax
+
+    kind = str(jax.devices()[0].device_kind)
+    cm.reset_perf()
+    scfg = SolverConfig(algorithm="mu", max_iter=50)
+    try:
+        cm.set_device_peak(kind, 1e12, 1e12)
+        cm.attribute_dispatch("one.dev", scfg, M, N, {3: [20]}, 0.1,
+                              devices=1)
+        cm.attribute_dispatch("four.dev", scfg, M, N, {3: [20]}, 0.1,
+                              devices=4)
+        kinds = cm.perf_summary()["kinds"]
+        assert kinds["one.dev"]["mfu"] == pytest.approx(
+            4 * kinds["four.dev"]["mfu"])
+        recs = {r["kind"]: r for r in cm.recent_attributions()}
+        assert kinds["four.dev"]["mfu"] == pytest.approx(
+            recs["four.dev"]["mfu"])
+    finally:
+        with cm._peaks_lock:
+            cm.DEVICE_PEAKS.pop(kind, None)
+
+
+def test_regress_no_rounds(tmp_path):
+    from nmfx.obs import regress
+
+    assert regress.load_rounds(str(tmp_path)) == []
+    assert regress.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_regress_corrupt_round_skipped(tmp_path):
+    from nmfx.obs import regress
+
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    shutil.copy(_repo_root() + "/BENCH_r05.json",
+                tmp_path / "BENCH_r05.json")
+    rounds = regress.load_rounds(str(tmp_path))
+    assert [r["round"] for r in rounds] == [5]

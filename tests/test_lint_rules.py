@@ -1091,3 +1091,119 @@ def test_nmfx008_rule_registered():
     from nmfx.analysis import RULES
 
     assert "NMFX008" in RULES
+
+
+# ---------------------------------------------------------------- NMFX009
+# engine-family cost-model coverage (ISSUE 13): every reachable
+# (algorithm, engine-family) pair must have a FLOPs+bytes model in
+# nmfx.obs.costmodel, the exemption list must stay honest, and no model
+# entry may go stale. Same pure-check + mutated-universe shape as
+# NMFX001/NMFX007/NMFX008.
+
+def _perf_universe(**over):
+    base = dict(
+        universe=frozenset({("mu", "packed"), ("mu", "vmap"),
+                            ("kl", "vmap")}),
+        covered=frozenset({("mu", "packed"), ("mu", "vmap"),
+                           ("kl", "vmap")}),
+        exempt=("pg",),
+        algorithms=frozenset({"mu", "kl", "pg"}))
+    base.update(over)
+    return base
+
+
+def test_nmfx009_clean_universe_quiet():
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    assert check_costmodel_coverage(**_perf_universe()) == []
+
+
+def test_nmfx009_live_tree_clean():
+    """The shipped tree must satisfy its own coverage contract: every
+    engine the routing tables can reach has a model (the tier-1
+    zero-findings gate covers the Rule wrapper; this pins the pure
+    check on the live universe directly)."""
+    from nmfx.analysis.rules_perf import _live_universe
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    assert check_costmodel_coverage(**_live_universe()) == []
+
+
+def test_nmfx009_missing_model_fires():
+    """A reachable engine without a model is the mfu-None blind spot
+    the rule exists for (bad universe)."""
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    problems = check_costmodel_coverage(**_perf_universe(
+        covered=frozenset({("mu", "packed"), ("mu", "vmap")})))
+    assert len(problems) == 1
+    assert "'kl'" in problems[0] and "no cost model" in problems[0]
+
+
+def test_nmfx009_stale_model_entry_fires():
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    problems = check_costmodel_coverage(**_perf_universe(
+        covered=frozenset({("mu", "packed"), ("mu", "vmap"),
+                           ("kl", "vmap"), ("kl", "pallas")})))
+    assert len(problems) == 1
+    assert "stale entry" in problems[0]
+
+
+def test_nmfx009_modeled_exempt_fires():
+    """An algorithm both exempt and modeled is a contradiction — one
+    of the two declarations is rotten."""
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    problems = check_costmodel_coverage(**_perf_universe(
+        covered=frozenset({("mu", "packed"), ("mu", "vmap"),
+                           ("kl", "vmap"), ("pg", "vmap")})))
+    # fires twice by design: the entry is unreachable (exempt
+    # algorithms are outside the universe) AND contradicts the
+    # exemption — both messages point at the same rotten declaration
+    assert len(problems) == 2
+    assert any("COSTMODEL_EXEMPT" in p for p in problems)
+    assert any("stale entry" in p for p in problems)
+
+
+def test_nmfx009_stale_exemption_fires():
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    problems = check_costmodel_coverage(**_perf_universe(
+        exempt=("pg", "ghost")))
+    assert len(problems) == 1
+    assert "'ghost'" in problems[0]
+
+
+def test_nmfx009_rule_fires_on_mutated_live_table(monkeypatch):
+    """End-to-end through the Rule wrapper: dropping a live model
+    entry turns the tree red, anchored at the _FLOPS declaration in
+    the analyzed costmodel.py."""
+    from nmfx.obs import costmodel as cm_mod
+
+    target = ["nmfx/obs/costmodel.py"]
+    findings = [f for f in run(target, jaxpr=False,
+                               rule_ids=["NMFX009"])
+                if f.rule_id == "NMFX009"]
+    assert findings == []  # live tree compliant
+    broken = dict(cm_mod._FLOPS)
+    broken.pop(("snmf", "packed"))
+    monkeypatch.setattr(cm_mod, "_FLOPS", broken)
+    findings = [f for f in run(target, jaxpr=False,
+                               rule_ids=["NMFX009"])
+                if f.rule_id == "NMFX009"]
+    assert len(findings) == 1
+    assert "'snmf'" in findings[0].message
+    import inspect
+
+    src_lines, decl = inspect.getsourcelines(cm_mod)
+    flops_line = next(i for i, line
+                      in enumerate(src_lines, start=decl or 1)
+                      if line.startswith("_FLOPS ="))
+    assert findings[0].line == flops_line
+
+
+def test_nmfx009_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX009" in RULES

@@ -115,6 +115,45 @@ def test_cross_request_packing_bit_identical(small_data, scfg):
                                       seed=29, scfg=scfg))
 
 
+def test_three_request_pack_bit_identical_toy_shape():
+    """Regression: the PR-12-flagged pre-existing violation — a
+    ≥3-request packed dispatch at toy shapes (120×48, maxiter 400,
+    bfloat16 precision) drifted bitwise from the solo runs in
+    dnorms/best_w/best_h (~1 ulp/iteration) while consensus/labels
+    agreed, because the packed pool's wider lane-folded GEMMs
+    partitioned their reductions differently from each request's
+    narrower solo pool on this 8-virtual-device platform. The fix pads
+    every serving-tier dispatch to the same fixed ``grid_slots``-wide
+    pool (``sweep._pad_pool_lanes``) with the tail cascade pinned off,
+    so per-lane GEMM shapes — and reduction order — are
+    composition-independent. This test runs the exact deterministic
+    pause/resume composition that reproduced the bug and asserts full
+    bit-identity for every request, not just the head."""
+    from nmfx.datasets import grouped_matrix
+    from nmfx.exec_cache import ExecCache
+
+    a = grouped_matrix(120, (12,) * 4, effect=2.0, seed=0)
+    scfg3 = SolverConfig(algorithm="mu", max_iter=400,
+                         matmul_precision="bfloat16")
+    seeds = (1012, 123, 456)
+    cache = ExecCache()
+    before = serve.packed_dispatch_count()
+    with NMFXServer(ServeConfig(max_batch_requests=4), exec_cache=cache,
+                    start=False) as srv:
+        futs = [(sd, srv.submit(a, ks=(2, 3), restarts=6, seed=sd,
+                                solver_cfg=scfg3)) for sd in seeds]
+        srv.resume()
+        results = [(sd, f.result(timeout=600)) for sd, f in futs]
+    # all three requests must have shared ONE packed dispatch — a
+    # degraded (solo) composition would not exercise the bug
+    assert serve.packed_dispatch_count() == before + 1
+    assert srv.stats()["packed_requests"] == 3
+    for sd, res in results:
+        assert_result_bit_equal(
+            res, _solo(a, cache, ks=(2, 3), restarts=6, seed=sd,
+                       scfg=scfg3))
+
+
 def test_incompatible_matrices_degrade_to_solo(small_data, scfg):
     """Different input matrices share no resident device buffer: they
     must NOT pack (the DataKey is part of the compatibility key), each

@@ -178,18 +178,14 @@ def test_sketched_refuses_bit_exact_surfaces(tmp_path):
 def test_model_flops_compression():
     """The analytic accounting the bench stage records: at north-star-
     like shapes the sketched per-iteration FLOPs are a small fraction
-    of the exact engine's."""
+    of the exact engine's. Since ISSUE 13 the exact model lives in the
+    costmodel registry (bench's local `_MODEL_FLOPS` trio is gone)."""
+    from nmfx.obs import costmodel
+
     m, n, k = 5000, 500, 10
     r = sk.resolve_dim(SolverConfig(backend="sketched"), m, n, k)
-    import os
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from bench import _mu_model_flops
-
-    ratio = _mu_model_flops(m, n, k) / sk.sketched_model_flops(m, n, k,
-                                                               r)
+    mu_flops = costmodel.iteration_flops("mu", "vmap", m, n, k)
+    ratio = mu_flops / sk.sketched_model_flops(m, n, k, r)
     assert ratio > 5.0  # ~4mnk vs ~4rk(m+n): n/r-ish compression
 
 
