@@ -1286,6 +1286,170 @@ def main():
     # the per-sweep trace event count, the attributed-dispatch count,
     # and the flight-recorder postmortem size, so a span-explosion or
     # event-flood regression shows up as a number, not a vibe.
+    def run_fleet_rung():
+        """Multi-process aggregation rung (ISSUE 14): subprocess
+        publishers write known series into a shared telemetry_dir and
+        the merged fleet view must be EXACT — counters equal the
+        per-instance sums, merged histogram quantiles equal the
+        union-of-observations quantiles — then an injected failure
+        breach (the chaos rung's ``solve.nonfinite`` site quarantining
+        every lane under a ``min_restarts`` floor, so live served
+        requests FAIL) must flip the fleet availability burn alert
+        with the transition landing in the flight recorder, and
+        ``nmfx-top`` must render a non-empty dashboard from the run's
+        live telemetry dir. Exit 2 on any miss."""
+        import shutil
+        import subprocess
+        import tempfile
+        import textwrap
+
+        from nmfx import faults as faults_mod
+        from nmfx.datasets import grouped_matrix
+        from nmfx.obs import flight as obs_flight
+        from nmfx.obs import metrics as obs_metrics
+        from nmfx.obs import slo as obs_slo
+        from nmfx.obs import top as obs_top
+        from nmfx.obs.aggregate import FleetCollector
+        from nmfx.serve import NMFXServer, ServeConfig
+
+        here_dir = os.path.dirname(os.path.abspath(__file__))
+        tdir = tempfile.mkdtemp(prefix="nmfx-bench-fleet-")
+        n_children = 2
+        child_src = textwrap.dedent("""
+            import sys
+            from nmfx.obs import export, metrics
+            tdir, idx = sys.argv[1], int(sys.argv[2])
+            reg = metrics.MetricsRegistry()
+            c = reg.counter("nmfx_serve_dispatches_total",
+                            "dispatches", ("packed",))
+            c.inc(10 + idx, packed="false")
+            h = reg.histogram("nmfx_serve_solve_seconds", "solve wall")
+            for i in range(40):
+                h.observe(0.002 * (i + 1) * (idx + 1))
+            export.TelemetryPublisher(
+                tdir, instance=f"bench-child-{idx}", role="bench",
+                registry=reg).publish_once()
+        """)
+        try:
+            script = os.path.join(tdir, "publisher.py")
+            with open(script, "w") as f:
+                f.write(child_src)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=here_dir + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+            procs = [subprocess.Popen(
+                [sys.executable, script, tdir, str(i)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env) for i in range(n_children)]
+            errs = []
+            for p in procs:
+                _, e = p.communicate(timeout=240)
+                if p.returncode != 0:
+                    errs.append(e[-2000:])
+            if errs:
+                print("bench FLEET FAILURE: subprocess publisher "
+                      f"died: {errs}", file=sys.stderr)
+                raise SystemExit(2)
+            collector = FleetCollector(tdir, stale_after_s=600.0)
+            snap = collector.fleet_snapshot()
+            got = snap["nmfx_serve_dispatches_total"]["series"][
+                ("false",)]
+            want = sum(10 + i for i in range(n_children))
+            if got != want:
+                print("bench FLEET FAILURE: merged counter "
+                      f"{got} != exact per-instance sum {want}",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            # merged quantiles vs one histogram over the union of every
+            # child's observations — equality, not tolerance
+            union = obs_metrics.MetricsRegistry().histogram(
+                "bench_fleet_union_seconds", "")
+            for idx in range(n_children):
+                for i in range(40):
+                    union.observe(0.002 * (i + 1) * (idx + 1))
+            for q in (0.5, 0.9, 0.99):
+                mq = collector.quantile("nmfx_serve_solve_seconds", q,
+                                        snapshot=snap)
+                uq = union.quantile(q)
+                if mq != uq:
+                    print("bench FLEET FAILURE: merged quantile "
+                          f"q={q} {mq} != union quantile {uq}",
+                          file=sys.stderr)
+                    raise SystemExit(2)
+            # SLO breach: serve requests whose every lane the armed
+            # chaos site quarantines (InsufficientRestarts =>
+            # outcome=failed on the live e2e histogram), published
+            # into the same ledger
+            engine = obs_slo.SLOEngine(
+                snapshot_fn=collector.fleet_snapshot)
+            a_f = grouped_matrix(60, (4, 4, 4, 4), effect=2.0, seed=0)
+            ks_f, restarts_f = (2,), 2
+            faults_mod.arm("solve.nonfinite",
+                           lanes=tuple((ks_f[0], r)
+                                       for r in range(restarts_f)))
+            try:
+                with NMFXServer(ServeConfig(
+                        pack=False, telemetry_dir=tdir,
+                        telemetry_interval_s=0.2)) as srv:
+                    # baseline AFTER the server's first publish: the
+                    # published registry is process-CUMULATIVE, so the
+                    # earlier bench stages' e2e history must be in the
+                    # t0 cut — the windowed delta below is then exactly
+                    # this rung's injected failures, whether the rung
+                    # runs standalone or after the full traffic stage
+                    srv._publisher.publish_once()
+                    t0 = time.time()
+                    engine.evaluate(now=t0)
+                    futs = [srv.submit(
+                        a_f, ks=ks_f, restarts=restarts_f,
+                        min_restarts=restarts_f,
+                        solver_cfg=SolverConfig(max_iter=60))
+                        for _ in range(3)]
+                    failed = sum(
+                        1 for f in futs
+                        if f.exception(timeout=240) is not None)
+            finally:
+                faults_mod.disarm("solve.nonfinite")
+            if failed != 3:
+                print("bench FLEET FAILURE: expected every "
+                      "quarantined request to fail typed, got "
+                      f"{failed}/3", file=sys.stderr)
+                raise SystemExit(2)
+            status = engine.evaluate(now=t0 + 300)
+            avail = status["objectives"]["availability"]
+            transitions = obs_flight.default_recorder().events(
+                "slo.transition")
+            flipped = [e for e in transitions
+                       if e["objective"] == "availability"
+                       and e["to_state"] == "fast_burn"]
+            if avail["state"] != "fast_burn" or not flipped:
+                print("bench FLEET FAILURE: injected failure breach "
+                      "did not flip the availability burn alert "
+                      f"(state={avail['state']}, "
+                      f"transitions={len(flipped)})", file=sys.stderr)
+                raise SystemExit(2)
+            # nmfx-top renders a non-empty dashboard from the live dir
+            frame = obs_top.gather(
+                FleetCollector(tdir, stale_after_s=600.0),
+                obs_slo.SLOEngine(snapshot_fn=collector.fleet_snapshot))
+            text = obs_top.render_text(frame, tdir)
+            if "bench-child-0" not in text \
+                    or "slo availability" not in text:
+                print("bench FLEET FAILURE: nmfx-top rendered an "
+                      f"empty/incomplete dashboard:\n{text}",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            return {
+                "instances": len(frame["instances"]),
+                "counter_merge": "exact",
+                "quantile_merge": "exact",
+                "slo_alert_flip": "ok",
+                "top_render": "ok",
+                "failed_requests": failed,
+            }
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+
     def run_obs_stage():
         from nmfx.obs import costmodel, flight, metrics, trace
 
@@ -1351,7 +1515,11 @@ def main():
                   "attribution wiring is dead (sweep/exec_cache "
                   "_attribute_dispatch)", file=sys.stderr)
             raise SystemExit(2)
+        fleet = run_fleet_rung()
+        print(f"bench: fleet aggregation rung: {json.dumps(fleet)}",
+              file=sys.stderr)
         return {
+            "fleet": fleet,
             "wall_untraced_s": round(off, 3),
             "wall_traced_s": round(on, 3),
             "overhead_frac": round(overhead_frac, 4),
@@ -2055,11 +2223,27 @@ def main():
 
         here = os.path.dirname(os.path.abspath(__file__))
         rounds = obs_regress.load_rounds(here)
-        verdict = obs_regress.compare(
-            rounds, {"file": "<this run>",
-                     "metrics": obs_regress.extract_metrics(record)})
+        candidate = {"file": "<this run>",
+                     "metrics": obs_regress.extract_metrics(record)}
+        verdict = obs_regress.compare(rounds, candidate)
         print(f"bench: regression verdict: {json.dumps(verdict)}",
               file=sys.stderr)
+        # the verdict used to be exit-code-only: the markdown trend
+        # report now lands as an artifact next to the BENCH_r*.json
+        # rounds it judges (the nmfx-perf rendering), so the round's
+        # reviewer reads the metric x round table without re-running
+        # the judge
+        trend_path = os.path.join(here, "PERF_TREND.md")
+        try:
+            with open(trend_path, "w") as f:
+                f.write(obs_regress.markdown_report(
+                    rounds + [candidate], verdict) + "\n")
+            print(f"bench: trend report written to {trend_path}",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"bench: could not write trend report "
+                  f"({e}); the verdict above still stands",
+                  file=sys.stderr)
         if verdict["status"] == "regression":
             for row in verdict["regressions"]:
                 print(

@@ -1,4 +1,5 @@
 """NMFX008 — fault-site flight-recorder coverage.
+NMFX010 — registry metric naming + docs-table coverage.
 
 The failure class: a chaos rehearsal whose postmortem is silent about
 its own injected failure. ISSUE 10's flight recorder
@@ -28,6 +29,7 @@ the live modules and anchors findings at the ``SITES`` declaration.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable
 
 from nmfx.analysis.core import Finding, Rule, register
@@ -113,3 +115,150 @@ class FaultFlightCoverage(Rule):
         line = _sites_decl_line(analyzed.tree)
         return [self.finding(analyzed.path, line, msg)
                 for msg in check_fault_event_coverage(**_live_universe())]
+
+
+# --------------------------------------------------------------------------
+# NMFX010 — registry metric naming + docs-table coverage (ISSUE 14)
+# --------------------------------------------------------------------------
+# The failure class: a fleet namespace is only mergeable and queryable
+# while its names stay disciplined. The collector (nmfx.obs.aggregate)
+# merges N processes' registries BY NAME, dashboards and SLO
+# objectives address series BY NAME, and docs/observability.md's
+# metric table is the operator's index of what exists. A metric that
+# breaks the ``nmfx_<subsystem>_<what>[_<unit>]`` scheme (or a counter
+# without the ``_total`` convention) scrapes wrong; a live metric
+# missing from the docs table is invisible to operators; a documented
+# name with no live metric is a stale row that misdirects queries. The
+# rule cross-references the LIVE registry (every declaring module
+# imported, names filtered to the ``nmfx_`` namespace — test fixtures
+# register foreign names in-process) against the names in
+# docs/observability.md's tables, both ways, via a pure check tests
+# can feed mutated universes.
+
+#: the naming scheme: nmfx_ + at least <subsystem>_<what>, lowercase
+#: alphanumeric segments (Prometheus-clean; docs/observability.md
+#: "Metric naming")
+_METRIC_NAME_RE = re.compile(r"nmfx(_[a-z][a-z0-9]*){2,}")
+
+#: a docs metric-table row's first cell: | `nmfx_...{labels}` | ...
+_DOC_ROW_RE = re.compile(r"^\s*\|\s*`(nmfx_[a-z0-9_]+)(?:\{[^}]*\})?`")
+
+
+def check_metric_naming(live: "dict[str, str]",
+                        documented: "frozenset[str]") -> "list[str]":
+    """The pure contract check: every live ``nmfx_*`` registry metric
+    must match the naming scheme, carry the type-appropriate suffix
+    (counters end ``_total``; nothing else may), and appear in the
+    docs metric table; every documented name must exist live (no
+    stale rows). ``live`` maps name -> instrument kind."""
+    problems: "list[str]" = []
+    for name in sorted(live):
+        kind = live[name]
+        if not _METRIC_NAME_RE.fullmatch(name):
+            problems.append(
+                f"metric {name!r} breaks the naming scheme "
+                "nmfx_<subsystem>_<what>[_<unit>] (lowercase "
+                "alphanumeric segments; docs/observability.md "
+                "'Metric naming') — the fleet collector and every "
+                "dashboard/SLO query address series by name, so the "
+                "scheme is the namespace contract")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"counter {name!r} must end in '_total' (the "
+                "Prometheus counter convention the naming scheme "
+                "adopts)")
+        elif kind != "counter" and name.endswith("_total"):
+            problems.append(
+                f"{kind} {name!r} ends in '_total', which declares a "
+                "counter to every Prometheus consumer — rename it or "
+                "make it a counter")
+        if name not in documented:
+            problems.append(
+                f"metric {name!r} is live in the registry but missing "
+                "from the docs/observability.md metric table — an "
+                "undocumented series is invisible to operators; add a "
+                "table row")
+    for name in sorted(documented - live.keys()):
+        problems.append(
+            f"docs/observability.md documents metric {name!r}, which "
+            "is not live in the registry — stale row; a renamed "
+            "metric would ship while the table still claims the old "
+            "name")
+    return problems
+
+
+def _documented_metrics(doc_path: str) -> frozenset:
+    """Metric names from docs/observability.md's table rows (first
+    cell, backticked, optional ``{labels}`` suffix)."""
+    names = set()
+    with open(doc_path, encoding="utf-8") as f:
+        for line in f:
+            m = _DOC_ROW_RE.match(line)
+            if m:
+                names.add(m.group(1))
+    return frozenset(names)
+
+
+def _live_metrics() -> "dict[str, str]":
+    """Name -> kind of every ``nmfx_``-namespaced metric on the live
+    registry, with every instrument-declaring module imported first
+    (declarations are module-level, so importing is registering).
+    Foreign (non-``nmfx_``) names — test fixtures register plenty
+    in-process — are out of scope."""
+    import importlib
+
+    for mod in ("nmfx.exec_cache", "nmfx.data_cache", "nmfx.serve",
+                "nmfx.checkpoint", "nmfx.distributed",
+                "nmfx.obs.costmodel", "nmfx.obs.export",
+                "nmfx.obs.slo"):
+        importlib.import_module(mod)
+    from nmfx.obs import metrics as obs_metrics
+
+    snap = obs_metrics.registry().snapshot()
+    return {name: rec["type"] for name, rec in snap.items()
+            if name.startswith("nmfx_")}
+
+
+@register
+class MetricNamingCoverage(Rule):
+    """NMFX010: every live ``nmfx_*`` registry metric must match the
+    ``nmfx_<subsystem>_<what>[_<unit>]`` scheme (counters end
+    ``_total``) AND appear in docs/observability.md's metric table;
+    no documented name may go stale."""
+
+    rule_id = "NMFX010"
+    title = "registry metric naming + docs-table coverage"
+
+    def check(self, project) -> "Iterable[Finding]":
+        # semantic whole-package rule, gated like NMFX008: runs only
+        # when the real registry module is analyzed, and only against
+        # the checkout the import machinery resolves
+        import inspect
+        import os
+
+        analyzed = next(
+            (m for m in project.modules
+             if m.path.replace("\\", "/")
+             .endswith("nmfx/obs/metrics.py")),
+            None)
+        if analyzed is None:
+            return []
+        from nmfx.obs import metrics as obs_metrics
+
+        live_file = inspect.getsourcefile(obs_metrics) or analyzed.path
+        if os.path.abspath(live_file) != os.path.abspath(analyzed.path):
+            # NMFX001 already reports the wrong-tree condition loudly
+            return []
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(analyzed.path))))
+        doc_path = os.path.join(repo, "docs", "observability.md")
+        if not os.path.isfile(doc_path):
+            return [self.finding(
+                analyzed.path, 1,
+                "docs/observability.md (the metric table NMFX010 "
+                "cross-references) does not exist next to this "
+                "checkout — the metric namespace has no operator "
+                "index")]
+        return [self.finding(analyzed.path, 1, msg)
+                for msg in check_metric_naming(
+                    _live_metrics(), _documented_metrics(doc_path))]

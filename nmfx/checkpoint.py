@@ -485,9 +485,16 @@ class SweepCheckpoint:
         cross-process deployments read :meth:`shard_status` to detect
         shards whose heartbeat went stale and re-dispatch their
         incomplete chunks (completion records are the ground truth — a
-        re-dispatched chunk that WAS committed is simply skipped)."""
+        re-dispatched chunk that WAS committed is simply skipped).
+        The payload always carries the writing process's pid (plus any
+        caller fields — the elastic runner adds its cross-process
+        ``trace_id``), so a fleet view over N sharding processes can
+        attribute each shard heartbeat to its process and join it with
+        that process's telemetry snapshots and trace exports
+        (docs/observability.md "Fleet telemetry")."""
         path = os.path.join(self.directory, f"shard_{shard}.json")
-        payload = dict(info, shard=shard, time=time.time())
+        payload = dict(info, shard=shard, pid=os.getpid(),
+                       time=time.time())
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "wt") as f:

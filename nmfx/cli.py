@@ -352,6 +352,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "attribution'). Runs the sweep with phase "
                         "timing enabled (the --profile discipline) so "
                         "the attributed walls are honest")
+    p.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                   help="with --serve-smoke: publish this process's "
+                        "telemetry snapshots (registry + instance "
+                        "identity + heartbeat) into DIR every "
+                        "couple of seconds (ServeConfig.telemetry_dir "
+                        "— the fleet-observatory ledger; merge N "
+                        "processes with nmfx.obs.aggregate, watch "
+                        "them live with nmfx-top DIR; "
+                        "docs/observability.md 'Fleet telemetry')")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="with --serve-smoke: also serve the registry's "
+                        "Prometheus exposition over HTTP on PORT "
+                        "(0 = ephemeral, printed to stderr) for "
+                        "scraper-based deployments "
+                        "(ServeConfig.metrics_port)")
+    p.add_argument("--slo", action="store_true",
+                   help="with --serve-smoke: print the server's SLO "
+                        "burn-rate status (nmfx.obs.slo — "
+                        "availability, p99 latency bound, "
+                        "goodput/MFU floors as multi-window burn "
+                        "rates) to stderr after the run")
     p.add_argument("--flight-dir", default=None, metavar="DIR",
                    help="arm the crash flight recorder's disk dump: on "
                         "a serve scheduler crash or SIGTERM the last "
@@ -616,6 +638,23 @@ def _run_cli(argv: list[str] | None = None) -> int:
     if args.warm_cache and not args.warm_shapes:
         parser.error("--warm-cache backgrounds the --warm-shapes warmup; "
                      "pass --warm-shapes with the shapes to pre-compile")
+    # fleet-telemetry flags ride the serving engine's config: without
+    # a server there is no publisher/endpoint/SLO engine to configure
+    # — reject-don't-drop, the compose-guard discipline
+    if args.telemetry_dir is not None and not args.serve_smoke:
+        parser.error("--telemetry-dir configures the serving engine's "
+                     "telemetry publisher (ServeConfig.telemetry_dir); "
+                     "pass --serve-smoke")
+    if args.metrics_port is not None and not args.serve_smoke:
+        parser.error("--metrics-port configures the serving engine's "
+                     "Prometheus endpoint (ServeConfig.metrics_port); "
+                     "pass --serve-smoke")
+    if args.metrics_port is not None \
+            and not 0 <= args.metrics_port <= 65535:
+        parser.error("--metrics-port must be in [0, 65535]")
+    if args.slo and not args.serve_smoke:
+        parser.error("--slo reports the serving engine's SLO burn "
+                     "status; pass --serve-smoke")
     if args.serve_smoke:
         if mesh is not None:
             parser.error("--serve-smoke owns ONE device (the serving "
@@ -776,8 +815,13 @@ def _serve_smoke(args, run_scfg, exec_cache, output, profiler):
     from nmfx.config import InitConfig
     from nmfx.serve import NMFXServer, ServeConfig
 
-    with NMFXServer(ServeConfig(), exec_cache=exec_cache,
+    serve_cfg = ServeConfig(telemetry_dir=args.telemetry_dir,
+                            metrics_port=args.metrics_port)
+    with NMFXServer(serve_cfg, exec_cache=exec_cache,
                     profiler=profiler) as srv:
+        if srv.metrics_port is not None:
+            print(f"nmfx: serving /metrics on 127.0.0.1:"
+                  f"{srv.metrics_port}", file=sys.stderr)
         fut = srv.submit(args.dataset, ks=args.ks,
                          restarts=args.restarts, seed=args.seed,
                          solver_cfg=run_scfg,
@@ -787,6 +831,18 @@ def _serve_smoke(args, run_scfg, exec_cache, output, profiler):
                          grid_slots=args.grid_slots,
                          grid_tail_slots=args.grid_tail_slots)
         result = fut.result()
+        if args.slo:
+            slo_status = srv.stats_snapshot()["slo"]
+            for name, obj in sorted(slo_status["objectives"].items()):
+                burns = " ".join(
+                    f"{w}={'n/a' if b is None else round(b, 3)}"
+                    for w, b in obj["burn"].items())
+                print(f"nmfx: slo {name}: state={obj['state']} "
+                      f"burn[{burns}]", file=sys.stderr)
+    if args.telemetry_dir is not None:
+        print(f"nmfx: telemetry published to {args.telemetry_dir} "
+              f"(fleet view: nmfx-top {args.telemetry_dir})",
+              file=sys.stderr)
     s = srv.stats()
     st = fut.stats
 

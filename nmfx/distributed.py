@@ -29,13 +29,32 @@ CPU tests exercise this exact code path (SURVEY.md §4).
 from __future__ import annotations
 
 import collections
+import itertools
+import os
 import threading
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from nmfx.obs import metrics as _metrics
+from nmfx.obs import trace as _trace
 from nmfx.sweep import RESTART_AXIS
+
+#: elastic-runner fleet instruments (ISSUE 14): per-shard progress as
+#: labeled counters (the fleet view sums them; the shard label keeps
+#: the per-shard drill-down) and the live-shard level gauge
+_units_solved_total = _metrics.counter(
+    "nmfx_elastic_units_solved_total",
+    "work units solved and committed by elastic shards",
+    labelnames=("shard",))
+_shards_alive_gauge = _metrics.gauge(
+    "nmfx_elastic_shards_alive",
+    "elastic shards currently alive in this process's runner")
+
+#: per-process elastic run sequence — with the pid it forms the
+#: cross-process trace id shard heartbeats and spans carry
+_run_seq = itertools.count()
 
 
 def initialize(coordinator_address: str | None = None,
@@ -196,7 +215,8 @@ class ElasticShardRunner:
     (tests/test_distributed.py pins it on forced CPU devices).
     """
 
-    def __init__(self, ck, ccfg, scfg, icfg, arr, devices=None):
+    def __init__(self, ck, ccfg, scfg, icfg, arr, devices=None,
+                 telemetry_dir=None, trace_id=None):
         self.ck = ck
         self.ccfg = ccfg
         self.scfg = scfg
@@ -206,6 +226,15 @@ class ElasticShardRunner:
                             if devices is None else devices)
         if not self.devices:
             raise ValueError("need at least one device")
+        #: cross-process sweep identity (ISSUE 14): every shard
+        #: heartbeat in the ledger and every elastic.unit trace span
+        #: carries it, so N processes sharding one ledger join into one
+        #: merged timeline (trace.merge_traces) and one fleet view
+        self.trace_id = trace_id if trace_id is not None else \
+            f"elastic-{os.getpid()}-{next(_run_seq)}"
+        #: telemetry ledger (nmfx.obs.export): run() publishes this
+        #: process's registry snapshots here for the fleet collector
+        self.telemetry_dir = telemetry_dir
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending = collections.deque(
@@ -224,6 +253,7 @@ class ElasticShardRunner:
         a_dev = jax.device_put(
             place_input(self.arr, self.scfg, None), dev)
         key_cache: dict = {}
+        tracer = _trace.default_tracer()
         while True:
             with self._cond:
                 # an empty queue is NOT the end while units are still in
@@ -235,7 +265,8 @@ class ElasticShardRunner:
                     self._cond.wait()
                 if not self._pending:
                     self.ck.heartbeat(idx, alive=True, done=done,
-                                      unit=None)
+                                      unit=None, trace_id=self.trace_id)
+                    _shards_alive_gauge.inc(-1)
                     return
                 unit = self._pending.popleft()
                 self._inflight += 1
@@ -246,9 +277,14 @@ class ElasticShardRunner:
                         jax.random.fold_in(jax.random.key(self.ccfg.seed),
                                            k),
                         self.ccfg.restarts), dev)
-                rec = ckpt.solve_chunk_host(a_dev, k, r0, r1, self.ccfg,
-                                            self.scfg, self.icfg,
-                                            keys=key_cache[k])
+                with tracer.span("elastic.unit", cat="elastic",
+                                 args={"shard": idx, "k": k, "r0": r0,
+                                       "r1": r1,
+                                       "trace_id": self.trace_id}):
+                    rec = ckpt.solve_chunk_host(a_dev, k, r0, r1,
+                                                self.ccfg, self.scfg,
+                                                self.icfg,
+                                                keys=key_cache[k])
             except ckpt.Preempted:
                 # shard death: hand the in-flight unit back so a
                 # survivor re-runs it (same keys => same results), and
@@ -258,7 +294,9 @@ class ElasticShardRunner:
                     self._inflight -= 1
                     self.dead_shards.append(idx)
                     self._cond.notify_all()
-                self.ck.heartbeat(idx, alive=False, done=done, unit=unit)
+                self.ck.heartbeat(idx, alive=False, done=done, unit=unit,
+                                  trace_id=self.trace_id)
+                _shards_alive_gauge.inc(-1)
                 return
             except BaseException as e:  # real crash: recorded (raised
                 from nmfx.faults import warn_once  # by run() only if
@@ -269,7 +307,9 @@ class ElasticShardRunner:
                     self.dead_shards.append(idx)
                     self._errors.append(e)
                     self._cond.notify_all()
-                self.ck.heartbeat(idx, alive=False, done=done, unit=unit)
+                self.ck.heartbeat(idx, alive=False, done=done, unit=unit,
+                                  trace_id=self.trace_id)
+                _shards_alive_gauge.inc(-1)
                 warn_once(
                     "elastic-shard-crash",
                     f"elastic shard {idx} ({dev}) crashed on unit "
@@ -278,7 +318,9 @@ class ElasticShardRunner:
                 return
             self.ck.save(k, r0, r1, rec)
             done += 1
-            self.ck.heartbeat(idx, alive=True, done=done, unit=unit)
+            _units_solved_total.inc(shard=str(idx))
+            self.ck.heartbeat(idx, alive=True, done=done, unit=unit,
+                              trace_id=self.trace_id)
             with self._cond:
                 self._records[unit] = rec
                 self._inflight -= 1
@@ -290,6 +332,20 @@ class ElasticShardRunner:
         process solved. Units already committed in the ledger are
         loaded at finalize, not re-run (zero stranded AND zero wasted
         committed work)."""
+        publisher = None
+        if self.telemetry_dir is not None:
+            # per-shard publishing (ISSUE 14): this process's registry
+            # snapshots — the per-shard nmfx_elastic_* series included
+            # — land in the shared telemetry ledger while the sweep
+            # runs, so a fleet view over N sharding processes sees
+            # every shard's progress and liveness
+            from nmfx.obs.export import TelemetryPublisher
+
+            publisher = TelemetryPublisher(
+                self.telemetry_dir, role="elastic",
+                instance=f"elastic-{os.getpid()}",
+                interval_s=1.0).start()
+        _shards_alive_gauge.set(len(self.devices))
         threads = [threading.Thread(target=self._worker, args=(i, d),
                                     daemon=True,
                                     name=f"nmfx-elastic-{i}")
@@ -298,6 +354,8 @@ class ElasticShardRunner:
             t.start()
         for t in threads:
             t.join()
+        if publisher is not None:
+            publisher.close()
         # every_s-buffered records land NOW — before the all-dead error
         # below claims "the committed records remain", and before the
         # process can exit with a 'durable' run that never touched disk
@@ -321,7 +379,7 @@ def elastic_consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, *,
                       checkpoint, seed: int = 123, solver_cfg=None,
                       init_cfg=None, label_rule: str = "argmax",
                       linkage: str = "average", min_restarts: int = 1,
-                      devices=None):
+                      devices=None, telemetry_dir=None):
     """Durable, elastic restart-grid consensus sweep: the (k x chunk)
     units of ``checkpoint``'s plan are dispatched across ``devices``
     (default: all local devices) by :class:`ElasticShardRunner`; a
@@ -329,8 +387,11 @@ def elastic_consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, *,
     is bit-identical to a single-device checkpointed run of the same
     plan. ``checkpoint`` is an ``nmfx.CheckpointConfig`` or a directory
     path; a partially-complete ledger resumes (only missing units
-    dispatch). Returns the same ``ConsensusResult`` as
-    ``nmfconsensus``."""
+    dispatch). ``telemetry_dir`` publishes this process's registry
+    snapshots (per-shard progress included) into a shared fleet-
+    telemetry ledger while the sweep runs (``nmfx.obs.export``;
+    docs/observability.md "Fleet telemetry"). Returns the same
+    ``ConsensusResult`` as ``nmfconsensus``."""
     from nmfx import checkpoint as ckpt
     from nmfx.api import ConsensusResult, _as_matrix, _build_k_result
     from nmfx.config import (CheckpointConfig, ConsensusConfig,
@@ -352,7 +413,8 @@ def elastic_consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, *,
     icfg = init_cfg if init_cfg is not None else InitConfig()
     ck = ckpt.SweepCheckpoint.open(arr, ccfg, scfg, icfg, checkpoint)
     runner = ElasticShardRunner(ck, ccfg, scfg, icfg, arr,
-                                devices=devices)
+                                devices=devices,
+                                telemetry_dir=telemetry_dir)
     solved = runner.run()
     per_k = {}
     for k in ccfg.ks:

@@ -35,17 +35,44 @@ The performance observatory (ISSUE 13) rides the same substrate:
   the newest round against the best prior one under noise-aware
   per-metric thresholds, and renders the trend report.
 
+The fleet observatory (ISSUE 14) turns the process-local pillars into
+a multi-process system view:
+
+* :mod:`nmfx.obs.export` — per-process telemetry publisher: a daemon
+  thread writing atomic JSON registry snapshots (+ instance identity
+  and heartbeat) into a shared ``telemetry_dir`` (the checkpoint
+  heartbeat-ledger idiom generalized), plus an optional stdlib
+  ``http.server`` Prometheus endpoint (``serve_metrics``).
+* :mod:`nmfx.obs.aggregate` — the fleet collector: merges N instance
+  snapshots into one view (counters sum, gauges key by instance,
+  histograms merge bucket-wise so merged quantiles equal
+  union-of-observations quantiles, stale instances keep counters but
+  drop gauges) with ``fleet_snapshot``/``fleet_delta``/Prometheus
+  exposition mirroring the single-process registry API.
+* :mod:`nmfx.obs.slo` — declarative objectives (availability, latency
+  bound, goodput/MFU floors) evaluated as multi-window burn rates over
+  snapshot deltas; alert transitions land in the flight recorder and
+  ``NMFXServer.stats_snapshot()["slo"]``.
+* :mod:`nmfx.obs.top` — the ``nmfx-top`` live terminal (and ``--html``
+  static) fleet dashboard over a telemetry_dir.
+
 See docs/observability.md for the API tour, the metric naming scheme,
 and the dump format.
 """
 
 from __future__ import annotations
 
-from nmfx.obs import costmodel, flight, metrics, regress, trace
+from nmfx.obs import (aggregate, costmodel, export, flight, metrics,
+                      regress, slo, trace)
+from nmfx.obs.aggregate import FleetCollector
+from nmfx.obs.export import TelemetryPublisher, serve_metrics
 from nmfx.obs.flight import FlightRecorder
 from nmfx.obs.metrics import MetricsRegistry, registry
-from nmfx.obs.trace import Tracer, default_tracer, traced
+from nmfx.obs.slo import Objective, SLOEngine
+from nmfx.obs.trace import Tracer, default_tracer, merge_traces, traced
 
-__all__ = ["FlightRecorder", "MetricsRegistry", "Tracer", "costmodel",
-           "default_tracer", "flight", "metrics", "regress",
-           "registry", "trace", "traced"]
+__all__ = ["FleetCollector", "FlightRecorder", "MetricsRegistry",
+           "Objective", "SLOEngine", "TelemetryPublisher", "Tracer",
+           "aggregate", "costmodel", "default_tracer", "export",
+           "flight", "merge_traces", "metrics", "regress", "registry",
+           "serve_metrics", "slo", "trace", "traced"]

@@ -1,0 +1,247 @@
+"""Per-process telemetry export: snapshot publishing + a /metrics port.
+
+Every telemetry surface built so far is process-local — one
+``NMFXServer.metrics_text()``, one Chrome trace, one postmortem — while
+the deployments the ROADMAP targets are multi-process (replicated
+servers, ``ElasticShardRunner`` shards over the heartbeat ledger,
+bench subprocess children). This module is the per-process HALF of the
+fleet observatory (ISSUE 14): each process periodically writes an
+atomic JSON snapshot of its metrics registry plus its instance
+identity into a shared ``telemetry_dir`` — the ``SweepCheckpoint
+.heartbeat`` ledger idiom (``shard_<i>.json``), generalized from shard
+progress to the full registry — and the collector
+(``nmfx.obs.aggregate``) merges N such snapshots into one fleet view.
+
+Design rules:
+
+* **Atomic tmp+rename, torn-tolerant.** A snapshot file is written via
+  ``telemetry_<instance>.json.tmp.<pid>`` + ``os.replace`` (the
+  checkpoint ledger's write discipline), so a reader can never observe
+  a half-written file; the collector still tolerates torn files
+  (warn-once skip) because a crashed writer may leave a stale one.
+* **Heartbeat = the snapshot's ``time``.** Liveness is the file's
+  embedded wall-clock timestamp, not mtime (NFS/container clock skew
+  on mtime is real; the embedded time is what the process asserted).
+* **Stdlib-only, jax-optional.** Like the rest of ``nmfx.obs`` this
+  module never imports jax; ``device_kind`` is read from jax ONLY when
+  the process already imported it (``sys.modules``) — publishing from
+  a jax-free collector/CLI process reports ``"unknown"`` rather than
+  dragging a backend up.
+* **Optional pull endpoint.** :func:`serve_metrics` exposes the same
+  registry as a stdlib ``http.server`` Prometheus endpoint for
+  scraper-based deployments; the snapshot ledger stays the primary
+  path because it needs no port coordination and survives the process
+  (a dead replica's last snapshot is still mergeable — counters
+  retained, gauges dropped by staleness; ``nmfx.obs.aggregate``).
+
+See docs/observability.md "Fleet telemetry" for the ledger layout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+from nmfx.obs import metrics as _metrics
+
+__all__ = ["TelemetryPublisher", "build_snapshot", "serve_metrics",
+           "snapshot_path"]
+
+#: snapshot format version — the collector skips (warn-once) files
+#: written by a future incompatible format instead of misreading them
+FORMAT_VERSION = 1
+
+#: telemetry snapshot filenames in a telemetry_dir; distinct from the
+#: checkpoint ledger's shard_<i>.json heartbeats and flight_*.json
+#: postmortems so every ledger can share one directory
+FILE_PREFIX = "telemetry_"
+
+_publishes_total = _metrics.counter(
+    "nmfx_telemetry_publishes_total",
+    "telemetry snapshots published to the shared telemetry_dir")
+
+
+def _device_kind() -> str:
+    """Best-effort device kind WITHOUT initializing a backend: read
+    jax only when the process already imported it."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "unknown"
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # nmfx: ignore[NMFX006] -- identity is advisory;
+        return "unknown"  # a backend error must not break publishing
+
+
+def _safe_instance(instance: str) -> str:
+    return "".join(c if c.isalnum() or c in "-._" else "-"
+                   for c in instance)
+
+
+def snapshot_path(telemetry_dir: str, instance: str) -> str:
+    """The ledger filename one instance publishes to."""
+    return os.path.join(telemetry_dir,
+                        f"{FILE_PREFIX}{_safe_instance(instance)}.json")
+
+
+def build_snapshot(registry: "_metrics.MetricsRegistry | None" = None,
+                   *, instance: str = "", role: str = "process",
+                   seq: int = 0) -> dict:
+    """One publishable snapshot: instance identity (instance name, pid,
+    host, role, device kind), the heartbeat timestamp, and the full
+    registry snapshot enriched with each metric's help text and (for
+    histograms) bucket bounds — everything the collector needs to
+    merge and re-export without importing the publishing process's
+    modules. Series label-tuples serialize as lists (JSON has no
+    tuples); the collector converts them back."""
+    reg = registry if registry is not None else _metrics.registry()
+    snap = reg.snapshot()
+    payload_metrics: dict = {}
+    for name, rec in snap.items():
+        m = reg.get(name)
+        entry = {
+            "type": rec["type"],
+            "labels": list(rec["labels"]),
+            "help": m.help if m is not None else "",
+            "series": [{"key": list(key), "value": val}
+                       for key, val in rec["series"].items()],
+        }
+        if rec["type"] == "histogram" and m is not None:
+            entry["buckets"] = list(m.buckets)
+        payload_metrics[name] = entry
+    return {
+        "format": FORMAT_VERSION,
+        "instance": instance,
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "role": role,
+        "device_kind": _device_kind(),
+        "time": time.time(),
+        "seq": seq,
+        "metrics": payload_metrics,
+    }
+
+
+class TelemetryPublisher:
+    """Daemon-thread publisher: writes this process's registry snapshot
+    into ``telemetry_dir`` every ``interval_s`` (atomic tmp+rename).
+    ``publish_once()`` is the deterministic single-shot form tests and
+    the bench rung drive directly; :meth:`close` publishes one final
+    snapshot (so shutdown-time counters land) and stops the thread.
+    Write failures degrade warn-once — telemetry is a side channel and
+    must never take the serving path down with it."""
+
+    def __init__(self, telemetry_dir: str, *,
+                 instance: "str | None" = None, role: str = "server",
+                 interval_s: float = 2.0,
+                 registry: "_metrics.MetricsRegistry | None" = None):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be positive")
+        os.makedirs(telemetry_dir, exist_ok=True)
+        self.telemetry_dir = telemetry_dir
+        self.role = role
+        self.instance = instance if instance is not None else \
+            f"{role}-{socket.gethostname()}-{os.getpid()}"
+        self.path = snapshot_path(telemetry_dir, self.instance)
+        self.interval_s = interval_s
+        self._registry = registry
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def __enter__(self) -> "TelemetryPublisher":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def publish_once(self) -> "str | None":
+        """Build + atomically write one snapshot; returns the path, or
+        None when the write failed (warn-once)."""
+        from nmfx.faults import warn_once
+
+        payload = build_snapshot(self._registry, instance=self.instance,
+                                 role=self.role, seq=self._seq)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            try:
+                os.unlink(tmp)
+            except OSError:  # nmfx: ignore[NMFX006] -- tmp never
+                pass         # created / already gone
+            warn_once(
+                "telemetry-publish-failed",
+                f"could not publish telemetry snapshot to "
+                f"{self.path!r} ({e}); this instance goes stale in the "
+                "fleet view until a write succeeds")
+            return None
+        self._seq += 1
+        _publishes_total.inc()
+        return self.path
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.publish_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "TelemetryPublisher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"nmfx-telemetry-{self.instance}")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the thread and publish one final snapshot — shutdown-
+        time counter totals must reach the ledger (the collector keeps
+        a dead instance's counters; only its gauges drop)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        self.publish_once()
+
+
+def serve_metrics(port: int = 0, *,
+                  registry: "_metrics.MetricsRegistry | None" = None,
+                  host: str = "127.0.0.1"):
+    """Serve the registry's Prometheus text exposition over a stdlib
+    ``http.server`` endpoint on a daemon thread (every path returns the
+    payload — scrapers conventionally hit ``/metrics``). ``port=0``
+    binds an ephemeral port; read the bound one from the returned
+    server's ``.port``. Call ``.shutdown()`` to stop (the serve layer
+    does, on ``NMFXServer.close`` — ``ServeConfig.metrics_port``)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry if registry is not None else _metrics.registry()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server's casing
+            body = reg.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass  # a scrape per interval must not spam stderr
+
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name=f"nmfx-metrics-http-{server.port}")
+    thread.start()
+    return server

@@ -186,6 +186,26 @@ class FlightRecorder:
             "dropped_events": self.dropped,
             "events": self.events(),
         }
+        # perf/SLO context (ISSUE 14): a crash artifact used to carry
+        # fault events but nothing about what the process was DOING —
+        # the last per-dispatch roofline attributions (the ISSUE 13
+        # drill-down ring) and the latest SLO status ride along, each
+        # best-effort (a broken sibling module must not mask the crash
+        # being reported)
+        try:
+            from nmfx.obs import costmodel as _costmodel
+
+            artifact["perf_recent"] = [
+                {k: _redact_value(v) for k, v in rec.items()}
+                for rec in _costmodel.recent_attributions(limit=32)]
+        except Exception:  # nmfx: ignore[NMFX006] -- best-effort
+            artifact["perf_recent"] = []  # context only
+        try:
+            from nmfx.obs import slo as _slo
+
+            artifact["slo"] = _slo.last_status()
+        except Exception:  # nmfx: ignore[NMFX006] -- best-effort
+            artifact["slo"] = None        # context only
         if extra:
             artifact["extra"] = {k: _redact_value(v)
                                  for k, v in extra.items()}

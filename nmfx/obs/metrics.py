@@ -37,12 +37,68 @@ from __future__ import annotations
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "counter", "gauge", "histogram", "registry"]
+           "bucket_quantile", "counter", "gauge", "histogram",
+           "merge_bucket_state", "registry", "render_prometheus",
+           "snapshot_delta"]
 
 #: default histogram bucket upper bounds, in seconds — spans queue
 #: waits (sub-ms) through cold compiles (tens of seconds)
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def bucket_quantile(buckets: "tuple[float, ...]", state: dict,
+                    q: float) -> "float | None":
+    """Bucket-interpolated quantile over one histogram STATE dict
+    (``{"count", "min", "max", "bucket_counts"}``) — the Prometheus
+    ``histogram_quantile`` estimator, factored out of
+    :meth:`Histogram.quantile` so the fleet collector
+    (``nmfx.obs.aggregate``) computes quantiles over MERGED states with
+    the identical math. Because the state is a pure bucket-count sum,
+    the quantile of a bucket-wise merge equals the quantile of one
+    histogram that observed the union of the instances' observations —
+    the fleet-merge exactness contract tests/test_fleet.py pins.
+
+    Returns None before any observation. ``q`` in [0, 1]."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError("q must be in [0, 1]")
+    if not state or state.get("count", 0) == 0:
+        return None
+    counts = state["bucket_counts"]
+    total, lo, hi = state["count"], state["min"], state["max"]
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= rank:
+            lower = buckets[i - 1] if i >= 1 else 0.0
+            upper = (buckets[i] if i < len(buckets)
+                     else hi)  # +inf bucket: cap at observed max
+            frac = (rank - cum) / c
+            est = lower + (upper - lower) * max(frac, 0.0)
+            # the true extremes are tracked exactly; never
+            # extrapolate past them
+            return min(max(est, lo), hi)
+        cum += c
+    return hi
+
+
+def merge_bucket_state(dst: dict, src: dict) -> dict:
+    """Accumulate one histogram STATE dict into another, in place:
+    counts/sums/per-bucket counts add, min/max combine. The ONE copy of
+    the bucket-wise merge arithmetic behind the fleet collector's
+    cross-instance merge and nmfx-top's cross-series combine — both
+    must agree with :func:`bucket_quantile`'s union-exactness contract,
+    so the arithmetic lives once. Returns ``dst``."""
+    dst["count"] += src["count"]
+    dst["sum"] += src["sum"]
+    for i, c in enumerate(src["bucket_counts"]):
+        dst["bucket_counts"][i] += c
+    for fn, field in ((min, "min"), (max, "max")):
+        vals = [v for v in (dst[field], src[field]) if v is not None]
+        dst[field] = fn(vals) if vals else None
+    return dst
 
 
 def _label_key(labelnames: "tuple[str, ...]", labels: dict) -> tuple:
@@ -180,33 +236,18 @@ class Histogram(_Metric):
                 st["bucket_counts"][-1] += 1  # +inf bucket
 
     def quantile(self, q: float, **labels) -> "float | None":
-        """Bucket-interpolated quantile estimate for one series; None
-        before any observation. q in [0, 1]."""
+        """Bucket-interpolated quantile estimate for one series
+        (:func:`bucket_quantile`); None before any observation.
+        q in [0, 1]."""
         if not 0.0 <= q <= 1.0:
             raise ValueError("q must be in [0, 1]")
         key = _label_key(self.labelnames, labels)
         with self._lock:
             st = self._series.get(key)
-            if st is None or st["count"] == 0:
+            if st is None:
                 return None
-            counts = list(st["bucket_counts"])
-            total, lo, hi = st["count"], st["min"], st["max"]
-        rank = q * total
-        cum = 0
-        for i, c in enumerate(counts):
-            if c == 0:
-                continue
-            if cum + c >= rank:
-                lower = self.buckets[i - 1] if i >= 1 else 0.0
-                upper = (self.buckets[i] if i < len(self.buckets)
-                         else hi)  # +inf bucket: cap at observed max
-                frac = (rank - cum) / c
-                est = lower + (upper - lower) * max(frac, 0.0)
-                # the true extremes are tracked exactly; never
-                # extrapolate past them
-                return min(max(est, lo), hi)
-            cum += c
-        return hi
+            st = {**st, "bucket_counts": list(st["bucket_counts"])}
+        return bucket_quantile(self.buckets, st, q)
 
     def _snapshot_locked(self) -> dict:
         return {key: {**st, "bucket_counts": list(st["bucket_counts"])}
@@ -274,80 +315,111 @@ class MetricsRegistry:
         their CURRENT value (a gauge is a level, not a flow). Series
         absent from ``prev`` subtract from zero. The windowed view
         ``NMFXServer.stats_snapshot()`` returns."""
-        cur = self.snapshot()
-        out: dict = {}
-        for name, rec in cur.items():
-            prev_series = (prev.get(name) or {}).get("series", {})
-            series = {}
-            for key, val in rec["series"].items():
-                if rec["type"] == "counter":
-                    series[key] = val - prev_series.get(key, 0.0)
-                elif rec["type"] == "histogram":
-                    p = prev_series.get(key)
-                    series[key] = {
-                        "count": val["count"]
-                        - (p["count"] if p else 0),
-                        "sum": val["sum"] - (p["sum"] if p else 0.0),
-                        "bucket_counts": [
-                            c - (p["bucket_counts"][i] if p else 0)
-                            for i, c in
-                            enumerate(val["bucket_counts"])],
-                        # extremes are cumulative (cheap state holds no
-                        # window); reported as-is
-                        "min": val["min"], "max": val["max"],
-                    }
-                else:
-                    series[key] = val
-            out[name] = {"type": rec["type"], "labels": rec["labels"],
-                         "series": series}
-        return out
+        return snapshot_delta(self.snapshot(), prev)
 
     # -- exposition --------------------------------------------------------
     def prometheus_text(self) -> str:
         """The Prometheus text exposition format (the ``/metrics``
         wire format): HELP/TYPE headers then one line per series;
         histograms expose cumulative ``_bucket{le=...}`` plus ``_sum``
-        and ``_count``. Served by ``NMFXServer.metrics_text()`` and
+        and ``_count``. Served by ``NMFXServer.metrics_text()``, the
+        ``serve_metrics`` HTTP endpoint (``nmfx.obs.export``), and
         written by the CLI's ``--metrics-out``."""
-        def fmt_labels(labelnames, key, extra=()):
-            pairs = [f'{n}="{v}"' for n, v in zip(labelnames, key)]
-            pairs += [f'{n}="{v}"' for n, v in extra]
-            return "{" + ",".join(pairs) + "}" if pairs else ""
-
-        def fmt_val(v: float) -> str:
-            return repr(int(v)) if float(v).is_integer() else repr(v)
-
-        lines = []
         snap = self.snapshot()
-        for name in sorted(snap):
-            rec = snap[name]
-            if rec["series"]:
-                lines.append(f"# HELP {name} "
-                             f"{self._metrics[name].help}")
-                lines.append(f"# TYPE {name} {rec['type']}")
-            for key in sorted(rec["series"]):
-                val = rec["series"][key]
-                if rec["type"] == "histogram":
-                    cum = 0
-                    bounds = [*self._metrics[name].buckets, "+Inf"]
-                    for bound, c in zip(bounds, val["bucket_counts"]):
-                        cum += c
-                        lines.append(
-                            name + "_bucket"
-                            + fmt_labels(rec["labels"], key,
-                                         [("le", bound)])
-                            + f" {cum}")
-                    lines.append(name + "_sum"
-                                 + fmt_labels(rec["labels"], key)
-                                 + f" {fmt_val(val['sum'])}")
-                    lines.append(name + "_count"
-                                 + fmt_labels(rec["labels"], key)
-                                 + f" {val['count']}")
-                else:
-                    lines.append(name
-                                 + fmt_labels(rec["labels"], key)
-                                 + f" {fmt_val(val)}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        with self._lock:
+            for name, rec in snap.items():
+                m = self._metrics.get(name)
+                if m is not None:
+                    rec["help"] = m.help
+                    if m.kind == "histogram":
+                        rec["buckets"] = m.buckets
+        return render_prometheus(snap)
+
+
+def snapshot_delta(cur: dict, prev: dict) -> dict:
+    """The windowed-view arithmetic behind :meth:`MetricsRegistry
+    .delta`, over two snapshot-SHAPED dicts: counters and histogram
+    counts/sums/bucket-counts subtract, gauges pass through as their
+    current level. Shared with the fleet collector's
+    ``fleet_delta`` (``nmfx.obs.aggregate``), so a fleet window and a
+    process window are the same math."""
+    out: dict = {}
+    for name, rec in cur.items():
+        prev_series = (prev.get(name) or {}).get("series", {})
+        series = {}
+        for key, val in rec["series"].items():
+            if rec["type"] == "counter":
+                series[key] = val - prev_series.get(key, 0.0)
+            elif rec["type"] == "histogram":
+                p = prev_series.get(key)
+                series[key] = {
+                    "count": val["count"]
+                    - (p["count"] if p else 0),
+                    "sum": val["sum"] - (p["sum"] if p else 0.0),
+                    "bucket_counts": [
+                        c - (p["bucket_counts"][i] if p else 0)
+                        for i, c in
+                        enumerate(val["bucket_counts"])],
+                    # extremes are cumulative (cheap state holds no
+                    # window); reported as-is
+                    "min": val["min"], "max": val["max"],
+                }
+            else:
+                series[key] = val
+        out[name] = {"type": rec["type"], "labels": rec["labels"],
+                     "series": series}
+        # enrichment keys (fleet snapshots and registry_snapshot carry
+        # them) survive the windowing — a delta's histogram is only
+        # interpretable against its bucket bounds
+        for extra in ("help", "buckets"):
+            if extra in rec:
+                out[name][extra] = rec[extra]
+    return out
+
+
+def render_prometheus(snap: dict) -> str:
+    """Render one snapshot-shaped dict as Prometheus text exposition.
+    Entries may carry ``help`` (HELP header) and, for histograms, MUST
+    carry ``buckets`` (the ``le=`` bounds). Factored out of the
+    registry so the fleet collector's MERGED snapshot exports through
+    the identical formatter (``nmfx.obs.aggregate``)."""
+    def fmt_labels(labelnames, key, extra=()):
+        pairs = [f'{n}="{v}"' for n, v in zip(labelnames, key)]
+        pairs += [f'{n}="{v}"' for n, v in extra]
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+    def fmt_val(v: float) -> str:
+        return repr(int(v)) if float(v).is_integer() else repr(v)
+
+    lines = []
+    for name in sorted(snap):
+        rec = snap[name]
+        if rec["series"]:
+            lines.append(f"# HELP {name} {rec.get('help', '')}")
+            lines.append(f"# TYPE {name} {rec['type']}")
+        for key in sorted(rec["series"]):
+            val = rec["series"][key]
+            if rec["type"] == "histogram":
+                cum = 0
+                bounds = [*rec["buckets"], "+Inf"]
+                for bound, c in zip(bounds, val["bucket_counts"]):
+                    cum += c
+                    lines.append(
+                        name + "_bucket"
+                        + fmt_labels(rec["labels"], key,
+                                     [("le", bound)])
+                        + f" {cum}")
+                lines.append(name + "_sum"
+                             + fmt_labels(rec["labels"], key)
+                             + f" {fmt_val(val['sum'])}")
+                lines.append(name + "_count"
+                             + fmt_labels(rec["labels"], key)
+                             + f" {val['count']}")
+            else:
+                lines.append(name
+                             + fmt_labels(rec["labels"], key)
+                             + f" {fmt_val(val)}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 _registry = MetricsRegistry()

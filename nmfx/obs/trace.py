@@ -45,7 +45,8 @@ import threading
 import time
 from collections import deque
 
-__all__ = ["Tracer", "default_tracer", "disable", "enable", "traced"]
+__all__ = ["Tracer", "default_tracer", "disable", "enable",
+           "merge_traces", "traced"]
 
 #: default ring capacity — a served request is a few dozen spans, so
 #: this holds thousands of requests of history at ~100 B/event
@@ -72,6 +73,11 @@ class Tracer:
         self._thread_names: "dict[int, str]" = {}
         #: perf_counter epoch all timestamps are relative to
         self._t0 = time.perf_counter()
+        #: the same instant on the WALL clock — exported in the trace
+        #: metadata so :func:`merge_traces` can align traces recorded
+        #: by different processes (each process's perf_counter zero is
+        #: arbitrary; the wall clock is the shared axis)
+        self._t0_epoch = time.time()
 
     # -- recording ---------------------------------------------------------
     def _now_us(self) -> float:
@@ -167,7 +173,9 @@ class Tracer:
             if ev.get("args") is None:
                 ev.pop("args", None)
             out.append(ev)
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        return {"traceEvents": out, "displayTimeUnit": "ms",
+                "metadata": {"nmfx_pid": pid,
+                             "nmfx_t0_epoch_s": self._t0_epoch}}
 
     def export(self, path: str) -> str:
         """Write :meth:`chrome_trace` to ``path``; returns ``path``.
@@ -220,3 +228,67 @@ def traced(name_or_fn=None, cat: str = "fn"):
     if callable(name_or_fn):
         return deco(name_or_fn)
     return lambda fn: deco(fn, name=name_or_fn)
+
+
+def merge_traces(traces, path: "str | None" = None,
+                 names=None) -> dict:
+    """Join N exported Chrome traces into ONE cross-process timeline.
+
+    ``traces`` is a sequence of file paths (as written by
+    :meth:`Tracer.export`) or already-loaded trace dicts. Each trace's
+    timestamps are shifted onto a shared axis using the
+    ``nmfx_t0_epoch_s`` wall-clock anchor the exporter embeds (the
+    earliest anchor becomes zero); a trace without an anchor (foreign
+    tooling, pre-ISSUE-14 exports) keeps its own relative time at
+    offset zero — still rendered, just not aligned. Every merged trace
+    contributes a ``process_name`` metadata event (from ``names``, the
+    source filename, or its pid), so Perfetto shows one labeled track
+    group per process and the cross-process joins — a spilled request's
+    ``serve.spill``/``serve.readmit`` instants sharing a request id, an
+    elastic sweep's per-shard ``elastic.unit`` spans sharing a trace
+    id — line up on one wall-clock axis.
+
+    Caveat: pids are the track-group key; two processes that genuinely
+    share a pid (different hosts) would fold onto one group — name
+    them apart via ``names``. Returns the merged trace dict; with
+    ``path``, also writes it there."""
+    loaded = []
+    for i, t in enumerate(traces):
+        label = None
+        if isinstance(t, (str, bytes)) or hasattr(t, "__fspath__"):
+            import os
+
+            fname = os.fspath(t)
+            with open(fname) as f:
+                t = json.load(f)
+            label = os.path.basename(fname)
+        if names is not None and i < len(names):
+            label = names[i]
+        loaded.append((t, label))
+    anchors = [t.get("metadata", {}).get("nmfx_t0_epoch_s")
+               for t, _ in loaded]
+    known = [a for a in anchors if a is not None]
+    base = min(known) if known else None
+    out: "list[dict]" = []
+    for (t, label), anchor in zip(loaded, anchors):
+        shift_us = ((anchor - base) * 1e6
+                    if anchor is not None and base is not None else 0.0)
+        pids = set()
+        for ev in t.get("traceEvents", ()):
+            ev = dict(ev)
+            if "pid" in ev:
+                pids.add(ev["pid"])
+            if "ts" in ev and ev.get("ph") != "M":
+                ev["ts"] = ev["ts"] + shift_us
+            out.append(ev)
+        for pid in sorted(pids, key=str):
+            out.append({"name": "process_name", "ph": "M", "pid": pid,
+                        "args": {"name": label if label is not None
+                                 else f"pid {pid}"}})
+    merged = {"traceEvents": out, "displayTimeUnit": "ms",
+              "metadata": {"nmfx_merged": len(loaded),
+                           "nmfx_t0_epoch_s": base}}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(merged, f)
+    return merged

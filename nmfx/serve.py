@@ -132,6 +132,15 @@ _quality_degraded_total = _metrics.counter(
     "nmfx_serve_quality_degraded_total",
     "requests degraded to the sketched engine by quality-elastic "
     "scheduling", labelnames=("cause",))
+#: level gauges for the fleet view (ISSUE 14): a router/autoscaler
+#: reads per-replica queue depth and inflight load from the merged
+#: telemetry, where gauges stay keyed by instance (nmfx.obs.aggregate)
+_queue_depth_gauge = _metrics.gauge(
+    "nmfx_serve_queue_depth",
+    "requests queued but not yet dispatched (admission-bounded)")
+_inflight_gauge = _metrics.gauge(
+    "nmfx_serve_inflight",
+    "requests dispatched but not yet resolved")
 #: process-wide spill-record counter: per-SERVER request seqs restart
 #: at 0, so a restarted server in the same process would overwrite an
 #: earlier server's spill_{pid}_{seq}.npz — this counter keeps every
@@ -303,6 +312,21 @@ class ServeConfig:
     #: dropped). None = shutdown discards queued requests (the
     #: pre-ISSUE-9 behavior).
     spill_dir: "str | None" = None
+    #: fleet-telemetry ledger (ISSUE 14, docs/observability.md "Fleet
+    #: telemetry"): with a directory, the server runs a
+    #: ``TelemetryPublisher`` daemon writing atomic registry snapshots
+    #: (+ instance identity and heartbeat) here every
+    #: ``telemetry_interval_s``; a ``FleetCollector`` over the same
+    #: directory merges N replicas into one fleet view. None = no
+    #: publishing (the single-process default).
+    telemetry_dir: "str | None" = None
+    #: snapshot publish cadence for ``telemetry_dir``
+    telemetry_interval_s: float = 2.0
+    #: with a port, serve the registry's Prometheus exposition over a
+    #: stdlib HTTP endpoint (``nmfx.obs.export.serve_metrics``) for
+    #: scraper-based deployments; 0 = ephemeral port (read it from
+    #: ``NMFXServer.metrics_port``). None = no endpoint.
+    metrics_port: "int | None" = None
 
     def __post_init__(self):
         if self.max_queue_depth < 1:
@@ -329,6 +353,12 @@ class ServeConfig:
             raise ValueError("retry_backoff_s must be >= 0")
         if self.watchdog_interval_s <= 0:
             raise ValueError("watchdog_interval_s must be positive")
+        if self.telemetry_interval_s <= 0:
+            raise ValueError("telemetry_interval_s must be positive")
+        if self.metrics_port is not None and not \
+                0 <= self.metrics_port <= 65535:
+            raise ValueError("metrics_port must be in [0, 65535] or "
+                             "None")
 
 
 def serve_key_fields() -> frozenset:
@@ -641,6 +671,41 @@ class NMFXServer:
         # SERVER START, not process start (several servers may share
         # one process across a test session)
         self._metrics_t0 = _metrics.registry().snapshot()
+        # fleet observatory wiring (ISSUE 14): the SLO engine always
+        # runs (stats_snapshot()["slo"] — evaluation is host-side
+        # arithmetic on snapshot deltas); the telemetry publisher and
+        # the /metrics HTTP endpoint spin up only when configured
+        from nmfx.obs import slo as _slo
+
+        self._slo = _slo.SLOEngine()
+        self._publisher = None
+        self._metrics_server = None
+        self.metrics_port: "int | None" = None
+        try:
+            if serve_cfg.metrics_port is not None:
+                from nmfx.obs.export import serve_metrics
+
+                self._metrics_server = serve_metrics(
+                    serve_cfg.metrics_port)
+                self.metrics_port = self._metrics_server.port
+            # the publisher starts LAST: it is a daemon that keeps
+            # heart-beating into the fleet ledger, so nothing that can
+            # still fail may run after it — a half-constructed server
+            # must never read as a live replica to a router/autoscaler
+            if serve_cfg.telemetry_dir is not None:
+                from nmfx.obs.export import TelemetryPublisher
+
+                self._publisher = TelemetryPublisher(
+                    serve_cfg.telemetry_dir, role="server",
+                    interval_s=serve_cfg.telemetry_interval_s).start()
+        except BaseException:
+            # a failed __init__ (e.g. metrics_port already bound)
+            # never runs close(): tear down whatever started, then
+            # re-raise the construction failure
+            if self._metrics_server is not None:
+                self._metrics_server.shutdown()
+                self._metrics_server.server_close()
+            raise
         self.counters = {"submitted": 0, "completed": 0, "failed": 0,
                          "cancelled": 0, "deadline_expired": 0,
                          "rejected": 0, "dispatches": 0,
@@ -685,6 +750,7 @@ class NMFXServer:
                     self._queue.clear()
                     self._queued = 0
                     self._pending_bytes = 0
+                    self._sync_gauges()
                 self._paused = False  # a paused close must still drain
                 self._cond.notify_all()
             scheduler = self._scheduler
@@ -721,6 +787,15 @@ class NMFXServer:
             self._harvest_cond.notify_all()
         for t in self._harvesters:
             t.join()
+        # fleet-telemetry teardown AFTER the drain: the publisher's
+        # final snapshot carries the fully-drained counters, then this
+        # instance goes stale in the fleet view (counters retained,
+        # gauges dropped — nmfx.obs.aggregate)
+        if self._publisher is not None:
+            self._publisher.close()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()
 
     # -- spill-on-shutdown / re-admission (ISSUE 9) ------------------------
     def _spill(self, req: _Request) -> "str | None":
@@ -738,6 +813,12 @@ class NMFXServer:
         from nmfx.faults import warn_once
 
         meta = {
+            # identity for the cross-process timeline (ISSUE 14): the
+            # spilling server's request id rides in the payload, the
+            # readmitting server books a serve.readmit join against
+            # it, and merge_traces aligns both processes' traces — a
+            # spilled-and-readmitted request reads as ONE timeline
+            "request_id": req.seq, "spill_pid": os.getpid(),
             "ks": list(req.ks), "restarts": req.restarts,
             "seed": req.seed, "label_rule": req.label_rule,
             "linkage": req.linkage, "grid_slots": req.grid_slots,
@@ -766,6 +847,10 @@ class NMFXServer:
             return None
         with self._lock:
             self.counters["spilled"] += 1
+        _flight.record("serve.spill", request_id=req.seq, path=path)
+        _trace.default_tracer().instant(
+            "serve.spill", cat="serve",
+            args={"request_id": req.seq})
         return path
 
     def readmit(self, spill_dir: "str | None" = None) -> list:
@@ -853,6 +938,18 @@ class NMFXServer:
                 break
             with self._lock:
                 self.counters["readmitted"] += 1
+            # the cross-process join (ISSUE 14): the readmitted
+            # request's NEW id booked against the spilling server's
+            # original — merge_traces lines the two processes up
+            origin = meta.get("request_id")
+            _flight.record("serve.readmit",
+                           request_id=fut.stats.request_id,
+                           origin_request_id=origin,
+                           origin_pid=meta.get("spill_pid"))
+            _trace.default_tracer().instant(
+                "serve.readmit", cat="serve",
+                args={"request_id": fut.stats.request_id,
+                      "origin_request_id": origin})
             futures.append(fut)
             try:
                 os.unlink(path)
@@ -960,6 +1057,7 @@ class NMFXServer:
             heapq.heappush(self._queue, (req.order_key(), req))
             self._queued += 1
             self._pending_bytes += arr.nbytes
+            self._sync_gauges()
             self.counters["submitted"] += 1
             # watchdog registry: tracked until the future resolves, so
             # a scheduler crash can enumerate (and fail, typed) every
@@ -975,6 +1073,14 @@ class NMFXServer:
     def _untrack(self, seq: int) -> None:
         with self._tracked_lock:
             self._tracked.pop(seq, None)
+
+    def _sync_gauges(self) -> None:
+        """Export the queue/inflight LEVELS to the registry gauges the
+        fleet view reads (nmfx_serve_queue_depth / nmfx_serve_inflight)
+        — called wherever either level changes. The registry lock is a
+        leaf, so this is safe under self._lock/self._cond."""
+        _queue_depth_gauge.set(self._queued)
+        _inflight_gauge.set(self._inflight)
 
     @staticmethod
     def _sketch_eligible(scfg: SolverConfig) -> bool:
@@ -1046,9 +1152,15 @@ class NMFXServer:
         attribution summary (``nmfx.obs.costmodel.perf_summary`` —
         model FLOPs/bytes, achieved FLOP/s, MFU, arithmetic intensity
         and the compute-vs-bandwidth verdict per dispatch kind;
-        docs/observability.md "Performance attribution")."""
+        docs/observability.md "Performance attribution").
+
+        The ``"slo"`` key carries the server's SLO engine status
+        (``nmfx.obs.slo`` — per-objective multi-window burn rates and
+        alert states, evaluated over the process registry right now;
+        alert TRANSITIONS also land in the flight recorder)."""
         snap = _metrics.registry().delta(self._metrics_t0)
         snap["perf"] = _costmodel.perf_summary()
+        snap["slo"] = self._slo.evaluate()
         return snap
 
     def metrics_text(self) -> str:
@@ -1108,6 +1220,7 @@ class NMFXServer:
     def _drop_locked(self, req: _Request, why: str) -> None:
         self._queued -= 1
         self._pending_bytes -= req.a.nbytes
+        self._sync_gauges()
         self.counters["cancelled" if why == "cancelled"
                       else "deadline_expired"] += 1
 
@@ -1124,6 +1237,7 @@ class NMFXServer:
                 continue
             self._queued -= 1
             self._pending_bytes -= req.a.nbytes
+            self._sync_gauges()
             return req
         return None
 
@@ -1158,6 +1272,7 @@ class NMFXServer:
         if mates:
             self._queue[:] = keep
             heapq.heapify(self._queue)
+            self._sync_gauges()
         return mates
 
     def _scheduler_main(self) -> None:
@@ -1222,6 +1337,7 @@ class NMFXServer:
                                        (req.order_key(), req))
                         self._queued += 1
                         self._pending_bytes += req.a.nbytes
+                    self._sync_gauges()
                 continue
             self._dispatch(batch)
 
@@ -1266,6 +1382,7 @@ class NMFXServer:
                 self._queue.clear()
                 self._queued = 0
                 self._pending_bytes = 0
+                self._sync_gauges()
                 restart = self.cfg.restart_scheduler and not self._closed
                 if not restart:
                     self._down = cause
@@ -1538,6 +1655,7 @@ class NMFXServer:
                 self.counters["packed_requests"] += len(live)
                 self.counters["packed_lanes"] += lanes
             self._inflight += len(live)
+            self._sync_gauges()
         for req, raw in zip(live, raws):
             req.stats.pack_s = t1 - t0
             req.stats.packed_requests = len(live)
@@ -1651,3 +1769,4 @@ class NMFXServer:
                     self._harvest_owned.discard(req.seq)
                 with self._lock:
                     self._inflight -= 1
+                    self._sync_gauges()

@@ -404,3 +404,94 @@ def test_cli_sketched_screen_compose_guards(gct_path, capsys):
             main([gct_path, "--no-files"] + extra)
         err = capsys.readouterr().err
         assert needle in err, (extra, needle, err[-500:])
+
+
+def test_cli_serve_smoke_composes_with_obs_outputs(gct_path, tmp_path,
+                                                   capsys):
+    """ISSUE 14 satellite: the observability outputs compose with the
+    serving path — --trace-out carries the serve spans, --metrics-out
+    carries the serve latency histograms, --perf-report includes the
+    serve dispatch kind (pre-ISSUE-14 these were only pinned on the
+    direct path)."""
+    import json
+
+    from nmfx.obs import costmodel, trace
+
+    costmodel.reset_perf()
+    trace.default_tracer().clear()
+    trace_path = tmp_path / "serve-trace.json"
+    metrics_path = tmp_path / "serve-metrics.prom"
+    rc = main([gct_path, "--ks", "2", "--restarts", "2",
+               "--maxiter", "60", "--no-files", "--serve-smoke",
+               "--trace-out", str(trace_path),
+               "--metrics-out", str(metrics_path),
+               "--perf-report"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "perf attribution" in cap.out
+    assert "serve-smoke: submitted=1 completed=1" in cap.err
+    chrome = json.loads(trace_path.read_text())
+    names = {e["name"] for e in chrome["traceEvents"]
+             if e.get("ph") == "X"}
+    assert "serve.queue_wait" in names
+    assert "serve.dispatch" in names
+    # the exported trace carries the cross-process merge anchor
+    assert "nmfx_t0_epoch_s" in chrome["metadata"]
+    text = metrics_path.read_text()
+    assert "nmfx_serve_e2e_seconds" in text
+    assert "nmfx_serve_dispatches_total" in text
+    # the serve dispatch kind reached the attribution report
+    assert "serve" in costmodel.perf_summary()["kinds"]
+
+
+def test_cli_serve_smoke_fleet_flags(gct_path, tmp_path, capsys):
+    """--telemetry-dir publishes the run's snapshots (nmfx-top-ready),
+    --metrics-port 0 binds an ephemeral /metrics endpoint, --slo prints
+    the burn status — composed on one --serve-smoke run."""
+    import json
+    import os
+
+    tdir = tmp_path / "telemetry"
+    rc = main([gct_path, "--ks", "2", "--restarts", "2",
+               "--maxiter", "60", "--no-files", "--serve-smoke",
+               "--telemetry-dir", str(tdir),
+               "--metrics-port", "0", "--slo"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "serving /metrics on 127.0.0.1:" in cap.err
+    assert "slo availability: state=ok" in cap.err
+    assert "telemetry published" in cap.err
+    snaps = [n for n in os.listdir(tdir) if n.startswith("telemetry_")]
+    assert len(snaps) == 1
+    payload = json.loads((tdir / snaps[0]).read_text())
+    assert payload["role"] == "server"
+    assert "nmfx_serve_e2e_seconds" in payload["metrics"]
+    # the published ledger renders as a non-empty nmfx-top dashboard
+    from nmfx.obs import top
+
+    rc = top.main([str(tdir), "--once", "--stale-after", "600"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # the published registry is process-cumulative (other in-process
+    # runs' requests may precede this one) — pin presence, not counts
+    assert "server-" in out and "completed=" in out
+
+
+def test_cli_fleet_flags_require_serve_smoke(gct_path, tmp_path,
+                                             capsys):
+    """Compose-guards: the fleet-telemetry flags configure the serving
+    engine — without --serve-smoke they are usage errors, never
+    silently dropped."""
+    cases = [
+        (["--telemetry-dir", str(tmp_path / "t")], "--serve-smoke"),
+        (["--metrics-port", "0"], "--serve-smoke"),
+        (["--slo"], "--serve-smoke"),
+        (["--serve-smoke", "--metrics-port", "70000"], "65535"),
+    ]
+    for extra, needle in cases:
+        with pytest.raises(SystemExit):
+            main([gct_path, "--no-files"] + extra)
+        err = capsys.readouterr().err
+        assert needle in err, (extra, needle, err[-500:])

@@ -596,10 +596,23 @@ def test_scheduler_crash_emits_flight_recorder_dump(tmp_path):
     from nmfx.obs import flight
     from nmfx.serve import NMFXServer, ServeConfig, ServerCrashed
 
+    import numpy as np
+
+    from nmfx.config import SolverConfig
+    from nmfx.obs import costmodel, slo
+
     flight.configure(str(tmp_path))
     # fresh event ring: the recorder is process-global and the earlier
     # watchdog tests in this module left their own crash events on it
     flight.default_recorder().clear()
+    # seed the perf drill-down ring and the SLO status the postmortem
+    # must now embed (ISSUE 14: a crash artifact carries perf/SLO
+    # context, not just fault events)
+    perf_rec = costmodel.attribute_dispatch(
+        "crash-context", SolverConfig(), 32, 16,
+        {2: np.array([10, 10])}, 0.05)
+    assert perf_rec is not None
+    slo.SLOEngine().evaluate()
     try:
         faults.arm("serve.scheduler", every=1)
         cfg = ServeConfig(restart_scheduler=False,
@@ -646,6 +659,14 @@ def test_scheduler_crash_emits_flight_recorder_dump(tmp_path):
         assert crash["resolved"] == 2
         assert "FaultInjected" in crash["error"] \
             or "injected fault" in crash["error"]
+        # ... and the perf/SLO context rides in the payload (ISSUE 14):
+        # the recent_attributions drill-down ring tail and the latest
+        # SLO engine status — a postmortem answers "was the process
+        # healthy and within budget", not just "what faults fired"
+        assert any(rec["kind"] == "crash-context"
+                   for rec in art["perf_recent"])
+        assert art["slo"] is not None
+        assert "availability" in art["slo"]["objectives"]
         # in-process artifact mirrors the file
         assert flight.last_dump()["reason"] == "serve-scheduler-crash"
     finally:

@@ -1,0 +1,516 @@
+"""Fleet observatory (ISSUE 14): telemetry publisher/collector merge
+exactness, staleness semantics, torn-snapshot tolerance, SLO burn-rate
+alerting, cross-process trace joins, and the nmfx-top dashboard.
+
+The merge contracts are pinned EXACTLY (counter sums, bucket counts,
+union-of-observations quantiles) — a fleet view that is "approximately"
+the sum of its instances is a fleet view nothing can be gated on. The
+subprocess rungs drive real OS-process publishers through the same
+ledger; the heavyweight one is marked slow (tier-1 keeps a two-process
+representative)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from nmfx import faults
+from nmfx.obs import aggregate, export, metrics, slo, top, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_faults():
+    faults.disarm()
+    faults._reset_warned()
+    yield
+    faults.disarm()
+    faults._reset_warned()
+
+
+def _registry_with(instance_idx: int, obs=()):
+    """A fresh registry with one counter/gauge/histogram trio the merge
+    tests drive."""
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("nmfx_serve_dispatches_total", "d", ("packed",))
+    c.inc(10 + instance_idx, packed="false")
+    c.inc(2 * (instance_idx + 1), packed="true")
+    g = reg.gauge("nmfx_serve_queue_depth", "q")
+    g.set(3 + instance_idx)
+    h = reg.histogram("nmfx_serve_e2e_seconds", "e", ("outcome",))
+    for v in obs:
+        h.observe(v, outcome="completed")
+    return reg
+
+
+def _publish(tmp_path, name, reg, role="server"):
+    pub = export.TelemetryPublisher(str(tmp_path), instance=name,
+                                    role=role, registry=reg)
+    assert pub.publish_once() is not None
+    return pub
+
+
+# ---------------------------------------------------------------------
+# merge exactness
+# ---------------------------------------------------------------------
+
+def test_fleet_counters_sum_and_gauges_key_by_instance(tmp_path):
+    regs = [_registry_with(i) for i in range(3)]
+    for i, reg in enumerate(regs):
+        _publish(tmp_path, f"inst-{i}", reg)
+    col = aggregate.FleetCollector(str(tmp_path))
+    snap = col.fleet_snapshot()
+    c = snap["nmfx_serve_dispatches_total"]
+    assert c["series"][("false",)] == sum(10 + i for i in range(3))
+    assert c["series"][("true",)] == sum(2 * (i + 1) for i in range(3))
+    g = snap["nmfx_serve_queue_depth"]
+    assert g["labels"] == ("instance",)
+    assert g["series"] == {("inst-0",): 3.0, ("inst-1",): 4.0,
+                           ("inst-2",): 5.0}
+    # merged exposition renders through the shared formatter
+    text = col.prometheus_text()
+    assert 'nmfx_serve_queue_depth{instance="inst-1"} 4' in text
+    assert "# TYPE nmfx_serve_dispatches_total counter" in text
+
+
+def test_fleet_histogram_merge_equals_union_of_observations(tmp_path):
+    """The pinned quantile contract: bucket-wise merge then quantile ==
+    quantile of ONE histogram that observed every instance's
+    observations."""
+    import random
+
+    rng = random.Random(7)
+    all_obs = []
+    for i in range(3):
+        obs = [rng.uniform(0.0005, 40.0) for _ in range(120)]
+        all_obs += obs
+        _publish(tmp_path, f"inst-{i}", _registry_with(i, obs))
+    union = metrics.MetricsRegistry().histogram(
+        "union_seconds", "", ("outcome",))
+    for v in all_obs:
+        union.observe(v, outcome="completed")
+    col = aggregate.FleetCollector(str(tmp_path))
+    snap = col.fleet_snapshot()
+    st = snap["nmfx_serve_e2e_seconds"]["series"][("completed",)]
+    ust = union.series()[("completed",)]
+    assert st["count"] == ust["count"] == len(all_obs)
+    assert st["bucket_counts"] == ust["bucket_counts"]  # exact
+    assert st["min"] == ust["min"] and st["max"] == ust["max"]
+    for q in (0.0, 0.25, 0.5, 0.9, 0.99, 1.0):
+        assert col.quantile("nmfx_serve_e2e_seconds", q, snapshot=snap,
+                            outcome="completed") \
+            == union.quantile(q, outcome="completed"), q
+
+
+def test_fleet_delta_mirrors_registry_delta(tmp_path):
+    reg = _registry_with(0, obs=[0.1, 0.2])
+    pub = _publish(tmp_path, "inst-0", reg)
+    col = aggregate.FleetCollector(str(tmp_path))
+    prev = col.fleet_snapshot()
+    reg.counter("nmfx_serve_dispatches_total", "d",
+                ("packed",)).inc(5, packed="false")
+    reg.histogram("nmfx_serve_e2e_seconds", "e",
+                  ("outcome",)).observe(0.3, outcome="completed")
+    pub.publish_once()
+    delta = col.fleet_delta(prev)
+    assert delta["nmfx_serve_dispatches_total"]["series"][
+        ("false",)] == 5
+    hd = delta["nmfx_serve_e2e_seconds"]["series"][("completed",)]
+    assert hd["count"] == 1
+    assert hd["sum"] == pytest.approx(0.3)
+
+
+# ---------------------------------------------------------------------
+# staleness + torn tolerance
+# ---------------------------------------------------------------------
+
+def test_stale_instance_keeps_counters_drops_gauges(tmp_path):
+    _publish(tmp_path, "live", _registry_with(0))
+    _publish(tmp_path, "dead", _registry_with(1))
+    # age the dead instance's heartbeat INSIDE the payload (liveness is
+    # the embedded time, not mtime)
+    dead_path = export.snapshot_path(str(tmp_path), "dead")
+    payload = json.load(open(dead_path))
+    payload["time"] -= 3600.0
+    json.dump(payload, open(dead_path, "w"))
+    col = aggregate.FleetCollector(str(tmp_path), stale_after_s=10.0)
+    rows = {r["instance"]: r for r in col.instances()}
+    assert rows["live"]["stale"] is False
+    assert rows["dead"]["stale"] is True
+    snap = col.fleet_snapshot()
+    # counters: monotone history that happened — both instances count
+    assert snap["nmfx_serve_dispatches_total"]["series"][
+        ("false",)] == 10 + 11
+    # gauges: the dead replica's level no longer exists — dropped
+    assert set(snap["nmfx_serve_queue_depth"]["series"]) == {("live",)}
+
+
+def test_torn_and_foreign_snapshots_skipped_warn_once(tmp_path):
+    _publish(tmp_path, "good", _registry_with(0))
+    (tmp_path / "telemetry_torn.json").write_text('{"format": 1, "met')
+    (tmp_path / "telemetry_foreign.json").write_text(
+        '{"format": 999, "metrics": {}}')
+    col = aggregate.FleetCollector(str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="fleet-snapshot-torn"):
+        payloads = col.collect()
+    assert set(payloads) == {"good"}
+    # warn-once: the second collect is quiet, the skip persists
+    assert set(col.collect()) == {"good"}
+
+
+def test_conflicting_schema_skipped_warn_once(tmp_path):
+    _publish(tmp_path, "a", _registry_with(0))
+    reg_b = metrics.MetricsRegistry()
+    reg_b.gauge("nmfx_serve_dispatches_total", "now a gauge!").set(9)
+    _publish(tmp_path, "b", reg_b)
+    col = aggregate.FleetCollector(str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="fleet-metric-conflict"):
+        snap = col.fleet_snapshot()
+    # instance a's counter survives; b's conflicting series skipped
+    assert snap["nmfx_serve_dispatches_total"]["type"] == "counter"
+    assert snap["nmfx_serve_dispatches_total"]["series"][
+        ("false",)] == 10
+
+
+# ---------------------------------------------------------------------
+# publisher lifecycle + /metrics endpoint
+# ---------------------------------------------------------------------
+
+def test_publisher_thread_and_final_snapshot(tmp_path):
+    reg = _registry_with(0)
+    pub = export.TelemetryPublisher(str(tmp_path), instance="threaded",
+                                    interval_s=0.05, registry=reg)
+    with pub:
+        deadline = time.monotonic() + 10
+        while not os.path.exists(pub.path) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        reg.counter("nmfx_serve_dispatches_total", "d",
+                    ("packed",)).inc(100, packed="false")
+    # close() published a FINAL snapshot: the late increment landed
+    payload = json.load(open(pub.path))
+    series = {tuple(s["key"]): s["value"]
+              for s in payload["metrics"][
+                  "nmfx_serve_dispatches_total"]["series"]}
+    assert series[("false",)] == 110
+
+
+def test_serve_metrics_http_endpoint():
+    import urllib.request
+
+    reg = _registry_with(4)
+    srv = export.serve_metrics(0, registry=reg)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            timeout=10).read().decode()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert "# TYPE nmfx_serve_dispatches_total counter" in body
+    assert 'nmfx_serve_dispatches_total{packed="false"} 14' in body
+
+
+# ---------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------
+
+def _slo_registry():
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("nmfx_serve_e2e_seconds", "e", ("outcome",))
+    return reg, h
+
+
+def test_availability_breach_flips_fast_burn_and_recovers():
+    reg, h = _slo_registry()
+    eng = slo.SLOEngine(
+        objectives=(slo.Objective("availability",
+                                  kind="availability"),),
+        snapshot_fn=reg.snapshot)
+    t0 = 1_000_000.0
+    for _ in range(50):
+        h.observe(0.1, outcome="completed")
+    s = eng.evaluate(now=t0)
+    assert s["objectives"]["availability"]["state"] == "ok"
+    flight_before = len(_transitions())
+    for _ in range(50):
+        h.observe(0.1, outcome="failed")
+    s = eng.evaluate(now=t0 + 300)
+    avail = s["objectives"]["availability"]
+    # the 50 completed landed BEFORE the baseline cut, so the window's
+    # delta is 50 failed / 50 total: burn 1.0/0.01 = 100 >> 14.4 in
+    # BOTH fast windows (history shorter than 1h falls back to the
+    # oldest cut — lifetime burn)
+    assert avail["state"] == "fast_burn"
+    assert avail["burn"]["5m"] == pytest.approx(100.0)
+    evs = _transitions()
+    assert len(evs) == flight_before + 1
+    assert evs[-1]["objective"] == "availability"
+    assert evs[-1]["from_state"] == "ok"
+    assert evs[-1]["to_state"] == "fast_burn"
+    # recovery: a long clean stretch dilutes the short window to zero
+    for _ in range(5000):
+        h.observe(0.1, outcome="completed")
+    eng.evaluate(now=t0 + 3600)
+    s = eng.evaluate(now=t0 + 7800)
+    assert s["objectives"]["availability"]["state"] == "ok"
+    assert _transitions()[-1]["to_state"] == "ok"
+
+
+def _transitions():
+    from nmfx.obs import flight
+
+    return flight.default_recorder().events("slo.transition")
+
+
+def test_latency_objective_counts_over_bound_buckets():
+    reg, h = _slo_registry()
+    eng = slo.SLOEngine(
+        objectives=(slo.Objective("lat", kind="latency", target=0.9,
+                                  bound_s=1.0, budget=0.1),),
+        snapshot_fn=lambda: slo.registry_snapshot(reg))
+    t0 = 2_000_000.0
+    eng.evaluate(now=t0)
+    for _ in range(90):
+        h.observe(0.01, outcome="completed")
+    for _ in range(10):
+        h.observe(30.0, outcome="completed")  # over the 1s bound
+    s = eng.evaluate(now=t0 + 300)
+    lat = s["objectives"]["lat"]
+    # 10% over-bound against a 10% budget: burn exactly 1.0 — AT the
+    # sustainable rate, which is not yet a breach (thresholds are
+    # strict)
+    assert lat["burn"]["5m"] == pytest.approx(1.0)
+    assert lat["state"] == "ok"
+    for _ in range(100):
+        h.observe(30.0, outcome="completed")
+    s = eng.evaluate(now=t0 + 600)
+    lat = s["objectives"]["lat"]
+    # 100% of the new window over-bound: burn 10 — over the slow
+    # pair's 1x but under the fast pair's 14.4x (the multi-window
+    # thresholds grade severity; the slow windows see the lifetime
+    # 110/200 = burn 5.5, also over 1x)
+    assert lat["burn"]["5m"] == pytest.approx(10.0)
+    assert lat["state"] == "slow_burn"
+
+
+def test_floor_objective_rate_and_zero_floor():
+    reg, h = _slo_registry()
+    eng = slo.SLOEngine(
+        objectives=(slo.Objective("goodput", kind="floor",
+                                  value="rate", floor=10.0,
+                                  budget=0.25),
+                    slo.Objective("disabled", kind="floor",
+                                  value="rate", floor=0.0)),
+        snapshot_fn=reg.snapshot)
+    t0 = 3_000_000.0
+    eng.evaluate(now=t0)
+    for _ in range(30):  # 30 req / 300 s = 0.1 req/s << floor 10
+        h.observe(0.1, outcome="completed")
+    s = eng.evaluate(now=t0 + 300)
+    assert s["objectives"]["goodput"]["burn"]["5m"] \
+        == pytest.approx((10.0 - 0.1) / 10.0 / 0.25)
+    # burn ~3.96: over the slow pair's 1x, under the fast pair's 14.4x
+    assert s["objectives"]["goodput"]["state"] == "slow_burn"
+    # a zero floor never burns — shipped-default objectives stay
+    # visible without paging anyone
+    assert s["objectives"]["disabled"]["burn"]["5m"] == 0.0
+    assert s["objectives"]["disabled"]["state"] == "ok"
+
+
+def test_server_stats_snapshot_carries_slo_status():
+    from nmfx.serve import NMFXServer, ServeConfig
+
+    srv = NMFXServer(ServeConfig(), engine=object(), start=False)
+    try:
+        status = srv.stats_snapshot()["slo"]
+        assert set(status["objectives"]) == {
+            "availability", "latency_p99", "goodput", "mfu"}
+        for obj in status["objectives"].values():
+            assert obj["state"] == "ok"
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# cross-process traces: merge + spill/readmit id joins
+# ---------------------------------------------------------------------
+
+def test_merge_traces_aligns_on_wall_clock_anchor(tmp_path):
+    tr_a, tr_b = trace.Tracer(), trace.Tracer()
+    tr_a.enabled = tr_b.enabled = True
+    tr_a._t0_epoch -= 10.0  # process A started 10s earlier
+    with tr_a.span("a.work"):
+        pass
+    with tr_b.span("b.work"):
+        pass
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    tr_a.export(pa)
+    tr_b.export(pb)
+    merged = trace.merge_traces([pa, pb],
+                                path=str(tmp_path / "merged.json"))
+    on_disk = json.load(open(tmp_path / "merged.json"))
+    assert on_disk["metadata"]["nmfx_merged"] == 2
+    xs = {e["name"]: e["ts"] for e in merged["traceEvents"]
+          if e.get("ph") == "X"}
+    # A's span lands ~10s (1e7 us) before B's on the shared axis
+    assert xs["b.work"] - xs["a.work"] > 9e6
+    procs = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert procs == {"a.json", "b.json"}
+
+
+def test_spill_and_readmit_carry_request_identity(tmp_path):
+    """The spill payload carries the original request id; readmission
+    books the serve.readmit join (flight + trace instant) against it —
+    the hooks merge_traces renders as one cross-process timeline."""
+    import numpy as np
+
+    from nmfx.obs import flight
+    from nmfx.serve import NMFXServer, ServeConfig, ServerClosed
+
+    class _Eng:
+        def compatibility_key(self, req):
+            return None
+
+    spill = str(tmp_path / "spill")
+    a = np.abs(np.random.default_rng(0).normal(size=(8, 6))) + 0.1
+    srv = NMFXServer(ServeConfig(spill_dir=spill), engine=_Eng(),
+                     start=False)
+    fut = srv.submit(a, ks=(2,), restarts=2)
+    origin_id = fut.stats.request_id
+    srv.close(cancel_pending=True)
+    with pytest.raises(ServerClosed, match="spilled"):
+        fut.result(timeout=30)
+    names = [n for n in os.listdir(spill) if n.startswith("spill_")]
+    assert len(names) == 1
+    with np.load(os.path.join(spill, names[0]),
+                 allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+    assert meta["request_id"] == origin_id
+    assert meta["spill_pid"] == os.getpid()
+    spill_evs = flight.default_recorder().events("serve.spill")
+    assert spill_evs and spill_evs[-1]["request_id"] == origin_id
+    # readmission books the join against the spilled identity
+    srv2 = NMFXServer(ServeConfig(), engine=_Eng(), start=False)
+    futs = srv2.readmit(spill_dir=spill)
+    assert len(futs) == 1
+    evs = flight.default_recorder().events("serve.readmit")
+    assert evs[-1]["origin_request_id"] == origin_id
+    assert evs[-1]["request_id"] == futs[0].stats.request_id
+    srv2.close(cancel_pending=True)
+
+
+# ---------------------------------------------------------------------
+# nmfx-top
+# ---------------------------------------------------------------------
+
+def test_top_renders_text_and_html(tmp_path):
+    for i in range(2):
+        _publish(tmp_path, f"replica-{i}",
+                 _registry_with(i, obs=[0.01 * (j + 1)
+                                        for j in range(20)]))
+    col = aggregate.FleetCollector(str(tmp_path), stale_after_s=600.0)
+    eng = slo.SLOEngine(snapshot_fn=col.fleet_snapshot)
+    frame = top.gather(col, eng)
+    text = top.render_text(frame, str(tmp_path))
+    assert "replica-0" in text and "replica-1" in text
+    assert "live" in text
+    assert "slo availability" in text and "· ok" in text
+    assert "p50=" in text
+    html_out = top.render_html(frame, str(tmp_path))
+    assert "replica-1" in html_out and "fleet dashboard" in html_out
+    # the CLI surface: --once prints, --html writes the static render
+    out_html = tmp_path / "fleet.html"
+    rc = top.main([str(tmp_path), "--html", str(out_html),
+                   "--stale-after", "600"])
+    assert rc == 0
+    assert "replica-0" in out_html.read_text()
+
+
+def test_top_empty_dir_reports_no_instances(tmp_path, capsys):
+    rc = top.main([str(tmp_path), "--once"])
+    assert rc == 0
+    assert "no telemetry instances" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------
+# true multi-process publishing (OS-process publishers, one ledger)
+# ---------------------------------------------------------------------
+
+_CHILD = """
+import sys
+from nmfx.obs import export, metrics
+
+tdir, idx, series = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+reg = metrics.MetricsRegistry()
+c = reg.counter("nmfx_serve_dispatches_total", "d", ("packed",))
+for s in range(series):
+    c.inc(idx + s + 1, packed=str(s))
+h = reg.histogram("nmfx_serve_solve_seconds", "s")
+for i in range(30):
+    h.observe(0.003 * (i + 1) * (idx + 1))
+export.TelemetryPublisher(tdir, instance=f"child-{idx}", role="bench",
+                          registry=reg).publish_once()
+"""
+
+
+def _run_children(tmp_path, n_children, n_series):
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(tmp_path), str(i),
+         str(n_series)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(n_children)]
+    errs = []
+    for p in procs:
+        try:
+            _, e = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            _, e = p.communicate()
+        if p.returncode != 0:
+            errs.append(e[-3000:])
+    assert not errs, errs
+
+
+def _assert_exact_merge(tmp_path, n_children, n_series):
+    col = aggregate.FleetCollector(str(tmp_path), stale_after_s=600.0)
+    snap = col.fleet_snapshot()
+    c = snap["nmfx_serve_dispatches_total"]["series"]
+    for s in range(n_series):
+        assert c[(str(s),)] == sum(i + s + 1
+                                   for i in range(n_children)), s
+    union = metrics.MetricsRegistry().histogram("u_seconds", "")
+    for i in range(n_children):
+        for j in range(30):
+            union.observe(0.003 * (j + 1) * (i + 1))
+    st = snap["nmfx_serve_solve_seconds"]["series"][()]
+    assert st["count"] == n_children * 30
+    assert st["bucket_counts"] == union.series()[()]["bucket_counts"]
+    for q in (0.5, 0.95, 0.99):
+        assert col.quantile("nmfx_serve_solve_seconds", q,
+                            snapshot=snap) == union.quantile(q), q
+
+
+def test_two_process_publishers_merge_exactly(tmp_path):
+    """Two OS-process publishers x 2 labeled series: fleet counters
+    equal the per-instance sums EXACTLY, histogram bucket counts and
+    quantiles equal the union."""
+    _run_children(tmp_path, n_children=2, n_series=2)
+    _assert_exact_merge(tmp_path, 2, 2)
+
+
+@pytest.mark.slow
+def test_three_process_publishers_many_series_merge_exactly(tmp_path):
+    """The heavier rung: 3 processes x 5 labeled series."""
+    _run_children(tmp_path, n_children=3, n_series=5)
+    _assert_exact_merge(tmp_path, 3, 5)

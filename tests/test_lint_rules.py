@@ -1207,3 +1207,119 @@ def test_nmfx009_rule_registered():
     from nmfx.analysis import RULES
 
     assert "NMFX009" in RULES
+
+
+# ---------------------------------------------------------------- NMFX010
+# registry metric naming + docs-table coverage (ISSUE 14): every live
+# nmfx_* metric must match the nmfx_<subsystem>_<what>[_<unit>] scheme
+# (counters end _total), appear in docs/observability.md's metric
+# table, and no documented row may go stale. Same pure-check +
+# mutated-universe shape as NMFX008/NMFX009.
+
+def _metric_universe(**over):
+    base = dict(
+        live={"nmfx_serve_dispatches_total": "counter",
+              "nmfx_serve_queue_wait_seconds": "histogram",
+              "nmfx_serve_queue_depth": "gauge"},
+        documented=frozenset({"nmfx_serve_dispatches_total",
+                              "nmfx_serve_queue_wait_seconds",
+                              "nmfx_serve_queue_depth"}))
+    base.update(over)
+    return base
+
+
+def test_nmfx010_clean_universe_quiet():
+    from nmfx.analysis.rules_obs import check_metric_naming
+
+    assert check_metric_naming(**_metric_universe()) == []
+
+
+def test_nmfx010_live_tree_clean():
+    """The shipped tree must satisfy its own namespace contract: every
+    live nmfx_* metric is scheme-clean and documented, and every docs
+    row is live (the tier-1 zero-findings gate covers the Rule
+    wrapper; this pins the pure check on the live universe)."""
+    import os
+
+    from nmfx.analysis.rules_obs import (_documented_metrics,
+                                         _live_metrics,
+                                         check_metric_naming)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = _documented_metrics(
+        os.path.join(repo, "docs", "observability.md"))
+    assert check_metric_naming(_live_metrics(), doc) == []
+
+
+def test_nmfx010_bad_name_fires():
+    from nmfx.analysis.rules_obs import check_metric_naming
+
+    u = _metric_universe()
+    u["live"] = dict(u["live"], nmfx_Weird="gauge")
+    u["documented"] = u["documented"] | {"nmfx_Weird"}
+    problems = check_metric_naming(**u)
+    assert len(problems) == 1
+    assert "naming scheme" in problems[0]
+    assert "nmfx_Weird" in problems[0]
+
+
+def test_nmfx010_counter_suffix_fires_both_ways():
+    from nmfx.analysis.rules_obs import check_metric_naming
+
+    u = _metric_universe()
+    u["live"] = dict(u["live"])
+    u["live"]["nmfx_serve_dispatches_total"] = "gauge"  # fake counter
+    u["live"]["nmfx_ckpt_chunks_solved"] = "counter"    # missing _total
+    u["documented"] = u["documented"] | {"nmfx_ckpt_chunks_solved"}
+    problems = check_metric_naming(**u)
+    assert len(problems) == 2
+    assert any("_total" in p and "gauge" in p for p in problems)
+    assert any("must end in '_total'" in p for p in problems)
+
+
+def test_nmfx010_undocumented_and_stale_rows_fire():
+    from nmfx.analysis.rules_obs import check_metric_naming
+
+    u = _metric_universe(documented=frozenset(
+        {"nmfx_serve_dispatches_total",
+         "nmfx_serve_queue_wait_seconds",
+         "nmfx_ghost_metric_total"}))
+    problems = check_metric_naming(**u)
+    assert len(problems) == 2
+    assert any("missing from the docs" in p
+               and "nmfx_serve_queue_depth" in p for p in problems)
+    assert any("stale" in p and "nmfx_ghost_metric_total" in p
+               for p in problems)
+
+
+def test_nmfx010_rule_fires_through_run_on_mutated_docs(tmp_path,
+                                                        monkeypatch):
+    """End-to-end through the Rule wrapper: point the docs table at a
+    copy missing one live metric's row and the registered rule goes
+    red at the registry module; the real docs keep it quiet."""
+    import os
+
+    from nmfx.analysis import rules_obs
+
+    target = ["nmfx/obs/metrics.py"]
+    findings = [f for f in run(target, jaxpr=False,
+                               rule_ids=["NMFX010"])
+                if f.rule_id == "NMFX010"]
+    assert findings == []  # live tree compliant
+    real = rules_obs._documented_metrics(
+        os.path.join("docs", "observability.md"))
+    monkeypatch.setattr(
+        rules_obs, "_documented_metrics",
+        lambda path: frozenset(real - {"nmfx_serve_queue_depth"}))
+    findings = [f for f in run(target, jaxpr=False,
+                               rule_ids=["NMFX010"])
+                if f.rule_id == "NMFX010"]
+    assert len(findings) == 1
+    assert "nmfx_serve_queue_depth" in findings[0].message
+    assert findings[0].file.endswith("nmfx/obs/metrics.py")
+
+
+def test_nmfx010_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX010" in RULES
