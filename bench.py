@@ -2025,6 +2025,219 @@ def main():
                 / max(qe["shed"]["goodput_req_per_s"], 1e-9), 4)
             qe["parity"] = "ok"
 
+        # --- service-tier rungs (ISSUE 15, detail.serve.fleet): the
+        # router + replica pool over the same serving unit. Three
+        # rungs: (a) router OVERHEAD — the identical 1.0x Poisson
+        # arrival schedule through a 1-replica thread router sharing
+        # the warm exec cache, gated within 5% of the direct
+        # NMFXServer p50 (+50ms absolute timer-noise floor, the
+        # obs-stage discipline); (b) SCALING — goodput/p99 vs replica
+        # count 1/2/3 (thread replicas share ONE device in this
+        # process, so CPU-smoke numbers measure router mechanics, not
+        # speedup — the hardware host with per-replica devices is the
+        # real measurement); (c) KILL-A-REPLICA chaos — 3 subprocess
+        # workers against a warm persistent cache, one SIGKILLed at
+        # ~50% of the request ladder; gates: zero lost futures (every
+        # accepted request resolves a RESULT) and every readmitted
+        # request bit-identical to its solo reference (exit 2).
+        import shutil
+        import tempfile
+
+        from nmfx.replica import ReplicaPool
+        from nmfx.router import NMFXRouter, RouterConfig
+
+        fleet = {}
+        rung_root = tempfile.mkdtemp(prefix="nmfx-bench-fleet-")
+        try:
+            # (a) router overhead — PAIRED protocol: the direct server
+            # and the 1-replica router serve the IDENTICAL Poisson
+            # arrival schedule (same rng seed ⇒ same inter-arrival
+            # sleeps), so the comparison isolates the router hop from
+            # arrival-pattern luck (the clean ladder's rng had
+            # progressed through earlier rungs and is not replayable)
+            def _poisson_run(submit_fn):
+                rng_f = np.random.default_rng(seed + 99)
+                t0 = time.perf_counter()
+                futs = []
+                for i in range(n_req):
+                    sd = seeds_t[i % len(seeds_t)]
+                    futs.append((sd, submit_fn(sd)))
+                    if i < n_req - 1:
+                        time.sleep(rng_f.exponential(1.0 / capacity))
+                results = [(sd, f, f.result()) for sd, f in futs]
+                wall = time.perf_counter() - t0
+                lat = np.asarray(sorted(f.stats.latency_s
+                                        for _, f in futs))
+                return results, wall, float(np.percentile(lat, 50))
+
+            with NMFXServer(serve_cfg, exec_cache=cache) as srv:
+                d_results, d_wall, p50_direct = _poisson_run(
+                    lambda sd: srv.submit(a, ks=ks_t,
+                                          restarts=restarts_t, seed=sd,
+                                          solver_cfg=scfg_t))
+            for sd, f, res in d_results:
+                gate(_serve_parity_problems(
+                    res, refs[sd], f"fleet-overhead-direct seed={sd}"))
+            pool = ReplicaPool(
+                1, root=os.path.join(rung_root, "overhead"),
+                mode="thread", serve_cfg=serve_cfg, exec_cache=cache)
+            with NMFXRouter(pool, RouterConfig()) as router:
+                results, wall, p50_router = _poisson_run(
+                    lambda sd: router.submit(a, ks=ks_t,
+                                             restarts=restarts_t,
+                                             seed=sd,
+                                             solver_cfg=scfg_t))
+            for sd, f, res in results:
+                gate(_serve_parity_problems(
+                    res, refs[sd], f"fleet-overhead seed={sd}"))
+            if p50_router > 1.05 * p50_direct + 0.05:
+                gate([f"router overhead: p50 {p50_router:.3f}s through "
+                      f"a 1-replica router vs {p50_direct:.3f}s direct "
+                      "on the identical arrival schedule exceeds the "
+                      "5% (+50ms noise floor) bound"])
+            fleet["overhead"] = {
+                "p50_latency_s": round(p50_router, 3),
+                "p50_direct_s": round(p50_direct, 3),
+                "p50_ratio": round(p50_router
+                                   / max(p50_direct, 1e-9), 4),
+                "goodput_req_per_s": round(len(results) / wall, 4),
+                "direct_goodput_req_per_s": round(
+                    len(d_results) / d_wall, 4),
+                "gate": "p50 <= 1.05x direct + 50ms, paired arrivals",
+                "parity": "ok",
+            }
+            print(f"bench: fleet overhead rung: p50_router="
+                  f"{fleet['overhead']['p50_latency_s']}s "
+                  f"ratio={fleet['overhead']['p50_ratio']}",
+                  file=sys.stderr)
+
+            # (b) goodput + p99 vs replica count (burst arrivals,
+            # stickiness yields to least-loaded so the pool spreads)
+            scaling = []
+            for n_rep in (1, 2, 3):
+                pool = ReplicaPool(
+                    n_rep,
+                    root=os.path.join(rung_root, f"scale{n_rep}"),
+                    mode="thread", serve_cfg=serve_cfg,
+                    exec_cache=cache)
+                with NMFXRouter(pool, RouterConfig(
+                        stickiness_slack=0)) as router:
+                    t0 = time.perf_counter()
+                    futs = [(seeds_t[i % len(seeds_t)], router.submit(
+                        a, ks=ks_t, restarts=restarts_t,
+                        seed=seeds_t[i % len(seeds_t)],
+                        solver_cfg=scfg_t)) for i in range(n_req)]
+                    results = [(sd, f, f.result()) for sd, f in futs]
+                    wall = time.perf_counter() - t0
+                    rstats = router.stats()
+                for sd, f, res in results:
+                    gate(_serve_parity_problems(
+                        res, refs[sd],
+                        f"fleet-scale{n_rep} seed={sd}"))
+                lat = np.asarray(sorted(f.stats.latency_s
+                                        for _, f in futs))
+                scaling.append({
+                    "replicas": n_rep,
+                    "goodput_req_per_s": round(len(results) / wall, 4),
+                    "p50_latency_s": round(
+                        float(np.percentile(lat, 50)), 3),
+                    "p99_latency_s": round(
+                        float(np.percentile(lat, 99)), 3),
+                    "retried": rstats["retried"],
+                })
+                print(f"bench: fleet scaling replicas={n_rep}: "
+                      f"goodput={scaling[-1]['goodput_req_per_s']} "
+                      f"req/s p99={scaling[-1]['p99_latency_s']}s",
+                      file=sys.stderr)
+            fleet["scaling"] = scaling
+            fleet["scaling_note"] = (
+                "thread replicas share one device in this process — "
+                "CPU-smoke scaling measures router mechanics; "
+                "per-replica-device speedup is the hardware "
+                "measurement")
+
+            # (c) kill-a-replica chaos: subprocess workers against a
+            # warm disk cache (the scale-up story: deserialize, don't
+            # compile), one SIGKILLed mid-ladder
+            from nmfx.api import nmfconsensus as _nc
+            from nmfx.config import ExecCacheConfig
+
+            fleet_cache_dir = os.path.join(rung_root, "cache")
+            warm_cache = ExecCache(
+                ExecCacheConfig(cache_dir=fleet_cache_dir))
+            _nc(a, ks=ks_t, restarts=restarts_t, seed=seeds_t[0],
+                solver_cfg=scfg_t, use_mesh=False,
+                exec_cache=warm_cache)  # one solve persists the bucket
+            pool = ReplicaPool(
+                3, root=os.path.join(rung_root, "chaos"),
+                mode="process", cache_dir=fleet_cache_dir)
+            spawn_t0 = time.perf_counter()
+            with NMFXRouter(pool, RouterConfig(
+                    stickiness_slack=0)) as router:
+                while len([p for p in pool.heartbeats(30.0).values()
+                           if not p.get("stale")]) < 3:
+                    if time.perf_counter() - spawn_t0 > 180:
+                        gate(["fleet chaos: replicas failed to "
+                              "heartbeat within 180s of spawn"])
+                    time.sleep(0.1)
+                spawn_wall = time.perf_counter() - spawn_t0
+                n_fleet = 8
+                t0 = time.perf_counter()
+                futs = []
+                for i in range(n_fleet):
+                    sd = seeds_t[i % len(seeds_t)]
+                    futs.append((sd, router.submit(
+                        a, ks=ks_t, restarts=restarts_t, seed=sd,
+                        solver_cfg=scfg_t)))
+                    if i == n_fleet // 2 - 1:
+                        # ~50% of the ladder: SIGKILL the busiest
+                        loads = router.stats(
+                        )["outstanding_per_replica"]
+                        victim_id = max(loads, key=loads.get)
+                        pool.get(victim_id).kill()
+                        print(f"bench: fleet chaos: SIGKILLed "
+                              f"{victim_id} at request {i + 1}/"
+                              f"{n_fleet}", file=sys.stderr)
+                results = []
+                lost = []
+                for sd, f in futs:
+                    try:
+                        results.append((sd, f,
+                                        f.result(timeout=600)))
+                    except Exception as e:
+                        # a typed error or a timed-out (stranded)
+                        # future — both fail the zero-lost-futures
+                        # gate below with the cause in the message
+                        lost.append(f"request seed={sd}: {e!r}")
+                chaos_wall = time.perf_counter() - t0
+                rstats = router.stats()
+            gate([f"fleet chaos: {p} — every accepted request must "
+                  "resolve a result after the kill" for p in lost])
+            for sd, f, res in results:
+                gate(_serve_parity_problems(
+                    res, refs[sd], f"fleet-chaos seed={sd}"))
+            if rstats["readmitted"] < 1:
+                gate(["fleet chaos: the kill stranded no requests to "
+                      "readmit — the rung did not exercise recovery "
+                      "(victim selection failed?)"])
+            fleet["chaos"] = {
+                "replicas": 3, "killed": victim_id,
+                "requests": n_fleet,
+                "spawn_to_live_s": round(spawn_wall, 3),
+                "goodput_req_per_s": round(
+                    len(results) / chaos_wall, 4),
+                "readmitted": rstats["readmitted"],
+                "recovered_replicas": rstats["recovered"],
+                "retried": rstats["retried"],
+                "parity": "ok", "lost_futures": 0,
+            }
+            print(f"bench: fleet chaos rung: killed={victim_id} "
+                  f"readmitted={rstats['readmitted']} "
+                  f"goodput={fleet['chaos']['goodput_req_per_s']} "
+                  "req/s parity=ok", file=sys.stderr)
+        finally:
+            shutil.rmtree(rung_root, ignore_errors=True)
+
         return {
             "unit": f"ks={list(ks_t)} x {restarts_t} restarts over the "
                     f"{args.genes}x{args.samples} bench matrix",
@@ -2035,6 +2248,7 @@ def main():
             "ladder": ladder,
             "chaos": chaos,
             "quality_elastic": qe,
+            "fleet": fleet,
             "parity": "ok",
             "module_counters": {
                 "dispatches": serve_mod.dispatch_count(),

@@ -208,9 +208,9 @@ def _live_metrics() -> "dict[str, str]":
     import importlib
 
     for mod in ("nmfx.exec_cache", "nmfx.data_cache", "nmfx.serve",
-                "nmfx.checkpoint", "nmfx.distributed",
-                "nmfx.obs.costmodel", "nmfx.obs.export",
-                "nmfx.obs.slo"):
+                "nmfx.checkpoint", "nmfx.distributed", "nmfx.router",
+                "nmfx.replica", "nmfx.obs.costmodel",
+                "nmfx.obs.export", "nmfx.obs.slo"):
         importlib.import_module(mod)
     from nmfx.obs import metrics as obs_metrics
 

@@ -478,49 +478,44 @@ class SweepCheckpoint:
         return rec
 
     # -- shard heartbeat/completion ledger (elastic recovery) --------------
+    @property
+    def heartbeat_ledger(self):
+        """The shared :class:`nmfx.obs.export.HeartbeatLedger` this
+        sweep's shard heartbeats write through (``shard_<i>.json`` in
+        the checkpoint directory) — the write/read discipline factored
+        out in ISSUE 15 so the elastic runner and the replica pool
+        behind ``NMFXRouter`` share one liveness idiom."""
+        if getattr(self, "_hb_ledger", None) is None:
+            from nmfx.obs.export import HeartbeatLedger
+
+            self._hb_ledger = HeartbeatLedger(self.directory,
+                                              prefix="shard_")
+        return self._hb_ledger
+
     def heartbeat(self, shard: int, **info) -> None:
-        """Record shard liveness/progress (``shard_<i>.json``, atomic).
-        The elastic runner (``nmfx/distributed.py``) writes one per
-        completed unit and a final ``alive=False`` on shard death;
-        cross-process deployments read :meth:`shard_status` to detect
-        shards whose heartbeat went stale and re-dispatch their
-        incomplete chunks (completion records are the ground truth — a
-        re-dispatched chunk that WAS committed is simply skipped).
-        The payload always carries the writing process's pid (plus any
-        caller fields — the elastic runner adds its cross-process
-        ``trace_id``), so a fleet view over N sharding processes can
-        attribute each shard heartbeat to its process and join it with
-        that process's telemetry snapshots and trace exports
-        (docs/observability.md "Fleet telemetry")."""
-        path = os.path.join(self.directory, f"shard_{shard}.json")
-        payload = dict(info, shard=shard, pid=os.getpid(),
-                       time=time.time())
-        tmp = f"{path}.tmp.{os.getpid()}"
-        try:
-            with open(tmp, "wt") as f:
-                json.dump(payload, f)
-            os.replace(tmp, path)
-        except OSError:  # nmfx: ignore[NMFX006] -- liveness side-channel
-            pass         # only; completion records are the ground truth
+        """Record shard liveness/progress (``shard_<i>.json``, atomic,
+        best-effort — the shared ledger's contract). The elastic runner
+        (``nmfx/distributed.py``) writes one per completed unit and a
+        final ``alive=False`` on shard death; cross-process deployments
+        read :meth:`shard_status` to detect shards whose heartbeat went
+        stale and re-dispatch their incomplete chunks (completion
+        records are the ground truth — a re-dispatched chunk that WAS
+        committed is simply skipped). The payload always carries the
+        writing process's pid (plus any caller fields — the elastic
+        runner adds its cross-process ``trace_id``), so a fleet view
+        over N sharding processes can attribute each shard heartbeat to
+        its process and join it with that process's telemetry snapshots
+        and trace exports (docs/observability.md "Fleet telemetry")."""
+        self.heartbeat_ledger.beat(str(shard), shard=shard, **info)
 
     def shard_status(self, stale_after_s: "float | None" = None) -> dict:
         """``{shard: heartbeat_payload}``; with ``stale_after_s`` each
-        payload gains ``stale=True/False`` from its last-write age."""
-        out: dict = {}
-        for name in os.listdir(self.directory):
-            if not (name.startswith("shard_") and name.endswith(".json")):
-                continue
-            try:
-                with open(os.path.join(self.directory, name)) as f:
-                    payload = json.load(f)
-            except (OSError, json.JSONDecodeError):
-                # nmfx: ignore[NMFX006] -- a torn heartbeat IS staleness
-                continue
-            if stale_after_s is not None:
-                payload["stale"] = (time.time() - payload.get("time", 0)
-                                    > stale_after_s)
-            out[payload.get("shard")] = payload
-        return out
+        payload gains ``stale=True/False`` (and ``age_s``) from its
+        last-write age — :meth:`HeartbeatLedger.status`, keyed back by
+        the numeric shard id."""
+        status = self.heartbeat_ledger.status(stale_after_s)
+        return {payload.get("shard"): payload
+                for payload in status.values()}
 
 
 # -- chunk execution -------------------------------------------------------

@@ -306,6 +306,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "direct path — the serving exactness contract "
                         "(docs/serving.md 'Serving front-end'). Implies "
                         "--exec-cache; single-device (no shard flags)")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="with --serve-smoke: route the request through "
+                        "the resilient service tier instead of one "
+                        "server — an NMFXRouter over N in-process "
+                        "replica servers (nmfx.replica.ReplicaPool, "
+                        "thread mode; docs/serving.md 'Service tier'). "
+                        "Results stay bit-identical to the direct "
+                        "path; the router stats (placement, retries, "
+                        "readmissions) are reported to stderr")
+    p.add_argument("--router-spill-dir", default=None, metavar="DIR",
+                   help="with --replicas: root directory of the "
+                        "replica pool's spill/heartbeat ledger (spill-"
+                        "migration records and replica_<id>.json "
+                        "heartbeats live here; default: a temporary "
+                        "directory)")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "
@@ -655,6 +670,22 @@ def _run_cli(argv: list[str] | None = None) -> int:
     if args.slo and not args.serve_smoke:
         parser.error("--slo reports the serving engine's SLO burn "
                      "status; pass --serve-smoke")
+    if args.replicas is not None:
+        # service-tier compose-guards (reject-don't-drop)
+        if not args.serve_smoke:
+            parser.error("--replicas runs the serving engine behind "
+                         "the router front door; pass --serve-smoke")
+        if args.replicas < 1:
+            parser.error("--replicas must be >= 1")
+        if args.metrics_port is not None:
+            parser.error("--metrics-port does not compose with "
+                         "--replicas (N in-process replica servers "
+                         "cannot share one HTTP port; scrape the "
+                         "merged fleet via --telemetry-dir + "
+                         "nmfx.obs.aggregate instead)")
+    elif args.router_spill_dir is not None:
+        parser.error("--router-spill-dir configures the replica "
+                     "pool's ledger; pass --replicas")
     if args.serve_smoke:
         if mesh is not None:
             parser.error("--serve-smoke owns ONE device (the serving "
@@ -815,6 +846,9 @@ def _serve_smoke(args, run_scfg, exec_cache, output, profiler):
     from nmfx.config import InitConfig
     from nmfx.serve import NMFXServer, ServeConfig
 
+    if args.replicas is not None:
+        return _serve_smoke_router(args, run_scfg, exec_cache, output,
+                                   profiler)
     serve_cfg = ServeConfig(telemetry_dir=args.telemetry_dir,
                             metrics_port=args.metrics_port)
     with NMFXServer(serve_cfg, exec_cache=exec_cache,
@@ -863,6 +897,182 @@ def _serve_smoke(args, run_scfg, exec_cache, output, profiler):
         with profiler.phase("write_outputs"):
             save_results(result, output)
     return result
+
+
+def _serve_smoke_router(args, run_scfg, exec_cache, output, profiler):
+    """The service-tier smoke: the same single request through an
+    ``NMFXRouter`` over ``--replicas`` in-process replica servers —
+    results stay bit-identical to the direct path (the serving
+    exactness contract holds THROUGH the router), and the router's
+    placement/failover books are reported."""
+    import tempfile
+
+    from nmfx.api import save_results
+    from nmfx.config import InitConfig
+    from nmfx.replica import ReplicaPool
+    from nmfx.router import NMFXRouter, RouterConfig
+    from nmfx.serve import ServeConfig
+
+    import shutil
+
+    ephemeral = args.router_spill_dir is None
+    root = args.router_spill_dir if not ephemeral \
+        else tempfile.mkdtemp(prefix="nmfx-router-")
+    pool = ReplicaPool(
+        args.replicas, root=root, mode="thread",
+        serve_cfg=ServeConfig(),
+        exec_cache=exec_cache, telemetry_dir=args.telemetry_dir)
+    try:
+        with NMFXRouter(pool, RouterConfig()) as router:
+            fut = router.submit(args.dataset, ks=args.ks,
+                                restarts=args.restarts, seed=args.seed,
+                                solver_cfg=run_scfg,
+                                init_cfg=InitConfig(method=args.init),
+                                label_rule=args.label_rule,
+                                linkage=args.linkage,
+                                grid_slots=args.grid_slots,
+                                grid_tail_slots=args.grid_tail_slots)
+            result = fut.result()
+            s = router.stats()
+            if args.slo:
+                slo_status = router.slo_status(evaluate=True)
+                for name, obj in sorted(
+                        slo_status["objectives"].items()):
+                    burns = " ".join(
+                        f"{w}={'n/a' if b is None else round(b, 3)}"
+                        for w, b in obj["burn"].items())
+                    print(f"nmfx: slo {name}: state={obj['state']} "
+                          f"burn[{burns}]", file=sys.stderr)
+    finally:
+        if ephemeral:
+            # an unnamed pool root is run-scoped scratch — don't
+            # litter the temp dir with heartbeats/spill subdirs
+            shutil.rmtree(root, ignore_errors=True)
+    st = fut.stats
+    print("nmfx: serve-smoke (router): replicas="
+          f"{args.replicas} submitted={s['submitted']} "
+          f"completed={s['completed']} retried={s['retried']} "
+          f"readmitted={s['readmitted']} "
+          f"replica={st.replica} sticky={st.sticky} "
+          f"attempts={st.attempts} "
+          f"latency={'n/a' if st.latency_s is None else f'{st.latency_s:.3f}s'}",
+          file=sys.stderr)
+    if args.telemetry_dir is not None:
+        print(f"nmfx: telemetry published to {args.telemetry_dir} "
+              f"(fleet view: nmfx-top {args.telemetry_dir})",
+              file=sys.stderr)
+    if output is not None:
+        with profiler.phase("write_outputs"):
+            save_results(result, output)
+    return result
+
+
+def router_main(argv: "list[str] | None" = None) -> int:
+    """``nmfx-router`` — run a dataset's consensus requests through the
+    resilient service tier (router + replica pool) and report the
+    routing books. The operational entrypoint for the service tier:
+    thread replicas for one-process/multi-request serving, subprocess
+    replicas (``--mode process``) for the production shape — each
+    worker cold-starts against the warm persistent executable cache
+    (``--cache-dir``), which is what makes scale-up ~1 s instead of
+    ~22 s (docs/serving.md 'Service tier')."""
+    import argparse
+    import tempfile
+
+    p = argparse.ArgumentParser(
+        prog="nmfx-router",
+        description="Route consensus requests through the resilient "
+                    "service tier: an NMFXRouter front door over N "
+                    "replica servers with health-checked failover, "
+                    "spill-migration, and SLO-driven shedding.")
+    p.add_argument("dataset", help="input .gct or .res file")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--mode", choices=("thread", "process"),
+                   default="thread",
+                   help="replica kind: in-process servers (thread) or "
+                        "subprocess workers (process)")
+    p.add_argument("--requests", type=int, default=1, metavar="R",
+                   help="submit R copies of the request with distinct "
+                        "seeds (seed, seed+1, ...) — a small traffic "
+                        "sample through the tier")
+    p.add_argument("--ks", default="2-5", type=parse_ks)
+    p.add_argument("--restarts", type=int, default=10)
+    p.add_argument("--maxiter", type=int, default=10000)
+    p.add_argument("--seed", type=int, default=123)
+    p.add_argument("--algorithm", choices=ALGORITHMS, default="mu")
+    p.add_argument("--spill-root", default=None, metavar="DIR",
+                   help="pool root (spill records + heartbeat ledger; "
+                        "default: a temporary directory)")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="persistent executable cache replicas start "
+                        "against (process mode: what makes spawn "
+                        "warm)")
+    p.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                   help="fleet telemetry ledger (watch live with "
+                        "nmfx-top DIR)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="enable the router's metrics-driven "
+                        "autoscaler (RouterConfig.autoscale)")
+    args = p.parse_args(argv)
+    if not os.path.isfile(args.dataset):
+        p.error(f"dataset not found: {args.dataset}")
+    if args.replicas < 1:
+        p.error("--replicas must be >= 1")
+    if args.requests < 1:
+        p.error("--requests must be >= 1")
+    from nmfx.config import ExecCacheConfig, SolverConfig
+    from nmfx.replica import ReplicaPool
+    from nmfx.router import NMFXRouter, RouterConfig
+
+    exec_cache = None
+    if args.cache_dir is not None and args.mode == "thread":
+        from nmfx.exec_cache import ExecCache
+
+        exec_cache = ExecCache(ExecCacheConfig(cache_dir=args.cache_dir))
+    import shutil
+
+    ephemeral = args.spill_root is None
+    root = args.spill_root if not ephemeral \
+        else tempfile.mkdtemp(prefix="nmfx-router-")
+    pool = ReplicaPool(args.replicas, root=root, mode=args.mode,
+                       exec_cache=exec_cache, cache_dir=args.cache_dir,
+                       telemetry_dir=args.telemetry_dir)
+    scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter)
+    try:
+        with NMFXRouter(pool, RouterConfig(
+                autoscale=args.autoscale)) as router:
+            futs = [router.submit(args.dataset, ks=args.ks,
+                                  restarts=args.restarts,
+                                  seed=args.seed + i, solver_cfg=scfg)
+                    for i in range(args.requests)]
+            failed = 0
+            for fut in futs:
+                try:
+                    result = fut.result()
+                except Exception as e:  # nmfx: ignore[NMFX006] -- each
+                    # outcome is REPORTED per request; the command's
+                    # exit code carries the failure
+                    failed += 1
+                    print(f"nmfx-router: request "
+                          f"{fut.stats.request_id} FAILED: {e!r}",
+                          file=sys.stderr)
+                else:
+                    print(f"nmfx-router: request "
+                          f"{fut.stats.request_id} "
+                          f"ok on {fut.stats.replica} "
+                          f"(attempts={fut.stats.attempts})",
+                          file=sys.stderr)
+                    print(result.summary())
+            s = router.stats()
+    finally:
+        if ephemeral:
+            shutil.rmtree(root, ignore_errors=True)
+    print("nmfx-router: "
+          + " ".join(f"{k}={s[k]}" for k in
+                     ("submitted", "completed", "failed", "retried",
+                      "readmitted", "drained", "recovered",
+                      "routable_replicas")), file=sys.stderr)
+    return 1 if failed else 0
 
 
 def _warm_line(rec: dict) -> str:

@@ -326,6 +326,18 @@ class ElasticShardRunner:
                 self._inflight -= 1
                 self._cond.notify_all()
 
+    def shard_status(self, stale_after_s: "float | None" = None) -> dict:
+        """``{shard: heartbeat_payload}`` from the shared heartbeat
+        ledger this runner's shards beat into (``SweepCheckpoint
+        .heartbeat_ledger`` — the same :class:`nmfx.obs.export
+        .HeartbeatLedger` idiom the replica pool behind ``NMFXRouter``
+        uses for replica liveness, ISSUE 15). With ``stale_after_s``
+        each payload carries ``stale``/``age_s``, so a cross-process
+        supervisor can spot a shard whose process died without a final
+        ``alive=False`` heartbeat and re-dispatch its incomplete units
+        (completion records stay the ground truth)."""
+        return self.ck.shard_status(stale_after_s)
+
     def run(self) -> dict:
         """Dispatch until every unit is committed (or every shard died);
         returns ``{(k, r0, r1): ChunkSweepOutput}`` for the units this

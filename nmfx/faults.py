@@ -56,6 +56,16 @@ Sites (see docs/serving.md "Failure model" for the recovery matrix):
                       BaseException, unswallowable; kill-and-resume
                       chaos for tests, bench ``detail.durability``, and
                       the elastic shard runner)
+``router.forward``    the router's forward-to-replica step
+                      (``nmfx/router.py``; recovery = backoff retry on
+                      ANOTHER replica, at-most-once dispatch preserved)
+``replica.spawn``     replica-pool scale-up (``nmfx/replica.py``; a
+                      failed spawn degrades warn-once — the fleet keeps
+                      serving at its current size)
+``replica.heartbeat`` a replica's heartbeat/telemetry publication (the
+                      frozen-publisher rehearsal: the replica keeps
+                      serving but reads as stale, and the router drains
+                      it — queued requests land on survivors)
 ==================== ====================================================
 """
 
@@ -78,7 +88,8 @@ __all__ = ["SITES", "FaultConfig", "FaultInjected", "InsufficientRestarts",
 SITES = ("h2d.transfer", "compile.build", "persist.deserialize",
          "harvest.worker", "serve.scheduler", "solve.nonfinite",
          "sched.stale_reload", "ckpt.write", "ckpt.load",
-         "proc.preempt")
+         "proc.preempt", "router.forward", "replica.spawn",
+         "replica.heartbeat")
 
 #: sites whose armed state changes TRACED code and therefore must key
 #: the builder/executable caches (see trace_token)

@@ -196,7 +196,7 @@ class FleetCollector:
         rows = []
         for instance, payload in payloads.items():
             age = now - float(payload.get("time", 0.0))
-            rows.append({
+            row = {
                 "instance": instance,
                 "pid": payload.get("pid"),
                 "host": payload.get("host"),
@@ -205,7 +205,16 @@ class FleetCollector:
                 "seq": payload.get("seq"),
                 "heartbeat_age_s": round(age, 3),
                 "stale": age > self.stale_after_s,
-            })
+            }
+            # per-instance status levels embedded in the payload
+            # (ISSUE 15): the honest queue/inflight signal when N
+            # replicas share one process registry — see
+            # export.build_snapshot
+            status = payload.get("status")
+            if isinstance(status, dict):
+                row.update({k: v for k, v in status.items()
+                            if k not in row})
+            rows.append(row)
         return rows
 
     def _stale_set(self, payloads: dict,

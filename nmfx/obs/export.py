@@ -48,8 +48,8 @@ import time
 
 from nmfx.obs import metrics as _metrics
 
-__all__ = ["TelemetryPublisher", "build_snapshot", "serve_metrics",
-           "snapshot_path"]
+__all__ = ["HeartbeatLedger", "TelemetryPublisher", "build_snapshot",
+           "serve_metrics", "snapshot_path"]
 
 #: snapshot format version — the collector skips (warn-once) files
 #: written by a future incompatible format instead of misreading them
@@ -88,16 +88,111 @@ def snapshot_path(telemetry_dir: str, instance: str) -> str:
                         f"{FILE_PREFIX}{_safe_instance(instance)}.json")
 
 
+# --------------------------------------------------------------------------
+class HeartbeatLedger:
+    """Atomic per-instance JSON heartbeats in a shared directory — the
+    ``shard_<i>.json`` idiom of the durable sweep ledger
+    (``SweepCheckpoint.heartbeat``/``shard_status``), factored out
+    (ISSUE 15) so every liveness consumer shares ONE write/read
+    discipline: elastic shards, replica pools behind a router, and
+    anything else that needs cheap cross-process "I am alive and here
+    is my level" signaling without serializing a full registry
+    snapshot.
+
+    Semantics (the telemetry ledger's, scaled down):
+
+    * one file per instance, ``<prefix><instance>.json``, written via
+      tmp+rename — a reader can never observe a torn file from a live
+      writer, and a torn file from a crashed writer reads as staleness;
+    * liveness is the payload's embedded wall-clock ``time`` (what the
+      process asserted), never mtime;
+    * writes are best-effort: a heartbeat is a side channel, and an
+      unwritable ledger must never take the heartbeating path down.
+    """
+
+    def __init__(self, directory: str, *, prefix: str = "hb_"):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.prefix = prefix
+
+    def path(self, instance: str) -> str:
+        return os.path.join(
+            self.directory,
+            f"{self.prefix}{_safe_instance(str(instance))}.json")
+
+    def beat(self, instance: str, **info) -> "str | None":
+        """Write one heartbeat (payload = ``info`` + pid + time);
+        returns the path, or None when the write failed (best-effort
+        by design — completion records / telemetry snapshots stay the
+        ground truth)."""
+        path = self.path(instance)
+        payload = dict(info, instance=str(instance), pid=os.getpid(),
+                       time=time.time())
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wt") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:  # nmfx: ignore[NMFX006] -- liveness side-channel
+            return None  # only; see the class docstring
+        return path
+
+    def read(self, instance: str) -> "dict | None":
+        try:
+            with open(self.path(instance)) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            # nmfx: ignore[NMFX006] -- a torn heartbeat IS staleness
+            return None
+        return payload if isinstance(payload, dict) else None
+
+    def status(self, stale_after_s: "float | None" = None) -> dict:
+        """``{instance: payload}`` for every readable heartbeat; with
+        ``stale_after_s`` each payload gains ``stale`` and ``age_s``
+        from its embedded write time."""
+        out: dict = {}
+        now = time.time()
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(self.prefix)
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    payload = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                # nmfx: ignore[NMFX006] -- a torn heartbeat IS staleness
+                continue
+            if not isinstance(payload, dict):
+                continue
+            age = now - float(payload.get("time", 0.0))
+            if stale_after_s is not None:
+                payload["age_s"] = round(age, 3)
+                payload["stale"] = age > stale_after_s
+            key = payload.get("instance",
+                              name[len(self.prefix):-len(".json")])
+            out[key] = payload
+        return out
+
+
 def build_snapshot(registry: "_metrics.MetricsRegistry | None" = None,
                    *, instance: str = "", role: str = "process",
-                   seq: int = 0) -> dict:
+                   seq: int = 0, status: "dict | None" = None) -> dict:
     """One publishable snapshot: instance identity (instance name, pid,
     host, role, device kind), the heartbeat timestamp, and the full
     registry snapshot enriched with each metric's help text and (for
     histograms) bucket bounds — everything the collector needs to
     merge and re-export without importing the publishing process's
     modules. Series label-tuples serialize as lists (JSON has no
-    tuples); the collector converts them back."""
+    tuples); the collector converts them back. ``status`` is an
+    optional small dict of per-INSTANCE levels (queue depth, inflight)
+    riding the payload itself — the honest load signal when several
+    instances share one process registry (N in-process replicas would
+    overwrite each other's process-wide gauges), surfaced on the
+    collector's instance rows and the ``nmfx-top`` table."""
     reg = registry if registry is not None else _metrics.registry()
     snap = reg.snapshot()
     payload_metrics: dict = {}
@@ -113,7 +208,7 @@ def build_snapshot(registry: "_metrics.MetricsRegistry | None" = None,
         if rec["type"] == "histogram" and m is not None:
             entry["buckets"] = list(m.buckets)
         payload_metrics[name] = entry
-    return {
+    payload = {
         "format": FORMAT_VERSION,
         "instance": instance,
         "pid": os.getpid(),
@@ -124,6 +219,9 @@ def build_snapshot(registry: "_metrics.MetricsRegistry | None" = None,
         "seq": seq,
         "metrics": payload_metrics,
     }
+    if status:
+        payload["status"] = dict(status)
+    return payload
 
 
 class TelemetryPublisher:
@@ -138,7 +236,8 @@ class TelemetryPublisher:
     def __init__(self, telemetry_dir: str, *,
                  instance: "str | None" = None, role: str = "server",
                  interval_s: float = 2.0,
-                 registry: "_metrics.MetricsRegistry | None" = None):
+                 registry: "_metrics.MetricsRegistry | None" = None,
+                 status_fn=None):
         if interval_s <= 0:
             raise ValueError("interval_s must be positive")
         os.makedirs(telemetry_dir, exist_ok=True)
@@ -149,6 +248,10 @@ class TelemetryPublisher:
         self.path = snapshot_path(telemetry_dir, self.instance)
         self.interval_s = interval_s
         self._registry = registry
+        #: optional callable returning the per-instance ``status`` dict
+        #: embedded in each snapshot (see build_snapshot) — a failing
+        #: status_fn degrades to no status, never a missed heartbeat
+        self._status_fn = status_fn
         self._seq = 0
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
@@ -165,8 +268,19 @@ class TelemetryPublisher:
         None when the write failed (warn-once)."""
         from nmfx.faults import warn_once
 
+        status = None
+        if self._status_fn is not None:
+            try:
+                status = self._status_fn()
+            except Exception as e:  # nmfx: ignore[NMFX006] -- degrades
+                # to a status-less (still live) heartbeat, warn-once'd
+                warn_once("telemetry-status-fn-failed",
+                          f"telemetry status_fn failed ({e!r}); "
+                          "publishing without per-instance status")
+                status = None
         payload = build_snapshot(self._registry, instance=self.instance,
-                                 role=self.role, seq=self._seq)
+                                 role=self.role, seq=self._seq,
+                                 status=status)
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:

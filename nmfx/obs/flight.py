@@ -68,6 +68,9 @@ FAULT_EVENTS = {
     "ckpt.write": "fault.ckpt.write",
     "ckpt.load": "fault.ckpt.load",
     "proc.preempt": "fault.proc.preempt",
+    "router.forward": "fault.router.forward",
+    "replica.spawn": "fault.replica.spawn",
+    "replica.heartbeat": "fault.replica.heartbeat",
 }
 
 

@@ -108,6 +108,19 @@ METRICS = (
     MetricSpec("serve_chaos_goodput_retention",
                ("detail.serve.chaos.goodput_retention",), "higher",
                0.25),
+    # --- service tier (ISSUE 15: router + replica pool) -------------
+    MetricSpec("fleet_router_p50_ratio",
+               ("detail.serve.fleet.overhead.p50_ratio",), "lower",
+               0.25,
+               "router-vs-direct p50; the bench's own gate is the "
+               "hard 1.05x (+50ms) bound, this tracks drift"),
+    MetricSpec("fleet_goodput_3_replicas",
+               ("detail.serve.fleet.scaling[replicas=3]"
+                ".goodput_req_per_s",), "higher", 0.35),
+    MetricSpec("fleet_chaos_goodput_req_per_s",
+               ("detail.serve.fleet.chaos.goodput_req_per_s",),
+               "higher", 0.35,
+               "goodput with one of 3 replicas SIGKILLed mid-ladder"),
     MetricSpec("durability_resume_overhead_s",
                ("detail.durability.resume_overhead_s",), "lower", 0.50),
     MetricSpec("obs_overhead_frac", ("detail.obs.overhead_frac",),

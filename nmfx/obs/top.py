@@ -84,7 +84,12 @@ def gather(collector: FleetCollector, engine: "_slo.SLOEngine",
         for key, val in rec["series"].items():
             gauge_by_instance.setdefault(key[0], {})[field] = val
     for row in rows:
-        row.update(gauge_by_instance.get(row["instance"], {}))
+        # payload-embedded status (per-instance levels, ISSUE 15) wins
+        # over the process-wide gauges, which N in-process replicas
+        # overwrite each other on
+        for field, val in gauge_by_instance.get(row["instance"],
+                                                {}).items():
+            row.setdefault(field, val)
     e2e = snap.get("nmfx_serve_e2e_seconds")
     outcomes: "dict[str, int]" = {}
     if e2e is not None and "outcome" in e2e["labels"]:
@@ -121,6 +126,26 @@ def _fmt(v, suffix="", digits=3) -> str:
     return f"{v:.{digits}f}{suffix}"
 
 
+def _role_summary(rows: "list[dict]") -> str:
+    """One line summarizing the fleet BY ROLE (ISSUE 15): a service
+    tier reads as "router 1 live · replica 2 live / 1 stale", so an
+    operator sees the front door and its pool distinctly without
+    scanning the instance table."""
+    by_role: "dict[str, list[bool]]" = {}
+    for row in rows:
+        by_role.setdefault(str(row.get("role")), []).append(
+            bool(row["stale"]))
+    parts = []
+    for role in sorted(by_role):
+        stales = by_role[role]
+        live = len(stales) - sum(stales)
+        part = f"{role} {live} live"
+        if sum(stales):
+            part += f" / {sum(stales)} stale"
+        parts.append(part)
+    return " · ".join(parts)
+
+
 def render_text(frame: dict, telemetry_dir: str) -> str:
     """The terminal frame — plain text, fixed-width columns."""
     lines = [f"nmfx-top — fleet telemetry from {telemetry_dir}"]
@@ -129,6 +154,7 @@ def render_text(frame: dict, telemetry_dir: str) -> str:
         lines.append("  (no telemetry instances found — is anything "
                      "publishing here?)")
         return "\n".join(lines) + "\n"
+    lines.append("roles: " + _role_summary(rows))
     lines.append(f"{'instance':<34}{'role':<9}{'pid':>7} "
                  f"{'device':<14}{'hb age':>8} {'state':<6}"
                  f"{'queue':>6}{'infl':>6}")
@@ -236,6 +262,7 @@ def render_html(frame: dict, telemetry_dir: str) -> str:
 <div class="sub">telemetry: {esc(telemetry_dir)} · rendered {stamp}
 </div>
 <h2>Instances</h2>
+<div class="sub">roles: {esc(_role_summary(frame["instances"]))}</div>
 <table><tr><th>instance</th><th>role</th><th>pid</th><th>device</th>
 <th>hb age</th><th>state</th><th>queue</th><th>inflight</th></tr>
 {inst_rows or '<tr><td colspan="8">no instances</td></tr>'}</table>

@@ -87,8 +87,12 @@ if TYPE_CHECKING:
 __all__ = ["DeadlineExceeded", "Engine", "ExecCacheEngine", "NMFXServer",
            "QueueFull", "RequestFailed", "RequestStats", "ServeConfig",
            "ServeError", "ServerClosed", "ServerCrashed",
-           "dispatch_count", "packed_dispatch_count",
-           "packing_efficiency", "serve_key_fields"]
+           "break_spill_claim", "claim_spill", "dispatch_count",
+           "list_spills", "load_spill_record", "packed_dispatch_count",
+           "packing_efficiency", "release_spill_claim",
+           "serve_key_fields", "spill_claimant", "spill_dataset",
+           "spill_meta", "spill_submit_kwargs", "verify_spill_claim",
+           "write_spill_record"]
 
 
 # --------------------------------------------------------------------------
@@ -178,6 +182,285 @@ def _note_dispatch(n_requests: int, lanes: int) -> None:
     packed = "true" if n_requests >= 2 else "false"
     _dispatch_total.inc(packed=packed)
     _lanes_total.inc(lanes, packed=packed)
+
+
+# --------------------------------------------------------------------------
+# spill records + the claim protocol (ISSUE 15)
+#
+# A spill record is ONE request's full submission payload as an atomic
+# npz (``spill_*.npz``: the matrix + a JSON meta blob) — written by a
+# server spilling its queue on shutdown (``ServeConfig.spill_dir``), by
+# a router forwarding to a subprocess replica (the record IS the
+# forward), or by anything else that needs a request to survive a
+# process. Re-admitting one through :func:`spill_submit_kwargs` +
+# ``NMFXServer.submit`` reproduces the original submission
+# field-for-field, so results are bit-identical by the serving
+# exactness contract.
+#
+# The CLAIM protocol makes spill directories safe for MULTIPLE
+# consumers (two routers recovering one dead replica, N survivor
+# replicas draining one spill dir): a consumer must own
+# ``<record>.claim`` before readmitting, created with O_CREAT|O_EXCL —
+# the one atomic-exclusive primitive POSIX gives us (tmp+rename
+# REPLACES silently, so it cannot express mutual exclusion). Exclusion
+# is by existence; the claim's JSON payload (claimant, pid, time) is
+# advisory context for breaking the claim of a consumer that died
+# between claiming and readmitting (:func:`break_spill_claim`). The
+# record and its claim are removed only after the re-admission
+# SUCCEEDED, so a consumer crash at any point leaves either an
+# unclaimed record (anyone readmits) or a stale claim (broken by pid
+# or age), never a lost or double-readmitted request —
+# tests/test_multiprocess.py races two OS processes over one spill dir
+# to pin exactly-once re-admission.
+# --------------------------------------------------------------------------
+
+#: spill record filenames: spill_<unique>.npz (+ .claim while owned)
+SPILL_PREFIX = "spill_"
+_CLAIM_SUFFIX = ".claim"
+
+
+def spill_meta(*, request_id, ks, restarts, seed, scfg, icfg,
+               label_rule="argmax", linkage="average", grid_slots=48,
+               grid_tail_slots="auto", min_restarts=1, priority=0,
+               col_names=(), **extra) -> dict:
+    """The JSON-serializable meta half of a spill record. ``extra``
+    keys (e.g. a router's own request id) ride along verbatim and come
+    back from :func:`load_spill_record`."""
+    import os
+
+    meta = {
+        "request_id": request_id, "spill_pid": os.getpid(),
+        "ks": [int(k) for k in ks], "restarts": int(restarts),
+        "seed": int(seed), "label_rule": label_rule, "linkage": linkage,
+        "grid_slots": int(grid_slots),
+        "grid_tail_slots": (list(grid_tail_slots)
+                            if isinstance(grid_tail_slots, (list, tuple))
+                            else grid_tail_slots),
+        "min_restarts": int(min_restarts), "priority": int(priority),
+        "col_names": [str(c) for c in col_names],
+        "solver_cfg": dataclasses.asdict(scfg),
+        "init_cfg": dataclasses.asdict(icfg),
+    }
+    meta.update(extra)
+    return meta
+
+
+def write_spill_record(path: str, a: np.ndarray, meta: dict) -> str:
+    """Atomically persist one spill record (tmp+rename via the
+    checkpoint ledger's writer, which also passes the ``ckpt.write``
+    chaos site)."""
+    import json
+    import os
+
+    from nmfx.checkpoint import atomic_save_npz
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    atomic_save_npz(path, {"a": np.asarray(a),
+                           "meta": np.asarray(json.dumps(meta))})
+    return path
+
+
+def load_spill_record(path: str) -> "tuple[np.ndarray, dict]":
+    """Read one spill record back (raises on torn/corrupt — callers
+    apply the ledger's skip-warn-once discipline). Passes the
+    ``ckpt.load`` chaos site."""
+    import json
+
+    from nmfx import faults
+
+    faults.inject("ckpt.load")
+    with np.load(path, allow_pickle=False) as z:
+        a = z["a"]
+        meta = json.loads(str(z["meta"]))
+    return a, meta
+
+
+def spill_submit_kwargs(meta: dict) -> dict:
+    """Reconstruct ``NMFXServer.submit`` keyword arguments from a spill
+    record's meta — the ONE re-admission funnel ``readmit``, the
+    router's failover path, and the subprocess replica worker all
+    share, so a readmitted request is field-for-field the original
+    submission no matter who readmits it."""
+    from nmfx.config import ExperimentalConfig, SketchConfig
+
+    solver = dict(meta["solver_cfg"])
+    exp = solver.pop("experimental")
+    # nested configs were asdict()-flattened at spill time; sketch may
+    # be absent in pre-ISSUE-12 spill records
+    sk = solver.pop("sketch", None)
+    scfg = SolverConfig(**solver,
+                        experimental=ExperimentalConfig(**exp),
+                        sketch=(SketchConfig(**sk) if sk is not None
+                                else SketchConfig()))
+    icfg = InitConfig(**meta["init_cfg"])
+    tail = meta["grid_tail_slots"]
+    if isinstance(tail, list):
+        tail = tuple(tail)
+    return dict(ks=tuple(meta["ks"]), restarts=meta["restarts"],
+                seed=meta["seed"], solver_cfg=scfg, init_cfg=icfg,
+                label_rule=meta["label_rule"], linkage=meta["linkage"],
+                grid_slots=meta["grid_slots"], grid_tail_slots=tail,
+                min_restarts=meta["min_restarts"],
+                priority=meta["priority"])
+
+
+def spill_dataset(a: np.ndarray, meta: dict):
+    """A Dataset carrying the spilled col_names back through submit's
+    ``_as_matrix``, so the re-admitted result is field-for-field what
+    the original submission would have delivered (row names were never
+    retained by the request)."""
+    from nmfx.io import Dataset
+
+    names = [str(c) for c in meta["col_names"]]
+    return Dataset(values=a,
+                   row_names=[str(i + 1) for i in range(a.shape[0])],
+                   col_names=names)
+
+
+def list_spills(spill_dir: str) -> "list[str]":
+    """The spill record paths in a directory, sorted (stable
+    re-admission order across consumers)."""
+    import os
+
+    if not os.path.isdir(spill_dir):
+        return []
+    return [os.path.join(spill_dir, name)
+            for name in sorted(os.listdir(spill_dir))
+            if name.startswith(SPILL_PREFIX) and name.endswith(".npz")]
+
+
+def claim_spill(path: str, claimant: str) -> bool:
+    """Atomically claim one spill record for re-admission. True when
+    THIS caller now owns it; False when another consumer already does.
+    O_CREAT|O_EXCL on ``<path>.claim`` is the exclusion; the payload
+    (claimant/pid/time) is advisory context for
+    :func:`break_spill_claim`."""
+    import json
+    import os
+    import time as _time
+
+    try:
+        fd = os.open(path + _CLAIM_SUFFIX,
+                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, json.dumps({"claimant": claimant,
+                                 "pid": os.getpid(),
+                                 "time": _time.time()}).encode())
+    finally:
+        os.close(fd)
+    return True
+
+
+def spill_claimant(path: str) -> "dict | None":
+    """The advisory claim payload of a spill record, or None when
+    unclaimed (a torn claim payload reads as ``{}`` — the claim still
+    excludes; only its context is gone)."""
+    import json
+    import os
+
+    try:
+        with open(path + _CLAIM_SUFFIX) as f:
+            body = f.read()
+    except OSError:
+        return None
+    try:
+        payload = json.loads(body)
+        return payload if isinstance(payload, dict) else {}
+    except ValueError:
+        return {}
+
+
+def release_spill_claim(path: str) -> None:
+    """Drop a claim (after re-admission, or to hand the record back —
+    e.g. a draining replica releasing what it never started)."""
+    import os
+
+    try:
+        os.unlink(path + _CLAIM_SUFFIX)
+    except OSError:  # nmfx: ignore[NMFX006] -- already released/raced;
+        pass         # exclusion is by existence, absence needs no cleanup
+
+
+#: how long a ``.break`` marker may exist before it reads as a crashed
+#: breaker (the marker is held for microseconds on the happy path)
+_BREAK_MARKER_STALE_S = 60.0
+
+
+def break_spill_claim(path: str, *, owner_pid: "int | None" = None,
+                      older_than_s: "float | None" = None) -> bool:
+    """Break another consumer's claim when its owner is known dead
+    (``owner_pid`` matches the claim's pid — a router breaking a
+    SIGKILLed replica's claims) or provably stale (``older_than_s``).
+    Returns True when the record is claimable again.
+
+    Breaking is serialized through an O_EXCL ``.break`` marker, and
+    the staleness judgment happens UNDER the marker: a bare
+    read-then-unlink would let breaker B (acting on a stale read of
+    the OLD claim) delete breaker A's fresh re-claim, leaving both
+    believing they own the record — the double-readmission the claim
+    protocol exists to prevent. With the marker, exactly one breaker
+    unlinks per claim generation, and a fresh re-claim is never
+    judged by a stale read. A marker left by a crashed breaker is
+    removed once it ages past ``_BREAK_MARKER_STALE_S`` (the caller
+    retries on its next pass)."""
+    import json
+    import os
+    import time as _time
+
+    if spill_claimant(path) is None:
+        return True  # never claimed
+    marker = path + ".break"
+    try:
+        fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        # another breaker holds the marker; clean a crashed breaker's
+        # leftover so a later pass can retry
+        try:
+            if _time.time() - os.stat(marker).st_mtime \
+                    > _BREAK_MARKER_STALE_S:
+                os.unlink(marker)
+        except OSError:  # nmfx: ignore[NMFX006] -- marker already
+            pass         # released by its (live) owner
+        return False
+    try:
+        os.write(fd, json.dumps({"pid": os.getpid(),
+                                 "time": _time.time()}).encode())
+    finally:
+        os.close(fd)
+    try:
+        # judged under the marker: re-read the CURRENT claim
+        payload = spill_claimant(path)
+        if payload is None:
+            return True
+        ok = False
+        if owner_pid is not None and payload.get("pid") == owner_pid:
+            ok = True
+        if older_than_s is not None:
+            t = payload.get("time")
+            if not isinstance(t, (int, float)) \
+                    or _time.time() - t > older_than_s:
+                ok = True
+        if not ok:
+            return False
+        try:
+            os.unlink(path + _CLAIM_SUFFIX)
+        except OSError:  # nmfx: ignore[NMFX006] -- claim released by
+            pass         # its owner while we held the marker
+        return True
+    finally:
+        try:
+            os.unlink(marker)
+        except OSError:  # nmfx: ignore[NMFX006] -- a cleaner judged
+            pass         # our marker crashed-stale; harmless
+
+
+def verify_spill_claim(path: str, claimant: str) -> bool:
+    """Whether ``claimant`` currently holds the record's claim (a
+    belt-and-braces re-check after winning a contested break)."""
+    payload = spill_claimant(path)
+    return payload is not None and payload.get("claimant") == claimant
 
 
 # --------------------------------------------------------------------------
@@ -322,6 +605,16 @@ class ServeConfig:
     telemetry_dir: "str | None" = None
     #: snapshot publish cadence for ``telemetry_dir``
     telemetry_interval_s: float = 2.0
+    #: fleet identity (ISSUE 15): the role this server publishes under
+    #: in telemetry snapshots and heartbeats — "server" standalone,
+    #: "replica" when owned by a ``ReplicaPool`` behind an
+    #: ``NMFXRouter`` (the fleet view and ``nmfx-top`` render the two
+    #: distinctly; a router health-checks only rows it owns)
+    role: str = "server"
+    #: explicit telemetry instance name (None = the publisher's
+    #: ``<role>-<host>-<pid>`` default; a replica pool names its
+    #: members so heartbeats and snapshots join on one identity)
+    instance: "str | None" = None
     #: with a port, serve the registry's Prometheus exposition over a
     #: stdlib HTTP endpoint (``nmfx.obs.export.serve_metrics``) for
     #: scraper-based deployments; 0 = ephemeral port (read it from
@@ -359,6 +652,8 @@ class ServeConfig:
                 0 <= self.metrics_port <= 65535:
             raise ValueError("metrics_port must be in [0, 65535] or "
                              "None")
+        if not self.role:
+            raise ValueError("role must be non-empty")
 
 
 def serve_key_fields() -> frozenset:
@@ -695,9 +990,16 @@ class NMFXServer:
             if serve_cfg.telemetry_dir is not None:
                 from nmfx.obs.export import TelemetryPublisher
 
+                # status_fn: this SERVER's queue/inflight levels ride
+                # the snapshot payload itself, so N in-process replicas
+                # sharing one registry still publish honest per-
+                # instance load rows (the process-wide gauges can only
+                # carry the last writer's level)
                 self._publisher = TelemetryPublisher(
-                    serve_cfg.telemetry_dir, role="server",
-                    interval_s=serve_cfg.telemetry_interval_s).start()
+                    serve_cfg.telemetry_dir, role=serve_cfg.role,
+                    instance=serve_cfg.instance,
+                    interval_s=serve_cfg.telemetry_interval_s,
+                    status_fn=self._telemetry_status).start()
         except BaseException:
             # a failed __init__ (e.g. metrics_port already bound)
             # never runs close(): tear down whatever started, then
@@ -763,11 +1065,16 @@ class NMFXServer:
                 continue  # caller already cancelled it: never spill —
                 # readmit() must not resurrect cancelled work
             path = self._spill(req)
-            req.future.set_exception(ServerClosed(
+            err = ServerClosed(
                 "server closed before dispatch"
                 + (f"; request spilled to {path} — a restarted server "
                    "re-admits it via NMFXServer.readmit()"
-                   if path else "")))
+                   if path else ""))
+            # machine-readable spill join (ISSUE 15): a router draining
+            # this replica reads the path off the typed error and
+            # claims the record for re-admission on a survivor
+            err.spill_path = path
+            req.future.set_exception(err)
             with self._lock:
                 self.counters["failed"] += 1
         if scheduler is not None:
@@ -806,38 +1113,30 @@ class NMFXServer:
         plain discard (the pre-spill behavior), never blocks close()."""
         if self.cfg.spill_dir is None:
             return None
-        import json
         import os
 
-        from nmfx.checkpoint import atomic_save_npz
         from nmfx.faults import warn_once
 
-        meta = {
-            # identity for the cross-process timeline (ISSUE 14): the
-            # spilling server's request id rides in the payload, the
-            # readmitting server books a serve.readmit join against
-            # it, and merge_traces aligns both processes' traces — a
-            # spilled-and-readmitted request reads as ONE timeline
-            "request_id": req.seq, "spill_pid": os.getpid(),
-            "ks": list(req.ks), "restarts": req.restarts,
-            "seed": req.seed, "label_rule": req.label_rule,
-            "linkage": req.linkage, "grid_slots": req.grid_slots,
-            "grid_tail_slots": (list(req.grid_tail_slots)
-                                if isinstance(req.grid_tail_slots,
-                                              (list, tuple))
-                                else req.grid_tail_slots),
-            "min_restarts": req.min_restarts, "priority": req.priority,
-            "col_names": list(req.col_names),
-            "solver_cfg": dataclasses.asdict(req.scfg),
-            "init_cfg": dataclasses.asdict(req.icfg),
-        }
+        # identity for the cross-process timeline (ISSUE 14): the
+        # spilling server's request id rides in the payload, the
+        # readmitting server books a serve.readmit join against it,
+        # and merge_traces aligns both processes' traces — a
+        # spilled-and-readmitted request reads as ONE timeline
+        meta = spill_meta(
+            request_id=req.seq, ks=req.ks, restarts=req.restarts,
+            seed=req.seed, scfg=req.scfg, icfg=req.icfg,
+            label_rule=req.label_rule, linkage=req.linkage,
+            grid_slots=req.grid_slots,
+            grid_tail_slots=req.grid_tail_slots,
+            min_restarts=req.min_restarts, priority=req.priority,
+            col_names=req.col_names)
         try:
-            os.makedirs(self.cfg.spill_dir, exist_ok=True)
-            path = os.path.join(
-                self.cfg.spill_dir,
-                f"spill_{os.getpid()}_{next(_spill_seq)}.npz")
-            atomic_save_npz(path, {"a": req.a,
-                                   "meta": np.asarray(json.dumps(meta))})
+            path = write_spill_record(
+                os.path.join(
+                    self.cfg.spill_dir,
+                    f"{SPILL_PREFIX}{os.getpid()}_"
+                    f"{next(_spill_seq)}.npz"),
+                req.a, meta)
         except Exception as e:
             warn_once(
                 "serve-spill-failed",
@@ -853,83 +1152,60 @@ class NMFXServer:
             args={"request_id": req.seq})
         return path
 
-    def readmit(self, spill_dir: "str | None" = None) -> list:
+    def readmit(self, spill_dir: "str | None" = None, *,
+                claimant: "str | None" = None,
+                break_claims_after_s: "float | None" = None) -> list:
         """Re-admit every request a previous server spilled on shutdown
         (``spill_dir`` defaults to this server's
-        ``ServeConfig.spill_dir``): each spill record is resubmitted
-        through the normal :meth:`submit` path — bit-identical results
-        to the original submission by the serving exactness contract —
-        and its file is removed once admitted. Torn/corrupt spill
-        records are skipped warn-once (the ledger's torn-record
-        tolerance); an admission rejection (``QueueFull``) stops the
-        loop warn-once, leaving that file and the rest in place for a
-        later readmit. Returns the futures of everything admitted."""
-        import json
+        ``ServeConfig.spill_dir``): each spill record is CLAIMED
+        (:func:`claim_spill` — O_EXCL exclusive, so two
+        routers/survivors draining one directory partition the records
+        instead of both readmitting them; tests/test_multiprocess.py
+        races it), resubmitted through the normal :meth:`submit` path —
+        bit-identical results to the original submission by the serving
+        exactness contract — and removed (record then claim) once
+        admitted. Records another consumer holds are skipped; pass
+        ``break_claims_after_s`` to break claims whose owner provably
+        died between claiming and readmitting (the claim's age is the
+        evidence). Torn/corrupt spill records are skipped warn-once
+        (the ledger's torn-record tolerance); an admission rejection
+        (``QueueFull``) stops the loop warn-once, RELEASING that
+        record's claim so it stays re-admittable by anyone. Returns the
+        futures of everything admitted."""
         import os
 
-        from nmfx import faults
-        from nmfx.config import ExperimentalConfig
         from nmfx.faults import warn_once
-        from nmfx.io import Dataset
 
         d = spill_dir if spill_dir is not None else self.cfg.spill_dir
         if d is None:
             raise ValueError("no spill directory: pass spill_dir= or "
                              "set ServeConfig.spill_dir")
+        who = claimant if claimant is not None \
+            else f"readmit-{os.getpid()}"
         futures = []
-        for name in sorted(os.listdir(d) if os.path.isdir(d) else ()):
-            if not (name.startswith("spill_") and name.endswith(".npz")):
-                continue
-            path = os.path.join(d, name)
+        for path in list_spills(d):
+            if spill_claimant(path) is not None:
+                if break_claims_after_s is None or not break_spill_claim(
+                        path, older_than_s=break_claims_after_s):
+                    continue  # another consumer owns it
+            if not claim_spill(path, who):
+                continue  # lost the claim race — the winner readmits
             try:
-                faults.inject("ckpt.load")
-                with np.load(path, allow_pickle=False) as z:
-                    a = z["a"]
-                    meta = json.loads(str(z["meta"]))
-                exp = meta["solver_cfg"].pop("experimental")
-                # nested configs were asdict()-flattened by _spill;
-                # sketch may be absent in pre-ISSUE-12 spill records
-                sk = meta["solver_cfg"].pop("sketch", None)
-                from nmfx.config import SketchConfig
-
-                scfg = SolverConfig(**meta["solver_cfg"],
-                                    experimental=ExperimentalConfig(
-                                        **exp),
-                                    sketch=(SketchConfig(**sk)
-                                            if sk is not None
-                                            else SketchConfig()))
-                icfg = InitConfig(**meta["init_cfg"])
-                tail = meta["grid_tail_slots"]
-                if isinstance(tail, list):
-                    tail = tuple(tail)
+                a, meta = load_spill_record(path)
+                kwargs = spill_submit_kwargs(meta)
+                data = spill_dataset(a, meta)
             except Exception as e:
+                release_spill_claim(path)
                 warn_once(
                     "serve-spill-corrupt",
                     f"spilled request record {path!r} is torn/corrupt "
                     f"({e!r}); skipping it — re-submit the request "
                     "manually if it still matters")
                 continue
-            # a Dataset carries the spilled col_names back through
-            # submit's _as_matrix, so the re-admitted result is
-            # field-for-field what the original submission would have
-            # delivered (row names were never retained by _Request)
-            names = [str(c) for c in meta["col_names"]]
-            data = Dataset(values=a,
-                           row_names=[str(i + 1)
-                                      for i in range(a.shape[0])],
-                           col_names=names)
             try:
-                fut = self.submit(data, ks=tuple(meta["ks"]),
-                                  restarts=meta["restarts"],
-                                  seed=meta["seed"], solver_cfg=scfg,
-                                  init_cfg=icfg,
-                                  label_rule=meta["label_rule"],
-                                  linkage=meta["linkage"],
-                                  grid_slots=meta["grid_slots"],
-                                  grid_tail_slots=tail,
-                                  min_restarts=meta["min_restarts"],
-                                  priority=meta["priority"])
+                fut = self.submit(data, **kwargs)
             except QueueFull as e:
+                release_spill_claim(path)
                 warn_once(
                     "serve-readmit-queue-full",
                     f"re-admission stopped at {path!r}: {e}; this and "
@@ -951,6 +1227,11 @@ class NMFXServer:
                 args={"request_id": fut.stats.request_id,
                       "origin_request_id": origin})
             futures.append(fut)
+            # record first, claim second: a crash between the two
+            # leaves an ORPHAN claim (record already admitted), which
+            # the sweep below — and every later consumer — cleans up;
+            # the reverse order would briefly leave the record
+            # unclaimed and double-admittable
             try:
                 os.unlink(path)
             except OSError as e:
@@ -958,6 +1239,16 @@ class NMFXServer:
                           f"could not remove re-admitted spill record "
                           f"{path!r} ({e}); remove it manually or the "
                           "next readmit will submit it again")
+            release_spill_claim(path)
+        # orphan-claim sweep: a claim whose record is gone marks a
+        # fully-admitted request whose consumer died before releasing
+        if os.path.isdir(d):
+            for name in os.listdir(d):
+                if not name.endswith(_CLAIM_SUFFIX):
+                    continue
+                rec = os.path.join(d, name[:-len(_CLAIM_SUFFIX)])
+                if not os.path.exists(rec):
+                    release_spill_claim(rec)
         return futures
 
     # -- submission --------------------------------------------------------
@@ -1073,6 +1364,16 @@ class NMFXServer:
     def _untrack(self, seq: int) -> None:
         with self._tracked_lock:
             self._tracked.pop(seq, None)
+
+    def _telemetry_status(self) -> dict:
+        """Per-INSTANCE load levels for the telemetry snapshot payload
+        (``nmfx.obs.export.build_snapshot``'s ``status``): a router's
+        health checker and ``nmfx-top`` read these instead of the
+        process-wide gauges, which N in-process replicas would
+        overwrite each other on."""
+        with self._lock:
+            return {"queue_depth": self._queued,
+                    "inflight": self._inflight}
 
     def _sync_gauges(self) -> None:
         """Export the queue/inflight LEVELS to the registry gauges the

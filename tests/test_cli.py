@@ -495,3 +495,68 @@ def test_cli_fleet_flags_require_serve_smoke(gct_path, tmp_path,
             main([gct_path, "--no-files"] + extra)
         err = capsys.readouterr().err
         assert needle in err, (extra, needle, err[-500:])
+
+
+def test_cli_serve_smoke_replicas(gct_path, tmp_path, capsys):
+    """ISSUE 15: --replicas routes the smoke request through the
+    router + replica pool; the result equals the direct path and the
+    routing books are reported. --router-spill-dir pins the pool root
+    (heartbeat ledger + spill records land there)."""
+    import os
+
+    root = tmp_path / "pool"
+    rc = main([gct_path, "--ks", "2", "--restarts", "2",
+               "--maxiter", "60", "--no-files", "--serve-smoke",
+               "--replicas", "2", "--router-spill-dir", str(root)])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "serve-smoke (router): replicas=2" in cap.err
+    assert "completed=1" in cap.err
+    beats = [n for n in os.listdir(root)
+             if n.startswith("replica_") and n.endswith(".json")]
+    assert len(beats) == 2  # both replicas heartbeat into the ledger
+
+
+def test_cli_replicas_compose_guards(gct_path, tmp_path, capsys):
+    """Reject-don't-drop: the service-tier flags are usage errors
+    outside their composition."""
+    cases = [
+        (["--replicas", "2"], "--serve-smoke"),
+        (["--serve-smoke", "--replicas", "0"], ">= 1"),
+        (["--router-spill-dir", str(tmp_path / "r")], "--replicas"),
+        (["--serve-smoke", "--replicas", "2", "--metrics-port", "0"],
+         "does not compose"),
+    ]
+    for extra, needle in cases:
+        with pytest.raises(SystemExit):
+            main([gct_path, "--no-files"] + extra)
+        err = capsys.readouterr().err
+        assert needle in err, (extra, needle, err[-500:])
+
+
+def test_cli_router_main(gct_path, tmp_path, capsys):
+    """The nmfx-router entrypoint: a small traffic sample through the
+    thread-mode tier, per-request outcomes + router books reported."""
+    from nmfx.cli import router_main
+
+    rc = router_main([gct_path, "--replicas", "2", "--requests", "2",
+                      "--ks", "2", "--restarts", "2",
+                      "--maxiter", "60",
+                      "--spill-root", str(tmp_path / "root")])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert cap.out.count("best k = 2") == 2
+    assert "ok on replica-" in cap.err
+    assert "submitted=2 completed=2 failed=0" in cap.err
+
+
+def test_cli_router_main_usage_errors(tmp_path, capsys, gct_path):
+    from nmfx.cli import router_main
+
+    with pytest.raises(SystemExit):
+        router_main([str(tmp_path / "missing.gct")])
+    assert "dataset not found" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        router_main([gct_path, "--replicas", "0"])
+    assert ">= 1" in capsys.readouterr().err

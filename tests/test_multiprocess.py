@@ -202,3 +202,101 @@ def test_two_process_grid_axes(tmp_path):
     assert r0["summary"] == r1["summary"]
     assert r0["consensus2"] == r1["consensus2"]
     assert "best k = 2" in r0["summary"]
+
+
+_READMIT_RACER = textwrap.dedent("""
+    import json, os, sys, time
+    spill_dir, out_path, go_path = sys.argv[1], sys.argv[2], sys.argv[3]
+    from nmfx.serve import NMFXServer, ServeConfig, list_spills
+
+    class _InertEngine:
+        # readmit only ENQUEUES (the server stays paused); no dispatch
+        # ever runs, so the race is purely over the claim protocol
+        def compatibility_key(self, req):
+            return None
+
+        def place(self, req):
+            return None
+
+        def dispatch_solo(self, req, placed, scfg):
+            raise AssertionError("paused server must not dispatch")
+
+        def dispatch_packed(self, reqs, placed):
+            raise AssertionError("paused server must not dispatch")
+
+    srv = NMFXServer(ServeConfig(max_queue_depth=1000),
+                     engine=_InertEngine(), start=False)
+    while not os.path.exists(go_path):
+        time.sleep(0.002)
+    admitted = 0
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        admitted += len(srv.readmit(spill_dir))
+        # records claimed by the peer stay on disk until IT removes
+        # them — spin until the directory is fully consumed
+        if not list_spills(spill_dir):
+            break
+        time.sleep(0.002)
+    from nmfx.obs import flight
+    origins = sorted(e["origin_request_id"]
+                     for e in flight.default_recorder()
+                     .events("serve.readmit"))
+    assert len(origins) == admitted
+    with open(out_path, "w") as f:
+        json.dump({"origins": origins}, f)
+    srv.close(cancel_pending=True)
+""")
+
+
+def test_two_process_readmit_claim_race(tmp_path):
+    """The ISSUE 15 spill-claim satellite: two OS processes racing
+    ``NMFXServer.readmit`` over ONE spill directory partition the
+    records exactly — every record readmitted exactly once, never
+    twice (the O_EXCL claim protocol), and nothing left behind."""
+    import time
+
+    import numpy as np
+
+    from nmfx.config import InitConfig, SolverConfig
+    from nmfx.serve import spill_meta, write_spill_record
+
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    n = 8
+    for i in range(n):
+        meta = spill_meta(request_id=i, ks=(2,), restarts=2, seed=i,
+                          scfg=SolverConfig(), icfg=InitConfig(),
+                          col_names=("a", "b"))
+        write_spill_record(str(spill / f"spill_{i}.npz"),
+                           np.ones((3, 2), np.float32), meta)
+    racer = tmp_path / "racer.py"
+    racer.write_text(_READMIT_RACER)
+    go = tmp_path / "go"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, str(racer), str(spill),
+         str(tmp_path / f"racer{i}.json"), str(go)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(2)]
+    time.sleep(1.0)  # let both import; then release them together
+    go.write_text("go")
+    errs = []
+    for p in procs:
+        try:
+            _, e = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            _, e = p.communicate()
+        if p.returncode != 0:
+            errs.append(e[-3000:])
+    assert not errs, errs
+    payloads = [json.loads((tmp_path / f"racer{i}.json").read_text())
+                for i in range(2)]
+    all_origins = payloads[0]["origins"] + payloads[1]["origins"]
+    # exactly-once: every record admitted by exactly one consumer
+    assert sorted(all_origins) == list(range(n)), payloads
+    assert set(payloads[0]["origins"]).isdisjoint(
+        payloads[1]["origins"])
+    assert os.listdir(spill) == []  # records and claims all consumed

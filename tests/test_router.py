@@ -1,0 +1,820 @@
+"""Resilient service tier (ISSUE 15): replica pool + health-checked
+router — placement, failover, spill-migration, SLO shedding.
+
+Queue/placement/failover mechanics run against thread replicas whose
+servers use the scriptable :class:`test_serve.FakeEngine` (milliseconds,
+no device dispatch); the claim protocol is unit-tested directly; the
+cross-process half (subprocess workers, SIGKILL recovery) lives in the
+slow-marked process tests here plus the two-process claim race in
+tests/test_multiprocess.py and the bench ``detail.serve.fleet`` chaos
+rung. The stale-heartbeat eviction test is watchdog-bounded: every
+``result()`` carries a timeout, so a hang is a failure, never a stuck
+suite."""
+
+import hashlib
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_serve import FakeEngine, _mat
+
+import nmfx.serve as serve
+from nmfx import faults
+from nmfx.replica import ReplicaPool, SpawnFailed
+from nmfx.router import (ForwardFailed, NMFXRouter, NoRoutableReplicas,
+                         RouterClosed, RouterConfig, RouterOverloaded)
+from nmfx.serve import ServeConfig
+
+
+def _fast_cfg(**kw) -> RouterConfig:
+    base = dict(retry_backoff_s=0.01, health_interval_s=0.03)
+    base.update(kw)
+    return RouterConfig(**base)
+
+
+def _pool(tmp_path, n=2, engine_factory=FakeEngine, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    return ReplicaPool(n, root=str(tmp_path / "pool"), mode="thread",
+                       engine_factory=engine_factory, **kw)
+
+
+def _sticky_id(router, arr) -> str:
+    """Which replica the router's rendezvous hash prefers for this
+    matrix — computable by tests because the placement is
+    deterministic in (content hash, replica id)."""
+    chash = hashlib.sha256(np.asarray(arr).tobytes()).hexdigest()
+    ids = [rep.replica_id for rep in router.pool.routable()]
+    return max(ids, key=lambda rid: NMFXRouter._hrw(chash, rid))
+
+
+# ---------------------------------------------------------------------
+# config + basic forwarding
+# ---------------------------------------------------------------------
+
+def test_router_config_validation():
+    with pytest.raises(ValueError):
+        RouterConfig(max_outstanding=0)
+    with pytest.raises(ValueError):
+        RouterConfig(forward_retries=-1)
+    with pytest.raises(ValueError):
+        RouterConfig(forward_timeout_s=0.0)
+    with pytest.raises(ValueError):
+        RouterConfig(stale_after_s=0.0)
+    with pytest.raises(ValueError):
+        RouterConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        RouterConfig(stickiness_slack=-1)
+
+
+def test_pool_validation(tmp_path):
+    with pytest.raises(ValueError):
+        ReplicaPool(0, root=str(tmp_path / "p"))
+    with pytest.raises(ValueError):
+        ReplicaPool(1, root=str(tmp_path / "p"), mode="carrier-pigeon")
+    with pytest.raises(ValueError):
+        ReplicaPool(1, root=str(tmp_path / "p"), mode="process",
+                    engine_factory=FakeEngine)
+
+
+def test_basic_forward_resolves_with_stats(tmp_path):
+    with NMFXRouter(_pool(tmp_path), _fast_cfg()) as router:
+        fut = router.submit(_mat(), ks=(2,), restarts=2, seed=7)
+        res = fut.result(timeout=60)
+    assert res.per_k[2].consensus is not None
+    st = fut.stats
+    assert st.request_id and st.replica and st.attempts == 1
+    assert st.sticky is True and st.latency_s is not None
+    assert st.retried == []
+    s = router.stats()
+    assert s["submitted"] == 1 and s["completed"] == 1
+    assert s["failed"] == 0 and s["outstanding"] == 0
+
+
+def test_content_hash_stickiness_is_deterministic(tmp_path):
+    """Repeat submissions of one matrix land on ONE replica (the
+    rendezvous choice, predictable from content hash + ids), so its
+    device-resident input cache actually hits."""
+    with NMFXRouter(_pool(tmp_path, n=3), _fast_cfg()) as router:
+        a = _mat()
+        want = _sticky_id(router, a)
+        for seed in range(4):
+            f = router.submit(a, ks=(2,), restarts=2, seed=seed)
+            f.result(timeout=60)
+            assert f.stats.replica == want
+
+
+def test_stickiness_breaks_to_least_loaded(tmp_path):
+    """A loaded sticky replica yields: with slack 0, the second
+    concurrent request on the same matrix routes to the idle
+    replica."""
+    pool = _pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg(stickiness_slack=0)) as router:
+        a = _mat()
+        sticky = _sticky_id(router, a)
+        for rep in pool.routable():
+            rep.server.pause()  # queue everything deterministically
+        f1 = router.submit(a, ks=(2,), restarts=2, seed=1)
+        f2 = router.submit(a, ks=(2,), restarts=2, seed=2)
+        assert f1.stats.replica == sticky
+        assert f2.stats.replica != sticky
+        assert f2.stats.sticky is False
+        for rep in pool.routable():
+            rep.server.resume()
+        f1.result(timeout=60)
+        f2.result(timeout=60)
+
+
+# ---------------------------------------------------------------------
+# failover: retry on another replica, typed exhaustion, fault site
+# ---------------------------------------------------------------------
+
+class _BoomEngine(FakeEngine):
+    """Every dispatch fails — the replica's server exhausts its own
+    retries and resolves RequestFailed, the router's retryable cue."""
+
+    def __init__(self):
+        super().__init__(compat=None)
+
+    def dispatch_solo(self, req, placed, scfg):
+        raise RuntimeError("boom")
+
+    def dispatch_packed(self, reqs, placed):
+        raise RuntimeError("boom")
+
+
+def _pool_with_bad_sticky(tmp_path, arr, n=2):
+    """A pool where the replica STICKY for ``arr`` fails every
+    dispatch and the others serve normally — deterministic because
+    replica ids (and hence the rendezvous choice) are known up
+    front."""
+    pid = os.getpid()
+    ids = [f"replica-{pid}-{i}" for i in range(n)]
+    chash = hashlib.sha256(np.asarray(arr).tobytes()).hexdigest()
+    bad = max(ids, key=lambda rid: NMFXRouter._hrw(chash, rid))
+    made = {}
+
+    def factory():
+        # spawn order matches the id sequence
+        rid = ids[len(made)]
+        eng = _BoomEngine() if rid == bad else FakeEngine(compat=None)
+        made[rid] = eng
+        return eng
+
+    pool = ReplicaPool(n, root=str(tmp_path / "pool"), mode="thread",
+                       engine_factory=factory,
+                       serve_cfg=ServeConfig(dispatch_retries=0),
+                       heartbeat_interval_s=0.05)
+    assert list(made) == ids
+    return pool, bad, made
+
+
+def test_retry_on_another_replica(tmp_path):
+    a = _mat()
+    pool, bad, engines = _pool_with_bad_sticky(tmp_path, a)
+    with NMFXRouter(pool, _fast_cfg()) as router:
+        fut = router.submit(a, ks=(2,), restarts=2, seed=5)
+        res = fut.result(timeout=60)
+    assert res is not None
+    assert fut.stats.attempts == 2
+    assert fut.stats.replica != bad
+    assert fut.stats.retried == ["RequestFailed"]
+    assert router.stats()["retried"] == 1
+
+
+def test_forward_exhaustion_resolves_typed(tmp_path):
+    pool = _pool(tmp_path, engine_factory=_BoomEngine,
+                 serve_cfg=ServeConfig(dispatch_retries=0))
+    with NMFXRouter(pool, _fast_cfg(forward_retries=1)) as router:
+        fut = router.submit(_mat(), ks=(2,), restarts=2)
+        with pytest.raises(ForwardFailed) as ei:
+            fut.result(timeout=60)
+    assert isinstance(ei.value.__cause__, serve.RequestFailed)
+    assert fut.stats.attempts == 2  # initial + 1 re-forward
+
+
+def test_router_forward_fault_site_retries(tmp_path):
+    """The armed ``router.forward`` chaos site fails the first forward;
+    the request recovers on the retry and the fire lands on the flight
+    recorder (NMFX008 coverage end-to-end)."""
+    from nmfx.obs import flight
+
+    with NMFXRouter(_pool(tmp_path), _fast_cfg()) as router:
+        with faults.scoped("router.forward", every=1, max_fires=1):
+            fut = router.submit(_mat(), ks=(2,), restarts=2)
+            fut.result(timeout=60)
+            assert faults.fires("router.forward") == 1
+    assert fut.stats.attempts == 2
+    assert fut.stats.retried == ["FaultInjected"]
+    fires = flight.default_recorder().events("fault.router.forward")
+    assert fires and fires[-1]["site"] == "router.forward"
+
+
+def test_queue_full_fails_over(tmp_path):
+    """A replica at its admission bound raises QueueFull at forward
+    time; the router immediately places the request elsewhere."""
+    a = _mat()
+    pool = _pool(tmp_path,
+                 serve_cfg=ServeConfig(max_queue_depth=1))
+    with NMFXRouter(pool, _fast_cfg(stickiness_slack=5)) as router:
+        sticky = _sticky_id(router, a)
+        pool.get(sticky).server.pause()
+        f1 = router.submit(a, ks=(2,), restarts=2, seed=1)  # fills it
+        f2 = router.submit(a, ks=(2,), restarts=2, seed=2)
+        assert f2.stats.replica != sticky
+        assert f2.stats.retried == ["QueueFull"]
+        f2.result(timeout=60)
+        pool.get(sticky).server.resume()
+        f1.result(timeout=60)
+
+
+def test_no_routable_replicas_typed(tmp_path):
+    with NMFXRouter(_pool(tmp_path, n=1), _fast_cfg()) as router:
+        router.drain_replica(next(iter(router.pool.replicas)))
+        with pytest.raises(NoRoutableReplicas):
+            router.submit(_mat(), ks=(2,), restarts=2)
+
+
+# ---------------------------------------------------------------------
+# at-most-once dispatch
+# ---------------------------------------------------------------------
+
+def test_forward_timeout_waits_for_dispatched_request(tmp_path):
+    """A forward that already DISPATCHED is never re-placed on a live
+    replica: at-most-once dispatch beats tail latency. One engine
+    dispatch total, one delivery."""
+    eng_holder = []
+
+    def factory():
+        eng = FakeEngine(compat=None, delay=0.6)
+        eng_holder.append(eng)
+        return eng
+
+    pool = _pool(tmp_path, engine_factory=factory)
+    with NMFXRouter(pool,
+                    _fast_cfg(forward_timeout_s=0.1)) as router:
+        fut = router.submit(_mat(), ks=(2,), restarts=2)
+        res = fut.result(timeout=60)
+    assert res is not None
+    assert fut.stats.attempts == 1
+    assert sum(len(e.solo) for e in eng_holder) == 1
+
+
+def test_forward_timeout_replaces_undispatched(tmp_path):
+    """A forward still QUEUED at timeout provably never dispatched
+    (the cancel succeeds) — re-placing it elsewhere is safe and the
+    router does so."""
+    a = _mat()
+    pool = _pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg(forward_timeout_s=0.1)) as router:
+        sticky = _sticky_id(router, a)
+        pool.get(sticky).server.pause()
+        fut = router.submit(a, ks=(2,), restarts=2)
+        res = fut.result(timeout=60)
+        pool.get(sticky).server.resume()
+    assert res is not None
+    assert fut.stats.replica != sticky
+    assert fut.stats.retried == ["TimeoutError"]
+
+
+# ---------------------------------------------------------------------
+# drain + stale-heartbeat eviction (the ISSUE 15 satellite)
+# ---------------------------------------------------------------------
+
+def test_drain_migrates_queued_requests(tmp_path):
+    """drain_replica: queued requests spill, the router claims each
+    record and re-forwards on the survivor — every future resolves,
+    no spill record is left behind."""
+    a = _mat()
+    pool = _pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg()) as router:
+        sticky = _sticky_id(router, a)
+        victim = pool.get(sticky)
+        victim.server.pause()
+        futs = [router.submit(a, ks=(2,), restarts=2, seed=i)
+                for i in range(3)]
+        assert all(f.stats.replica == sticky for f in futs)
+        router.drain_replica(sticky)
+        for f in futs:
+            assert f.result(timeout=60) is not None
+            assert f.stats.replica != sticky
+            assert f.stats.retried == ["ServerClosed"]
+        s = router.stats()
+        assert s["drained"] == 1 and s["readmitted"] == 3
+        assert sticky not in [r.replica_id for r in pool.routable()]
+        assert os.listdir(victim.spill_dir) == []
+        # the drained replica's beater stopped: its heartbeat must AGE
+        # into staleness, not keep publishing a phantom live instance
+        assert victim._beater._thread is None
+
+
+def test_stale_heartbeat_eviction(tmp_path):
+    """The satellite contract: a replica whose heartbeat publisher
+    freezes (the armed ``replica.heartbeat`` site) is drained by the
+    health checker and its queued requests land on a survivor with
+    typed causes on their stats — never a hang (every wait is
+    timeout-bounded)."""
+    a = _mat()
+    pool = _pool(tmp_path)
+    router = NMFXRouter(pool, _fast_cfg(stale_after_s=0.3,
+                                        health_interval_s=0.03))
+    try:
+        sticky = _sticky_id(router, a)
+        victim = pool.get(sticky)
+        survivor = next(rep for rep in pool.routable()
+                        if rep.replica_id != sticky)
+        victim.server.pause()
+        futs = [router.submit(a, ks=(2,), restarts=2, seed=i)
+                for i in range(3)]
+        assert all(f.stats.replica == sticky for f in futs)
+        # the survivor's beater is replaced by direct ledger writes so
+        # the armed site freezes ONLY the victim's publisher (arming
+        # is process-global; the test needs one frozen, one fresh)
+        survivor._beater.close()
+        stop = threading.Event()
+
+        def keep_fresh():
+            while not stop.is_set():
+                pool.ledger.beat(survivor.replica_id, role="replica",
+                                 state="routable")
+                time.sleep(0.03)
+
+        fresh = threading.Thread(target=keep_fresh, daemon=True)
+        fresh.start()
+        try:
+            with faults.scoped("replica.heartbeat", every=1):
+                results = [f.result(timeout=60) for f in futs]
+                assert faults.fires("replica.heartbeat") >= 1
+        finally:
+            stop.set()
+            fresh.join()
+        assert all(r is not None for r in results)
+        for f in futs:
+            assert f.stats.replica == survivor.replica_id
+            assert f.stats.retried == ["ServerClosed"]  # typed cause
+        s = router.stats()
+        assert s["drained"] == 1 and s["readmitted"] == 3
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------
+# deadlines, admission, close
+# ---------------------------------------------------------------------
+
+def test_deadline_enforced_at_router(tmp_path):
+    pool = _pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg()) as router:
+        for rep in pool.routable():
+            rep.server.pause()
+        fut = router.submit(_mat(), ks=(2,), restarts=2, timeout=0.05)
+        with pytest.raises(serve.DeadlineExceeded):
+            fut.result(timeout=60)
+        for rep in pool.routable():
+            rep.server.resume()
+    assert router.stats()["outstanding"] == 0
+
+
+def test_admission_bound_sheds_typed(tmp_path):
+    pool = _pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg(max_outstanding=1)) as router:
+        for rep in pool.routable():
+            rep.server.pause()
+        f1 = router.submit(_mat(), ks=(2,), restarts=2)
+        with pytest.raises(RouterOverloaded):
+            router.submit(_mat(), ks=(2,), restarts=2)
+        assert router.stats()["shed"] == 1
+        for rep in pool.routable():
+            rep.server.resume()
+        f1.result(timeout=60)
+
+
+def test_closed_router_rejects(tmp_path):
+    router = NMFXRouter(_pool(tmp_path), _fast_cfg())
+    router.close()
+    with pytest.raises(RouterClosed):
+        router.submit(_mat(), ks=(2,), restarts=2)
+
+
+def test_close_cancel_pending_resolves_typed(tmp_path):
+    pool = _pool(tmp_path)
+    router = NMFXRouter(pool, _fast_cfg())
+    for rep in pool.routable():
+        rep.server.pause()
+    fut = router.submit(_mat(), ks=(2,), restarts=2)
+    router.close(cancel_pending=True)
+    with pytest.raises(RouterClosed):
+        fut.result(timeout=60)
+
+
+# ---------------------------------------------------------------------
+# SLO-driven shedding + quality degradation
+# ---------------------------------------------------------------------
+
+class _BurnStub:
+    """Scriptable SLO engine: reports the given objectives in fast
+    burn."""
+
+    def __init__(self, burning=()):
+        self.burning = list(burning)
+        self._last = None
+
+    def evaluate(self, now=None):
+        objs = {name: {"state": ("fast_burn" if name in self.burning
+                                 else "ok"), "burn": {}}
+                for name in ("availability", "latency_p99")}
+        self._last = {"t": 0.0, "objectives": objs,
+                      "alerting": list(self.burning)}
+        return self._last
+
+    def status(self):
+        return self._last
+
+
+def test_slo_burn_sheds(tmp_path):
+    stub = _BurnStub(burning=["availability"])
+    with NMFXRouter(_pool(tmp_path),
+                    _fast_cfg(shed_on_burn=True, slo_interval_s=0.01),
+                    slo_engine=stub) as router:
+        router._last_slo = 0.0
+        router._check_slo()
+        with pytest.raises(RouterOverloaded, match="fast burn"):
+            router.submit(_mat(), ks=(2,), restarts=2)
+        assert router.stats()["shed"] == 1
+        # the burn clears -> submissions flow again
+        stub.burning = []
+        router._last_slo = 0.0
+        router._check_slo()
+        router.submit(_mat(), ks=(2,), restarts=2).result(timeout=60)
+
+
+def test_slo_burn_quality_elastic_degrades_tagged(tmp_path):
+    """With quality_elastic, burn-shed requests are served by the
+    sketched engine instead of rejected — and the degradation is
+    TAGGED end-to-end (stats cause + the engine actually receiving
+    backend='sketched'), never silent."""
+    stub = _BurnStub(burning=["latency_p99"])
+    engines = []
+
+    def factory():
+        eng = FakeEngine(compat=None)
+        engines.append(eng)
+        return eng
+
+    pool = _pool(tmp_path, engine_factory=factory)
+    with NMFXRouter(pool,
+                    _fast_cfg(shed_on_burn=True, quality_elastic=True,
+                              slo_interval_s=0.01),
+                    slo_engine=stub) as router:
+        router._check_slo()
+        fut = router.submit(_mat(), ks=(2,), restarts=2)
+        res = fut.result(timeout=60)
+    assert fut.stats.degraded_cause == "slo_burn"
+    assert res.quality == "sketched"
+    dispatched = [scfg for eng in engines for _, scfg in eng.solo]
+    assert len(dispatched) == 1
+    assert dispatched[0].backend == "sketched"
+    assert router.stats()["degraded"] == 1
+
+
+# ---------------------------------------------------------------------
+# elasticity: scale up/down, autoscale, spawn fault
+# ---------------------------------------------------------------------
+
+def test_scale_up_and_down(tmp_path):
+    pool = _pool(tmp_path, n=1)
+    with NMFXRouter(pool, _fast_cfg(min_replicas=1,
+                                    max_replicas=3)) as router:
+        assert len(pool.routable()) == 1
+        rep = router.scale_up()
+        assert rep is not None and len(pool.routable()) == 2
+        # scale-down drains the least-loaded and migrates nothing
+        # (idle) — the pool shrinks back
+        assert router.scale_down() is True
+        assert len(pool.routable()) == 1
+        # refuses below min_replicas
+        assert router.scale_down() is False
+
+
+def test_scale_down_migrates_via_spill(tmp_path):
+    """Scale-down of a replica with queued work is a DRAIN: the queued
+    requests spill-migrate to a survivor and still resolve."""
+    a = _mat()
+    pool = _pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg()) as router:
+        sticky = _sticky_id(router, a)
+        pool.get(sticky).server.pause()
+        futs = [router.submit(a, ks=(2,), restarts=2, seed=i)
+                for i in range(2)]
+        assert router.scale_down(sticky) is True
+        for f in futs:
+            assert f.result(timeout=60) is not None
+            assert f.stats.replica != sticky
+
+
+def test_spawn_fault_degrades_warn_once(tmp_path):
+    pool = _pool(tmp_path, n=1)
+    with NMFXRouter(pool, _fast_cfg()) as router:
+        with faults.scoped("replica.spawn", every=1):
+            with pytest.raises(SpawnFailed):
+                pool.spawn()
+            assert router.scale_up() is None  # degrades, no raise
+        assert len(pool.routable()) == 1
+        assert router.scale_up() is not None  # disarmed: works again
+
+
+def test_autoscale_tick_scales_on_load_and_burn(tmp_path):
+    pool = _pool(tmp_path, n=1)
+    with NMFXRouter(pool,
+                    _fast_cfg(scale_up_outstanding=2.0,
+                              max_replicas=3)) as router:
+        for rep in pool.routable():
+            rep.server.pause()
+        futs = [router.submit(_mat(), ks=(2,), restarts=2, seed=i)
+                for i in range(2)]
+        router.autoscale_tick()  # 2 outstanding >= 2.0 * 1 replica
+        assert len(pool.routable()) == 2
+        for rep in pool.routable():
+            rep.server.resume()
+        for f in futs:
+            f.result(timeout=60)
+        # burn also triggers scale-up regardless of load
+        with router._lock:
+            router._burning = ["availability"]
+        router.autoscale_tick()
+        assert len(pool.routable()) == 3
+
+
+# ---------------------------------------------------------------------
+# the spill claim protocol (serve.py satellite)
+# ---------------------------------------------------------------------
+
+def _record(tmp_path, name="spill_x.npz"):
+    from nmfx.config import InitConfig, SolverConfig
+
+    meta = serve.spill_meta(request_id="x", ks=(2,), restarts=2,
+                            seed=1, scfg=SolverConfig(),
+                            icfg=InitConfig(), col_names=("a", "b"))
+    return serve.write_spill_record(str(tmp_path / name),
+                                    np.ones((3, 2)), meta)
+
+
+def test_claim_is_exclusive_and_releasable(tmp_path):
+    p = _record(tmp_path)
+    assert serve.claim_spill(p, "a")
+    assert not serve.claim_spill(p, "b")
+    assert serve.spill_claimant(p)["claimant"] == "a"
+    serve.release_spill_claim(p)
+    assert serve.spill_claimant(p) is None
+    assert serve.claim_spill(p, "b")
+
+
+def test_break_claim_by_pid_and_age(tmp_path):
+    p = _record(tmp_path)
+    assert serve.claim_spill(p, "a")
+    # live claim, wrong pid, fresh: unbreakable
+    assert not serve.break_spill_claim(p, owner_pid=1)
+    assert not serve.break_spill_claim(p, older_than_s=3600)
+    # matching owner pid: breakable
+    assert serve.break_spill_claim(p, owner_pid=os.getpid())
+    assert serve.claim_spill(p, "b")
+    # age: breakable once provably stale
+    assert serve.break_spill_claim(p, older_than_s=0.0)
+    assert serve.claim_spill(p, "c")
+
+
+def test_concurrent_breakers_yield_one_owner(tmp_path):
+    """Two threads racing break+reclaim of one stale claim: the
+    ``.break`` marker serializes the break, so exactly one ends up
+    owning the record — never both (the double-readmission TOCTOU)."""
+    import json
+
+    p = _record(tmp_path)
+    with open(p + ".claim", "w") as f:
+        json.dump({"claimant": "dead", "pid": 999999, "time": 1.0}, f)
+    winners = []
+    barrier = threading.Barrier(2)
+
+    def contend(who):
+        barrier.wait()
+        for _ in range(50):
+            if serve.break_spill_claim(p, older_than_s=60.0) \
+                    and serve.claim_spill(p, who):
+                winners.append(who)
+                return
+
+    threads = [threading.Thread(target=contend, args=(w,))
+               for w in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(winners) == 1
+    assert serve.spill_claimant(p)["claimant"] == winners[0]
+    assert not os.path.exists(p + ".break")  # marker released
+
+
+def test_readmit_skips_claimed_records(tmp_path):
+    """Two consumers over one spill dir partition it: a record claimed
+    by someone else is NOT readmitted (the race-fix satellite;
+    tests/test_multiprocess.py races two real processes over it)."""
+    eng = FakeEngine()
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    p1 = _record(spill, "spill_1.npz")
+    _record(spill, "spill_2.npz")
+    assert serve.claim_spill(p1, "someone-else")
+    srv = serve.NMFXServer(ServeConfig(spill_dir=str(spill)),
+                           engine=eng)
+    futs = srv.readmit()
+    assert len(futs) == 1
+    futs[0].result(timeout=60)
+    srv.close()
+    assert os.path.exists(p1)  # the claimed record stayed put
+    assert serve.spill_claimant(p1)["claimant"] == "someone-else"
+
+
+def test_readmit_breaks_stale_claims_on_request(tmp_path):
+    import json
+
+    eng = FakeEngine()
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    p1 = _record(spill, "spill_1.npz")
+    # a claim whose owner died long ago (embedded time far in the past)
+    with open(p1 + ".claim", "w") as f:
+        json.dump({"claimant": "dead", "pid": 999999, "time": 1.0}, f)
+    srv = serve.NMFXServer(ServeConfig(spill_dir=str(spill)),
+                           engine=eng)
+    assert srv.readmit() == []  # default: never break
+    futs = srv.readmit(break_claims_after_s=60.0)
+    assert len(futs) == 1
+    futs[0].result(timeout=60)
+    srv.close()
+    assert not os.path.exists(p1)
+
+
+def test_readmit_cleans_orphan_claims(tmp_path):
+    eng = FakeEngine()
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    # an orphan claim: its record was already admitted by a consumer
+    # that died before releasing
+    orphan = str(spill / "spill_gone.npz")
+    assert serve.claim_spill(orphan, "dead-consumer")
+    srv = serve.NMFXServer(ServeConfig(spill_dir=str(spill)),
+                           engine=eng)
+    srv.readmit()
+    srv.close()
+    assert os.listdir(spill) == []
+
+
+# ---------------------------------------------------------------------
+# process replicas: the subprocess worker transport + SIGKILL recovery
+# ---------------------------------------------------------------------
+
+def _worker_env():
+    """Subprocess replicas must match the parent's virtual-device
+    platform (conftest forces 8 CPU devices via jax.config, which
+    children cannot inherit) — same platform, same GEMM partitioning,
+    same bits (the PR 13 fixed-geometry contract is per-platform)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _assert_bit_equal(got, ref):
+    for k in ref.per_k:
+        for field in ("consensus", "membership", "order", "iterations",
+                      "dnorms", "stop_reasons", "best_w", "best_h"):
+            assert np.array_equal(
+                np.asarray(getattr(got.per_k[k], field)),
+                np.asarray(getattr(ref.per_k[k], field))), \
+                f"{field} k={k}"
+        assert got.per_k[k].rho == ref.per_k[k].rho
+
+
+def test_process_replica_serves_bit_identical(tmp_path):
+    """One subprocess worker end to end: the spill-record transport +
+    claim protocol + outbox result path deliver bit-identical results
+    to a solo run through the same serving layer."""
+    from nmfx.api import nmfconsensus
+    from nmfx.config import SolverConfig
+    from nmfx.datasets import two_group_matrix
+    from nmfx.exec_cache import ExecCache
+
+    a = two_group_matrix(n_genes=60, n_per_group=10, seed=3)
+    scfg = SolverConfig(max_iter=30)
+    pool = ReplicaPool(1, root=str(tmp_path / "pool"), mode="process",
+                       env=_worker_env())
+    with NMFXRouter(pool, _fast_cfg()) as router:
+        fut = router.submit(a, ks=(2,), restarts=2, seed=11,
+                            solver_cfg=scfg)
+        res = fut.result(timeout=180)
+    ref = nmfconsensus(a, ks=(2,), restarts=2, seed=11,
+                       solver_cfg=scfg, use_mesh=False,
+                       exec_cache=ExecCache())
+    _assert_bit_equal(res, ref)
+    # the transport cleaned up after itself
+    rep = next(iter(pool.replicas.values()))
+    assert os.listdir(rep.inbox) == []
+    assert os.listdir(rep.outbox) == []
+
+
+def test_sigkilled_process_replica_recovers_bit_identical(tmp_path):
+    """The acceptance chaos shape: one of two subprocess replicas is
+    SIGKILLed with requests outstanding; the router reclaims its
+    write-ahead inbox records (breaking the dead pid's claims) and
+    readmits on the survivor — every future resolves, results
+    bit-identical to an uninterrupted solo run."""
+    from nmfx.api import nmfconsensus
+    from nmfx.config import SolverConfig
+    from nmfx.datasets import two_group_matrix
+    from nmfx.exec_cache import ExecCache
+
+    a = two_group_matrix(n_genes=60, n_per_group=10, seed=3)
+    scfg = SolverConfig(max_iter=30)
+    pool = ReplicaPool(2, root=str(tmp_path / "pool"), mode="process",
+                       env=_worker_env())
+    with NMFXRouter(pool, _fast_cfg(stickiness_slack=8)) as router:
+        victim_id = _sticky_id(router, a)
+        victim = pool.get(victim_id)
+        futs = [router.submit(a, ks=(2,), restarts=2, seed=s,
+                              solver_cfg=scfg)
+                for s in (11, 12, 13)]
+        assert all(f.stats.replica == victim_id for f in futs)
+        victim.kill()
+        results = [f.result(timeout=180) for f in futs]
+    cache = ExecCache()
+    for seed, (f, res) in zip((11, 12, 13), zip(futs, results)):
+        ref = nmfconsensus(a, ks=(2,), restarts=2, seed=seed,
+                           solver_cfg=scfg, use_mesh=False,
+                           exec_cache=cache)
+        _assert_bit_equal(res, ref)
+    s = router.stats()
+    assert s["recovered"] == 1 and s["readmitted"] >= 1
+    assert s["completed"] == 3 and s["failed"] == 0
+
+
+# ---------------------------------------------------------------------
+# fleet view: router + replica roles render distinctly
+# ---------------------------------------------------------------------
+
+def test_top_renders_roles_distinctly(tmp_path):
+    from nmfx.obs.aggregate import FleetCollector
+    from nmfx.obs.export import TelemetryPublisher
+    from nmfx.obs.slo import SLOEngine
+    from nmfx.obs.top import gather, render_html, render_text
+
+    tdir = str(tmp_path / "telemetry")
+    TelemetryPublisher(tdir, role="router",
+                       instance="router-0").publish_once()
+    TelemetryPublisher(
+        tdir, role="replica", instance="replica-0",
+        status_fn=lambda: {"queue_depth": 5,
+                           "inflight": 1}).publish_once()
+    collector = FleetCollector(tdir, stale_after_s=30.0)
+    rows = collector.instances()
+    by_role = {r["role"]: r for r in rows}
+    assert set(by_role) == {"router", "replica"}
+    # the payload-embedded status reaches the instance row
+    assert by_role["replica"]["queue_depth"] == 5
+    assert by_role["replica"]["inflight"] == 1
+    frame = gather(collector,
+                   SLOEngine(snapshot_fn=collector.fleet_snapshot))
+    text = render_text(frame, tdir)
+    assert "roles:" in text
+    assert "replica 1 live" in text and "router 1 live" in text
+    html = render_html(frame, tdir)
+    assert "replica 1 live" in html and "router 1 live" in html
+
+
+def test_replica_heartbeats_carry_levels(tmp_path):
+    """Pool replicas publish queue-depth/inflight into the shared
+    ledger — the load row the router health checker and nmfx-top
+    read."""
+    from nmfx.config import InitConfig, SolverConfig
+
+    pool = _pool(tmp_path, n=1)
+    try:
+        rep = pool.routable()[0]
+        rep.server.pause()
+        a = np.asarray(_mat())
+        meta = serve.spill_meta(
+            request_id="rid-x", ks=(2,), restarts=2, seed=1,
+            scfg=SolverConfig(), icfg=InitConfig(),
+            col_names=[str(i) for i in range(a.shape[1])])
+        fut = rep.forward("rid-x", a, meta)
+        rep._beater.beat_once()
+        hb = pool.heartbeats(stale_after_s=30.0)[rep.replica_id]
+        assert hb["role"] == "replica" and hb["queue_depth"] == 1
+        assert hb["stale"] is False
+        rep.server.resume()
+        fut.result(timeout=60)
+    finally:
+        pool.close()
