@@ -1593,6 +1593,20 @@ def main():
         seeds_sk = (123, 456, 789)
         ARI_GATE = 0.75  # min ARI at the structured rank, over seeds
         RHO_GATE = 0.15  # max |d rho| at the structured rank
+        # TOY-SHAPE gate policy (ISSUE 16): the agreement thresholds
+        # are calibrated on the hardware-shape planted design
+        # (5000×500, effect=2.0), where the 4 groups are recoverable
+        # and the EXACT arm itself clusters them cleanly. Group
+        # separability scales with the number of genes; at CPU smoke
+        # shapes (120×48) the exact consensus is already unstable at
+        # the structured rank (ARI ~0.24 vs its sketched twin,
+        # reproduced on trunk) — there is no signal to gate, only
+        # noise-vs-noise. Below the threshold the stage still runs
+        # BOTH arms and keeps every hardware-independent gate that
+        # does have signal at any shape (quality tag, stop-reason
+        # integrity, screening mask arithmetic) and records the
+        # measured agreement ungated.
+        agreement_gated = args.genes >= 1000 and args.samples >= 100
 
         def run_arm(scfg_a):
             t0 = time.perf_counter()
@@ -1616,12 +1630,12 @@ def main():
             rep = consensus_agreement(exact_res[s], sk_res[s])
             agreements[s] = rep
             sk_rec = rep["per_k"][struct_k]
-            if sk_rec["ari"] < ARI_GATE:
+            if agreement_gated and sk_rec["ari"] < ARI_GATE:
                 problems.append(
                     f"seed={s}: ARI at the structured rank k="
                     f"{struct_k} is {sk_rec['ari']:.3f}, below the "
                     f"{ARI_GATE} agreement gate")
-            if sk_rec["rho_gap"] > RHO_GATE:
+            if agreement_gated and sk_rec["rho_gap"] > RHO_GATE:
                 problems.append(
                     f"seed={s}: |d rho| at k={struct_k} is "
                     f"{sk_rec['rho_gap']:.3f}, above the {RHO_GATE} "
@@ -1717,7 +1731,9 @@ def main():
             "agreement_gate": {"structured_k": struct_k,
                                "min_ari": ARI_GATE,
                                "max_rho_gap": RHO_GATE,
-                               "status": "ok"},
+                               "status": "ok" if agreement_gated
+                               else ("ungated (toy shape: calibrated "
+                                     "for >=1000x100)")},
             "screening": {"screen_keep": keep,
                           "wall_s": round(scr_wall, 3),
                           "restarts_per_s": round(
@@ -2238,6 +2254,167 @@ def main():
         finally:
             shutil.rmtree(rung_root, ignore_errors=True)
 
+        # --- request-economics rung (detail.serve.economics): a
+        # Zipf-distributed request mix — a few identities dominate,
+        # the planet-scale regime where goodput is bounded by
+        # hit/coalesce/extend rates rather than raw solve speed.
+        # COLD arm: the identical schedule against a plain server
+        # (no result cache, no coalescing) — every request solves.
+        # MIXED arm: cache + coalescing on; repeats attach to the
+        # in-flight leader or hit the cache. WARM arm: the same
+        # schedule replayed against the now-warm disk tier — every
+        # request must hit. Gates (exit 2): every served result
+        # bit-identical to its solo reference (this rung never
+        # degrades, so tag-gating degenerates to parity), the warm
+        # replay performs ZERO solve dispatches (module dispatch
+        # counter), its accounting is exact (hits == requests), and
+        # warm goodput >= 5x the cold baseline. The extend mini-rung
+        # times the checkpoint ledger's incremental widen (same
+        # A/config, 2x the restart budget) against a from-scratch
+        # run at the widened budget, bit-identity gated hard.
+        import dataclasses as _dc
+
+        n_econ = 24
+        rng_e = np.random.default_rng(seed + 16)
+        zw = 1.0 / np.arange(1, len(seeds_t) + 1)
+        schedule = [seeds_t[i] for i in rng_e.choice(
+            len(seeds_t), size=n_econ, p=zw / zw.sum())]
+
+        def _econ_run(cfg_e, label):
+            with NMFXServer(cfg_e, exec_cache=cache) as srv:
+                d0 = serve_mod.dispatch_count()
+                t0 = time.perf_counter()
+                futs = [(sd, srv.submit(a, ks=ks_t,
+                                        restarts=restarts_t, seed=sd,
+                                        solver_cfg=scfg_t))
+                        for sd in schedule]
+                results = [(sd, f, f.result()) for sd, f in futs]
+                wall = time.perf_counter() - t0
+                st = srv.stats()
+                n_disp = serve_mod.dispatch_count() - d0
+            for sd, f, res in results:
+                gate(_serve_parity_problems(
+                    res, refs[sd], f"economics-{label} seed={sd}"))
+            return wall, st, n_disp
+
+        cold_wall_e, _, cold_disp = _econ_run(serve_cfg, "cold")
+        econ_dir = tempfile.mkdtemp(prefix="nmfx-bench-rescache-")
+        try:
+            econ_cfg = _dc.replace(serve_cfg, coalesce_requests=True,
+                                   result_cache_dir=econ_dir)
+            mixed_wall, mixed_st, mixed_disp = _econ_run(econ_cfg,
+                                                         "mixed")
+            warm_wall, warm_st, warm_disp = _econ_run(econ_cfg,
+                                                      "warm")
+        finally:
+            shutil.rmtree(econ_dir, ignore_errors=True)
+        if warm_disp != 0:
+            gate([f"economics: the warm-cache replay dispatched "
+                  f"{warm_disp} solve(s) — a warm hit must serve "
+                  "with ZERO dispatches"])
+        if warm_st["result_cache_hits"] != n_econ:
+            gate([f"economics: warm replay hit "
+                  f"{warm_st['result_cache_hits']}/{n_econ} — "
+                  "request accounting is broken"])
+        reused = (mixed_st["result_cache_hits"]
+                  + mixed_st["coalesced"])
+        if (reused + mixed_disp > n_econ
+                or mixed_st["completed"] != n_econ):
+            gate([f"economics: mixed-arm books don't balance "
+                  f"(hits+coalesced={reused}, "
+                  f"dispatches={mixed_disp}, "
+                  f"completed={mixed_st['completed']}, "
+                  f"requests={n_econ})"])
+        goodput_vs_cold = ((n_econ / warm_wall)
+                           / max(n_econ / cold_wall_e, 1e-9))
+        if goodput_vs_cold < 5.0:
+            gate([f"economics: warm goodput is only "
+                  f"{goodput_vs_cold:.2f}x the cold-solve baseline "
+                  "(gate: >= 5x)"])
+
+        # extend mini-rung: widen the restart budget through the
+        # ledger; only the delta chunks solve, and the result must be
+        # bit-identical to a from-scratch run at the widened budget
+        from nmfx.checkpoint import run_checkpointed_sweep
+        from nmfx.config import CheckpointConfig
+
+        ext_root = tempfile.mkdtemp(prefix="nmfx-bench-extend-")
+        try:
+            r_half = max(2, restarts_t // 2)
+            r_full = 2 * r_half
+            chunk = max(1, r_half // 2)
+            cc_half = ConsensusConfig(ks=ks_t, restarts=r_half,
+                                      seed=seed)
+            cc_full = ConsensusConfig(ks=ks_t, restarts=r_full,
+                                      seed=seed)
+            d_inc = os.path.join(ext_root, "inc")
+            d_scratch = os.path.join(ext_root, "scratch")
+            # untimed warmup at the FULL budget: pays every compile
+            # (including the widened-budget consensus finalization)
+            # once, outside both timed arms — without it the first
+            # timed run eats the compile and the comparison measures
+            # ordering, not work
+            run_checkpointed_sweep(
+                a, cc_full, scfg_t, icfg,
+                CheckpointConfig(directory=os.path.join(ext_root, "w"),
+                                 every_n_restarts=chunk))
+            run_checkpointed_sweep(
+                a, cc_half, scfg_t, icfg,
+                CheckpointConfig(directory=d_inc,
+                                 every_n_restarts=chunk))
+            t0 = time.perf_counter()
+            out_ext = run_checkpointed_sweep(
+                a, cc_full, scfg_t, icfg,
+                CheckpointConfig(directory=d_inc,
+                                 every_n_restarts=chunk))
+            ext_wall = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out_scratch = run_checkpointed_sweep(
+                a, cc_full, scfg_t, icfg,
+                CheckpointConfig(directory=d_scratch,
+                                 every_n_restarts=chunk))
+            scratch_wall = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(ext_root, ignore_errors=True)
+        for k in ks_t:
+            for fld in ("consensus", "best_w", "best_h", "dnorms"):
+                if not np.array_equal(
+                        np.asarray(getattr(out_ext[k], fld)),
+                        np.asarray(getattr(out_scratch[k], fld))):
+                    gate([f"economics extend: k={k} field {fld} of "
+                          "the extended run differs from the "
+                          "from-scratch run at the widened budget — "
+                          "the extend-exactness contract is broken"])
+        extend_speedup = scratch_wall / max(ext_wall, 1e-9)
+
+        economics = {
+            "unit": f"{n_econ} Zipf-mix requests (p ~ 1/rank) over "
+                    f"{len(seeds_t)} identities; extend "
+                    f"{r_half}->{r_full} restarts, chunk {chunk}",
+            "cold_goodput_req_per_s": round(n_econ / cold_wall_e, 4),
+            "mixed_goodput_req_per_s": round(n_econ / mixed_wall, 4),
+            "warm_goodput_req_per_s": round(n_econ / warm_wall, 4),
+            "goodput_vs_cold": round(goodput_vs_cold, 4),
+            "hit_rate": round(
+                mixed_st["result_cache_hits"] / n_econ, 4),
+            "coalesce_rate": round(
+                mixed_st["coalesced"] / n_econ, 4),
+            "reuse_rate": round(reused / n_econ, 4),
+            "cold_dispatches": cold_disp,
+            "mixed_dispatches": mixed_disp,
+            "warm_dispatches": warm_disp,
+            "extend_wall_s": round(ext_wall, 3),
+            "from_scratch_wall_s": round(scratch_wall, 3),
+            "extend_speedup": round(extend_speedup, 4),
+            "extend_parity": "ok",
+            "parity": "ok",
+        }
+        print(f"bench: serve economics rung: goodput_vs_cold="
+              f"{economics['goodput_vs_cold']} hit_rate="
+              f"{economics['hit_rate']} coalesce_rate="
+              f"{economics['coalesce_rate']} extend_speedup="
+              f"{economics['extend_speedup']}", file=sys.stderr)
+
         return {
             "unit": f"ks={list(ks_t)} x {restarts_t} restarts over the "
                     f"{args.genes}x{args.samples} bench matrix",
@@ -2249,6 +2426,7 @@ def main():
             "chaos": chaos,
             "quality_elastic": qe,
             "fleet": fleet,
+            "economics": economics,
             "parity": "ok",
             "module_counters": {
                 "dispatches": serve_mod.dispatch_count(),

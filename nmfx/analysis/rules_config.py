@@ -366,6 +366,125 @@ class CheckpointManifestCoverage(Rule):
                     **_live_manifest_universe())]
 
 
+def check_result_cache_coverage(
+    solver_fields: "frozenset[str]",
+    consensus_fields: "frozenset[str]",
+    cache_solver: "frozenset[str]",
+    cache_consensus: "frozenset[str]",
+    declared_non_numerics: "tuple[str, ...]",
+    declared_result_cache_exempt: "tuple[str, ...]",
+) -> "list[str]":
+    """NMFX011's pure contract check (the ``check_config_coverage``
+    pattern): every result-affecting ``SolverConfig``/``ConsensusConfig``
+    field must appear in ``result_cache.cache_key_fields()`` or be
+    explicitly declared exempt. A field invisible to the result-cache
+    key lets a finished consensus computed under one configuration be
+    SERVED verbatim to a request for another — plausible result, wrong
+    numbers, no crash, and unlike a stale checkpoint resume the cache
+    replays it in O(1) forever. Note the asymmetry with NMFX007: the
+    checkpoint ledger legitimately exempts ``restarts``/``ks`` (its
+    per-(k, chunk) records make them resumable deltas), but the result
+    cache stores the FINISHED result, so those fields MUST be in this
+    key — which is why the exemption list is a separate declaration
+    (``ConsensusConfig.RESULT_CACHE_EXEMPT_FIELDS``), not a reuse of
+    ``CHECKPOINT_EXEMPT_FIELDS``. Tests inject mutated universes; the
+    Rule wrapper reads the live modules."""
+    problems: "list[str]" = []
+    # 1. declarations must not go stale
+    for name in declared_result_cache_exempt:
+        if name not in consensus_fields:
+            problems.append(
+                "ConsensusConfig.RESULT_CACHE_EXEMPT_FIELDS names "
+                f"{name!r}, which is not a ConsensusConfig field — "
+                "stale declaration")
+    # 2. every SolverConfig field must reach the result-cache key
+    #    unless declared execution-strategy-only (the shared
+    #    NON_NUMERICS_FIELDS declaration: those fields change
+    #    scheduling, never the finished numbers, so excluding them is
+    #    what makes a restart_chunk-retuned rerun a HIT)
+    for name in sorted(solver_fields - cache_solver):
+        if name not in declared_non_numerics:
+            problems.append(
+                f"SolverConfig.{name} does not reach the result-cache "
+                "key (result_cache.cache_key_fields()['solver']) and "
+                "is not declared in NON_NUMERICS_FIELDS — finished "
+                "results computed under different values of it would "
+                "be served interchangeably")
+    # 3. every ConsensusConfig field must reach the key unless
+    #    declared result-cache-exempt (with its rationale on record)
+    for name in sorted(consensus_fields - cache_consensus):
+        if name not in declared_result_cache_exempt:
+            problems.append(
+                f"ConsensusConfig.{name} does not reach the result-"
+                "cache key (result_cache.cache_key_fields()"
+                "['consensus']) and is not declared in "
+                "RESULT_CACHE_EXEMPT_FIELDS — finished results "
+                "computed under different values of it would be "
+                "served interchangeably")
+    # 4. a field both declared exempt AND covered is a contradictory
+    #    declaration — one of the two is stale
+    for name in declared_result_cache_exempt:
+        if name in cache_consensus:
+            problems.append(
+                f"ConsensusConfig.{name} is declared in "
+                "RESULT_CACHE_EXEMPT_FIELDS but still reaches the "
+                "result-cache key — contradictory declarations; "
+                "drop one")
+    return problems
+
+
+def _live_result_cache_universe():
+    from nmfx import result_cache
+    from nmfx.config import ConsensusConfig, SolverConfig
+
+    covered = result_cache.cache_key_fields()
+    return dict(
+        solver_fields=frozenset(
+            f.name for f in dataclasses.fields(SolverConfig)),
+        consensus_fields=frozenset(
+            f.name for f in dataclasses.fields(ConsensusConfig)),
+        cache_solver=covered["solver"],
+        cache_consensus=covered["consensus"],
+        declared_non_numerics=tuple(SolverConfig.NON_NUMERICS_FIELDS),
+        declared_result_cache_exempt=tuple(
+            ConsensusConfig.RESULT_CACHE_EXEMPT_FIELDS),
+    )
+
+
+@register
+class ResultCacheKeyCoverage(Rule):
+    """NMFX011: every result-affecting SolverConfig/ConsensusConfig
+    field must reach the content-addressed result-cache key
+    (``nmfx.result_cache.cache_key_fields``) or be explicitly declared
+    exempt with its rationale."""
+
+    rule_id = "NMFX011"
+    title = "result-cache key coverage"
+
+    def check(self, project) -> "Iterable[Finding]":
+        # semantic whole-package rule, same gating as NMFX001/007: run
+        # only when the real package is the analyzed set, and only
+        # against the checkout the import machinery actually resolves
+        import os
+
+        analyzed_cfg = next(
+            (m.path for m in project.modules
+             if m.path.replace("\\", "/").endswith("nmfx/config.py")),
+            None)
+        if analyzed_cfg is None:
+            return []
+        from nmfx.config import ConsensusConfig
+
+        cfg_file, cfg_line = _decl_site(ConsensusConfig, "nmfx/config.py")
+        if os.path.abspath(cfg_file) != os.path.abspath(analyzed_cfg):
+            # NMFX001 already reports the wrong-tree condition loudly;
+            # don't double-report it per rule
+            return []
+        return [self.finding(cfg_file, cfg_line, msg)
+                for msg in check_result_cache_coverage(
+                    **_live_result_cache_universe())]
+
+
 @register
 class ConfigFingerprintCoverage(Rule):
     """NMFX001: every numerics-affecting config field must reach the

@@ -412,6 +412,7 @@ def nmfconsensus(
     checkpoint=None,
     profiler=None,
     exec_cache=None,
+    result_cache=None,
 ) -> ConsensusResult:
     """Full consensus-NMF rank sweep (the reference's ``runExample`` pipeline,
     nmf.r:6-14, minus the hardcoded paths).
@@ -491,6 +492,15 @@ def nmfconsensus(
     in-flight compile rather than duplicating it. Ignored for
     non-cacheable configurations and checkpointed runs; see
     ``docs/serving.md``.
+
+    ``result_cache``: an ``nmfx.result_cache.ResultCache`` (or a cache
+    directory path) of FINISHED ``ConsensusResult``s, keyed by input
+    content + every result-affecting config field + quality tag
+    (docs/serving.md "Request economics"). A warm hit returns in O(1)
+    with zero solve dispatches; a miss solves normally and populates
+    the cache on the way out. ``keep_factors=True`` requests solve
+    through uncached (the full factor stacks would blow the byte
+    budget; ``restart_factors`` recomputes any restart exactly).
     """
     if rank_selection not in ("host", "device"):
         raise ValueError("rank_selection must be 'host' or 'device', got "
@@ -520,6 +530,23 @@ def nmfconsensus(
                            grid_tail_slots=grid_tail_slots,
                            min_restarts=min_restarts)
     scfg, icfg = _resolve_cfgs(algorithm, max_iter, init, solver_cfg, init_cfg)
+    rcache = rkey = None
+    if result_cache is not None:
+        from nmfx.result_cache import (ResultCache, cacheable,
+                                       key_for_array, request_quality)
+
+        if cacheable(ccfg):
+            rcache = (result_cache
+                      if isinstance(result_cache, ResultCache)
+                      else ResultCache(cache_dir=os.fspath(result_cache),
+                                       layer="api"))
+            rkey = key_for_array(arr, scfg, ccfg, icfg,
+                                 request_quality(scfg))
+            cached = rcache.lookup(rkey)
+            if cached is not None:
+                if output is not None:
+                    save_results(cached, output)
+                return cached
     if checkpoint is not None:
         from nmfx.config import CheckpointConfig
 
@@ -630,6 +657,12 @@ def nmfconsensus(
                              quality=("sketched"
                                       if scfg.backend == "sketched"
                                       else "exact"))
+    if rcache is not None and rkey is not None:
+        try:
+            rcache.put(rkey, result, ccfg=ccfg)
+        except Exception:  # nmfx: ignore[NMFX006] -- cache trouble
+            # must never fail a solved request
+            pass
     if output is not None:
         with profiler.phase("write_outputs"):
             save_results(result, output)

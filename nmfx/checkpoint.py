@@ -91,8 +91,14 @@ _RECORD_RE = re.compile(r"^k\d+_r\d+-\d+\.npz$")
 #: on cold start too, or a prior incarnation's stale heartbeats would
 #: report phantom dead shards through :meth:`shard_status`
 _SHARD_RE = re.compile(r"^shard_\d+\.json$")
-#: v1: ISSUE 9 — the initial durable-ledger format
-_FORMAT_VERSION = 1
+#: v1: ISSUE 9 — the initial durable-ledger format. v2: ISSUE 16 —
+#: ``restarts`` left the manifest fingerprint (per-chunk records are
+#: restart-BUDGET independent; prefix-stable PRNG chains make chunk
+#: ``[r0, r1)`` byte-identical under any budget that contains it), so a
+#: widened budget EXTENDS a compatible ledger — solving only the delta
+#: chunks — instead of cold-starting. v1 ledgers (whose fingerprints
+#: included restarts) cold-start once, cleanly.
+_FORMAT_VERSION = 2
 
 #: AUTHORITATIVE list of ConsensusConfig fields excluded from the
 #: checkpoint manifest. Every entry must be declared checkpoint-exempt
@@ -103,7 +109,7 @@ _FORMAT_VERSION = 1
 #: registry fingerprint).
 MANIFEST_CONSENSUS_EXCLUDED = ("ks", "linkage", "min_restarts",
                                "keep_factors", "grid_exec", "grid_slots",
-                               "grid_tail_slots")
+                               "grid_tail_slots", "restarts")
 
 
 class Preempted(BaseException):
@@ -125,6 +131,14 @@ _chunks_solved_total = _metrics.counter(
 _chunks_loaded_total = _metrics.counter(
     "nmfx_ckpt_chunks_loaded_total",
     "restart-chunks served from completion records on disk")
+# declared identically in nmfx.result_cache (which this module must not
+# import — it imports manifest_key_fields from here); the registry's
+# idempotent get-or-create hands both sites one shared series
+_extended_total = _metrics.counter(
+    "nmfx_result_cache_extended_total",
+    "checkpointed sweeps that resumed a compatible ledger under a "
+    "widened budget (more restarts / more ranks) and solved only the "
+    "delta chunks")
 
 
 def chunks_solved_count() -> int:
@@ -284,6 +298,10 @@ class SweepCheckpoint:
         self._pending: "list[tuple[int, int, int, object]]" = []
         self._pending_lock = threading.Lock()
         self._last_flush = time.monotonic()
+        #: this open EXTENDED an existing compatible ledger (same data/
+        #: config/env fingerprint, different restart budget or chunk
+        #: plan) — records kept, only missing plan chunks will solve
+        self.extended = False
         meta = {"fingerprint": fingerprint, "env": env,
                 "plan": [list(c) for c in self.plan],
                 "restarts": restarts, "format": _FORMAT_VERSION}
@@ -309,18 +327,40 @@ class SweepCheckpoint:
             self._clear_records()
             fresh = True
         elif not fresh and old != meta:
-            # the one rule: NEVER a wrong resume. A manifest written for
-            # different data/config/env/plan (or by a different format)
-            # means the records describe a different run — cold start.
-            warn_once(
-                "ckpt-manifest-mismatch",
-                f"checkpoint ledger at {directory!r} was written for a "
-                "different (data, config, environment, chunk-plan) "
-                "combination — starting a CLEAN COLD START (existing "
-                "records cleared and recomputed), never a wrong resume")
-            self._clear_records()
-            fresh = True
-        if fresh:
+            same_run = all(old.get(f) == meta[f]
+                           for f in ("fingerprint", "env", "format"))
+            if same_run:
+                # same data/config/environment, different restart
+                # budget or chunk plan: INCREMENTAL EXTENSION (ISSUE
+                # 16). The records stay — chunk [r0, r1) solves under
+                # keys split(fold_in(key(seed), k), R)[r0:r1], which
+                # counter-mode threefry makes independent of the budget
+                # R — and try_load serves exactly the records whose
+                # boundaries appear in the NEW plan, so only the delta
+                # chunks solve and the result is bit-identical to a
+                # from-scratch run at the extended budget. Records at
+                # stale boundaries are left on disk (content-addressed
+                # by (k, r0, r1) + fingerprint; a later plan that
+                # matches them reuses them again).
+                self.extended = True
+                _flight.record("ckpt.extend", directory=directory,
+                               old_restarts=old.get("restarts"),
+                               new_restarts=restarts)
+            else:
+                # the one rule: NEVER a wrong resume. A manifest
+                # written for different data/config/env (or by a
+                # different format) means the records describe a
+                # different run — cold start.
+                warn_once(
+                    "ckpt-manifest-mismatch",
+                    f"checkpoint ledger at {directory!r} was written "
+                    "for a different (data, config, environment) "
+                    "combination — starting a CLEAN COLD START "
+                    "(existing records cleared and recomputed), never "
+                    "a wrong resume")
+                self._clear_records()
+                fresh = True
+        if fresh or self.extended:
             tmp = os.path.join(directory, _MANIFEST_NAME + ".tmp")
             with open(tmp, "wt") as f:
                 json.dump(meta, f)
@@ -666,6 +706,8 @@ def run_checkpointed_sweep(a, cfg: ConsensusConfig,
     restore = install_signal_flush(ck)
     a_dev = None
     out: dict = {}
+    loaded_total = 0
+    solved_total = 0
     try:
         for k in cfg.ks:
             recs: dict = {}
@@ -677,7 +719,9 @@ def run_checkpointed_sweep(a, cfg: ConsensusConfig,
                     missing.append((r0, r1))
                 else:
                     recs[(r0, r1)] = rec
+                    loaded_total += 1
             if missing:
+                solved_total += len(missing)
                 if a_dev is None:  # fully-resumed sweeps never transfer
                     a_dev = place_resilient(arr, solver_cfg, None,
                                             profiler=profiler)
@@ -701,6 +745,19 @@ def run_checkpointed_sweep(a, cfg: ConsensusConfig,
             ck.flush()  # rank boundary: buffered records land
             if on_rank is not None:
                 on_rank(k, out[k])
+        if loaded_total > 0 and (ck.extended or solved_total > 0):
+            # an incremental run that actually REUSED records while
+            # producing new work — a widened restart budget (manifest
+            # rewritten, ck.extended) or a widened ks / partial resume
+            # (ks is manifest-exempt by design, so the manifest matches
+            # exactly; records loaded AND delta chunks solved). The
+            # request-economics signal nmfx-top/bench read. A fully-
+            # loaded warm re-run is a pure replay, not an extension;
+            # a widened budget that found nothing to reuse is a solve.
+            _extended_total.inc()
+            _flight.record("result_cache.extend", directory=ck.directory,
+                           loaded=loaded_total, restarts=cfg.restarts,
+                           ks=list(cfg.ks))
         return {k: out[k] for k in cfg.ks}
     finally:
         ck.flush()

@@ -268,6 +268,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "start'). Implies --exec-cache; independent of "
                         "--compile-cache (which caches XLA's intermediate "
                         "compilation products, not loaded executables)")
+    p.add_argument("--result-cache-dir", default=None, metavar="DIR",
+                   help="content-addressed FINISHED-RESULT cache "
+                        "(nmfx.result_cache): completed ConsensusResults "
+                        "are stored here keyed by the input bytes plus "
+                        "every result-affecting config field, and a "
+                        "repeat invocation is served in O(1) with zero "
+                        "solve dispatches (docs/serving.md 'Request "
+                        "economics'). Composes with --serve-smoke (the "
+                        "server's own cache tier), --replicas (the "
+                        "router front door's tier), and "
+                        "--checkpoint-dir (a miss resumes the durable "
+                        "ledger as usual, then the finished result is "
+                        "cached). Independent of --cache-dir, which "
+                        "caches compiled EXECUTABLES, not results")
     p.add_argument("--pipeline-ranks", action="store_true",
                    help="serve each rank through its OWN bucketed "
                         "executable (ExecCacheConfig.pipeline_ranks): "
@@ -641,6 +655,14 @@ def _run_cli(argv: list[str] | None = None) -> int:
         # ignored --no-resume would leave the user believing the ledger
         # was cleared
         parser.error("--resume/--no-resume require --checkpoint-dir")
+    if args.result_cache_dir is not None and args.keep_factors:
+        # reject-don't-drop: the result cache refuses factor-retaining
+        # results (result_cache.cacheable), so the flag would be
+        # silently inert
+        parser.error("--result-cache-dir does not compose with "
+                     "--keep-factors (results retaining every "
+                     "restart's factor stacks are not admitted to the "
+                     "result cache; drop one of the flags)")
     exec_cache = None
     warm_task = None
     if args.input_cache_bytes is not None:
@@ -787,6 +809,7 @@ def _run_cli(argv: list[str] | None = None) -> int:
                 checkpoint=ckpt_cfg,
                 profiler=profiler,
                 exec_cache=exec_cache,
+                result_cache=args.result_cache_dir,
             )
     if warm_task is not None and args.cache_dir:
         # with a persistent cache dir, joining is worth the wait: every
@@ -850,7 +873,8 @@ def _serve_smoke(args, run_scfg, exec_cache, output, profiler):
         return _serve_smoke_router(args, run_scfg, exec_cache, output,
                                    profiler)
     serve_cfg = ServeConfig(telemetry_dir=args.telemetry_dir,
-                            metrics_port=args.metrics_port)
+                            metrics_port=args.metrics_port,
+                            result_cache_dir=args.result_cache_dir)
     with NMFXServer(serve_cfg, exec_cache=exec_cache,
                     profiler=profiler) as srv:
         if srv.metrics_port is not None:
@@ -887,7 +911,10 @@ def _serve_smoke(args, run_scfg, exec_cache, output, profiler):
           f"{s['submitted']} completed={s['completed']} "
           f"dispatches={s['dispatches']} "
           f"packed_dispatches={s['packed_dispatches']} "
-          f"packing_efficiency={s['packing_efficiency']}",
+          f"packing_efficiency={s['packing_efficiency']}"
+          + (f" result_cache_hits={s['result_cache_hits']}"
+             f" coalesced={s['coalesced']}"
+             if args.result_cache_dir is not None else ""),
           file=sys.stderr)
     print("nmfx: serve-smoke spans: "
           f"queue-wait={fmt(st.queue_wait_s)} pack={fmt(st.pack_s)} "
@@ -923,7 +950,8 @@ def _serve_smoke_router(args, run_scfg, exec_cache, output, profiler):
         serve_cfg=ServeConfig(),
         exec_cache=exec_cache, telemetry_dir=args.telemetry_dir)
     try:
-        with NMFXRouter(pool, RouterConfig()) as router:
+        with NMFXRouter(pool, RouterConfig(
+                result_cache_dir=args.result_cache_dir)) as router:
             fut = router.submit(args.dataset, ks=args.ks,
                                 restarts=args.restarts, seed=args.seed,
                                 solver_cfg=run_scfg,

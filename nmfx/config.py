@@ -502,10 +502,30 @@ class ConsensusConfig:
     #: ``grid_exec``/``grid_slots``/``grid_tail_slots`` — inert under
     #: checkpointing (the chunk executor is its own per-(k, chunk)
     #: execution plan; the manifest hashes the checkpoint engine family
-    #: instead).
+    #: instead); ``restarts`` — per-chunk records are restart-BUDGET
+    #: independent: chunk ``[r0, r1)`` solves under keys
+    #: ``split(fold_in(key(seed), k), R)[r0:r1]`` and counter-mode
+    #: threefry makes ``split(key, R)[i]`` depend only on ``(key, i)``,
+    #: never on ``R`` — so raising the budget from 50 to 100 restarts
+    #: leaves every finished chunk byte-identical and the ledger resumes
+    #: by solving only the delta chunks (the manifest pins the chunk
+    #: PLAN separately; extension reuses only records whose exact
+    #: boundaries appear in the new plan — see
+    #: ``checkpoint.SweepCheckpoint``).
     CHECKPOINT_EXEMPT_FIELDS: ClassVar[tuple] = (
         "ks", "linkage", "min_restarts", "keep_factors", "grid_exec",
-        "grid_slots", "grid_tail_slots")
+        "grid_slots", "grid_tail_slots", "restarts")
+
+    #: AUTHORITATIVE declaration of the ConsensusConfig fields the
+    #: finished-result cache key (``nmfx.result_cache.cache_key_fields``)
+    #: may exclude. Deliberately EMPTY: unlike the checkpoint ledger —
+    #: whose unit is a per-(k, chunk) record, making ``ks``/``restarts``
+    #: resumable deltas — the result cache stores the FINISHED
+    #: ``ConsensusResult``, and every ConsensusConfig field (including
+    #: finalize-time ones like ``linkage``) shapes that result. The
+    #: static analyzer (rule NMFX011) cross-references this list against
+    #: the live key so a field can never silently drop out.
+    RESULT_CACHE_EXEMPT_FIELDS: ClassVar[tuple] = ()
 
     ks: Sequence[int] = (2, 3, 4, 5)
     restarts: int = 10
@@ -737,6 +757,44 @@ class CheckpointConfig:
             raise ValueError("every_n_restarts must be >= 1 or None")
         if self.every_s is not None and self.every_s <= 0:
             raise ValueError("every_s must be positive or None")
+
+
+@dataclasses.dataclass(frozen=True)
+class ResultCacheConfig:
+    """Finished-result cache policy (``nmfx/result_cache.py``).
+
+    At service scale the dominant waste is REPEATED solves: the same
+    atlas resubmitted under the same configuration re-solves from
+    scratch even though the input is already content-hashed
+    (``data_cache.DataKey``) and the result is deterministic given
+    (data, config, seed). The result cache closes that loop: finished
+    ``ConsensusResult``s are stored content-addressed by (input
+    fingerprint, result-affecting config fingerprint, quality tag) in
+    an in-memory LRU over an atomic tmp+rename disk tier, so a warm
+    resubmission is served in O(1) with ZERO solve dispatches and ZERO
+    host-to-device transfers. See docs/serving.md "Request economics".
+    """
+
+    #: persistent cache directory (None = in-memory only). Entries are
+    #: ``ConsensusResult.save`` archives written atomically
+    #: (tmp + ``os.replace``), named by the content-addressed key
+    #: digest; corrupt or key-mismatched entries are treated as misses
+    #: with one warning, never served.
+    cache_dir: "str | None" = None
+    #: LRU bound on in-memory results (each holds its per-k consensus
+    #: matrices — n×n float64 per rank — so the default stays modest;
+    #: evicting from memory never deletes a disk entry)
+    max_entries: int = 32
+    #: byte cap on the disk tier: oldest-mtime entries are evicted once
+    #: the directory exceeds it (every disk hit touches its entry's
+    #: mtime — an mtime-LRU, the exec-cache discipline)
+    max_disk_bytes: int = 4 << 30  # 4 GiB
+
+    def __post_init__(self):
+        if self.max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        if self.max_disk_bytes < 1:
+            raise ValueError("max_disk_bytes must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

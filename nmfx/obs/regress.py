@@ -131,6 +131,26 @@ METRICS = (
                ("detail.sketched.flops_compression_per_restart",),
                "higher", 0.20,
                "analytic, shape-derived — hardware-independent"),
+    # --- request economics (ISSUE 16: cache/coalesce/extend) --------
+    MetricSpec("econ_result_cache_hit_rate",
+               ("detail.serve.economics.hit_rate",), "higher", 0.50,
+               "mixed-arm hit fraction; the split between hits and "
+               "coalesces is timing-dependent, so the threshold is "
+               "loose — reuse_rate is the deterministic sum"),
+    MetricSpec("econ_coalesce_rate",
+               ("detail.serve.economics.coalesce_rate",), "higher",
+               0.90,
+               "mixed-arm coalesce fraction; see hit-rate note"),
+    MetricSpec("econ_goodput_vs_cold",
+               ("detail.serve.economics.goodput_vs_cold",), "higher",
+               0.35,
+               "warm-replay goodput over the cold-solve baseline; "
+               "the bench's own gate is the hard 5x bound"),
+    MetricSpec("econ_extend_speedup",
+               ("detail.serve.economics.extend_speedup",), "higher",
+               0.35,
+               "from-scratch wall over incremental-extend wall at a "
+               "2x-widened restart budget, bit-identity gated"),
 )
 
 

@@ -114,10 +114,34 @@ def gather(collector: FleetCollector, engine: "_slo.SLOEngine",
         for key, st in mrec["series"].items():
             if st["count"]:
                 mfu[",".join(key) or "all"] = st["sum"] / st["count"]
+    # request economics (ISSUE 16): the result-cache/coalescing/extend
+    # counters, fleet-summed across layers (server + router series)
+    def _counter_sum(name):
+        rec = snap.get(name)
+        if rec is None or rec.get("type") != "counter":
+            return None
+        return sum(rec["series"].values())
+
+    economics = None
+    hits = _counter_sum("nmfx_result_cache_hits_total")
+    misses = _counter_sum("nmfx_result_cache_misses_total")
+    coalesced = _counter_sum("nmfx_result_cache_coalesced_total")
+    extended = _counter_sum("nmfx_result_cache_extended_total")
+    if any(v is not None
+           for v in (hits, misses, coalesced, extended)):
+        h, m, c = hits or 0.0, misses or 0.0, coalesced or 0.0
+        served = sum(outcomes.values())
+        economics = {
+            "hits": int(h), "misses": int(m), "coalesced": int(c),
+            "extended": int(extended or 0),
+            "hit_rate": (h / (h + m)) if (h + m) else None,
+            "coalesce_rate": (c / served) if served else None,
+        }
     slo_status = engine.evaluate(now)
     return {"t": now, "instances": rows, "outcomes": outcomes,
             "p50_s": p50, "p99_s": p99, "goodput_req_per_s": goodput,
-            "mfu": mfu, "slo": slo_status, "snapshot": snap}
+            "mfu": mfu, "economics": economics, "slo": slo_status,
+            "snapshot": snap}
 
 
 def _fmt(v, suffix="", digits=3) -> str:
@@ -180,6 +204,14 @@ def render_text(frame: dict, telemetry_dir: str) -> str:
         lines.append("mfu: " + " ".join(
             f"{kind}={val:.3f}"
             for kind, val in sorted(frame["mfu"].items())))
+    econ = frame.get("economics")
+    if econ is not None:
+        lines.append(
+            f"economics: hit_rate={_fmt(econ['hit_rate'], '', 2)} "
+            f"(hits={econ['hits']} misses={econ['misses']}) "
+            f"coalesce_rate={_fmt(econ['coalesce_rate'], '', 2)} "
+            f"(coalesced={econ['coalesced']}) "
+            f"extended={econ['extended']}")
     slo = frame["slo"]
     for name, obj in sorted(slo["objectives"].items()):
         burns = " ".join(f"{w}={_fmt(b, '', 2)}"
@@ -226,6 +258,13 @@ def render_html(frame: dict, telemetry_dir: str) -> str:
         ("goodput", _fmt(frame["goodput_req_per_s"], " req/s", 2)),
     ] + [(f"mfu {k}", f"{v:.3f}")
          for k, v in sorted(frame["mfu"].items())]
+    if frame.get("economics") is not None:
+        econ = frame["economics"]
+        stats += [
+            ("cache hit rate", _fmt(econ["hit_rate"], "", 2)),
+            ("coalesce rate", _fmt(econ["coalesce_rate"], "", 2)),
+            ("extended sweeps", str(econ["extended"])),
+        ]
     stat_tiles = "".join(
         f'<div class="tile"><div class="label">{esc(label)}</div>'
         f'<div class="value">{esc(value)}</div></div>'

@@ -102,6 +102,12 @@ _outstanding_gauge = _metrics.gauge(
 _router_e2e_hist = _metrics.histogram(
     "nmfx_router_e2e_seconds",
     "router submit-to-resolution latency", labelnames=("outcome",))
+# declared identically in nmfx.result_cache / nmfx.serve — the registry
+# get-or-creates, so whichever module imports first owns the instance
+_coalesced_total = _metrics.counter(
+    "nmfx_result_cache_coalesced_total",
+    "requests attached as followers to an identical in-flight solve "
+    "instead of dispatching their own", labelnames=("layer",))
 
 
 class RouterError(ServeError):
@@ -197,6 +203,18 @@ class RouterConfig:
     #: its records — an alive-but-unresponsive worker must not hold
     #: its queued requests hostage
     drain_kill_after_s: float = 60.0
+    #: coalesce concurrent identical submissions (same content hash +
+    #: result-affecting config) onto ONE forwarded solve: followers
+    #: never forward, attach to the leader's outcome, and survive
+    #: replica failover through the leader's re-forward (exactly one
+    #: re-dispatch fleet-wide). Deadline'd requests never coalesce.
+    #: Opt-in: deduplication changes per-replica dispatch observables
+    #: that placement tests and A/B baselines key on
+    coalesce_requests: bool = False
+    #: directory for the router-level content-addressed result cache
+    #: (``nmfx.result_cache``) — a warm hit resolves at the router with
+    #: zero forwards; None disables the disk tier and the cache
+    result_cache_dir: "str | None" = None
 
     def __post_init__(self):
         if self.max_outstanding < 1:
@@ -276,6 +294,13 @@ class _Pending:
     retry_due: "float | None" = None
     retry_cause: "BaseException | None" = None
     forwarded_at: float = 0.0
+    #: content-addressed result key — set (leaders only) when this
+    #: request coalesces or populates the result cache; None otherwise
+    ckey: "str | None" = None
+    #: (scfg, ccfg, icfg, requested-quality) to re-key a result the
+    #: replica served degraded (a sketched answer must never be
+    #: replayed to exact-quality submissions)
+    ckey_parts: "tuple | None" = None
 
 
 class NMFXRouter:
@@ -285,7 +310,7 @@ class NMFXRouter:
 
     def __init__(self, pool, cfg: RouterConfig = RouterConfig(), *,
                  slo_engine=None, telemetry_dir: "str | None" = None,
-                 own_pool: bool = True):
+                 own_pool: bool = True, result_cache=None):
         self.pool = pool
         self.cfg = cfg
         self._own_pool = own_pool
@@ -299,10 +324,26 @@ class NMFXRouter:
         self._last_slo = 0.0
         self._idle_since: "float | None" = None
         self._wake = threading.Event()
+        if result_cache is not None:
+            self.result_cache = result_cache
+        elif cfg.result_cache_dir is not None:
+            from nmfx.result_cache import ResultCache
+
+            self.result_cache = ResultCache(
+                cache_dir=cfg.result_cache_dir, layer="router")
+        else:
+            self.result_cache = None
+        # in-flight coalescing (ISSUE 16), guarded by self._lock:
+        # result key → leader _Pending / attached follower rids.
+        # Followers live in _pending (close()/stats see them) but
+        # never forward — they resolve from the leader's fan-out
+        self._coalesce: "dict[str, _Pending]" = {}
+        self._cofollowers: "dict[str, list[str]]" = {}
         self.counters = {"submitted": 0, "completed": 0, "failed": 0,
                          "retried": 0, "shed": 0, "degraded": 0,
                          "readmitted": 0, "duplicates": 0,
-                         "drained": 0, "recovered": 0}
+                         "drained": 0, "recovered": 0,
+                         "result_cache_hits": 0, "coalesced": 0}
         if slo_engine is not None:
             self._slo = slo_engine
         elif telemetry_dir is not None:
@@ -447,9 +488,47 @@ class NMFXRouter:
         # ascontiguousarray is a no-op on the common contiguous case,
         # and the uint8 view hashes in place instead of materializing
         # a full tobytes() copy of the matrix per submission
+        submitted_at = time.monotonic()
         chash = hashlib.sha256(
             np.ascontiguousarray(arr).view(np.uint8)
             .reshape(-1)).hexdigest()
+        # request economics (ISSUE 16): the content-addressed result
+        # key — shared verbatim with the server layer, so a router
+        # cache directory and a replica cache directory interoperate.
+        # Deadline'd requests bypass both the cache and coalescing
+        # (a replayed/shared result cannot honor a latency contract
+        # it never saw)
+        ckey = ckey_parts = None
+        if deadline is None and (self.result_cache is not None
+                                 or self.cfg.coalesce_requests):
+            from nmfx.config import ConsensusConfig
+            from nmfx.result_cache import request_quality, result_key
+
+            ccfg = ConsensusConfig(
+                ks=tuple(ks), restarts=restarts, seed=seed,
+                label_rule=label_rule, linkage=linkage,
+                grid_slots=grid_slots,
+                grid_tail_slots=grid_tail_slots,
+                min_restarts=min_restarts)
+            quality = request_quality(scfg)
+            ckey_parts = (chash, tuple(arr.shape), arr.dtype.str,
+                          scfg, ccfg, icfg, quality)
+            ckey = result_key(*ckey_parts)
+            if self.result_cache is not None:
+                cached = self.result_cache.lookup(ckey)
+                if cached is not None:
+                    with self._lock:
+                        if self._closed:
+                            raise RouterClosed("router is closed")
+                        self.counters["submitted"] += 1
+                        self.counters["completed"] += 1
+                        self.counters["result_cache_hits"] += 1
+                    stats.latency_s = time.monotonic() - submitted_at
+                    fut = _RouterFuture(stats)
+                    fut.set_result(cached)
+                    _router_e2e_hist.observe(stats.latency_s,
+                                             outcome="completed")
+                    return fut
         pending = _Pending(rid=rid, a=arr, meta=meta,
                            future=_RouterFuture(stats), chash=chash,
                            submitted=time.monotonic(),
@@ -472,13 +551,40 @@ class NMFXRouter:
                 raise RouterOverloaded(
                     f"router outstanding bound reached "
                     f"({self.cfg.max_outstanding})")
-            self._pending[rid] = pending
-            _outstanding_gauge.set(len(self._pending))
-            self.counters["submitted"] += 1
+            leader = None
+            if ckey is not None and self.cfg.coalesce_requests:
+                cand = self._coalesce.get(ckey)
+                if cand is not None and cand.rid in self._pending:
+                    leader = cand
+            if leader is not None:
+                # attach as a follower: accounted in _pending (close()
+                # and stats() must see it) but never forwarded — the
+                # leader's fan-out resolves it, across re-forwards
+                self._pending[rid] = pending
+                self._cofollowers.setdefault(ckey, []).append(rid)
+                _outstanding_gauge.set(len(self._pending))
+                self.counters["submitted"] += 1
+                self.counters["coalesced"] += 1
+            else:
+                self._pending[rid] = pending
+                if ckey is not None and self.cfg.coalesce_requests:
+                    # the key's in-flight leader (registered under the
+                    # SAME lock section as admission — a raise above
+                    # can never strand a registry entry)
+                    self._coalesce[ckey] = pending
+                pending.ckey = ckey
+                pending.ckey_parts = ckey_parts
+                _outstanding_gauge.set(len(self._pending))
+                self.counters["submitted"] += 1
+        if leader is not None:
+            _coalesced_total.inc(layer="router")
+            _flight.record("router.coalesce", request_id=rid,
+                           leader=leader.rid, key=ckey[:12])
+            return pending.future
         try:
             self._forward(pending)
-        except RouterError:
-            self._drop(rid)
+        except RouterError as e:
+            self._abort_leader(pending, e)
             raise
         return pending.future
 
@@ -657,6 +763,19 @@ class NMFXRouter:
             _flight.record("router.readmit", request_id=pending.rid,
                            source=path)
 
+    def _release_coalesced_locked(self,
+                                  pending: _Pending) -> "list[_Pending]":
+        """Pop this leader's coalesce registration and return its
+        still-pending followers. Caller holds the router lock. An
+        identical submit arriving after the pop becomes the key's new
+        leader — attach-after-pop never strands a request."""
+        if pending.ckey is None \
+                or self._coalesce.get(pending.ckey) is not pending:
+            return []
+        del self._coalesce[pending.ckey]
+        rids = self._cofollowers.pop(pending.ckey, [])
+        return [self._pending[r] for r in rids if r in self._pending]
+
     def _resolve(self, pending: _Pending, result=None,
                  error: "BaseException | None" = None) -> None:
         now = time.monotonic()
@@ -666,11 +785,32 @@ class NMFXRouter:
                 return
             del self._pending[pending.rid]
             self._unassign_locked(pending)
+            followers = self._release_coalesced_locked(pending)
             _outstanding_gauge.set(len(self._pending))
             self.counters["completed" if error is None
                           else "failed"] += 1
+        if error is None and result is not None \
+                and self.result_cache is not None \
+                and pending.ckey_parts is not None:
+            # re-key a degraded answer at its ACTUAL served quality —
+            # a sketched result must never be replayed to
+            # exact-quality submissions
+            chash, shape, dt, scfg, ccfg, icfg, quality = \
+                pending.ckey_parts
+            try:
+                key = pending.ckey
+                if result.quality != quality or key is None:
+                    from nmfx.result_cache import result_key
+
+                    key = result_key(chash, shape, dt, scfg, ccfg,
+                                     icfg, result.quality)
+                self.result_cache.put(key, result)
+            except Exception:  # nmfx: ignore[NMFX006] -- cache trouble
+                # must never fail a solved request
+                pass
         pending.future.stats.latency_s = now - pending.submitted
         fut = pending.future
+        self._fanout(pending, followers, result, error)
         if fut.done():
             return
         fut.set_running_or_notify_cancel()
@@ -689,13 +829,34 @@ class NMFXRouter:
         _router_e2e_hist.observe(pending.future.stats.latency_s,
                                  outcome=outcome)
 
-    def _drop(self, rid: str) -> None:
+    def _fanout(self, leader: _Pending, followers: "list[_Pending]",
+                result, error: "BaseException | None") -> None:
+        """Share the leader's outcome with its coalesced followers —
+        through the ordinary `_resolve` path, so per-follower counters,
+        latency spans, and the outstanding gauge stay exact. Followers
+        have ``ckey=None``, so the recursion is one level deep."""
+        if not followers:
+            return
+        _flight.record("router.coalesce_fanout", leader=leader.rid,
+                       followers=len(followers),
+                       outcome="error" if error is not None
+                       else "result")
+        for f in followers:
+            self._resolve(f, result=result, error=error)
+
+    def _abort_leader(self, pending: _Pending,
+                      err: BaseException) -> None:
+        """Unwind a submission whose INITIAL placement raised
+        synchronously (`submit` re-raises to the caller): un-admit it
+        and fail any followers that attached while `_forward` ran."""
         with self._lock:
-            pending = self._pending.pop(rid, None)
-            if pending is not None:
+            dropped = self._pending.pop(pending.rid, None)
+            if dropped is not None:
                 self._unassign_locked(pending)
                 self.counters["submitted"] -= 1
+            followers = self._release_coalesced_locked(pending)
             _outstanding_gauge.set(len(self._pending))
+        self._fanout(pending, followers, None, err)
 
     # -- maintenance -------------------------------------------------------
     def _run_maintenance(self) -> None:

@@ -65,6 +65,7 @@ its :class:`RequestStats`). See docs/serving.md "Serving front-end".
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import heapq
 import itertools
 import threading
@@ -136,6 +137,13 @@ _quality_degraded_total = _metrics.counter(
     "nmfx_serve_quality_degraded_total",
     "requests degraded to the sketched engine by quality-elastic "
     "scheduling", labelnames=("cause",))
+#: request-economics counter (ISSUE 16): also declared in
+#: nmfx.result_cache — the registry's idempotent get-or-create hands
+#: both sites one shared series
+_coalesced_total = _metrics.counter(
+    "nmfx_result_cache_coalesced_total",
+    "requests attached as followers to an identical in-flight solve "
+    "instead of dispatching their own", labelnames=("layer",))
 #: level gauges for the fleet view (ISSUE 14): a router/autoscaler
 #: reads per-replica queue depth and inflight load from the merged
 #: telemetry, where gauges stay keyed by instance (nmfx.obs.aggregate)
@@ -584,6 +592,28 @@ class ServeConfig:
     #: ``nmfx_serve_quality_degraded_total{cause=…}`` counter, and a
     #: ``serve.quality_degraded`` flight event.
     quality_elastic: bool = False
+    #: request coalescing (ISSUE 16, docs/serving.md "Request
+    #: economics"): concurrent IDENTICAL submissions — same
+    #: content-addressed result key: input bytes, every
+    #: result-affecting config field, seed, quality — attach as
+    #: FOLLOWERS to the one in-flight leader solve instead of
+    #: dispatching their own; followers share the leader's outcome
+    #: (result, typed error, or degraded-and-tagged result) and are
+    #: never left hanging (a cancelled leader promotes its first live
+    #: follower into the queue). Only requests WITHOUT a deadline
+    #: coalesce — attaching a deadline'd request to a solve that may
+    #: outlive its budget would conflate two expiry semantics. Opt-in:
+    #: deduplication changes dispatch-count observables that existing
+    #: packing tests and A/B baselines key on.
+    coalesce_requests: bool = False
+    #: finished-result cache directory (ISSUE 16): with a directory
+    #: (or a ``result_cache=`` instance passed to the server), a
+    #: submission whose content-addressed result key is already stored
+    #: resolves IMMEDIATELY from the cache — zero solve dispatches,
+    #: zero host-to-device transfers (counter-gated) — and every
+    #: harvested result is admitted back. None = no result caching
+    #: (the default: serving stays solve-through).
+    result_cache_dir: "str | None" = None
     #: spill-on-shutdown directory (docs/serving.md "Durability
     #: model"): ``close(cancel_pending=True)`` persists each queued-but-
     #: undispatched request's full submission payload here (atomic
@@ -750,6 +780,15 @@ class _Request:
     degrade_cause: "str | None" = None
     #: the quality the request will actually be served at
     quality: str = "exact"
+    #: content-addressed result-cache key (ISSUE 16); None when the
+    #: request is ineligible (deadline'd, or caching+coalescing off)
+    cache_key: "str | None" = None
+    #: the (content fingerprint, shape, src dtype) triple behind
+    #: ``cache_key`` — kept so the harvest-time put can re-key a
+    #: mid-flight quality degradation without re-hashing the bytes
+    cache_fp: "tuple | None" = None
+    #: the quality ``cache_key`` was computed under at submit
+    cache_quality: str = "exact"
 
     @property
     def lanes(self) -> int:
@@ -923,7 +962,7 @@ class NMFXServer:
 
     def __init__(self, serve_cfg: ServeConfig = ServeConfig(), *,
                  engine: "Engine | None" = None, exec_cache=None,
-                 profiler=None, start: bool = True):
+                 result_cache=None, profiler=None, start: bool = True):
         from nmfx.profiling import NullProfiler
 
         if engine is not None and exec_cache is not None:
@@ -932,6 +971,14 @@ class NMFXServer:
         self._prof = profiler if profiler is not None else NullProfiler()
         self.engine: Engine = engine if engine is not None else \
             ExecCacheEngine(exec_cache, profiler=self._prof)
+        # finished-result cache (ISSUE 16): an explicit instance wins;
+        # else a configured directory builds one; else caching is off
+        if result_cache is None and serve_cfg.result_cache_dir is not None:
+            from nmfx.result_cache import ResultCache
+
+            result_cache = ResultCache(
+                cache_dir=serve_cfg.result_cache_dir, layer="server")
+        self.result_cache = result_cache
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: "list[tuple[tuple, _Request]]" = []  # heap
@@ -956,6 +1003,14 @@ class NMFXServer:
         # close(cancel_pending=True)) — so it must not touch self._lock
         self._tracked_lock = threading.Lock()
         self._tracked: "dict[int, _Request]" = {}
+        # in-flight coalescing registry (ISSUE 16): result-cache key →
+        # leader request / attached followers. Guarded by _tracked_lock
+        # (NOT self._lock): the leader's fan-out runs as a Future
+        # done-callback, which may fire on threads already holding
+        # self._lock (the close(cancel_pending=True) path) — same
+        # constraint as _untrack; lock order stays _lock → _tracked
+        self._coalesce: "dict[str, _Request]" = {}
+        self._followers: "dict[str, list[_Request]]" = {}
         self._harvest_owned: "set[int]" = set()  # guarded by _harvest_cond
         self._crash: "BaseException | None" = None  # set by _scheduler_main
         self._sched_clean = False  # scheduler exited via close(), not crash
@@ -1014,7 +1069,8 @@ class NMFXServer:
                          "packed_dispatches": 0, "packed_requests": 0,
                          "total_lanes": 0, "packed_lanes": 0,
                          "budget_clamped": 0, "spilled": 0,
-                         "readmitted": 0, "quality_degraded": 0}
+                         "readmitted": 0, "quality_degraded": 0,
+                         "result_cache_hits": 0, "coalesced": 0}
 
     # -- lifecycle ---------------------------------------------------------
     def __enter__(self) -> "NMFXServer":
@@ -1326,6 +1382,33 @@ class NMFXServer:
             req.quality = "sketched"
             stats.quality = "sketched"
         degradable = self._sketch_eligible(scfg)
+        # request economics (ISSUE 16): key the request content-
+        # addressed and try the finished-result cache BEFORE admission
+        # — a warm hit resolves without queueing, dispatching, or
+        # touching the device (the zero-dispatch/zero-h2d contract,
+        # counter-gated). Deadline'd requests are ineligible (a cached
+        # or coalesced outcome has its own timing semantics).
+        if deadline is None and (self.result_cache is not None
+                                 or self.cfg.coalesce_requests):
+            arr_c = np.ascontiguousarray(arr)
+            fp = hashlib.sha256(
+                arr_c.view(np.uint8).reshape(-1)).hexdigest()
+            req.cache_fp = (fp, tuple(arr.shape), arr_c.dtype.str)
+            req.cache_quality = req.quality
+            req.cache_key = self._result_key(req, req.quality)
+            if self.result_cache is not None:
+                cached = self.result_cache.lookup(req.cache_key)
+                if cached is not None:
+                    req.stats.latency_s = time.monotonic() - req.submitted
+                    req.stats.quality = cached.quality
+                    with self._lock:
+                        self.counters["submitted"] += 1
+                        self.counters["completed"] += 1
+                        self.counters["result_cache_hits"] += 1
+                    req.future.set_result(cached)
+                    _e2e_hist.observe(req.stats.latency_s,
+                                      outcome="completed")
+                    return req.future
         # admission pre-check BEFORE the O(bytes) fingerprint: under
         # overload QueueFull is the hot path, and rejecting must stay
         # cheap; the authoritative (race-free) check re-runs at enqueue
@@ -1336,7 +1419,46 @@ class NMFXServer:
         # scheduler thread's pop-to-dispatch path hash-free
         req.compat = self.engine.compatibility_key(req)
         with self._cond:
+            coalescing = (req.cache_key is not None
+                          and self.cfg.coalesce_requests
+                          and not self._closed and self._down is None)
+            if coalescing:
+                with self._tracked_lock:
+                    leader = self._coalesce.get(req.cache_key)
+                    attach = (leader is not None
+                              and not leader.future.done())
+                    if attach:
+                        self._followers.setdefault(
+                            req.cache_key, []).append(req)
+                if attach:
+                    # follower: no admission, no queue slot, no
+                    # dispatch — the leader's outcome fans out
+                    self.counters["submitted"] += 1
+                    self.counters["coalesced"] += 1
+                    with self._tracked_lock:
+                        self._tracked[req.seq] = req
+                    req.future.add_done_callback(
+                        lambda _f, seq=req.seq: self._untrack(seq))
+                    _coalesced_total.inc(layer="server")
+                    _flight.record("serve.coalesce", request_id=req.seq,
+                                   leader=leader.seq,
+                                   key=req.cache_key[:12])
+                    return req.future
             cause = self._admit_locked(arr.nbytes, degradable=degradable)
+            if coalescing:
+                # admitted: register as the key's leader — strictly
+                # AFTER admission, so a QueueFull raise can never
+                # strand a registry entry followers would attach to.
+                # Submissions serialize on self._cond, so no identical
+                # submit can interleave between the attach-check above
+                # and this registration; the fan-out callback only
+                # REMOVES entries it still owns, so a stale leader can
+                # never orphan this one's followers.
+                with self._tracked_lock:
+                    self._coalesce[req.cache_key] = req
+                req.future.add_done_callback(
+                    lambda _f, key=req.cache_key, lead=req:
+                        self._coalesce_fanout(key, lead))
             if cause is not None:
                 # quality-elastic soft admission: the request admission
                 # control would have SHED is served degraded instead —
@@ -1364,6 +1486,127 @@ class NMFXServer:
     def _untrack(self, seq: int) -> None:
         with self._tracked_lock:
             self._tracked.pop(seq, None)
+
+    def _result_key(self, req: _Request, quality: str) -> str:
+        """The request's content-addressed result key (ISSUE 16) —
+        ``result_cache.result_key`` over the precomputed content
+        fingerprint and the request's full consensus/solver/init
+        configuration, at ``quality``."""
+        from nmfx.result_cache import result_key
+
+        fp, shape, src_dtype = req.cache_fp
+        ccfg = ConsensusConfig(ks=req.ks, restarts=req.restarts,
+                               seed=req.seed, label_rule=req.label_rule,
+                               linkage=req.linkage,
+                               grid_slots=req.grid_slots,
+                               grid_tail_slots=req.grid_tail_slots,
+                               min_restarts=req.min_restarts)
+        return result_key(fp, shape, src_dtype, req.scfg, ccfg,
+                          req.icfg, quality)
+
+    def _coalesce_fanout(self, key: str, leader: _Request) -> None:
+        """Leader done-callback: release the in-flight registry entry
+        and share the leader's outcome with every attached follower.
+
+        Runs on whatever thread resolved the leader's future —
+        including threads holding ``self._lock`` (the
+        ``close(cancel_pending=True)`` path) — so it takes ONLY
+        ``_tracked_lock`` (the ``_untrack`` constraint). It pops the
+        follower list only while it still owns the registry entry: if
+        a new leader already replaced this one (an identical submit
+        raced the resolution), the followers are inherited by the new
+        leader — identical key, identical eventual outcome."""
+        with self._tracked_lock:
+            if self._coalesce.get(key) is not leader:
+                return  # superseded: followers ride the new leader
+            del self._coalesce[key]
+            followers = self._followers.pop(key, [])
+        if not followers:
+            return
+        fut = leader.future
+        if fut.cancelled():
+            self._coalesce_promote(key, followers)
+            return
+        err = fut.exception()
+        result = None if err is not None else fut.result()
+        now = time.monotonic()
+        resolved = 0
+        for f in followers:
+            if f.future.done():
+                continue  # e.g. the watchdog already failed it, typed
+            f.stats.latency_s = now - f.submitted
+            try:
+                if err is not None:
+                    f.future.set_exception(err)
+                    _e2e_hist.observe(f.stats.latency_s,
+                                      outcome="failed")
+                else:
+                    f.stats.quality = result.quality
+                    f.future.set_result(result)
+                    _e2e_hist.observe(f.stats.latency_s,
+                                      outcome="completed")
+                resolved += 1
+            except Exception:  # nmfx: ignore[NMFX006] -- lost a
+                # resolution race: the follower's Future is already
+                # resolved (cancel/close), nothing is swallowed
+                continue
+        if resolved:
+            # safe to take self._lock here: leaders are deadline-free,
+            # so nothing resolves one under _cond (_expire_locked) —
+            # every leader-resolution site (harvester, watchdog, the
+            # close(cancel_pending=True) drain, a caller's cancel())
+            # runs lock-free
+            with self._lock:
+                self.counters["failed" if err is not None
+                              else "completed"] += resolved
+        _flight.record("serve.coalesce_fanout", leader=leader.seq,
+                       key=key[:12], followers=resolved,
+                       outcome="error" if err is not None else "result")
+
+    def _coalesce_promote(self, key: str,
+                          followers: "list[_Request]") -> None:
+        """The leader was cancelled before dispatch: promote the first
+        still-live follower into the queue as the new leader and
+        re-attach the rest — followers never inherit a cancellation
+        they didn't ask for. Only ever reached from a caller-thread
+        ``future.cancel()`` (cancellation finalizes on the cancelling
+        thread), so taking the scheduler condition here is safe."""
+        live = [f for f in followers if not f.future.done()]
+        if not live:
+            return
+        head, rest = live[0], live[1:]
+        err = None
+        with self._cond:
+            if self._closed or self._down is not None:
+                err = ServerClosed(
+                    "server closed while promoting coalesced followers "
+                    "of a cancelled leader")
+            else:
+                with self._tracked_lock:
+                    self._coalesce[key] = head
+                    if rest:
+                        self._followers.setdefault(key, []).extend(rest)
+                head.future.add_done_callback(
+                    lambda _f, k=key, lead=head:
+                        self._coalesce_fanout(k, lead))
+                heapq.heappush(self._queue, (head.order_key(), head))
+                self._queued += 1
+                self._pending_bytes += head.a.nbytes
+                self._sync_gauges()
+                self._ensure_workers()
+                self._cond.notify_all()
+        if err is not None:
+            for f in live:
+                if not f.future.done():
+                    try:
+                        f.future.set_exception(err)
+                    except Exception:  # nmfx: ignore[NMFX006] -- lost
+                        # a resolution race: the Future resolved
+                        # concurrently (cancel/close), nothing swallowed
+                        continue
+            return
+        _flight.record("serve.coalesce_promote", request_id=head.seq,
+                       key=key[:12], followers=len(rest))
 
     def _telemetry_status(self) -> dict:
         """Per-INSTANCE load levels for the telemetry snapshot payload
@@ -2053,6 +2296,21 @@ class NMFXServer:
                     result = ConsensusResult(ks=req.ks, per_k=per_k,
                                              col_names=req.col_names,
                                              quality=req.quality)
+                    if (self.result_cache is not None
+                            and req.cache_fp is not None):
+                        # degraded requests re-key at their ACTUAL
+                        # served quality — a sketched answer must never
+                        # be replayed to exact-quality submissions
+                        pkey = (req.cache_key
+                                if result.quality == req.cache_quality
+                                else self._result_key(req,
+                                                      result.quality))
+                        try:
+                            self.result_cache.put(pkey, result)
+                        except Exception:  # nmfx: ignore[NMFX006] -- best-
+                            # effort admission: cache trouble (disk
+                            # full, perms) never fails the solve
+                            pass
                     req.future.set_result(result)
                     _e2e_hist.observe(req.stats.latency_s,
                                       outcome="completed")

@@ -560,3 +560,82 @@ def test_cli_router_main_usage_errors(tmp_path, capsys, gct_path):
     with pytest.raises(SystemExit):
         router_main([gct_path, "--replicas", "0"])
     assert ">= 1" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# --result-cache-dir (ISSUE 16): request economics from the CLI
+# ---------------------------------------------------------------------
+
+def test_cli_result_cache_warm_repeat_bit_identical(gct_path, tmp_path,
+                                                    capsys):
+    """Second identical run is served from the finished-result cache:
+    the saved results are bit-identical, and the cache directory holds
+    the entry after run one."""
+    import numpy as np
+
+    from nmfx.api import ConsensusResult
+
+    cdir = tmp_path / "rescache"
+    argv = [gct_path, "--ks", "2", "--restarts", "3", "--maxiter", "100",
+            "--no-files", "--result-cache-dir", str(cdir)]
+    assert main(argv + ["--save-result",
+                        str(tmp_path / "r1.npz")]) == 0
+    entries = [p for p in cdir.iterdir() if p.suffix == ".nmfxres"]
+    assert len(entries) == 1
+    assert main(argv + ["--save-result",
+                        str(tmp_path / "r2.npz")]) == 0
+    r1 = ConsensusResult.load(str(tmp_path / "r1.npz"))
+    r2 = ConsensusResult.load(str(tmp_path / "r2.npz"))
+    assert r1.best_k == r2.best_k == 2
+    for k in r1.per_k:
+        assert np.asarray(r1.per_k[k].consensus).tobytes() == \
+            np.asarray(r2.per_k[k].consensus).tobytes()
+    assert capsys.readouterr().out.count("best k = 2") == 2
+
+
+def test_cli_result_cache_composes_with_serve_smoke(gct_path, tmp_path,
+                                                    capsys):
+    cdir = tmp_path / "rescache"
+    argv = [gct_path, "--ks", "2", "--restarts", "3", "--maxiter", "100",
+            "--no-files", "--serve-smoke",
+            "--result-cache-dir", str(cdir)]
+    assert main(argv) == 0
+    assert "result_cache_hits=0" in capsys.readouterr().err
+    assert main(argv) == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "result_cache_hits=1" in cap.err
+
+
+def test_cli_result_cache_composes_with_checkpoint_dir(gct_path,
+                                                       tmp_path,
+                                                       capsys):
+    """Orthogonal durability layers: the ledger persists chunks, the
+    result cache persists the finished answer — one run may use both."""
+    rc = main([gct_path, "--ks", "2", "--restarts", "4",
+               "--maxiter", "100", "--no-files",
+               "--checkpoint-dir", str(tmp_path / "ckpt"),
+               "--result-cache-dir", str(tmp_path / "rescache")])
+    assert rc == 0
+    assert "best k = 2" in capsys.readouterr().out
+
+
+def test_cli_result_cache_composes_with_replicas(gct_path, tmp_path,
+                                                 capsys):
+    rc = main([gct_path, "--ks", "2", "--restarts", "2",
+               "--maxiter", "60", "--no-files", "--serve-smoke",
+               "--replicas", "2",
+               "--router-spill-dir", str(tmp_path / "root"),
+               "--result-cache-dir", str(tmp_path / "rescache")])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "serve-smoke (router): replicas=2" in cap.err
+
+
+def test_cli_result_cache_rejects_keep_factors(gct_path, tmp_path,
+                                               capsys):
+    with pytest.raises(SystemExit):
+        main([gct_path, "--keep-factors", "--no-files",
+              "--result-cache-dir", str(tmp_path / "rescache")])
+    assert "keep-factors" in capsys.readouterr().err

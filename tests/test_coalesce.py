@@ -1,0 +1,224 @@
+"""Request coalescing (ISSUE 16): concurrent identical submissions
+attach to ONE in-flight solve — at the server, at the router, and
+across replica failover (the satellite acceptance shape: the leader's
+replica SIGKILLed mid-solve with followers attached — zero lost
+futures, exactly one re-dispatch, bit-identical results).
+
+Server/router mechanics run against the scriptable
+:class:`test_serve.FakeEngine` (milliseconds, no device dispatch); the
+cross-process failover half uses two subprocess replicas like
+tests/test_router.py's SIGKILL recovery test."""
+
+import concurrent.futures
+
+import numpy as np
+import pytest
+
+from test_router import _assert_bit_equal, _fast_cfg, _pool, _sticky_id, \
+    _worker_env
+from test_serve import FakeEngine, _mat
+
+from nmfx.replica import ReplicaPool
+from nmfx.router import NMFXRouter, RouterConfig
+from nmfx.serve import NMFXServer, ServeConfig
+
+KW = dict(ks=(2,), restarts=2, seed=7)
+
+
+# ---------------------------------------------------------------------
+# server-level coalescing
+# ---------------------------------------------------------------------
+
+def test_server_coalesce_is_opt_in():
+    assert ServeConfig().coalesce_requests is False
+
+
+def test_identical_submissions_share_one_dispatch():
+    eng = FakeEngine(compat=None)
+    a = _mat()
+    with NMFXServer(ServeConfig(coalesce_requests=True), engine=eng,
+                    start=False) as srv:
+        leader = srv.submit(a, **KW)
+        f2 = srv.submit(a, **KW)
+        f3 = srv.submit(a, **KW)
+        assert srv.stats()["coalesced"] == 2
+        srv.resume()
+        r1 = leader.result(timeout=60)
+        # followers share the leader's outcome — the SAME object
+        assert f2.result(timeout=60) is r1
+        assert f3.result(timeout=60) is r1
+    assert len(eng.solo) == 1          # exactly one dispatch
+    st = srv.stats()
+    assert st["submitted"] == 3 and st["completed"] == 3
+    assert f2.stats.latency_s is not None
+
+
+def test_different_config_never_coalesces():
+    eng = FakeEngine(compat=None)
+    a = _mat()
+    with NMFXServer(ServeConfig(coalesce_requests=True), engine=eng,
+                    start=False) as srv:
+        f1 = srv.submit(a, **KW)
+        f2 = srv.submit(a, **dict(KW, seed=8))   # different key
+        srv.resume()
+        assert f1.result(timeout=60) is not f2.result(timeout=60)
+    assert srv.stats()["coalesced"] == 0
+    assert len(eng.solo) == 2
+
+
+def test_deadline_requests_never_coalesce():
+    """A deadline'd submission bypasses coalescing entirely — a shared
+    outcome cannot honor a latency contract it never saw."""
+    eng = FakeEngine(compat=None)
+    a = _mat()
+    with NMFXServer(ServeConfig(coalesce_requests=True), engine=eng,
+                    start=False) as srv:
+        f1 = srv.submit(a, **KW)
+        f2 = srv.submit(a, timeout=120.0, **KW)
+        srv.resume()
+        f1.result(timeout=60), f2.result(timeout=60)
+    assert srv.stats()["coalesced"] == 0
+    assert len(eng.solo) == 2
+
+
+def test_coalesced_error_fans_out_typed():
+    class FailingEngine(FakeEngine):
+        def dispatch_solo(self, req, placed, scfg):
+            raise RuntimeError("engine exploded")
+
+    eng = FailingEngine(compat=None)
+    a = _mat()
+    with NMFXServer(ServeConfig(coalesce_requests=True,
+                                dispatch_retries=0),
+                    engine=eng, start=False) as srv:
+        f1 = srv.submit(a, **KW)
+        f2 = srv.submit(a, **KW)
+        srv.resume()
+        with pytest.raises(Exception):
+            f1.result(timeout=60)
+        with pytest.raises(Exception):
+            f2.result(timeout=60)     # follower resolves too: no hang
+    st = srv.stats()
+    assert st["coalesced"] == 1 and st["failed"] == 2
+
+
+def test_cancelled_leader_promotes_follower():
+    """Cancelling the leader pre-dispatch must not cancel its
+    followers: the first live follower is promoted into the queue and
+    the rest re-attach to it."""
+    eng = FakeEngine(compat=None)
+    a = _mat()
+    with NMFXServer(ServeConfig(coalesce_requests=True), engine=eng,
+                    start=False) as srv:
+        leader = srv.submit(a, **KW)
+        f2 = srv.submit(a, **KW)
+        f3 = srv.submit(a, **KW)
+        assert leader.cancel()
+        srv.resume()
+        r2 = f2.result(timeout=60)
+        assert f3.result(timeout=60) is r2
+        with pytest.raises(concurrent.futures.CancelledError):
+            leader.result(timeout=60)
+    assert len(eng.solo) == 1          # the promoted follower's solve
+
+
+def test_coalesce_composes_with_result_cache(tmp_path):
+    """Mixed economics in one server: first wave coalesces onto one
+    solve, whose finished result then serves a later identical
+    submission from the cache with no dispatch at all."""
+    eng = FakeEngine(compat=None)
+    a = _mat()
+    cfg = ServeConfig(coalesce_requests=True,
+                      result_cache_dir=str(tmp_path))
+    with NMFXServer(cfg, engine=eng, start=False) as srv:
+        f1 = srv.submit(a, **KW)
+        f2 = srv.submit(a, **KW)
+        srv.resume()
+        r1 = f1.result(timeout=60)
+        assert f2.result(timeout=60) is r1
+        f4 = srv.submit(a, **KW)
+        assert f4.result(timeout=60) is not None
+        st = srv.stats()
+    assert len(eng.solo) == 1
+    assert st["coalesced"] == 1 and st["result_cache_hits"] == 1
+    assert st["submitted"] == 3 and st["completed"] == 3
+
+
+# ---------------------------------------------------------------------
+# router-level coalescing (thread replicas)
+# ---------------------------------------------------------------------
+
+def test_router_coalesce_single_forward(tmp_path):
+    a = _mat()
+    pool = _pool(tmp_path, n=2,
+                 engine_factory=lambda: FakeEngine(compat=None,
+                                                   delay=0.4))
+    with NMFXRouter(pool, _fast_cfg(coalesce_requests=True)) as router:
+        leader = router.submit(a, **KW)
+        f2 = router.submit(a, **KW)
+        f3 = router.submit(a, **KW)
+        s_mid = router.stats()
+        r1 = leader.result(timeout=60)
+        assert f2.result(timeout=60) is r1
+        assert f3.result(timeout=60) is r1
+        s = router.stats()
+    assert s_mid["coalesced"] == 2
+    assert s["completed"] == 3 and s["failed"] == 0
+    # followers were never forwarded — no replica ever saw them
+    assert leader.stats.replica is not None
+    assert f2.stats.replica is None and f3.stats.replica is None
+
+
+def test_router_coalesce_is_opt_in(tmp_path):
+    assert RouterConfig().coalesce_requests is False
+    a = _mat()
+    pool = _pool(tmp_path, n=1,
+                 engine_factory=lambda: FakeEngine(compat=None,
+                                                   delay=0.2))
+    with NMFXRouter(pool, _fast_cfg()) as router:
+        f1 = router.submit(a, **KW)
+        f2 = router.submit(a, **KW)
+        f1.result(timeout=60), f2.result(timeout=60)
+    assert router.stats()["coalesced"] == 0
+
+
+# ---------------------------------------------------------------------
+# the satellite acceptance shape: coalescing × replica failover
+# ---------------------------------------------------------------------
+
+def test_coalesced_followers_survive_leader_replica_sigkill(tmp_path):
+    """The leader's subprocess replica is SIGKILLed mid-solve with two
+    followers coalesced onto it. The router reclaims the leader's
+    write-ahead record and re-dispatches it on the survivor — EXACTLY
+    once (followers were never forwarded, so there is nothing else to
+    readmit) — and the whole cohort resolves bit-identically to a solo
+    run. Zero lost futures."""
+    from nmfx.api import nmfconsensus
+    from nmfx.config import SolverConfig
+    from nmfx.datasets import two_group_matrix
+    from nmfx.exec_cache import ExecCache
+
+    a = two_group_matrix(n_genes=60, n_per_group=10, seed=3)
+    scfg = SolverConfig(max_iter=30)
+    pool = ReplicaPool(2, root=str(tmp_path / "pool"), mode="process",
+                       env=_worker_env())
+    with NMFXRouter(pool, _fast_cfg(stickiness_slack=8,
+                                    coalesce_requests=True)) as router:
+        victim_id = _sticky_id(router, a)
+        victim = pool.get(victim_id)
+        leader = router.submit(a, solver_cfg=scfg, **KW)
+        assert leader.stats.replica == victim_id
+        followers = [router.submit(a, solver_cfg=scfg, **KW)
+                     for _ in range(2)]
+        assert router.stats()["coalesced"] == 2
+        victim.kill()
+        results = [f.result(timeout=180)
+                   for f in [leader] + followers]      # zero lost futures
+    ref = nmfconsensus(a, solver_cfg=scfg, use_mesh=False,
+                       exec_cache=ExecCache(), **KW)
+    for res in results:
+        _assert_bit_equal(res, ref)
+    s = router.stats()
+    assert s["recovered"] == 1
+    assert s["readmitted"] == 1        # exactly one re-dispatch
+    assert s["completed"] == 3 and s["failed"] == 0

@@ -1,0 +1,142 @@
+"""Incremental consensus (ISSUE 16): re-running the same (data,
+config) at a WIDENED restarts/ks budget resumes the checkpoint ledger,
+solves only the delta chunks, and is BIT-IDENTICAL to a from-scratch
+run at the widened budget.
+
+Why this can be exact: restart r's key is ``split(fold_in(key(seed),
+k), R)[r]``, which counter-mode threefry makes independent of the
+total budget R — so a chunk record solved under restarts=4 is the same
+bits the restarts=8 run would solve for those rows, and the ledger's
+manifest treats a budget change as an extension (``ck.extended``), not
+a cold start. The engine matrix mirrors tests/test_checkpoint.py;
+heavier families ride the slow tier."""
+
+import numpy as np
+import pytest
+
+from test_checkpoint import assert_bit_identical
+
+from nmfx import checkpoint as ckpt
+from nmfx.api import nmfconsensus
+from nmfx.config import CheckpointConfig, SolverConfig
+
+KW = dict(ks=(2, 3), seed=5)
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    from nmfx.datasets import two_group_matrix
+
+    return two_group_matrix(n_genes=60, n_per_group=10, seed=7)
+
+
+def _run(data, path, scfg, restarts, chunk=2, **over):
+    kw = dict(KW, **over)
+    cp = CheckpointConfig(directory=str(path), every_n_restarts=chunk)
+    return nmfconsensus(data, solver_cfg=scfg, max_iter=None,
+                        checkpoint=cp, restarts=restarts, **kw)
+
+
+def _extended_count() -> int:
+    return int(ckpt._extended_total.total())
+
+
+#: tier-1 covers the three fast chunk-executor routes (the ISSUE 16
+#: acceptance matrix); als/kl ride the slow tier
+ENGINES = [
+    pytest.param(SolverConfig(algorithm="mu", max_iter=30),
+                 id="mu-packed"),
+    pytest.param(SolverConfig(algorithm="mu", max_iter=30,
+                              backend="vmap"), id="mu-vmap"),
+    pytest.param(SolverConfig(algorithm="hals", max_iter=30),
+                 id="hals"),
+]
+
+ENGINES_SLOW = [
+    pytest.param(SolverConfig(algorithm="als", max_iter=30), id="als"),
+    pytest.param(SolverConfig(algorithm="kl", max_iter=30), id="kl"),
+]
+
+
+def _restart_widening_roundtrip(small_data, tmp_path, scfg):
+    """restarts 4 -> 8 over one ledger: only the delta chunks solve,
+    the extension flag/counter fire, and the result is bit-identical to
+    a fresh restarts=8 run."""
+    _run(small_data, tmp_path / "inc", scfg, restarts=4)
+    solved = ckpt.chunks_solved_count()
+    ext0 = _extended_count()
+    wide = _run(small_data, tmp_path / "inc", scfg, restarts=8)
+    # chunk plan for 8 with chunk=2 is 4 chunks/rank; the first 2 per
+    # rank are served from the restarts=4 records — only 2×|ks| solve
+    assert ckpt.chunks_solved_count() == solved + 2 * len(KW["ks"])
+    assert _extended_count() == ext0 + 1
+    fresh = _run(small_data, tmp_path / "fresh", scfg, restarts=8)
+    assert_bit_identical(wide, fresh)
+
+
+@pytest.mark.parametrize("scfg", ENGINES)
+def test_restart_widening_bit_identical(small_data, tmp_path, scfg):
+    _restart_widening_roundtrip(small_data, tmp_path, scfg)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scfg", ENGINES_SLOW)
+def test_restart_widening_bit_identical_slow_engines(small_data,
+                                                     tmp_path, scfg):
+    _restart_widening_roundtrip(small_data, tmp_path, scfg)
+
+
+def test_ks_widening_solves_only_new_ranks(small_data, tmp_path):
+    """ks (2,) -> (2, 3): rank 2 replays from records (bit-identical to
+    its narrow-run self), only rank 3's chunks solve, and the widened
+    result matches a fresh (2, 3) run bit-for-bit."""
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    narrow = _run(small_data, tmp_path / "inc", scfg, restarts=4,
+                  ks=(2,))
+    solved = ckpt.chunks_solved_count()
+    ext0 = _extended_count()
+    wide = _run(small_data, tmp_path / "inc", scfg, restarts=4,
+                ks=(2, 3))
+    assert ckpt.chunks_solved_count() == solved + 2  # rank 3 only
+    assert _extended_count() == ext0 + 1
+    assert np.asarray(narrow.per_k[2].consensus).tobytes() == \
+        np.asarray(wide.per_k[2].consensus).tobytes()
+    fresh = _run(small_data, tmp_path / "fresh", scfg, restarts=4,
+                 ks=(2, 3))
+    assert_bit_identical(wide, fresh)
+
+
+def test_combined_restarts_and_ks_widening(small_data, tmp_path):
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    _run(small_data, tmp_path / "inc", scfg, restarts=4, ks=(2,))
+    wide = _run(small_data, tmp_path / "inc", scfg, restarts=8,
+                ks=(2, 3))
+    fresh = _run(small_data, tmp_path / "fresh", scfg, restarts=8,
+                 ks=(2, 3))
+    assert_bit_identical(wide, fresh)
+
+
+def test_pure_replay_is_not_an_extension(small_data, tmp_path):
+    """A fully-checkpointed identical re-run is a replay: zero solves
+    and NO extension counted (the counter means 'reused AND produced
+    new work')."""
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    _run(small_data, tmp_path / "c", scfg, restarts=4)
+    solved = ckpt.chunks_solved_count()
+    ext0 = _extended_count()
+    _run(small_data, tmp_path / "c", scfg, restarts=4)
+    assert ckpt.chunks_solved_count() == solved
+    assert _extended_count() == ext0
+
+
+def test_narrowing_replays_prefix_records(small_data, tmp_path):
+    """restarts 8 -> 4 re-plans to the narrow budget's chunk set, whose
+    records all exist: nothing solves, and the result is bit-identical
+    to a direct restarts=4 run."""
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    _run(small_data, tmp_path / "inc", scfg, restarts=8)
+    solved = ckpt.chunks_solved_count()
+    narrow = _run(small_data, tmp_path / "inc", scfg, restarts=4)
+    assert ckpt.chunks_solved_count() == solved
+    direct = _run(small_data, tmp_path / "direct", scfg, restarts=4)
+    assert_bit_identical(narrow, direct)

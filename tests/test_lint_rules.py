@@ -1323,3 +1323,132 @@ def test_nmfx010_rule_registered():
     from nmfx.analysis import RULES
 
     assert "NMFX010" in RULES
+
+
+# ---------------------------------------------------------------- NMFX011
+# result-cache key coverage (ISSUE 16): every result-affecting
+# SolverConfig/ConsensusConfig field must reach the content-addressed
+# result key or be explicitly declared exempt — the stale-SERVE class
+# (one finished result replayed to two configurations that must
+# differ). Same pure-check + bad-universe/clean-twin + live-tree +
+# mutation-through-run shape as NMFX001/NMFX007/NMFX008; the baseline
+# stays empty.
+
+def _rescache_universe(**over):
+    """A minimal healthy result-cache-key universe; overrides inject
+    the defect (the NMFX007 bad-universe pattern)."""
+    base = dict(
+        solver_fields=frozenset({"algorithm", "tol_x", "restart_chunk"}),
+        consensus_fields=frozenset({"restarts", "seed", "ks",
+                                    "linkage"}),
+        cache_solver=frozenset({"algorithm", "tol_x"}),
+        cache_consensus=frozenset({"restarts", "seed", "ks",
+                                   "linkage"}),
+        declared_non_numerics=("restart_chunk",),
+        declared_result_cache_exempt=(),
+    )
+    base.update(over)
+    return base
+
+
+def test_nmfx011_clean_universe_quiet():
+    from nmfx.analysis.rules_config import check_result_cache_coverage
+
+    assert check_result_cache_coverage(**_rescache_universe()) == []
+
+
+def test_nmfx011_live_tree_clean():
+    """The shipped tree must satisfy its own key-coverage contract —
+    in particular RESULT_CACHE_EXEMPT_FIELDS stays EMPTY (unlike the
+    checkpoint ledger, the result cache must key restarts/ks: a
+    finished restarts=4 answer is not a restarts=8 answer)."""
+    from nmfx.analysis.rules_config import (
+        _live_result_cache_universe, check_result_cache_coverage)
+
+    live = _live_result_cache_universe()
+    assert live["declared_result_cache_exempt"] == ()
+    assert {"restarts", "ks", "seed"} <= live["cache_consensus"]
+    assert check_result_cache_coverage(**live) == []
+
+
+def test_nmfx011_solver_field_dropped_fires():
+    from nmfx.analysis.rules_config import check_result_cache_coverage
+
+    problems = check_result_cache_coverage(**_rescache_universe(
+        cache_solver=frozenset({"algorithm"})))
+    assert any("SolverConfig.tol_x" in p and "result-cache" in p
+               for p in problems)
+
+
+def test_nmfx011_consensus_field_dropped_fires():
+    """The headline hazard: restarts invisible to the key would replay
+    a narrow-budget consensus to a widened-budget request forever."""
+    from nmfx.analysis.rules_config import check_result_cache_coverage
+
+    problems = check_result_cache_coverage(**_rescache_universe(
+        cache_consensus=frozenset({"seed", "ks", "linkage"})))
+    assert any("ConsensusConfig.restarts" in p
+               and "RESULT_CACHE_EXEMPT_FIELDS" in p for p in problems)
+
+
+def test_nmfx011_declared_exemption_quiet():
+    """An exclusion WITH its declaration on record is accepted — the
+    rule enforces honesty, not a fixed key shape."""
+    from nmfx.analysis.rules_config import check_result_cache_coverage
+
+    assert check_result_cache_coverage(**_rescache_universe(
+        cache_consensus=frozenset({"restarts", "seed", "ks"}),
+        declared_result_cache_exempt=("linkage",))) == []
+
+
+def test_nmfx011_stale_exempt_declaration_fires():
+    from nmfx.analysis.rules_config import check_result_cache_coverage
+
+    problems = check_result_cache_coverage(**_rescache_universe(
+        declared_result_cache_exempt=("not_a_field",)))
+    assert any("not_a_field" in p and "stale" in p for p in problems)
+
+
+def test_nmfx011_contradictory_declaration_fires():
+    """Exempt AND covered at once: one declaration is stale."""
+    from nmfx.analysis.rules_config import check_result_cache_coverage
+
+    problems = check_result_cache_coverage(**_rescache_universe(
+        declared_result_cache_exempt=("linkage",)))
+    assert any("linkage" in p and "contradictory" in p
+               for p in problems)
+
+
+def test_nmfx011_rule_fires_through_run_on_mutated_key(monkeypatch):
+    """Acceptance mutation: drop 'restarts' from the live key coverage
+    (without declaring it exempt) and the REGISTERED rule — through the
+    real run() path over the real config.py — goes red at the
+    ConsensusConfig declaration; restore and the run is quiet again."""
+    from nmfx import result_cache
+    from nmfx.analysis import run
+
+    target = ["nmfx/config.py"]
+    findings = [f for f in run(target, jaxpr=False,
+                               rule_ids=["NMFX011"])
+                if f.rule_id == "NMFX011"]
+    assert findings == []  # live tree compliant
+    real = result_cache.cache_key_fields()
+    monkeypatch.setattr(
+        result_cache, "cache_key_fields",
+        lambda: {"solver": real["solver"],
+                 "consensus": real["consensus"] - {"restarts"}})
+    findings = [f for f in run(target, jaxpr=False,
+                               rule_ids=["NMFX011"])
+                if f.rule_id == "NMFX011"]
+    assert len(findings) == 1
+    assert "ConsensusConfig.restarts" in findings[0].message
+    assert findings[0].file.endswith("nmfx/config.py")
+    monkeypatch.undo()
+    assert [f for f in run(target, jaxpr=False, rule_ids=["NMFX011"])
+            if f.rule_id == "NMFX011"] == []
+
+
+def test_nmfx011_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX011" in RULES
