@@ -591,7 +591,8 @@ def run_durability_child(args) -> int:
     a = grouped_matrix(args.genes, tuple(sizes), effect=2.0, seed=0)
     scfg = SolverConfig(algorithm=args.algorithm, max_iter=args.maxiter,
                         matmul_precision=args.precision,
-                        backend=args.backend)
+                        backend=args.backend,
+                        tile_rows=args.atlas_tile_rows)
     faults.arm("proc.preempt", every=args.preempt_after, max_fires=1)
     cfg = CheckpointConfig(args.durability_child,
                            every_n_restarts=args.durability_chunk)
@@ -659,6 +660,12 @@ def main():
     p.add_argument("--preempt-after", type=int, default=None,
                    help=argparse.SUPPRESS)
     p.add_argument("--durability-chunk", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    # internal: the atlas rung's kill-at-50% child is the SAME protocol
+    # with a tiled solver config — the preemption then lands mid-MATRIX
+    # (between Gram passes, after a .part.npz partial landed) instead of
+    # between chunks
+    p.add_argument("--atlas-tile-rows", type=int, default=None,
                    help=argparse.SUPPRESS)
     p.add_argument("--regress", action="store_true",
                    help="after recording, judge this run's metrics "
@@ -1274,6 +1281,324 @@ def main():
         finally:
             shutil.rmtree(ref_dir, ignore_errors=True)
             shutil.rmtree(kill_dir, ignore_errors=True)
+
+    def run_atlas_stage():
+        """Atlas rung (ISSUE 17, detail.atlas): the out-of-core tile
+        pipeline + sparse ingestion, exercised for real on CPU by
+        forcing the tile budget small enough that the bench matrix no
+        longer fits in a single resident tile. Four sub-rungs:
+
+        ladder
+            tiled sweeps at 1/4, 1/2 and full feature count under the
+            forced budget; the full rung MUST plan >1 tile (that IS the
+            larger-than-budget condition) — restarts/s, streamed-pass
+            and h2d-byte counters, and the h2d-overlap split from the
+            profiler's overlap accounting (``xfer.h2d_tile`` = dispatch
+            hidden behind compute, ``xfer.h2d_tile_wait`` = the
+            unhidden stall; their ratio is what prefetch buys).
+        parity (exit-2 gates)
+            single-tile delegation must be BIT-identical to the dense
+            sweep (the in-core contract); a multi-tile sweep with
+            prefetch ON must be bit-identical to prefetch OFF (overlap
+            must never change math); multi-tile vs dense is agreement-
+            gated (ARI/rho — tile-order f32 Gram accumulation is a
+            different summation order, so bitwise is not the contract
+            there), gated only at hardware shapes like the sketched
+            stage.
+        sparse
+            ``make_sparse_design`` at 90%/99% sparsity: restarts/s for
+            the BCOO ingestion path vs the densified twin through the
+            plain dense path, plus their agreement report (the hard
+            sparse==densified gates live in tests/test_sparse.py at
+            controlled shapes; the bench records the measurement).
+        resume (exit-2 gates)
+            kill-at-<=50% for a TILED checkpointed run: the child
+            re-enters this entrypoint with --atlas-tile-rows, the
+            injected preemption lands mid-matrix AFTER a partial
+            checkpoint record (.part.npz) hit disk, the child exits
+            137; the parent asserts the partial survived, resumes,
+            asserts the partial was CONSUMED (the
+            nmfx_tile_partial_resumes_total counter moved) and then
+            cleared, and gates the resumed result bit-identical to an
+            uninterrupted run."""
+        import dataclasses as _dc
+        import shutil
+        import subprocess
+        import tempfile
+
+        from nmfx import checkpoint as ckpt
+        from nmfx import tiles
+        from nmfx.agreement import consensus_agreement
+        from nmfx.api import nmfconsensus
+        from nmfx.config import TILED_ALGORITHMS, CheckpointConfig
+        from nmfx.datasets import make_sparse_design
+
+        scfg_base = cfgs[args.backend]
+        if scfg_base.algorithm not in TILED_ALGORITHMS:
+            return {"skipped": f"algorithm {scfg_base.algorithm!r} is "
+                               "outside the Gram-accumulation tiled "
+                               f"family {TILED_ALGORITHMS}"}
+        if scfg_base.backend in ("pallas", "sketched"):
+            return {"skipped": f"backend {scfg_base.backend!r} cannot "
+                               "stream tiles (SolverConfig contract)"}
+        # stage-local iteration budget: the rung measures streaming
+        # mechanics and parity, not convergence depth
+        mi_t = min(args.maxiter, 500)
+        scfg_dense = _dc.replace(scfg_base, max_iter=mi_t)
+        ks_t = ks[:2]
+        restarts_t = min(args.restarts, 8)
+        itemsize = np.dtype(scfg_dense.dtype).itemsize
+
+        def gate(problems, what):
+            if problems:
+                for prob in problems:
+                    print(f"bench ATLAS {what} FAILURE: {prob}",
+                          file=sys.stderr)
+                raise SystemExit(2)
+
+        def run_one(mat, scfg_r, *, prof=None, ckpt_cfg=None,
+                    seed_r=seed):
+            t0 = time.perf_counter()
+            if prof is not None:
+                with prof:
+                    res = nmfconsensus(
+                        mat, ks=ks_t, restarts=restarts_t, seed=seed_r,
+                        solver_cfg=scfg_r, use_mesh=False,
+                        profiler=prof, checkpoint=ckpt_cfg)
+            else:
+                res = nmfconsensus(
+                    mat, ks=ks_t, restarts=restarts_t, seed=seed_r,
+                    solver_cfg=scfg_r, use_mesh=False,
+                    checkpoint=ckpt_cfg)
+            return res, time.perf_counter() - t0
+
+        total_restarts_t = restarts_t * len(ks_t)
+        detail = {}
+        try:
+            # --- ladder: force the budget so the FULL shape overflows
+            # a single tile (two resident buffers fit the budget, so
+            # tiles are sized budget/2 -> the smallest rung streams 2
+            # tiles, the full rung ~8)
+            m_rungs = sorted({max(64, args.genes // 4),
+                              max(64, args.genes // 2), args.genes})
+            budget = 2 * max(64, args.genes // 8) * args.samples \
+                * itemsize
+            tiles.set_tile_budget_bytes(budget)
+            scfg_auto = _dc.replace(scfg_dense, tile_rows="auto")
+            ladder = []
+            for m_r in m_rungs:
+                a_r = a[:m_r]
+                plan_r = tiles.plan_for(a_r, scfg_auto)
+                prof = Profiler()
+                passes0 = tiles._tile_passes_total.value()
+                h2d0 = tiles._tile_h2d_bytes_total.value()
+                _, wall_r = run_one(a_r, scfg_auto, prof=prof)
+                xfer = prof.phases.get(tiles.TILE_XFER_PHASE)
+                wait = prof.phases.get(tiles.TILE_WAIT_PHASE)
+                xfer_s = xfer.seconds if xfer is not None else 0.0
+                wait_s = wait.seconds if wait is not None else 0.0
+                h2d_total = xfer_s + wait_s
+                ladder.append({
+                    "shape": f"{m_r}x{args.samples}",
+                    "device_bytes": m_r * args.samples * itemsize,
+                    "tile_rows": plan_r.tile_rows,
+                    "n_tiles": plan_r.n_tiles,
+                    "wall_s": round(wall_r, 3),
+                    "restarts_per_s": round(total_restarts_t / wall_r,
+                                            2),
+                    "tile_passes": int(
+                        tiles._tile_passes_total.value() - passes0),
+                    "h2d_bytes": int(
+                        tiles._tile_h2d_bytes_total.value() - h2d0),
+                    "h2d_xfer_s": round(xfer_s, 3),
+                    "h2d_wait_s": round(wait_s, 3),
+                    # fraction of tile-transfer time hidden behind
+                    # compute (dispatch vs stall); 1.0 = fully
+                    # overlapped
+                    "h2d_hidden_frac": round(
+                        xfer_s / h2d_total, 3) if h2d_total > 0
+                    else None,
+                    "overlap_ratio": prof.audit(
+                        wall_r)["overlap_ratio"],
+                })
+            top = ladder[-1]
+            if top["n_tiles"] < 2:
+                gate([f"full rung {top['shape']} planned "
+                      f"{top['n_tiles']} tile(s) under the forced "
+                      f"{budget}-byte budget — the larger-than-budget "
+                      "condition never happened"], "LADDER")
+            detail["ladder"] = ladder
+            detail["out_of_core"] = top
+            detail["tile_budget_bytes"] = budget
+            tiles.set_tile_budget_bytes(None)
+
+            # --- parity gates on the smallest rung (cost-bounded)
+            m0 = m_rungs[0]
+            a0 = a[:m0]
+            ref_dense, _ = run_one(a0, scfg_dense)
+            single, _ = run_one(
+                a0, _dc.replace(scfg_dense, tile_rows=m0))
+            gate(_serve_parity_problems(single, ref_dense,
+                                        "atlas single-tile delegation"),
+                 "PARITY")
+            tr_multi = max(1, m0 // 3)
+            multi_on, _ = run_one(
+                a0, _dc.replace(scfg_dense, tile_rows=tr_multi))
+            tiles.set_tile_prefetch(False)
+            multi_off, _ = run_one(
+                a0, _dc.replace(scfg_dense, tile_rows=tr_multi))
+            tiles.set_tile_prefetch(True)
+            gate(_serve_parity_problems(multi_on, multi_off,
+                                        "atlas prefetch on-vs-off"),
+                 "PARITY")
+            agree = consensus_agreement(multi_on, ref_dense)
+            # same TOY-SHAPE policy as the sketched stage: at smoke
+            # shapes the dense consensus is itself unstable, so the
+            # agreement numbers are recorded but only gated at
+            # hardware shapes
+            agreement_gated = args.genes >= 1000 and args.samples >= 100
+            if agreement_gated and agree["min_ari"] < 0.75:
+                gate([f"multi-tile vs dense min ARI "
+                      f"{agree['min_ari']:.3f} < 0.75"], "AGREEMENT")
+            if agreement_gated and agree["max_rho_gap"] > 0.15:
+                gate([f"multi-tile vs dense |d rho| "
+                      f"{agree['max_rho_gap']:.3f} > 0.15"],
+                     "AGREEMENT")
+            detail["parity"] = {
+                "single_tile_delegation": "bitwise-ok",
+                "prefetch_on_off": "bitwise-ok",
+                "multi_tile_tiles": -(-m0 // tr_multi),
+                "vs_dense_min_ari": round(agree["min_ari"], 3),
+                "vs_dense_max_rho_gap": round(agree["max_rho_gap"], 4),
+                "agreement_gated": agreement_gated,
+            }
+
+            # --- sparse ingestion: 90% / 99% sparsity vs the
+            # densified twin through the plain dense path
+            m_sp = min(args.genes, 1500)
+            n_sp = min(args.samples, 200)
+            sparse_detail = {}
+            for dens, tag in ((0.10, "density_90"), (0.01,
+                                                     "density_99")):
+                sp = make_sparse_design(m_sp, n_sp, k=4, density=dens,
+                                        seed=11)
+                res_sp, wall_sp = run_one(sp, scfg_dense)
+                res_dn, wall_dn = run_one(sp.toarray(), scfg_dense)
+                rep = consensus_agreement(res_sp, res_dn)
+                sparse_detail[tag] = {
+                    "shape": f"{m_sp}x{n_sp}",
+                    "nnz": int(sp.nnz),
+                    "density": round(sp.density, 4),
+                    "sparse_wall_s": round(wall_sp, 3),
+                    "dense_wall_s": round(wall_dn, 3),
+                    "sparse_restarts_per_s": round(
+                        total_restarts_t / wall_sp, 2),
+                    "dense_restarts_per_s": round(
+                        total_restarts_t / wall_dn, 2),
+                    # >1 = the nonzero-only contraction beats the
+                    # dense GEMM on this host (expect <1 on CPU
+                    # containers, >1 only where nnz/mn is far below
+                    # the host's GEMM efficiency crossover)
+                    "speedup_vs_dense": round(wall_dn / wall_sp, 3),
+                    "min_ari_vs_densified": round(rep["min_ari"], 3),
+                }
+            detail["sparse"] = sparse_detail
+
+            # --- kill-at-<=50% mid-matrix resume (tiled + durable
+            # ledger)
+            tr_kill = max(1, args.genes // 4)
+            scfg_kill = _dc.replace(scfg_dense, tile_rows=tr_kill)
+            chunk_t = max(1, restarts_t // 4)
+            total_chunks = len(ckpt.plan_chunks(restarts_t, chunk_t)) \
+                * len(ks_t)
+            ref_dir = tempfile.mkdtemp(prefix="nmfx-bench-atlas-ref-")
+            kill_dir = tempfile.mkdtemp(prefix="nmfx-bench-atlas-kill-")
+            try:
+                t0 = time.perf_counter()
+                ref = nmfconsensus(
+                    a, ks=ks_t, restarts=restarts_t, seed=seed,
+                    solver_cfg=scfg_kill, use_mesh=False,
+                    checkpoint=CheckpointConfig(
+                        ref_dir, every_n_restarts=chunk_t))
+                full_wall = time.perf_counter() - t0
+                # every tiled chunk polls the preempt site at each
+                # check boundary AND once post-solve (>= 2 polls per
+                # chunk), so the Nth poll with N = total_chunks lands
+                # inside the first half of the chunk sequence —
+                # kill-at-<=50%, mid-matrix
+                cmd = [sys.executable, os.path.abspath(__file__),
+                       "--durability-child", kill_dir,
+                       "--preempt-after", str(total_chunks),
+                       "--durability-chunk", str(chunk_t),
+                       "--atlas-tile-rows", str(tr_kill),
+                       "--genes", str(args.genes),
+                       "--samples", str(args.samples),
+                       "--kmax", str(ks_t[-1]),
+                       "--restarts", str(restarts_t),
+                       "--maxiter", str(mi_t),
+                       "--precision", args.precision,
+                       "--algorithm", args.algorithm,
+                       "--backend", args.backend]
+                proc = subprocess.run(cmd, capture_output=True,
+                                      text=True)
+                if proc.returncode != 137:
+                    gate([f"kill child exited {proc.returncode}, "
+                          "expected 137 (injected preemption)\n"
+                          + proc.stderr[-2000:]], "RESUME")
+                parts = [name for name in os.listdir(kill_dir)
+                         if name.endswith(".part.npz")]
+                if not parts:
+                    gate(["no .part.npz partial survived the kill — "
+                          "the preemption did not land mid-matrix"],
+                         "RESUME")
+                committed = sum(
+                    1 for name in os.listdir(kill_dir)
+                    if name.endswith(".npz")
+                    and not name.endswith(".part.npz"))
+                resumes0 = tiles._tile_partial_resumes_total.value()
+                t0 = time.perf_counter()
+                res = nmfconsensus(
+                    a, ks=ks_t, restarts=restarts_t, seed=seed,
+                    solver_cfg=scfg_kill, use_mesh=False,
+                    checkpoint=CheckpointConfig(
+                        kill_dir, every_n_restarts=chunk_t))
+                resume_wall = time.perf_counter() - t0
+                partial_resumes = int(
+                    tiles._tile_partial_resumes_total.value()
+                    - resumes0)
+                gate(_serve_parity_problems(res, ref,
+                                            "atlas kill-resume"),
+                     "RESUME")
+                if partial_resumes < 1:
+                    gate(["the surviving partial was recomputed, not "
+                          "resumed (nmfx_tile_partial_resumes_total "
+                          "did not move)"], "RESUME")
+                leftover = [name for name in os.listdir(kill_dir)
+                            if name.endswith(".part.npz")]
+                if leftover:
+                    gate([f"partials not cleared after commit: "
+                          f"{leftover}"], "RESUME")
+                detail["resume"] = {
+                    "tile_rows": tr_kill,
+                    "total_chunks": total_chunks,
+                    "partials_at_kill": len(parts),
+                    "committed_at_kill": committed,
+                    "partial_resumes": partial_resumes,
+                    "full_wall_s": round(full_wall, 3),
+                    "resume_wall_s": round(resume_wall, 3),
+                    "resume_overhead_s": round(
+                        max(resume_wall - full_wall
+                            * ((total_chunks - committed)
+                               / total_chunks), 0.0), 3),
+                    "parity": "ok",
+                }
+            finally:
+                shutil.rmtree(ref_dir, ignore_errors=True)
+                shutil.rmtree(kill_dir, ignore_errors=True)
+        finally:
+            tiles.set_tile_budget_bytes(None)
+            tiles.set_tile_prefetch(True)
+        return detail
 
     # --- observability stage (ISSUE 10/13, detail.obs) -----------------
     # The telemetry layer's own cost, tracked across BENCH rounds so it
@@ -2529,6 +2854,10 @@ def main():
     print(f"bench: durability stage: {json.dumps(durability)}",
           file=sys.stderr)
 
+    atlas_detail = run_atlas_stage()
+    print(f"bench: atlas stage: {json.dumps(atlas_detail)}",
+          file=sys.stderr)
+
     sketched_detail = run_sketched_stage()
     print(f"bench: sketched stage: {json.dumps(sketched_detail)}",
           file=sys.stderr)
@@ -2588,6 +2917,7 @@ def main():
             "exec_cache": serving,
             "serve": traffic,
             "durability": durability,
+            "atlas": atlas_detail,
             "sketched": sketched_detail,
             "obs": obs_detail,
             # cold_wall_s/compile_wall_s are first-session numbers; with

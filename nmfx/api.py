@@ -241,11 +241,17 @@ def run_example(outdir: str | None = "./nmfx_out", **kwargs):
     return nmfconsensus(a, **defaults)
 
 
-def _as_matrix(data) -> tuple[np.ndarray, list[str]]:
+def _as_matrix(data) -> tuple:
+    from nmfx.sparse import SparseMatrix
+
     if isinstance(data, str):
         data = read_dataset(data)
     if isinstance(data, Dataset):
         return np.asarray(data.values), list(data.col_names)
+    if isinstance(data, SparseMatrix):
+        # stays sparse end to end: sweep() streams it through the
+        # out-of-core tile pipeline without densifying
+        return data, [str(i + 1) for i in range(data.shape[1])]
     arr = np.asarray(data)
     return arr, [str(i + 1) for i in range(arr.shape[1])]
 
@@ -508,10 +514,15 @@ def nmfconsensus(
     if harvest not in ("streamed", "sequential"):
         raise ValueError("harvest must be 'streamed' or 'sequential', got "
                          f"{harvest!r}")
+    from nmfx.sparse import SparseMatrix
+
     arr, col_names = _as_matrix(data)
-    if not np.isfinite(arr).all():
+    # sparse inputs validate their stored nonzeros (the implicit zeros
+    # are finite and non-negative by construction)
+    vals = arr.data if isinstance(arr, SparseMatrix) else arr
+    if not np.isfinite(vals).all():
         raise ValueError("input matrix contains non-finite values")
-    if (arr < 0).any():
+    if (vals < 0).any():
         raise ValueError("input matrix must be non-negative")
     ks = tuple(ks)
     if not ks:
@@ -579,6 +590,11 @@ def nmfconsensus(
     if checkpoint_dir is not None:
         from nmfx.registry import SweepRegistry
 
+        if isinstance(arr, SparseMatrix) or scfg.tile_rows is not None:
+            raise ValueError(
+                "checkpoint_dir (the legacy per-rank registry) does not "
+                "support sparse/tiled inputs; pass checkpoint= (the "
+                "durable chunked ledger) for out-of-core resume")
         registry = SweepRegistry.open(checkpoint_dir, arr, scfg, icfg,
                                       restarts, seed, label_rule,
                                       keep_factors, mesh)

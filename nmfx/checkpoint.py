@@ -87,6 +87,13 @@ _MANIFEST_NAME = "manifest.json"
 #: SweepRegistry's per-rank ``k<k>.npz`` and any user files in the
 #: directory are never touched
 _RECORD_RE = re.compile(r"^k\d+_r\d+-\d+\.npz$")
+#: mid-chunk PARTIAL progress for out-of-core (tiled/sparse) solves —
+#: a snapshot of the tiled solver state at a convergence-check boundary
+#: (ISSUE 17). Same clear/fingerprint discipline as completion records;
+#: a partial never substitutes for a completion record, it only lets a
+#: preempted atlas-scale chunk resume mid-matrix instead of from
+#: iteration zero.
+_PART_RE = re.compile(r"^k\d+_r\d+-\d+\.part\.npz$")
 #: shard heartbeat files (:meth:`SweepCheckpoint.heartbeat`) — cleared
 #: on cold start too, or a prior incarnation's stale heartbeats would
 #: report phantom dead shards through :meth:`shard_status`
@@ -170,9 +177,21 @@ def engine_family(solver_cfg: SolverConfig) -> str:
     everything else — including the non-mu whole-grid opt-ins, whose
     slot-scheduled engine has no explicit-key chunk form. Hashed into
     the manifest so a ledger can never resume under a different engine
-    family."""
+    family.
+
+    ``tile_rows`` set resolves to the out-of-core streaming engine
+    ``"tiled"`` (``nmfx/tiles.py``) — conservatively: a single-tile
+    config that ``sweep()`` would delegate to the dense path still says
+    "tiled" here, which can only SPLIT identities of bit-identical
+    programs, never alias different ones (the delegated path consults
+    this after tile_rows is stripped). Sparse inputs without
+    ``tile_rows`` also run tiled, but their manifests can never collide
+    with a dense run's anyway — the data payload carries the sparse
+    content fingerprint and the tile plan (``_fingerprint``)."""
     from nmfx.sweep import _use_packed
 
+    if solver_cfg.tile_rows is not None:
+        return "tiled"
     if solver_cfg.backend == "pallas":
         return "pallas"
     return "packed" if _use_packed(solver_cfg) else "vmap"
@@ -215,16 +234,31 @@ def _env_info() -> dict:
             "device_kind": jax.devices()[0].device_kind}
 
 
-def _fingerprint(a: np.ndarray, ccfg: ConsensusConfig,
+def _fingerprint(a, ccfg: ConsensusConfig,
                  scfg: SolverConfig, icfg: InitConfig) -> str:
     """sha256 over everything that determines a completion record's
-    numbers: the input's DataKey content fingerprint, the covered
-    solver/consensus fields (``manifest_key_fields`` — backend hashed
-    as the chunk executor's resolved engine family), the full init
-    config, and the format version."""
+    numbers: the input's DataKey content fingerprint (or the sparse
+    triplet fingerprint for :class:`~nmfx.sparse.SparseMatrix` inputs),
+    the covered solver/consensus fields (``manifest_key_fields`` —
+    backend hashed as the chunk executor's resolved engine family), the
+    full init config, and the format version. Out-of-core runs
+    additionally hash the resolved TILE PLAN: a multi-tile chunk's
+    floats depend on the tile-blocked reduction order, so a changed
+    plan (different budget, different tile_rows) must cold-start, never
+    "resume" foreign records."""
     from nmfx.data_cache import default_cache
+    from nmfx.sparse import SparseMatrix
 
-    dkey = default_cache().key_for(np.asarray(a), scfg.dtype)
+    if isinstance(a, SparseMatrix):
+        data = {"fingerprint": a.fingerprint(),
+                "src_dtype": str(a.data.dtype),
+                "shape": list(a.shape), "dtype": str(scfg.dtype),
+                "sparse": True}
+    else:
+        dkey = default_cache().key_for(np.asarray(a), scfg.dtype)
+        data = {"fingerprint": dkey.fingerprint,
+                "src_dtype": dkey.src_dtype,
+                "shape": list(dkey.shape), "dtype": dkey.dtype}
     covered = manifest_key_fields()
     solver = {name: getattr(scfg, name)
               for name in sorted(covered["solver"])}
@@ -233,14 +267,16 @@ def _fingerprint(a: np.ndarray, ccfg: ConsensusConfig,
     consensus = {name: getattr(ccfg, name)
                  for name in sorted(covered["consensus"])}
     payload = {
-        "data": {"fingerprint": dkey.fingerprint,
-                 "src_dtype": dkey.src_dtype,
-                 "shape": list(dkey.shape), "dtype": dkey.dtype},
+        "data": data,
         "solver": solver,
         "consensus": consensus,
         "init": dataclasses.asdict(icfg),
         "format": _FORMAT_VERSION,
     }
+    if scfg.tile_rows is not None or isinstance(a, SparseMatrix):
+        from nmfx import tiles as _tiles
+
+        payload["tile_plan"] = _tiles.plan_for(a, scfg).as_meta()
     return hashlib.sha256(
         json.dumps(payload, sort_keys=True, default=str).encode()
     ).hexdigest()
@@ -387,7 +423,9 @@ class SweepCheckpoint:
     def open(cls, a, ccfg: ConsensusConfig, scfg: SolverConfig,
              icfg: InitConfig,
              cp_cfg: CheckpointConfig) -> "SweepCheckpoint":
-        arr = np.asarray(a)
+        from nmfx.sparse import SparseMatrix
+
+        arr = a if isinstance(a, SparseMatrix) else np.asarray(a)
         return cls(cp_cfg.directory,
                    _fingerprint(arr, ccfg, scfg, icfg), _env_info(),
                    plan_chunks(ccfg.restarts, cp_cfg.every_n_restarts),
@@ -415,6 +453,7 @@ class SweepCheckpoint:
         # SweepRegistry's k<k>.npz)
         for name in os.listdir(self.directory):
             if (_RECORD_RE.match(name) is None
+                    and _PART_RE.match(name) is None
                     and _SHARD_RE.match(name) is None):
                 continue
             try:
@@ -517,6 +556,69 @@ class SweepCheckpoint:
         _note(loaded=1)
         return rec
 
+    # -- mid-chunk partials (out-of-core solves, ISSUE 17) -----------------
+    def _partial_path(self, k: int, r0: int, r1: int) -> str:
+        return os.path.join(self.directory, f"k{k}_r{r0}-{r1}.part.npz")
+
+    def save_partial(self, k: int, r0: int, r1: int, payload) -> None:
+        """Persist a tiled solver's mid-chunk state snapshot
+        (``nmfx.tiles.partial_payload``) at a check boundary. Atomic +
+        fingerprint-stamped like completion records; write failures
+        degrade warn-once (only the mid-matrix resume win is lost)."""
+        from nmfx.faults import warn_once
+
+        arrays = dict(payload)
+        arrays["record_fingerprint"] = np.asarray(self.fingerprint)
+        try:
+            with _trace.default_tracer().span(
+                    "ckpt.partial", cat="ckpt",
+                    args={"k": k, "r0": r0, "r1": r1}):
+                atomic_save_npz(self._partial_path(k, r0, r1), arrays)
+        except Exception as e:
+            warn_once(
+                "ckpt-partial-write-failed",
+                f"failed to persist partial checkpoint k={k} "
+                f"r=[{r0},{r1}) ({e!r}); the solve continues — a "
+                "preemption before the next partial restarts this chunk "
+                "from its last durable snapshot")
+
+    def try_load_partial(self, k: int, r0: int, r1: int):
+        """Load a mid-chunk partial as the ``resume=`` payload dict for
+        ``nmfx.tiles.run_tiled_pool``, or None for missing/torn/foreign
+        partials (warn-once + restart the chunk from iteration zero —
+        self-healing, never a crash)."""
+        from nmfx import faults
+        from nmfx.faults import warn_once
+
+        path = self._partial_path(k, r0, r1)
+        if not os.path.exists(path):
+            return None
+        try:
+            faults.inject("ckpt.load")
+            with np.load(path, allow_pickle=False) as z:
+                if str(z["record_fingerprint"]) != self.fingerprint:
+                    raise ValueError("partial fingerprint does not match "
+                                     "the manifest")
+                payload = {name: z[name] for name in z.files
+                           if name != "record_fingerprint"}
+        except Exception as e:
+            warn_once(
+                "ckpt-partial-corrupt",
+                f"partial checkpoint {path!r} is torn/corrupt/foreign "
+                f"({e!r}); discarding it and re-running the chunk from "
+                "iteration zero — results are unaffected")
+            return None
+        return payload
+
+    def clear_partial(self, k: int, r0: int, r1: int) -> None:
+        """Drop a chunk's partial once its completion record committed
+        (or it was found stale) — partials are scaffolding, never
+        results."""
+        try:
+            os.unlink(self._partial_path(k, r0, r1))
+        except OSError:  # nmfx: ignore[NMFX006] -- already absent is fine
+            pass
+
     # -- shard heartbeat/completion ledger (elastic recovery) --------------
     @property
     def heartbeat_ledger(self):
@@ -561,13 +663,21 @@ class SweepCheckpoint:
 # -- chunk execution -------------------------------------------------------
 def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
                      ccfg: ConsensusConfig, scfg: SolverConfig,
-                     icfg: InitConfig, keys=None):
+                     icfg: InitConfig, keys=None, ck=None):
     """Solve restarts ``[r0, r1)`` of rank ``k`` and materialize the
     chunk's record on host. ``keys`` is the rank's full canonical key
     array (``split(fold_in(key(seed), k), restarts)``) — recomputed here
     when absent — so a chunk's draws are independent of which process,
     shard, or attempt runs it (the same-key-chains-same-results
     property elastic recovery rests on).
+
+    Out-of-core chunks (``scfg.tile_rows`` set, or a
+    :class:`~nmfx.sparse.SparseMatrix` ``a_dev``) route through the
+    streaming tiled engine instead of the in-core vmapped driver; with
+    a ``ck`` ledger they additionally persist mid-chunk partials at
+    check boundaries (and pass the ``proc.preempt`` site AT those
+    boundaries — after the partial saved — so the rehearsed kill lands
+    MID-MATRIX and resume restarts from the snapshot, not iteration 0).
 
     Passes the ``proc.preempt`` chaos site AFTER the solve completes
     but BEFORE the caller can commit the record: a fired preemption
@@ -576,6 +686,7 @@ def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
     import jax
 
     from nmfx import faults
+    from nmfx.sparse import SparseMatrix
     from nmfx.sweep import _build_chunk_sweep_fn
 
     if scfg.backend == "sketched" or scfg.screen:
@@ -595,6 +706,33 @@ def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
             ccfg.restarts)
     poison = tuple(r - r0 for r in faults.poison_restarts(k, ccfg.restarts)
                    if r0 <= r < r1)
+    if scfg.tile_rows is not None or isinstance(a_dev, SparseMatrix):
+        from nmfx import tiles
+
+        resume = ck.try_load_partial(k, r0, r1) if ck is not None else None
+        on_check = None
+        if ck is not None:
+            def on_check(step, state, carry):
+                ck.save_partial(k, r0, r1,
+                                tiles.partial_payload(state, carry, step))
+                # fire AFTER the partial landed: the rehearsed preempt
+                # kills mid-matrix with the snapshot durable, so resume
+                # continues from this very check boundary
+                if faults.fire("proc.preempt"):
+                    raise Preempted(
+                        f"injected preemption mid-matrix at step {step} "
+                        f"of chunk k={k} r=[{r0},{r1}) — the partial "
+                        "snapshot just saved survives for resume")
+        host = jax.device_get(tiles.solve_chunk_tiled(
+            a_dev, keys[r0:r1], k, scfg, icfg, ccfg.label_rule,
+            poison=poison, resume=resume, on_check=on_check))
+        _note(solved=1)
+        if faults.fire("proc.preempt"):
+            raise Preempted(
+                f"injected preemption after solving chunk k={k} "
+                f"r=[{r0},{r1}) and before its commit — this chunk is "
+                "lost; every committed record survives for resume")
+        return host
     fn = _build_chunk_sweep_fn(k, r1 - r0, scfg, icfg, ccfg.label_rule,
                                poison, faults.trace_token())
     host = jax.device_get(fn(a_dev, keys[r0:r1]))
@@ -701,7 +839,10 @@ def run_checkpointed_sweep(a, cfg: ConsensusConfig,
             "records bit-identically; the sketched/screened paths are "
             "whole-pool and statistical) — drop the checkpoint or use "
             "an exact unscreened engine")
-    arr = np.asarray(a)
+    from nmfx.sparse import SparseMatrix
+
+    tiled = solver_cfg.tile_rows is not None or isinstance(a, SparseMatrix)
+    arr = a if isinstance(a, SparseMatrix) else np.asarray(a)
     ck = SweepCheckpoint.open(arr, cfg, solver_cfg, init_cfg, cp_cfg)
     restore = install_signal_flush(ck)
     a_dev = None
@@ -723,8 +864,12 @@ def run_checkpointed_sweep(a, cfg: ConsensusConfig,
             if missing:
                 solved_total += len(missing)
                 if a_dev is None:  # fully-resumed sweeps never transfer
-                    a_dev = place_resilient(arr, solver_cfg, None,
-                                            profiler=profiler)
+                    # out-of-core chunks stream A from the HOST source
+                    # tile-by-tile (nmfx.tiles) — pinning the whole
+                    # matrix device-resident is exactly what tile_rows
+                    # exists to avoid
+                    a_dev = arr if tiled else place_resilient(
+                        arr, solver_cfg, None, profiler=profiler)
                 keys = jax.random.split(
                     jax.random.fold_in(jax.random.key(cfg.seed), k),
                     cfg.restarts)
@@ -733,12 +878,13 @@ def run_checkpointed_sweep(a, cfg: ConsensusConfig,
                         try:
                             rec = solve_chunk_host(a_dev, k, r0, r1, cfg,
                                                    solver_cfg, init_cfg,
-                                                   keys=keys)
+                                                   keys=keys, ck=ck)
                         except Preempted:
                             ck.flush()  # the SIGTERM-grace analogue:
                             raise       # committed work must survive
                     with profiler.phase("checkpoint"):
                         ck.save(k, r0, r1, rec)
+                        ck.clear_partial(k, r0, r1)
                     recs[(r0, r1)] = rec
             with profiler.phase("ckpt.finalize"):
                 out[k] = _finalize_rank(k, recs, cfg, arr.shape)

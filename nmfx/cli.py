@@ -83,6 +83,21 @@ def _check_block_arg(value: str):
     return n
 
 
+def _tile_rows_arg(value: str):
+    """'auto' or a positive int — validated at parse time."""
+    if value == "auto":
+        return value
+    try:
+        n = int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or a positive integer, got {value!r}")
+    if n < 1:
+        raise argparse.ArgumentTypeError(
+            f"tile-rows must be >= 1, got {value!r}")
+    return n
+
+
 def _warm_shapes_arg(value: str) -> tuple[tuple[int, int], ...]:
     """'5000x500,20000x1000' -> ((5000, 500), (20000, 1000)); validated
     at parse time so a bad spec is a usage error."""
@@ -106,7 +121,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="nmfx",
         description="TPU-native consensus NMF (capabilities of "
                     "mschubert/NMFconsensus, re-designed for JAX/XLA).")
-    p.add_argument("dataset", help="input .gct or .res file")
+    p.add_argument("dataset",
+                   help="input .gct or .res file (dense), or a sparse "
+                        ".mtx / .csr.npz matrix — sparse inputs stream "
+                        "through the out-of-core tile pipeline without "
+                        "densifying")
     p.add_argument("--ks", default="2-5", type=parse_ks,
                    help="ranks to sweep, e.g. '2-5' or '2,4,8' (default 2-5)")
     p.add_argument("--restarts", type=int, default=10,
@@ -150,6 +169,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap on restarts solved concurrently in the vmapped "
                         "driver (bounds peak memory for kl's m*n "
                         "intermediates; results are identical)")
+    p.add_argument("--tile-rows", default=None, type=_tile_rows_arg,
+                   metavar="N|auto",
+                   help="out-of-core tile pipeline "
+                        "(SolverConfig.tile_rows): stream A from host in "
+                        "N-row feature blocks instead of pinning it "
+                        "device-resident — for matrices larger than "
+                        "device memory. 'auto' sizes tiles to the "
+                        "device budget (--tile-budget-bytes). mu/hals; "
+                        "where A fits in-core the tiled sweep is "
+                        "bit-identical to the dense one. Sparse .mtx/"
+                        ".csr.npz inputs stream regardless of this flag")
+    p.add_argument("--tile-budget-bytes", type=int, default=None,
+                   metavar="BYTES",
+                   help="device-memory budget the 'auto' tile size is "
+                        "derived from (default: NMFX_TILE_BUDGET_BYTES "
+                        "env or 256 MiB; two tile buffers live at once "
+                        "— current + prefetched)")
     p.add_argument("--check-block", default="auto", type=_check_block_arg,
                    help="check blocks batched per scheduler trip "
                         "(SolverConfig.check_block): convergence is still "
@@ -496,6 +532,50 @@ def _run_cli(argv: list[str] | None = None) -> int:
         if args.backend != "sketched" and not args.screen:
             parser.error("--sketch-dim only applies to the compressed "
                          "paths; pass --backend sketched or --screen")
+    sparse_input = args.dataset.lower().endswith((".mtx", ".csr.npz"))
+    if args.tile_rows is not None or sparse_input:
+        from nmfx.config import TILED_ALGORITHMS
+
+        what = ("--tile-rows" if args.tile_rows is not None
+                else "sparse inputs")
+        if args.algorithm not in TILED_ALGORITHMS:
+            parser.error(f"{what} require(s) the Gram-accumulating "
+                         f"update family: --algorithm "
+                         f"{'/'.join(TILED_ALGORITHMS)}")
+        if args.backend in ("pallas", "sketched") or args.screen:
+            parser.error(f"{what} stream(s) A tile-by-tile through the "
+                         "out-of-core engine; --backend pallas/sketched "
+                         "and --screen need the whole matrix device-"
+                         "resident — use --backend auto")
+        if args.feature_shards > 1 or args.sample_shards > 1:
+            parser.error(f"{what} do(es) not compose with --feature-"
+                         "shards/--sample-shards (the tile stream owns "
+                         "one device; shard across processes with "
+                         "nmfx.distributed instead)")
+        if args.exec_cache or args.warm_shapes or args.cache_dir \
+                or args.pipeline_ranks:
+            parser.error(f"{what} do(es) not compose with --exec-cache/"
+                         "--warm-shapes/--cache-dir/--pipeline-ranks "
+                         "(the bucketed executable cache dispatches "
+                         "whole-matrix device solves)")
+        if args.serve_smoke:
+            parser.error(f"{what} do(es) not compose with --serve-smoke "
+                         "(served requests dispatch through the "
+                         "executable cache)")
+        if args.grid_exec == "grid":
+            parser.error(f"{what} solve(s) per rank over the tile "
+                         "stream; --grid-exec grid demands the whole-"
+                         "grid scheduler — use auto")
+    elif args.tile_budget_bytes is not None:
+        parser.error("--tile-budget-bytes requires --tile-rows (or a "
+                     "sparse .mtx/.csr.npz input)")
+    if args.tile_budget_bytes is not None:
+        from nmfx import tiles
+
+        try:
+            tiles.set_tile_budget_bytes(args.tile_budget_bytes)
+        except ValueError as e:
+            parser.error(str(e))
     if args.backend == "sketched" or args.screen:
         # compose-guards for the statistical-contract paths: every
         # surface whose contract is BIT-EXACT (or whose resume replays
@@ -622,7 +702,8 @@ def _run_cli(argv: list[str] | None = None) -> int:
                                     if args.sketch_dim is not None
                                     else SketchConfig()),
                             screen=args.screen,
-                            screen_keep=args.screen_keep)
+                            screen_keep=args.screen_keep,
+                            tile_rows=args.tile_rows)
     ckpt_cfg = None
     if args.checkpoint_every is not None and args.checkpoint_every < 1:
         parser.error("--checkpoint-every must be >= 1")

@@ -18,6 +18,12 @@ ALGORITHMS = ("mu", "als", "neals", "pg", "alspg", "kl", "snmf", "hals")
 #: guards, and (as the keys of sweep._GRID_EXEC_BACKENDS) the routing
 #: table itself
 PACKED_ALGORITHMS = ("mu", "hals", "neals", "als", "snmf", "kl")
+#: algorithms with a Gram-accumulation formulation the out-of-core tile
+#: pipeline (nmfx/tiles.py) can stream: per-tile contributions reduce
+#: into k×k / k×n Gram terms, so A never needs to exist on device at
+#: once (MPI-FAUN, arxiv 1609.09154). Shared by SolverConfig validation,
+#: the sweep routing, and the costmodel universe (NMFX009).
+TILED_ALGORITHMS = ("mu", "hals")
 INIT_METHODS = ("random", "nndsvd")
 LINKAGE_METHODS = ("average", "complete", "single")
 
@@ -374,6 +380,23 @@ class SolverConfig:
     #: None = all restarts at once; ignored by the packed/pallas mu backends
     #: (no m·n intermediates)
     restart_chunk: int | None = None
+    #: out-of-core tile pipeline (ISSUE 17): partition A into
+    #: feature-axis (row) blocks of at most ``tile_rows`` rows and stream
+    #: them through the device while W/H and the vmapped restart pool
+    #: stay resident — per-tile contributions reduce into k×k / k×n Gram
+    #: terms (MPI-FAUN, arxiv 1609.09154), with the next tile's
+    #: ``device_put`` overlapped against the current tile's update.
+    #: "auto" sizes tiles to the device budget
+    #: (``nmfx.tiles.tile_budget_bytes``; env NMFX_TILE_BUDGET_BYTES) and
+    #: resolves to NO tiling when A fits in-core, so the default path
+    #: costs nothing. A plan with one tile delegates to the dense
+    #: in-core engines verbatim (bit-identical by construction); a
+    #: multi-tile plan runs the streamed Gram engine, whose fixed
+    #: tile-order f32 reduction is its own engine family ("tiled") —
+    #: deliberately NOT in NON_NUMERICS_FIELDS, because a multi-tile
+    #: reduction order is a different (bit-level) numeric program than
+    #:  the in-core one. TILED_ALGORITHMS only; requires init "random".
+    tile_rows: "int | str | None" = None
 
     def __post_init__(self):
         if self.backend not in ("auto", "vmap", "packed", "pallas",
@@ -434,6 +457,25 @@ class SolverConfig:
                 f" got {self.matmul_precision!r}")
         if self.restart_chunk is not None and self.restart_chunk < 1:
             raise ValueError("restart_chunk must be >= 1 or None")
+        tr = self.tile_rows
+        if not (tr is None or tr == "auto"
+                or (isinstance(tr, int) and not isinstance(tr, bool)
+                    and tr >= 1)):
+            raise ValueError(
+                f"tile_rows must be None, 'auto' or an int >= 1, got {tr!r}")
+        if tr is not None and self.algorithm not in TILED_ALGORITHMS:
+            raise ValueError(
+                "tile_rows is only implemented for the Gram-accumulation "
+                f"algorithms {TILED_ALGORITHMS}, got "
+                f"algorithm={self.algorithm!r}")
+        if tr is not None and self.backend in ("pallas", "sketched"):
+            raise ValueError(
+                "tile_rows streams A through the XLA Gram engines; it "
+                f"cannot combine with backend={self.backend!r}")
+        if tr is not None and self.screen:
+            raise ValueError(
+                "tile_rows cannot combine with screen=True (the "
+                "screening pass needs in-core A)")
         if not 0.0 <= self.class_flip_tol < 1.0:
             raise ValueError(
                 f"class_flip_tol must be in [0, 1), got {self.class_flip_tol}")

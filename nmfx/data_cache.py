@@ -186,18 +186,30 @@ class DataCache:
         self.evictions = 0
 
     # -- policy ------------------------------------------------------------
-    def key_for(self, a: np.ndarray, dtype: str,
+    def key_for(self, a, dtype: str,
                 pad_shape: "tuple | None" = None,
                 mesh=None) -> DataKey:
-        arr = np.ascontiguousarray(a)
-        digest = hashlib.sha256(arr.view(np.uint8).reshape(-1)).hexdigest()
+        from nmfx.sparse import SparseMatrix
+
+        if isinstance(a, SparseMatrix):
+            # content-hash the canonical triplets, not a densified copy
+            # — densifying an atlas to fingerprint it defeats the sparse
+            # path; the triplet digest is exactly as content-addressed
+            # (SparseMatrix.fingerprint covers shape + value dtype too)
+            digest = a.fingerprint()
+            src_dtype = a.data.dtype.str
+        else:
+            arr = np.ascontiguousarray(a)
+            digest = hashlib.sha256(
+                arr.view(np.uint8).reshape(-1)).hexdigest()
+            src_dtype = arr.dtype.str
         if mesh is None:
             # the device an un-meshed device_put would target RIGHT NOW
             device = (getattr(jax.config, "jax_default_device", None)
                       or jax.devices()[0])
         else:
             device = None  # the mesh names the devices
-        return DataKey(fingerprint=digest, src_dtype=arr.dtype.str,
+        return DataKey(fingerprint=digest, src_dtype=src_dtype,
                        shape=tuple(a.shape), dtype=str(dtype),
                        pad_shape=pad_shape, mesh=mesh, device=device)
 

@@ -31,26 +31,48 @@ class Dataset(NamedTuple):
         return self.values.shape
 
 
-def read_dataset(path: str) -> Dataset:
-    """Dispatch on file extension (reference ``read.dataset``, nmf.r:261-269)."""
+def read_dataset(path: str):
+    """Dispatch on file extension (reference ``read.dataset``,
+    nmf.r:261-269, extended): dense GCT/RES load as a :class:`Dataset`;
+    the sparse formats (MatrixMarket ``.mtx``, the ``.csr.npz`` CSR
+    bundle) load as a :class:`nmfx.sparse.SparseMatrix` — the form the
+    out-of-core tile pipeline streams without densifying."""
     lower = path.lower()
     if lower.endswith(".gct"):
         return read_gct(path)
     if lower.endswith(".res"):
         return read_res(path)
-    raise ValueError(f"Input is not a res or gct file: {path}")
+    if lower.endswith(".mtx"):
+        return read_mtx(path)
+    if lower.endswith(".csr.npz"):
+        return read_csr_npz(path)
+    raise ValueError(f"Input is not a res/gct/mtx/csr.npz file: {path}")
 
 
-def read_gct(path: str) -> Dataset:
+#: rows per streamed parse batch (read_gct) — big enough that parser
+#: dispatch amortizes, small enough that the transient text of one
+#: batch is noise next to the values array itself
+_GCT_CHUNK_ROWS = 2048
+
+
+def read_gct(path: str, chunk_rows: int = _GCT_CHUNK_ROWS) -> Dataset:
     """Read a GCT v1.2 file (reference ``read.gct``, nmf.r:371-377).
 
     Layout: line 1 version tag ``#1.2``; line 2 ``<rows>TAB<cols>``; line 3
     header ``Name TAB Description TAB <sample names...>``; then one row per
     gene: name, description, values. The Description column is dropped, as the
     reference does (``ds <- ds[-1]``, nmf.r:376).
+
+    STREAMED (ISSUE 17): the header fixes the output shape, so the
+    values array is allocated once up front and data rows are parsed in
+    ``chunk_rows`` batches directly into it — peak host RAM is the
+    values array plus one batch of text, never the whole file's bytes
+    on top of the array (the atlas-scale requirement pinned by
+    tests/test_io.py). Batches stay binary end to end: only the header
+    lines and the row names are str-decoded.
     """
-    # binary end to end: the multi-hundred-MB data block of a large GCT is
-    # never str-decoded — only the three header lines and the row names are
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
     with open(path, "rb") as f:
         version = f.readline().decode().strip()
         if not version.startswith("#"):
@@ -61,51 +83,60 @@ def read_gct(path: str) -> Dataset:
         n_rows, n_cols = int(dims[0]), int(dims[1])
         header = f.readline().decode().rstrip("\r\n").split("\t")
         col_names = [c for c in header[2:] if c != ""]
-        # bulk-parse the numeric block: native C++ from_chars when the host
-        # library is built (nmfx/native/gct_io.cpp), else numpy's tokenizer
-        # — the per-value Python float() loop both replace was ~6x slower
-        # at 20000x1000 (the data loader must not dwarf the few-second
-        # on-TPU solve)
-        tail = f.read()
-        # single scan for line bounds and names — no full copy of the
-        # multi-hundred-MB block (only the short name slices are decoded)
-        spans: list[tuple[int, int]] = []
-        row_names = []
-        pos, total = 0, len(tail)
-        while pos < total:
-            nl = tail.find(b"\n", pos)
-            if nl == -1:
-                nl = total
-            end = nl - 1 if nl > pos and tail[nl - 1:nl] == b"\r" else nl
-            if end > pos:  # skip blank lines
-                spans.append((pos, end))
-                tab = tail.find(b"\t", pos, end)
-                row_names.append(
-                    tail[pos:tab if tab != -1 else end].decode())
-            pos = nl + 1
-        if len(spans) != n_rows:
-            raise ValueError(
-                f"{path}: found {len(spans)} data rows, header said {n_rows}")
-        from nmfx import native
+        values = np.empty((n_rows, n_cols), np.float64)
+        row_names: list[str] = []
+        chunk: list[bytes] = []
+        seen = 0  # data rows encountered (counted past n_rows for the error)
 
-        if native.available():
-            try:
-                values, _ = native.parse_gct_rows(tail, n_rows, n_cols)
-            except ValueError as e:
-                raise ValueError(
-                    f"{path}: {e}; expected name<TAB>description<TAB>"
-                    f"{n_cols} numeric values per row") from e
-        else:
-            try:
-                values = np.loadtxt(
-                    [tail[s:e].decode() for s, e in spans],
-                    delimiter="\t", dtype=np.float64, comments=None,
-                    usecols=range(2, 2 + n_cols), ndmin=2)
-            except ValueError as e:
-                raise ValueError(
-                    f"{path}: malformed GCT data row ({e}); expected "
-                    f"name<TAB>description<TAB>{n_cols} numeric values per "
-                    "row") from e
+        def _flush() -> None:
+            # bulk-parse one batch: native C++ from_chars when the host
+            # library is built (nmfx/native/gct_io.cpp), else numpy's
+            # tokenizer — the per-value Python float() loop both replace
+            # was ~6x slower at 20000x1000 (the data loader must not
+            # dwarf the few-second on-TPU solve)
+            from nmfx import native
+
+            r0 = seen - len(chunk)
+            if native.available():
+                try:
+                    block, _ = native.parse_gct_rows(
+                        b"\n".join(chunk) + b"\n", len(chunk), n_cols)
+                except ValueError as e:
+                    raise ValueError(
+                        f"{path}: {e}; expected name<TAB>description<TAB>"
+                        f"{n_cols} numeric values per row") from e
+            else:
+                try:
+                    block = np.loadtxt(
+                        [line.decode() for line in chunk],
+                        delimiter="\t", dtype=np.float64, comments=None,
+                        usecols=range(2, 2 + n_cols), ndmin=2)
+                except ValueError as e:
+                    raise ValueError(
+                        f"{path}: malformed GCT data row ({e}); expected "
+                        f"name<TAB>description<TAB>{n_cols} numeric values "
+                        "per row") from e
+            values[r0:seen] = block
+            chunk.clear()
+
+        for raw in f:
+            line = raw.rstrip(b"\r\n")
+            if not line:  # skip blank lines
+                continue
+            seen += 1
+            if seen > n_rows:
+                continue  # keep counting for the row-count error below
+            tab = line.find(b"\t")
+            row_names.append(
+                line[:tab if tab != -1 else len(line)].decode())
+            chunk.append(line)
+            if len(chunk) >= chunk_rows:
+                _flush()
+        if seen == n_rows and chunk:
+            _flush()
+        if seen != n_rows:
+            raise ValueError(
+                f"{path}: found {seen} data rows, header said {n_rows}")
     if len(col_names) != n_cols:
         # tolerate headers with trailing junk; fall back to numbered columns
         col_names = (col_names + [str(i + 1) for i in range(n_cols)])[:n_cols]
@@ -146,6 +177,83 @@ def read_res(path: str) -> Dataset:
             f"{path}: {values.shape[1]} value columns vs {len(col_names)} names"
         )
     return Dataset(values, row_names, col_names)
+
+
+def read_mtx(path: str):
+    """Read a MatrixMarket coordinate file as a
+    :class:`nmfx.sparse.SparseMatrix` (pure numpy — no scipy in the
+    container). Supports the ``matrix coordinate real|integer
+    general`` header; ``pattern`` entries load as 1.0. MatrixMarket is
+    1-indexed and may carry duplicate entries, which sum (the
+    ``from_coo`` canonicalization)."""
+    from nmfx.sparse import SparseMatrix
+
+    with open(path, "rb") as f:
+        banner = f.readline().decode().strip().lower().split()
+        if (len(banner) < 4 or banner[0] != "%%matrixmarket"
+                or banner[1] != "matrix" or banner[2] != "coordinate"):
+            raise ValueError(
+                f"{path}: expected a MatrixMarket 'matrix coordinate' "
+                f"banner, got {' '.join(banner)!r}")
+        field = banner[3]
+        if field not in ("real", "integer", "pattern"):
+            raise ValueError(
+                f"{path}: unsupported MatrixMarket field {field!r} "
+                "(real/integer/pattern)")
+        if len(banner) > 4 and banner[4] != "general":
+            raise ValueError(
+                f"{path}: only 'general' symmetry is supported, got "
+                f"{banner[4]!r}")
+        line = f.readline()
+        while line.startswith(b"%") or not line.strip():
+            line = f.readline()
+        dims = line.split()
+        if len(dims) != 3:
+            raise ValueError(f"{path}: malformed MatrixMarket size line")
+        m, n, nnz = int(dims[0]), int(dims[1]), int(dims[2])
+        ncols = 2 if field == "pattern" else 3
+        try:
+            trip = np.loadtxt(f, dtype=np.float64, comments="%",
+                              usecols=range(ncols), ndmin=2)
+        except ValueError as e:
+            raise ValueError(
+                f"{path}: malformed MatrixMarket entry ({e})") from e
+        if trip.shape[0] != nnz:
+            raise ValueError(
+                f"{path}: found {trip.shape[0]} entries, size line said "
+                f"{nnz}")
+    rows = trip[:, 0].astype(np.int64) - 1  # 1-indexed on disk
+    cols = trip[:, 1].astype(np.int64) - 1
+    vals = (np.ones(nnz, np.float64) if field == "pattern"
+            else trip[:, 2])
+    return SparseMatrix.from_coo(rows, cols, vals, (m, n))
+
+
+def read_csr_npz(path: str):
+    """Read the simple CSR bundle ``write_csr_npz`` emits (an ``npz``
+    with ``indptr``/``indices``/``data``/``shape`` — the loader pays
+    exactly the stored-triplet bytes, no text parse, no densify)."""
+    from nmfx.sparse import SparseMatrix
+
+    with np.load(path, allow_pickle=False) as z:
+        try:
+            return SparseMatrix(indptr=z["indptr"], indices=z["indices"],
+                                data=z["data"],
+                                shape=tuple(int(x) for x in z["shape"]))
+        except (KeyError, ValueError) as e:
+            raise ValueError(
+                f"{path}: not a valid CSR bundle "
+                f"(indptr/indices/data/shape): {e}") from e
+
+
+def write_csr_npz(sp, path: str) -> None:
+    """Persist a :class:`nmfx.sparse.SparseMatrix` as the ``.csr.npz``
+    bundle :func:`read_csr_npz` loads."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    np.savez(path, indptr=sp.indptr, indices=sp.indices, data=sp.data,
+             shape=np.asarray(sp.shape, np.int64))
 
 
 def _to_chars_double(v: float) -> str:

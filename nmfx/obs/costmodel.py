@@ -54,7 +54,7 @@ __all__ = [
     "enable_attribution", "engine_universe", "iteration_bytes",
     "iteration_flops", "perf_report", "perf_summary",
     "recent_attributions", "reset_perf", "set_device_peak",
-    "xla_iteration_cost",
+    "set_sparse_density", "sparse_density", "xla_iteration_cost",
 ]
 
 #: algorithms deliberately WITHOUT a cost model, with the rationale the
@@ -128,6 +128,43 @@ def _als_flops(m, n, k, cfg=None):
     so the cross-check gates this model against the GEMM share only
     (tests/test_costmodel.py documents the one-sided band)."""
     return 4.0 * m * n * k + 10.0 * k * k * (m + n)
+
+
+#: density of the sparse input the tiled dispatches are contracting
+#: (1.0 = dense input). A module-level hint, not a model argument,
+#: because the attribution call sites (:func:`attribute_dispatch`)
+#: carry only (m, n, iteration counts) — the sweep layer stamps the
+#: density when it routes a SparseMatrix (``sweep._sweep_tiled``), the
+#: same way the device-peak override extends the peak table.
+_sparse_density = 1.0
+
+
+def set_sparse_density(density: float) -> None:
+    """Record the stored-nonzero density of the sparse input the next
+    tiled dispatches contract (ISSUE 17). The tiled models scale their
+    data-sized FLOP/byte terms by it — MPI-FAUN's point that sparse NMF
+    pays only for nnz, not m·n. Reset to 1.0 for dense tiled inputs."""
+    global _sparse_density
+    d = float(density)
+    if not 0.0 <= d <= 1.0:
+        raise ValueError(f"density must be in [0, 1], got {density!r}")
+    _sparse_density = d
+
+
+def sparse_density() -> float:
+    """The current sparse-density hint the tiled models apply."""
+    return _sparse_density
+
+
+def _tiled_flops(m, n, k, cfg=None):
+    """Out-of-core tiled mu/hals iteration (``nmfx/tiles.py``): the
+    SAME leading-order math as the in-core engines — two data-sized
+    contractions (WᵀA for the next carry, A·Hᵀ-shaped terms inside the
+    streaming W pass) plus the k²-sized Gram products — except the
+    data terms contract stored nonzeros only, so they scale by the
+    density hint: 4·d·mnk + 4k²(m + n)."""
+    return (4.0 * _sparse_density * m * n * k
+            + 4.0 * k * k * (m + n))
 
 
 def _sketched_flops(m, n, k, cfg=None):
@@ -249,6 +286,32 @@ def _pallas_mu_bytes(m, n, k, cfg=None, family="pallas"):
             + 2.0 * (m * k + k * n) * s / launch_iters)
 
 
+def _tiled_bytes_common(m, n, k, cfg, factor_passes):
+    """Tiled byte model: the pipelined schedule reads A exactly ONCE
+    per iteration (head + single streaming pass — the module's whole
+    point), so a-traffic is one m×n pass for dense sources, or the
+    stored-triplet payload d·mn·(itemsize + 8) for sparse (values plus
+    the (row, col) int32 pair each nonzero ships with), plus the usual
+    factor-sized passes."""
+    s = _itemsize(cfg)
+    d = _sparse_density
+    if d < 1.0:
+        a_bytes = d * m * n * (s + 8.0)
+    else:
+        a_bytes = m * n * s
+    return a_bytes + factor_passes * (m * k + k * n) * s
+
+
+def _tiled_mu_bytes(m, n, k, cfg=None, family="tiled"):
+    return _tiled_bytes_common(m, n, k, cfg, 8.0)
+
+
+def _tiled_hals_bytes(m, n, k, cfg=None, family="tiled"):
+    # the k unrolled coordinate passes re-touch the updating factor,
+    # as in the in-core hals model above
+    return _tiled_bytes_common(m, n, k, cfg, 8.0 + 5.0 * k)
+
+
 def _sketched_bytes(m, n, k, cfg=None, family="sketched"):
     """Per compressed iteration: the r-sized sketches L·A (r×n), A·R
     (m×r) and the projections L (r×m), R (n×r) are read once each —
@@ -282,9 +345,11 @@ _FLOPS = {
     ("mu", "packed"): _mu_flops,
     ("mu", "pallas"): _mu_flops,
     ("mu", "sketched"): _sketched_flops,
+    ("mu", "tiled"): _tiled_flops,
     ("hals", "vmap"): _hals_flops,
     ("hals", "packed"): _hals_flops,
     ("hals", "sketched"): _sketched_flops,
+    ("hals", "tiled"): _tiled_flops,
     ("kl", "vmap"): _kl_flops,
     ("kl", "packed"): _kl_flops,
     ("als", "vmap"): _als_flops,
@@ -300,9 +365,11 @@ _BYTES = {
     ("mu", "packed"): _mu_bytes,
     ("mu", "pallas"): _pallas_mu_bytes,
     ("mu", "sketched"): _sketched_bytes,
+    ("mu", "tiled"): _tiled_mu_bytes,
     ("hals", "vmap"): _hals_bytes,
     ("hals", "packed"): _hals_bytes,
     ("hals", "sketched"): _sketched_bytes,
+    ("hals", "tiled"): _tiled_hals_bytes,
     ("kl", "vmap"): _kl_bytes,
     ("kl", "packed"): _kl_bytes,
     ("als", "vmap"): _als_bytes,
@@ -333,7 +400,8 @@ def engine_universe() -> "frozenset[tuple[str, str]]":
     the kernel-capable algorithms) — minus :data:`COSTMODEL_EXEMPT`.
     A new algorithm or a new family routing expands this set while the
     literal model table stays behind, which is the NMFX009 finding."""
-    from nmfx.config import PACKED_ALGORITHMS, SKETCHED_ALGORITHMS
+    from nmfx.config import (PACKED_ALGORITHMS, SKETCHED_ALGORITHMS,
+                             TILED_ALGORITHMS)
     from nmfx.solvers import SOLVERS
     from nmfx.sweep import _GRID_EXEC_BACKENDS
 
@@ -348,6 +416,8 @@ def engine_universe() -> "frozenset[tuple[str, str]]":
             pairs.add((algo, "pallas"))
         if algo in SKETCHED_ALGORITHMS:
             pairs.add((algo, "sketched"))
+        if algo in TILED_ALGORITHMS:
+            pairs.add((algo, "tiled"))
     return frozenset(pairs)
 
 
@@ -759,7 +829,11 @@ def _compile_unrolled(algorithm, family, m, n, k, cfg, t):
     import jax.numpy as jnp
 
     cfg = _resolve_cfg(cfg)
-    if family == "pallas":
+    if family in ("pallas", "tiled"):
+        # pallas: Mosaic does not compile on CPU. tiled: the streaming
+        # loop is host-driven across many dispatches — no single
+        # compiled step exists to difference; its update math is the
+        # in-core mu/hals math, cross-checked through the vmap family.
         return None
     key = jax.random.key(0)
     kw, kh, ka = jax.random.split(key, 3)

@@ -151,6 +151,22 @@ METRICS = (
                0.35,
                "from-scratch wall over incremental-extend wall at a "
                "2x-widened restart budget, bit-identity gated"),
+    # --- atlas-scale solves (ISSUE 17: tiles + sparse ingestion) ----
+    MetricSpec("atlas_tiled_restarts_per_s",
+               ("detail.atlas.out_of_core.restarts_per_s",), "higher",
+               0.35,
+               "throughput of the larger-than-budget multi-tile rung "
+               "(forced-small budget); hardware-host measurement"),
+    MetricSpec("atlas_sparse_speedup_99",
+               ("detail.atlas.sparse.density_99.speedup_vs_dense",),
+               "higher", 0.50,
+               "99%-sparse BCOO ingestion wall vs the densified twin; "
+               "crossover is host-GEMM-dependent, threshold loose"),
+    MetricSpec("atlas_resume_overhead_s",
+               ("detail.atlas.resume.resume_overhead_s",), "lower",
+               0.50,
+               "mid-matrix kill/resume overhead of the tiled durable "
+               "ledger; bit-identity gated by the bench itself"),
 )
 
 

@@ -204,7 +204,14 @@ def key_for_array(a, scfg: SolverConfig = SolverConfig(),
     """Convenience wrapper: content-hash a host matrix and key it.
     Costs one sha256 pass over the host bytes — serving layers that
     already placed the input through ``data_cache`` should pass the
-    DataKey's fingerprint to :func:`result_key` instead."""
+    DataKey's fingerprint to :func:`result_key` instead. Sparse inputs
+    (:class:`nmfx.sparse.SparseMatrix`) hash their canonical triplets,
+    never a densified copy."""
+    from nmfx.sparse import SparseMatrix
+
+    if isinstance(a, SparseMatrix):
+        return result_key(a.fingerprint(), tuple(a.shape),
+                          a.data.dtype.str, scfg, ccfg, icfg, quality)
     arr = np.ascontiguousarray(a)
     digest = hashlib.sha256(arr.view(np.uint8).reshape(-1)).hexdigest()
     return result_key(digest, tuple(a.shape), arr.dtype.str,

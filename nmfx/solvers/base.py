@@ -399,6 +399,13 @@ def solve(a: jax.Array, w0: jax.Array, h0: jax.Array,
             "solve_sketched — nmf()/restart_factors() route there "
             "automatically) and screen=True only exists at the sweep "
             "layer")
+    if cfg.tile_rows is not None:
+        # this signature takes a device-resident A; the out-of-core
+        # streaming loop lives at the sweep layer
+        raise ValueError(
+            "tile_rows streams A from host through nmfx.tiles; solve() "
+            "is the in-core single-restart engine (sweep()/nmf() route "
+            "tiled configs automatically)")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0 = jnp.asarray(w0, dtype)

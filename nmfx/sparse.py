@@ -1,0 +1,222 @@
+"""Sparse ingestion for atlas-scale inputs (ISSUE 17).
+
+The atlases real users submit — million-sample scRNA-seq count matrices
+— are >90% zeros, and MPI-FAUN (arxiv 1609.09154) shows why sparsity
+pays for NMF specifically: the alternating updates consume A only
+through the Gram-style contractions WᵀA and AHᵀ, so contracting against
+the stored nonzeros alone cuts the data-sized FLOPs and bytes by the
+density factor while every k-sized term stays dense. This module is the
+HOST-SIDE half of that story:
+
+* :class:`SparseMatrix` — a minimal CSR container (``indptr``/
+  ``indices``/``data`` + ``shape``) with deterministic canonical form
+  (row-major, column-sorted, explicit zeros dropped), cheap row-block
+  slicing (the exact operation the tile pipeline in ``nmfx/tiles.py``
+  streams by), and a content fingerprint over the canonical triplets —
+  the same honesty discipline as ``data_cache.DataKey``: a mutated
+  matrix gets a new fingerprint, never a stale resume or cache hit.
+* Tile → BCOO conversion (:meth:`SparseMatrix.tile_coo`): each streamed
+  row block becomes the ``(indices, data)`` pair a device-side
+  ``jax.experimental.sparse.BCOO`` wraps, so the per-tile Gram updates
+  contract against stored nonzeros only (the stacked-GEMM formulation in
+  ``nmfx/tiles.py`` — one sparse×dense GEMM over lane-stacked factors,
+  never a vmap over BCOO ops).
+
+Exactness contract: a sparse solve is the SAME mathematical program as
+the densified solve — the agreement gates (``nmfx/agreement.py``)
+pin sparse≡densified consensus/label equivalence at test shapes
+(tests/test_sparse.py); bit-level identity is not promised (sparse
+contractions order their reductions by stored-nonzero layout).
+
+File loaders (MatrixMarket ``.mtx``, the simple CSR ``.csr.npz``
+bundle) live in ``nmfx/io.py`` next to the dense GCT/RES readers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from nmfx.obs import metrics as _metrics
+
+__all__ = ["SparseMatrix"]
+
+#: nonzeros streamed through sparse tile contractions (every tile of
+#: every pass counts its stored nnz once) — the honesty counter behind
+#: the contract that the sparse path's data work scales with nnz, not
+#: m·n; docs/observability.md documents it (NMFX010)
+_sparse_nnz_total = _metrics.counter(
+    "nmfx_sparse_nnz_total",
+    "stored nonzeros streamed through sparse tile contractions")
+_sparse_nnz_bytes_total = _metrics.counter(
+    "nmfx_sparse_nnz_bytes_total",
+    "bytes of sparse tile payloads (values + indices) transferred "
+    "host-to-device")
+
+
+def note_sparse_tile(nnz: int, nbytes: int) -> None:
+    """Book one sparse tile's streamed nonzeros/bytes (called by the
+    tile stream, ``nmfx/tiles.py``)."""
+    _sparse_nnz_total.inc(nnz)
+    _sparse_nnz_bytes_total.inc(nbytes)
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseMatrix:
+    """Host CSR matrix in canonical form.
+
+    Canonical means: ``indptr`` is a monotone ``int64`` array of length
+    ``m + 1``; within each row ``indices`` is strictly increasing
+    ``int32`` (no duplicates); ``data`` holds no explicit zeros. Both
+    constructors (:meth:`from_dense`, :meth:`from_coo`) canonicalize, so
+    two representations of the same matrix always fingerprint
+    identically — the content-addressing the checkpoint manifest and
+    ``DataKey`` rely on."""
+
+    indptr: np.ndarray  # (m + 1,) int64
+    indices: np.ndarray  # (nnz,) int32 column indices
+    data: np.ndarray  # (nnz,) values
+    shape: tuple
+
+    def __post_init__(self):
+        m, n = self.shape
+        object.__setattr__(self, "shape", (int(m), int(n)))
+        indptr = np.ascontiguousarray(self.indptr, np.int64)
+        indices = np.ascontiguousarray(self.indices, np.int32)
+        data = np.ascontiguousarray(self.data)
+        if indptr.shape != (self.shape[0] + 1,):
+            raise ValueError(
+                f"indptr must have shape ({self.shape[0] + 1},), got "
+                f"{indptr.shape}")
+        if indptr[0] != 0 or indptr[-1] != len(data):
+            raise ValueError("indptr must run [0, ..., nnz]")
+        if np.any(np.diff(indptr) < 0):
+            raise ValueError("indptr must be monotone non-decreasing")
+        if len(indices) != len(data):
+            raise ValueError("indices and data must have equal length")
+        if len(indices) and (indices.min() < 0
+                             or indices.max() >= self.shape[1]):
+            raise ValueError("column indices out of range")
+        object.__setattr__(self, "indptr", indptr)
+        object.__setattr__(self, "indices", indices)
+        object.__setattr__(self, "data", data)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_dense(cls, a) -> "SparseMatrix":
+        """CSR of a dense host array (row-major scan — canonical by
+        construction)."""
+        a = np.asarray(a)
+        if a.ndim != 2:
+            raise ValueError(f"expected a 2-D matrix, got shape {a.shape}")
+        rows, cols = np.nonzero(a)
+        indptr = np.zeros(a.shape[0] + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        np.cumsum(indptr, out=indptr)
+        return cls(indptr=indptr, indices=cols.astype(np.int32),
+                   data=a[rows, cols], shape=a.shape)
+
+    @classmethod
+    def from_coo(cls, rows, cols, vals, shape) -> "SparseMatrix":
+        """CSR from COO triplets: sorts row-major then by column,
+        SUMS duplicate entries (the MatrixMarket convention), and drops
+        entries that cancel to exact zero — canonical form."""
+        rows = np.asarray(rows, np.int64)
+        cols = np.asarray(cols, np.int64)
+        vals = np.asarray(vals)
+        m, n = int(shape[0]), int(shape[1])
+        if len(rows) and (rows.min() < 0 or rows.max() >= m
+                          or cols.min() < 0 or cols.max() >= n):
+            raise ValueError("COO indices out of range for shape "
+                             f"({m}, {n})")
+        order = np.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], vals[order]
+        if len(rows):
+            # sum duplicates: group boundaries where (row, col) changes
+            new = np.empty(len(rows), bool)
+            new[0] = True
+            new[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])
+            group = np.cumsum(new) - 1
+            vals = np.bincount(group, weights=vals.astype(np.float64),
+                               minlength=group[-1] + 1).astype(vals.dtype)
+            rows, cols = rows[new], cols[new]
+        keep = vals != 0
+        rows, cols, vals = rows[keep], cols[keep], vals[keep]
+        indptr = np.zeros(m + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        np.cumsum(indptr, out=indptr)
+        return cls(indptr=indptr, indices=cols.astype(np.int32),
+                   data=vals, shape=(m, n))
+
+    # -- basic queries ------------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return int(len(self.data))
+
+    @property
+    def density(self) -> float:
+        m, n = self.shape
+        return self.nnz / float(m * n) if m and n else 0.0
+
+    @property
+    def nbytes(self) -> int:
+        return (self.indptr.nbytes + self.indices.nbytes
+                + self.data.nbytes)
+
+    def toarray(self, dtype=None) -> np.ndarray:
+        """Densify (test shapes / the sparse≡densified agreement gates
+        only — densifying an atlas defeats the module)."""
+        m, n = self.shape
+        out = np.zeros((m, n), dtype if dtype is not None
+                       else self.data.dtype)
+        rows = np.repeat(np.arange(m), np.diff(self.indptr))
+        out[rows, self.indices] = self.data
+        return out
+
+    # -- tiling -------------------------------------------------------------
+    def row_block(self, r0: int, r1: int) -> "SparseMatrix":
+        """Rows ``[r0, r1)`` as their own canonical CSR (shares the
+        value/index buffers — a view, not a copy)."""
+        p0, p1 = int(self.indptr[r0]), int(self.indptr[r1])
+        return SparseMatrix(indptr=self.indptr[r0:r1 + 1] - p0,
+                            indices=self.indices[p0:p1],
+                            data=self.data[p0:p1],
+                            shape=(r1 - r0, self.shape[1]))
+
+    def tile_coo(self, r0: int, r1: int, dtype
+                 ) -> "tuple[np.ndarray, np.ndarray]":
+        """Rows ``[r0, r1)`` as the ``(indices, data)`` pair of a
+        row-local COO block — exactly what a device-side
+        ``jax.experimental.sparse.BCOO`` of shape ``(r1 - r0, n)``
+        wraps. ``indices`` is ``(nnz_t, 2) int32`` ``[row - r0, col]``
+        in canonical (row-major, column-sorted) order; ``data`` is cast
+        to the solve dtype host-side so the transfer carries the bytes
+        the device consumes."""
+        p0, p1 = int(self.indptr[r0]), int(self.indptr[r1])
+        counts = np.diff(self.indptr[r0:r1 + 1]).astype(np.int64)
+        local_rows = np.repeat(np.arange(r1 - r0, dtype=np.int32), counts)
+        idx = np.stack([local_rows, self.indices[p0:p1]], axis=1)
+        return idx, np.asarray(self.data[p0:p1], dtype)
+
+    def block_sq_norms(self, boundaries) -> np.ndarray:
+        """``sum(data**2)`` per ``(r0, r1)`` row block, accumulated in
+        float64 — the per-tile ‖A_t‖² constants the tiled residual's
+        Gram form needs (``nmfx/tiles.py``)."""
+        sq = (self.data.astype(np.float64) ** 2)
+        csum = np.concatenate([[0.0], np.cumsum(sq)])
+        return np.asarray([csum[self.indptr[r1]] - csum[self.indptr[r0]]
+                           for r0, r1 in boundaries])
+
+    # -- content addressing --------------------------------------------------
+    def fingerprint(self) -> str:
+        """sha256 over the canonical triplets + shape + value dtype —
+        the sparse analogue of ``DataKey.fingerprint`` (content, not
+        identity: in-place mutation yields a new digest)."""
+        h = hashlib.sha256()
+        h.update(repr((self.shape, self.data.dtype.str)).encode())
+        h.update(np.ascontiguousarray(self.indptr).view(np.uint8))
+        h.update(np.ascontiguousarray(self.indices).view(np.uint8))
+        h.update(np.ascontiguousarray(self.data).view(np.uint8))
+        return h.hexdigest()

@@ -257,6 +257,15 @@ def resolve_engine_family(solver_cfg: SolverConfig,
     the engine its exact phase actually runs (the ``screen``/
     ``screen_keep`` fields themselves are hashed separately, so a
     screened registry never aliases an unscreened one)."""
+    if solver_cfg.tile_rows is not None:
+        # the out-of-core streaming engine (nmfx/tiles.py). Conservative
+        # on purpose: a single-tile config that sweep() would delegate
+        # to the dense path still fingerprints "tiled" when consulted
+        # directly — splitting two identical numeric programs is safe,
+        # aliasing two different ones is not (sweep() strips tile_rows
+        # BEFORE the delegated path consults this, so the routed dense
+        # run keeps its dense identity)
+        return "tiled"
     if solver_cfg.backend == "sketched":
         return "sketched"
     if solver_cfg.screen:
@@ -1036,6 +1045,11 @@ def grid_exec_ok(solver_cfg: SolverConfig, mesh: Mesh | None) -> bool:
         # serving contract excludes them by construction — cacheable()
         # reads this predicate)
         return False
+    if solver_cfg.tile_rows is not None:
+        # the out-of-core streaming engine holds A on host; the slot
+        # scheduler (and the exec-cache serving contract built on this
+        # predicate) assumes a device-resident A
+        return False
     backends = _GRID_EXEC_BACKENDS.get(solver_cfg.algorithm, ())
     if solver_cfg.backend not in backends:
         return False
@@ -1677,6 +1691,18 @@ def sweep_one_k(a, key, k: int, restarts: int,
     reductions without re-solving. ``grid_slots`` bounds the concurrent
     lanes of the slot-scheduled backends (hals backend='packed';
     ConsensusConfig.grid_slots at the sweep level)."""
+    if solver_cfg.tile_rows is not None:
+        # sweep() owns the out-of-core routing (single-tile delegation
+        # included) because it runs BEFORE A is placed on device; by the
+        # time this per-k entry runs, a tiled config should have been
+        # delegated or routed — reaching here means a direct caller
+        # skipped that
+        raise ValueError(
+            "tile_rows is routed by sweep() (which delegates one-tile "
+            "dense configs to this in-core path and streams the rest "
+            "through nmfx.tiles); call sweep(), or "
+            "nmfx.tiles.sweep_one_k_tiled for the streaming engine "
+            "directly")
     if (solver_cfg.algorithm == "mu" or solver_cfg.backend
             not in _GRID_EXEC_BACKENDS.get(solver_cfg.algorithm, ())
             or grid_axes_active(mesh)):
@@ -1694,6 +1720,65 @@ def sweep_one_k(a, key, k: int, restarts: int,
                          keep_factors, grid_slots, grid_tail_slots,
                          fault_token=faults.trace_token())
     return fn(jnp.asarray(a), key)
+
+
+def _sweep_tiled(a, plan, cfg: ConsensusConfig,
+                 solver_cfg: SolverConfig, init_cfg: InitConfig, *,
+                 mesh=None, registry=None, profiler=None, on_rank=None,
+                 checkpoint=None) -> "dict[int, KSweepOutput]":
+    """The out-of-core arm of :func:`sweep`: per-k sequential solves
+    through the streaming tiled engine (``nmfx/tiles.py``), sharing the
+    canonical per-k key chain (``fold_in(root, k)``) and the on_rank
+    streaming hook. A stays HOST-side — the stream owns all transfers —
+    so the in-core path's ``place_resilient`` first-touch, grid
+    execution, and the exec-cache (device-resident A by contract,
+    ``grid_exec_ok``) do not apply here."""
+    from nmfx import tiles as _tiles
+    from nmfx.sparse import SparseMatrix
+
+    if mesh is not None and any(
+            mesh.shape[ax] > 1 for ax in mesh.axis_names):
+        raise ValueError(
+            "out-of-core (tiled/sparse) sweeps stream tiles through the "
+            "default device; drop the mesh (the tile budget, not the "
+            "device count, bounds the working set)")
+    if registry is not None:
+        raise ValueError(
+            "out-of-core sweeps checkpoint mid-matrix through the "
+            "durable chunk ledger (pass checkpoint=CheckpointConfig()); "
+            "the legacy per-rank registry has no partial-pass records")
+    if cfg.grid_exec == "grid":
+        raise ValueError(
+            "grid_exec='grid' is the in-core whole-grid solve; "
+            "tiled/sparse sweeps run the streaming engine per rank "
+            "(use grid_exec='auto')")
+    if checkpoint is not None:
+        from nmfx.checkpoint import run_checkpointed_sweep
+
+        return run_checkpointed_sweep(a, cfg, solver_cfg, init_cfg,
+                                      checkpoint, profiler=profiler,
+                                      on_rank=on_rank)
+    if isinstance(a, SparseMatrix):
+        from nmfx.obs import costmodel
+
+        costmodel.set_sparse_density(a.density)
+    root = jax.random.key(cfg.seed)
+    out: dict[int, KSweepOutput] = {}
+    for k in cfg.ks:
+        key = jax.random.fold_in(root, k)
+        t0 = time.perf_counter()
+        with profiler.phase(f"solve.k={k}") as sync:
+            out[k] = sync(_tiles.sweep_one_k_tiled(
+                a, key, k, cfg.restarts, solver_cfg, init_cfg,
+                cfg.label_rule, cfg.keep_factors, profiler))
+        on_rank(k, out[k])
+        if 0 < _log.level <= logging.INFO:
+            iters = np.asarray(out[k].iterations)
+            _log.info(
+                "k=%d (tiled, %d tiles): %d restarts in %.2fs "
+                "(mean %.0f iters)", k, plan.n_tiles, cfg.restarts,
+                time.perf_counter() - t0, float(iters.mean()))
+    return {k: out[k] for k in cfg.ks}
 
 
 def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
@@ -1750,6 +1835,30 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
         profiler = NullProfiler()
     if on_rank is None:
         on_rank = _noop_rank
+    # Out-of-core routing (nmfx/tiles.py) decides FIRST, before the
+    # checkpoint/exec-cache/registry branches consult the config: a
+    # dense input whose plan resolves to ONE tile is delegated to the
+    # in-core path with tile_rows stripped — bit-identical by
+    # construction (the same jit graph runs), so aliasing the dense
+    # identity everywhere downstream (fingerprints, cache keys,
+    # manifests) is correct, not a collision. Multi-tile dense and all
+    # sparse inputs run the streaming "tiled" engine family.
+    from nmfx.sparse import SparseMatrix
+
+    sparse_input = isinstance(a, SparseMatrix)
+    if solver_cfg.tile_rows is not None or sparse_input:
+        import dataclasses
+
+        from nmfx import tiles as _tiles
+
+        plan = _tiles.plan_for(a, solver_cfg)
+        if plan.n_tiles == 1 and not sparse_input:
+            solver_cfg = dataclasses.replace(solver_cfg, tile_rows=None)
+        else:
+            return _sweep_tiled(a, plan, cfg, solver_cfg, init_cfg,
+                                mesh=mesh, registry=registry,
+                                profiler=profiler, on_rank=on_rank,
+                                checkpoint=checkpoint)
     if checkpoint is not None:
         if registry is not None:
             raise ValueError(

@@ -1,0 +1,788 @@
+"""Out-of-core tile pipeline: atlas-scale solves that stream A (ISSUE 17).
+
+nmfx's in-core engines require A on device for every update; the atlases
+real users submit do not fit. "Distributed Out-of-Memory NMF" (arxiv
+2202.09518) gives the decomposition this module implements on a single
+device: partition A into feature-axis (row) blocks sized to a device
+budget, keep W/H — and the whole vmapped restart pool — device-resident,
+and stream the blocks through the mu/hals updates with the NEXT tile's
+``device_put`` overlapped against the CURRENT tile's compute (the same
+double-buffer idiom as ``data_cache._chunked_put``, promoted from a
+first-touch trick into the steady-state iteration loop). MPI-FAUN
+(arxiv 1609.09154) supplies the algebra that makes tiling work at all:
+mu and hals consume A only through the Gram-style contractions WᵀA and
+AHᵀ, so each tile's contribution reduces into k×n / k×k terms and the
+full matrix never needs to exist on device at once.
+
+Per-iteration schedule — ONE pass over A, not two:
+
+* head (no A): the H half-step. mu reads the carried numerator
+  C = WᵀA (accumulated by the previous pass) and computes
+  H ← update(H, C, (WᵀW)H); hals replays its k coordinate updates from
+  the carried (WᵀA, WᵀW). Then HHᵀ is formed from the fresh H.
+* tile pass (streams A): for each row block t in FIXED tile order, the
+  W half-step on the resident slice W[t] using A_t·Hᵀ and the shared
+  HHᵀ, followed by accumulation of the NEXT iteration's carried Grams
+  (W_newᵀA and, for hals, W_newᵀW_new) in float32 — the mu W-update's
+  "fresh H" and H-update's "previous W" semantics fall out exactly.
+
+The residual needed by hals's TolFun check and by every final result is
+free: ‖A − WH‖² = ‖A‖² − 2⟨WᵀA, H⟩ + ⟨WᵀW, HHᵀ⟩, and the pass already
+produced WᵀA — so convergence checks never trigger an extra pass.
+
+Engine-family contract (``checkpoint.engine_family`` / docs/serving.md):
+
+* A config that resolves to ONE tile on a dense input never reaches this
+  module — ``sweep.sweep`` delegates it to the in-core path with
+  ``tile_rows=None``, so "tiled but fits" is bit-identical to dense by
+  construction (same jit graph, same cache/fingerprint identity).
+* Multi-tile (or sparse) solves run here as their own engine family
+  ``"tiled"``: fixed tile order + f32 accumulators make the reduction
+  deterministic, so streamed runs are bitwise reproducible against
+  themselves (prefetch on or off, resumed or uninterrupted) and
+  statistically gated against dense (``nmfx/agreement.py``).
+
+Sparse inputs (``nmfx.sparse.SparseMatrix``) stream each row block as a
+device BCOO and contract stored nonzeros only, via ONE stacked
+sparse×dense GEMM over lane-stacked factors per contraction — never a
+vmap over BCOO ops. Tile nse is padded to the plan-wide maximum with
+explicit zeros so every tile shares one compiled executable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import sparse as jsparse
+
+from nmfx.config import TILED_ALGORITHMS, InitConfig, SolverConfig
+from nmfx.init import random_init
+from nmfx.obs import metrics as _metrics
+from nmfx.profiling import NullProfiler
+from nmfx.solvers.base import StopReason, clamp, matmul_precision_ctx
+from nmfx.solvers.mu import _mu_update
+from nmfx.sparse import SparseMatrix, note_sparse_tile
+
+__all__ = [
+    "TilePlan", "TileStream", "TiledState", "TiledPoolResult",
+    "plan_for", "resolve_tile_rows", "tile_budget_bytes",
+    "set_tile_budget_bytes", "set_tile_prefetch", "tile_prefetch_enabled",
+    "run_tiled_pool", "sweep_one_k_tiled", "solve_chunk_tiled",
+    "partial_payload", "resume_from_payload",
+]
+
+#: profiler phase names (``xfer.`` prefix = overlap class, see
+#: ``profiling.OVERLAP_PREFIXES``): dispatch cost vs blocking wait on a
+#: prefetched tile — the bench's h2d-overlap ratio is 1 − wait/solve
+TILE_XFER_PHASE = "xfer.h2d_tile"
+TILE_WAIT_PHASE = "xfer.h2d_tile_wait"
+
+_tile_passes_total = _metrics.counter(
+    "nmfx_tile_passes_total",
+    "full streaming passes over A by the out-of-core tile pipeline")
+_tile_h2d_bytes_total = _metrics.counter(
+    "nmfx_tile_h2d_bytes_total",
+    "bytes of tile payloads transferred host-to-device by the tile "
+    "pipeline")
+_tile_partial_resumes_total = _metrics.counter(
+    "nmfx_tile_partial_resumes_total",
+    "tiled chunk solves resumed mid-matrix from a partial checkpoint "
+    "record")
+
+
+def note_partial_resume() -> None:
+    """Book one mid-matrix resume (called by ``nmfx/checkpoint.py``)."""
+    _tile_partial_resumes_total.inc()
+
+
+# -- device budget -----------------------------------------------------------
+
+#: default per-tile working-set budget: two resident buffers (current +
+#: prefetched) must fit, so tiles are sized to budget/2
+_DEFAULT_TILE_BUDGET_BYTES = 256 << 20
+
+_budget_override: "int | None" = None
+
+
+def set_tile_budget_bytes(nbytes: "int | None") -> None:
+    """Process-wide override of the tile budget (None restores the
+    env/default chain) — the bench's larger-than-device-memory rung
+    forces this small on CPU to exercise real multi-tile streaming."""
+    global _budget_override
+    if nbytes is not None and int(nbytes) < 1:
+        raise ValueError(f"tile budget must be >= 1 byte, got {nbytes}")
+    _budget_override = None if nbytes is None else int(nbytes)
+
+
+def tile_budget_bytes() -> int:
+    """Device-budget for streamed tiles: override > env
+    ``NMFX_TILE_BUDGET_BYTES`` > default (256 MiB)."""
+    if _budget_override is not None:
+        return _budget_override
+    env = os.environ.get("NMFX_TILE_BUDGET_BYTES", "").strip()
+    if env:
+        return max(1, int(env))
+    return _DEFAULT_TILE_BUDGET_BYTES
+
+
+_prefetch_enabled = True
+
+
+def set_tile_prefetch(on: bool) -> None:
+    """Toggle next-tile prefetch (double-buffering). Streaming results
+    are bit-identical either way — the toggle exists so tests/bench can
+    PIN that, and measure what overlap buys."""
+    global _prefetch_enabled
+    _prefetch_enabled = bool(on)
+
+
+def tile_prefetch_enabled() -> bool:
+    return _prefetch_enabled
+
+
+# -- tile plan ---------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TilePlan:
+    """Deterministic feature-axis partition of an (m, n) matrix into
+    row blocks of ``tile_rows`` (last block ragged). The plan is part of
+    a tiled sweep's identity: the multi-tile reduction order depends on
+    it, so the checkpoint fingerprint hashes ``as_meta()`` and a changed
+    plan cold-starts rather than resuming foreign partials."""
+
+    m: int
+    n: int
+    tile_rows: int
+
+    def __post_init__(self):
+        if self.m < 1 or self.n < 1:
+            raise ValueError(f"degenerate matrix ({self.m}, {self.n})")
+        if not 1 <= self.tile_rows:
+            raise ValueError(f"tile_rows must be >= 1, got {self.tile_rows}")
+        object.__setattr__(self, "tile_rows", min(self.tile_rows, self.m))
+
+    @property
+    def n_tiles(self) -> int:
+        return -(-self.m // self.tile_rows)
+
+    @property
+    def boundaries(self) -> "tuple[tuple[int, int], ...]":
+        return tuple((r0, min(r0 + self.tile_rows, self.m))
+                     for r0 in range(0, self.m, self.tile_rows))
+
+    def as_meta(self) -> dict:
+        return {"m": self.m, "n": self.n, "tile_rows": self.tile_rows,
+                "n_tiles": self.n_tiles}
+
+
+def resolve_tile_rows(tile_rows, m: int, n: int, itemsize: int,
+                      avg_row_bytes: "float | None" = None,
+                      budget: "int | None" = None) -> int:
+    """Resolve a ``SolverConfig.tile_rows`` knob to a concrete block
+    height. ``"auto"`` sizes blocks so two (current + prefetched) fit
+    the byte budget; ints clamp to [1, m]."""
+    if isinstance(tile_rows, int) and not isinstance(tile_rows, bool):
+        return max(1, min(tile_rows, m))
+    if tile_rows != "auto":
+        raise ValueError(
+            f"cannot resolve tile_rows={tile_rows!r} (expected an int or "
+            "'auto')")
+    if budget is None:
+        budget = tile_budget_bytes()
+    row_bytes = float(avg_row_bytes) if avg_row_bytes else float(n * itemsize)
+    row_bytes = max(row_bytes, 1.0)
+    rows = int(budget // (2.0 * row_bytes))
+    return max(1, min(rows, m))
+
+
+def plan_for(source, solver_cfg: SolverConfig) -> TilePlan:
+    """The tile plan a config implies for ``source`` (host dense array
+    or :class:`~nmfx.sparse.SparseMatrix`). ``tile_rows=None`` on a
+    sparse source means one whole-matrix tile (sparse inputs always run
+    the tiled engine — there is no dense in-core path to delegate to)."""
+    m, n = int(source.shape[0]), int(source.shape[1])
+    itemsize = jnp.dtype(solver_cfg.dtype).itemsize
+    tr = solver_cfg.tile_rows
+    if tr is None:
+        return TilePlan(m, n, m)
+    avg_row_bytes = None
+    if isinstance(source, SparseMatrix):
+        # stored-nonzero payload per row: value + (row, col) int32 pair
+        avg_row_bytes = (source.nnz / max(m, 1)) * (itemsize + 8)
+    rows = resolve_tile_rows(tr, m, n, itemsize, avg_row_bytes=avg_row_bytes)
+    return TilePlan(m, n, rows)
+
+
+# -- tile stream -------------------------------------------------------------
+
+class TileStream:
+    """Streams a host matrix's row blocks onto the device, double-
+    buffered: tile t+1's ``device_put`` is dispatched before tile t is
+    consumed, so the transfer rides under tile t's update compute. The
+    host bytes per tile are identical with prefetch on or off, so the
+    toggle cannot change results — only overlap.
+
+    Dense sources yield ``(mt, n)`` device arrays; sparse sources yield
+    device BCOO blocks whose nse is padded to the plan-wide maximum
+    with explicit zeros (index (0, 0), value 0 — contributing exact
+    zeros to every contraction) so all tiles share one compiled
+    executable per pass function.
+
+    Accounting: dispatch time books to ``xfer.h2d_tile`` and the
+    blocking wait on an unfinished transfer to ``xfer.h2d_tile_wait``
+    (both overlap-class phases, ``profiling.OVERLAP_PREFIXES``); bytes
+    to ``nmfx_tile_h2d_bytes_total``, and sparse payloads additionally
+    through ``nmfx.sparse.note_sparse_tile``.
+    """
+
+    def __init__(self, source, plan: TilePlan, dtype,
+                 profiler=None, prefetch: "bool | None" = None):
+        if tuple(int(s) for s in source.shape) != (plan.m, plan.n):
+            raise ValueError(
+                f"source shape {tuple(source.shape)} does not match plan "
+                f"({plan.m}, {plan.n})")
+        self.source = source
+        self.plan = plan
+        self.dtype = np.dtype(dtype)
+        self.profiler = profiler if profiler is not None else NullProfiler()
+        self.prefetch = (tile_prefetch_enabled() if prefetch is None
+                         else bool(prefetch))
+        self.sparse = isinstance(source, SparseMatrix)
+        if self.sparse:
+            nnzs = [int(source.indptr[r1] - source.indptr[r0])
+                    for r0, r1 in plan.boundaries]
+            self._pad_nse = max(max(nnzs), 1)
+
+    def _put(self, t: int):
+        """Dispatch tile t's host->device transfer (async)."""
+        r0, r1 = self.plan.boundaries[t]
+        t0 = time.perf_counter()
+        if self.sparse:
+            idx, data = self.source.tile_coo(r0, r1, self.dtype)
+            nnz = len(data)
+            pad = self._pad_nse - nnz
+            if pad:
+                idx = np.concatenate(
+                    [idx, np.zeros((pad, 2), np.int32)], axis=0)
+                data = np.concatenate([data, np.zeros(pad, self.dtype)])
+            dev = (jax.device_put(data), jax.device_put(idx))
+            nbytes = data.nbytes + idx.nbytes
+            note_sparse_tile(nnz, nbytes)
+        else:
+            block = np.ascontiguousarray(
+                np.asarray(self.source[r0:r1], self.dtype))
+            dev = jax.device_put(block)
+            nbytes = block.nbytes
+        _tile_h2d_bytes_total.inc(nbytes)
+        self.profiler.add_seconds(TILE_XFER_PHASE,
+                                  time.perf_counter() - t0)
+        return dev
+
+    def _wait(self, dev, t: int):
+        """Block until tile t's transfer finished; wrap sparse tiles."""
+        r0, r1 = self.plan.boundaries[t]
+        t0 = time.perf_counter()
+        if self.sparse:
+            data, idx = dev
+            data.block_until_ready()
+            idx.block_until_ready()
+            out = jsparse.BCOO((data, idx), shape=(r1 - r0, self.plan.n))
+        else:
+            dev.block_until_ready()
+            out = dev
+        self.profiler.add_seconds(TILE_WAIT_PHASE,
+                                  time.perf_counter() - t0)
+        return out
+
+    def tiles(self):
+        """One full pass over A in fixed tile order: yields
+        ``(t, r0, r1, a_t)`` with ``a_t`` ready on device."""
+        _tile_passes_total.inc()
+        nt = self.plan.n_tiles
+        pending: "dict[int, Any]" = {}
+        for t in range(nt):
+            if t not in pending:
+                pending[t] = self._put(t)
+            if self.prefetch and t + 1 < nt and t + 1 not in pending:
+                pending[t + 1] = self._put(t + 1)
+            a_t = self._wait(pending.pop(t), t)
+            r0, r1 = self.plan.boundaries[t]
+            yield t, r0, r1, a_t
+
+
+# -- tiled engine ------------------------------------------------------------
+
+class TiledState(NamedTuple):
+    """Device-resident restart-pool carry (leading axis = restarts).
+    Only A is atlas-sized; W/H and the convergence bookkeeping are
+    m·k / k·n per lane and stay resident — so the per-lane freeze
+    masking and TolX deltas read resident state directly, mirroring
+    ``solvers.base.State`` under the batched while_loop."""
+
+    w: jax.Array  # (R, m, k)
+    h: jax.Array  # (R, k, n)
+    w_prev: jax.Array  # (R, m, k)
+    h_prev: jax.Array  # (R, k, n)
+    iteration: jax.Array  # (R,) i32
+    dnorm: jax.Array  # (R,) residual at last check, inf until computed
+    classes: jax.Array  # (R, n) i32
+    stable: jax.Array  # (R,) i32
+    done: jax.Array  # (R,) bool
+    stop_reason: jax.Array  # (R,) i32 StopReason
+
+
+class TiledPoolResult(NamedTuple):
+    w: jax.Array  # (R, m, k)
+    h: jax.Array  # (R, k, n)
+    iterations: jax.Array  # (R,)
+    dnorm: jax.Array  # (R,) final ||A - W H||_F / sqrt(m n)
+    stop_reason: jax.Array  # (R,)
+
+
+def _contract_ah(a_t, h):
+    """(A_t)·Hᵀ over the lane stack: (mt, n) × (R, k, n) -> (R, mt, k).
+
+    Sparse tiles use ONE stacked sparse×dense GEMM — H reshaped to
+    (n, R·k) — instead of vmapping BCOO ops over lanes (BCOO has no
+    batching rule worth trusting here, and one big GEMM is the shape
+    sparse kernels are good at)."""
+    r, k, n = h.shape
+    if isinstance(a_t, jsparse.BCOO):
+        hs = jnp.transpose(h, (2, 0, 1)).reshape(n, r * k)
+        out = jsparse.bcoo_dot_general(
+            a_t, hs, dimension_numbers=(((1,), (0,)), ((), ())))
+        return jnp.transpose(out.reshape(-1, r, k), (1, 0, 2))
+    return jnp.einsum("mn,rkn->rmk", a_t, h)
+
+
+def _contract_wa(a_t, w_t):
+    """(W_t)ᵀ·A_t over the lane stack: (R, mt, k) × (mt, n) -> (R, k, n).
+
+    This is each tile's contribution to the carried Gram numerator
+    WᵀA — the term the NEXT iteration's H half-step consumes."""
+    r, mt, k = w_t.shape
+    if isinstance(a_t, jsparse.BCOO):
+        ws = jnp.transpose(w_t, (1, 0, 2)).reshape(mt, r * k)
+        out = jsparse.bcoo_dot_general(
+            a_t, ws, dimension_numbers=(((0,), (0,)), ((), ())))
+        return jnp.transpose(out.reshape(-1, r, k), (1, 2, 0))
+    return jnp.einsum("rmk,mn->rkn", w_t, a_t)
+
+
+def _zero_carry(algorithm: str, r: int, k: int, n: int):
+    """Fresh f32 Gram accumulators for one streaming pass (fixed tile
+    order + f32 makes the multi-tile reduction deterministic — the
+    bitwise self-consistency half of the engine-family contract)."""
+    if algorithm == "mu":
+        return (jnp.zeros((r, k, n), jnp.float32),)
+    return (jnp.zeros((r, k, n), jnp.float32),
+            jnp.zeros((r, k, k), jnp.float32))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _head_update(state: TiledState, carry, cfg: SolverConfig):
+    """The A-free half of one iteration: previous-factor snapshots and
+    per-lane iteration advance (masked exactly like the batched
+    while_loop in ``solvers.base.run_loop``), then the H half-step from
+    the carried Grams, then HHᵀ from the fresh H. Returns the updated
+    state and HHᵀ for the tile pass."""
+    active = ~state.done
+    mask = active[:, None, None]
+    w_prev = jnp.where(mask, state.w, state.w_prev)
+    h_prev = jnp.where(mask, state.h, state.h_prev)
+    iteration = state.iteration + active.astype(jnp.int32)
+    h0 = state.h
+    dtype = h0.dtype
+    with matmul_precision_ctx(cfg.matmul_precision):
+        if cfg.algorithm == "mu":
+            # H ← H ∘ (WᵀA) / ((WᵀW)H + ε), numerator from the carry
+            gram = jnp.einsum("rmk,rml->rkl", state.w, state.w)
+            denomh = jnp.einsum("rkl,rln->rkn", gram, h0)
+            h = _mu_update(h0, carry[0].astype(dtype), denomh, cfg)
+        else:  # hals: k coordinate updates against the carried Grams
+            wta = carry[0].astype(dtype)
+            wtw = carry[1].astype(dtype)
+            eps = cfg.div_eps
+            h = h0
+            k = h0.shape[1]
+            for j in range(k):
+                hj = h[:, j, :] + (
+                    wta[:, j, :]
+                    - jnp.einsum("rl,rln->rn", wtw[:, j, :], h)
+                ) / (wtw[:, j, j][:, None] + eps)
+                h = h.at[:, j, :].set(clamp(hj, cfg.zero_threshold))
+        h = jnp.where(mask, h, h0)
+        hht = jnp.einsum("rkn,rln->rkl", h, h)
+    state = state._replace(h=h, w_prev=w_prev, h_prev=h_prev,
+                           iteration=iteration)
+    return state, hht
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _tile_update(state: TiledState, hht, carry, inner, a_t, r0,
+                 cfg: SolverConfig):
+    """One tile of the W half-step + next-carry accumulation.
+
+    ``r0`` is a traced scalar (one compiled executable per tile SHAPE,
+    not per tile index — at most two: uniform + ragged-last). Frozen
+    lanes keep their W slice bit-for-bit, and their carry contribution
+    is recomputed from unchanged factors, so it is identical every
+    pass — the invariant that lets a resumed solve replay exactly."""
+    r, m, k = state.w.shape
+    mt = a_t.shape[0]
+    active = ~state.done
+    mask = active[:, None, None]
+    w_t = lax.dynamic_slice(state.w, (0, r0, 0), (r, mt, k))
+    with matmul_precision_ctx(cfg.matmul_precision):
+        aht = _contract_ah(a_t, state.h)  # (R, mt, k), H is fresh
+        if cfg.algorithm == "mu":
+            denomw = jnp.einsum("rmk,rkl->rml", w_t, hht)
+            w_new = _mu_update(w_t, aht, denomw, cfg)
+        else:  # hals: coordinate updates are row-local => tile-local
+            eps = cfg.div_eps
+            w_new = w_t
+            for j in range(k):
+                wj = w_new[:, :, j] + (
+                    aht[:, :, j]
+                    - jnp.einsum("rml,rl->rm", w_new, hht[:, j, :])
+                ) / (hht[:, j, j][:, None] + eps)
+                w_new = w_new.at[:, :, j].set(
+                    clamp(wj, cfg.zero_threshold))
+        w_new = jnp.where(mask, w_new, w_t)
+        cw = _contract_wa(a_t, w_new)  # (R, k, n)
+        inner = inner + jnp.sum(
+            cw.astype(jnp.float32) * state.h.astype(jnp.float32),
+            axis=(1, 2))
+        if cfg.algorithm == "mu":
+            carry = (carry[0] + cw.astype(jnp.float32),)
+        else:
+            wtw_t = jnp.einsum("rmk,rml->rkl", w_new, w_new)
+            carry = (carry[0] + cw.astype(jnp.float32),
+                     carry[1] + wtw_t.astype(jnp.float32))
+    w = lax.dynamic_update_slice(state.w, w_new, (0, r0, 0))
+    return state._replace(w=w), carry, inner
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _tile_accumulate(state: TiledState, carry, inner, a_t, r0,
+                     cfg: SolverConfig):
+    """Gram accumulation WITHOUT a factor update: the prologue pass
+    (builds iteration 1's carry from W0) and the final residual pass
+    (rebuilds ⟨WᵀA, H⟩ for the last dnorm) share this."""
+    r, m, k = state.w.shape
+    mt = a_t.shape[0]
+    w_t = lax.dynamic_slice(state.w, (0, r0, 0), (r, mt, k))
+    with matmul_precision_ctx(cfg.matmul_precision):
+        cw = _contract_wa(a_t, w_t)
+        inner = inner + jnp.sum(
+            cw.astype(jnp.float32) * state.h.astype(jnp.float32),
+            axis=(1, 2))
+        if cfg.algorithm == "mu":
+            carry = (carry[0] + cw.astype(jnp.float32),)
+        else:
+            wtw_t = jnp.einsum("rmk,rml->rkl", w_t, w_t)
+            carry = (carry[0] + cw.astype(jnp.float32),
+                     carry[1] + wtw_t.astype(jnp.float32))
+    return carry, inner
+
+
+def _gram_dnorm(state: TiledState, inner, nrm_a_sq,
+                cfg: SolverConfig):
+    """RMS residual from Gram terms only — no pass over A:
+    ‖A − WH‖² = ‖A‖² − 2⟨WᵀA, H⟩ + ⟨WᵀW, HHᵀ⟩, clamped at 0 against
+    f32 cancellation near convergence. ``inner`` is the streaming
+    pass's ⟨WᵀA, H⟩; the k×k Grams come from resident factors."""
+    m = state.w.shape[1]
+    n = state.h.shape[2]
+    with matmul_precision_ctx(cfg.matmul_precision):
+        gram = jnp.einsum("rmk,rml->rkl", state.w, state.w)
+        hht = jnp.einsum("rkn,rln->rkl", state.h, state.h)
+    cross = jnp.sum(gram.astype(jnp.float32) * hht.astype(jnp.float32),
+                    axis=(1, 2))
+    sq = jnp.maximum(nrm_a_sq - 2.0 * inner + cross, 0.0)
+    return jnp.sqrt(sq / (m * n)).astype(state.dnorm.dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _tiled_check(state: TiledState, inner, nrm_a_sq, cfg: SolverConfig):
+    """Per-lane convergence tests, a faithful batched transcription of
+    ``solvers.base.check_convergence`` (same order: nonfinite guard
+    FIRST, then class stability, TolX, TolFun; same formulas, same i32
+    stop-reason discipline). Transcribed rather than reused because the
+    base TolFun branch recomputes the residual from full A — which the
+    out-of-core engine cannot hold; here the Gram-form ``new_dnorm``
+    from the just-finished pass stands in. mu checks class+TolX, hals
+    additionally TolFun — matching each solver's in-core ``step``."""
+    use_class = cfg.use_class_stop
+    use_tolfun = cfg.algorithm == "hals"
+    it = state.iteration
+    is_check = (it > 1) & (it % cfg.check_every == 0) & (~state.done)
+    done = state.done
+    reason = state.stop_reason
+
+    if cfg.nonfinite_guard:
+        bad_w = ~jnp.all(jnp.isfinite(state.w), axis=(1, 2))
+        bad_h = ~jnp.all(jnp.isfinite(state.h), axis=(1, 2))
+        faulted = is_check & (bad_w | bad_h)
+        done = done | faulted
+        is_check = is_check & ~faulted
+        reason = jnp.where(faulted, jnp.int32(StopReason.NUMERIC_FAULT),
+                           reason)
+
+    classes = state.classes
+    stable = state.stable
+    if use_class:
+        new_classes = jnp.argmax(state.h, axis=1).astype(jnp.int32)
+        n = new_classes.shape[1]
+        flip_tol = int(cfg.class_flip_tol * n + 1e-9)
+        mism = jnp.sum((new_classes != state.classes).astype(jnp.int32),
+                       axis=1)
+        same = mism <= flip_tol
+        stable = jnp.where(is_check,
+                           jnp.where(same, state.stable + 1, 0),
+                           state.stable)
+        classes = jnp.where((is_check & ~same)[:, None], new_classes,
+                            state.classes)
+        hit = is_check & (stable >= cfg.stable_checks)
+        done = done | hit
+        reason = jnp.where(hit, jnp.int32(StopReason.CLASS_STABLE),
+                           reason)
+
+    if cfg.use_tol_checks:
+        sqrteps = jnp.sqrt(jnp.finfo(state.w.dtype).eps)
+        dw = (jnp.max(jnp.abs(state.w - state.w_prev), axis=(1, 2))
+              / (sqrteps + jnp.max(jnp.abs(state.w_prev), axis=(1, 2))))
+        dh = (jnp.max(jnp.abs(state.h - state.h_prev), axis=(1, 2))
+              / (sqrteps + jnp.max(jnp.abs(state.h_prev), axis=(1, 2))))
+        delta = jnp.maximum(dw, dh)
+        hit = is_check & (delta < cfg.tol_x) & ~done
+        done = done | hit
+        reason = jnp.where(hit, jnp.int32(StopReason.TOL_X), reason)
+
+    dnorm = state.dnorm
+    if use_tolfun and cfg.use_tol_checks:
+        new_dnorm = _gram_dnorm(state, inner, nrm_a_sq, cfg)
+        hit = (is_check & jnp.isfinite(state.dnorm)
+               & (state.dnorm - new_dnorm <= cfg.tol_fun * state.dnorm)
+               & ~done)
+        dnorm = jnp.where(is_check, new_dnorm, state.dnorm)
+        done = done | hit
+        reason = jnp.where(hit, jnp.int32(StopReason.TOL_FUN), reason)
+
+    return state._replace(classes=classes, stable=stable, done=done,
+                          stop_reason=reason, dnorm=dnorm)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _final_dnorm(state: TiledState, inner, nrm_a_sq,
+                 cfg: SolverConfig):
+    """Every lane's final residual (in-core ``run_loop`` recomputes it
+    unconditionally after the loop; so does the tiled engine, from the
+    dedicated final accumulation pass)."""
+    return state._replace(dnorm=_gram_dnorm(state, inner, nrm_a_sq, cfg))
+
+
+# -- partial-progress payloads (mid-matrix checkpoint records) ---------------
+
+_STATE_FIELDS = ("w", "h", "w_prev", "h_prev", "iteration", "dnorm",
+                 "classes", "stable", "done", "stop_reason")
+
+
+def partial_payload(state: TiledState, carry, step: int
+                    ) -> "dict[str, np.ndarray]":
+    """Flatten mid-solve progress to host arrays for an npz partial
+    record (``nmfx/checkpoint.py``): the full pool state, the carried
+    Grams the next head consumes, and the completed step count."""
+    out = {f: np.asarray(v)
+           for f, v in zip(_STATE_FIELDS, state)}
+    for i, c in enumerate(carry):
+        out[f"carry{i}"] = np.asarray(c)
+    out["step"] = np.asarray(int(step), np.int64)
+    return out
+
+
+def resume_from_payload(payload) -> "tuple[TiledState, tuple, int]":
+    """Inverse of :func:`partial_payload`. Device round-trip of the
+    saved f32 arrays is exact, and every pass function is
+    deterministic on identical inputs — so a resumed solve is bitwise
+    the uninterrupted one (the NMFX007 parity gate for this engine)."""
+    state = TiledState(*(jnp.asarray(payload[f]) for f in _STATE_FIELDS))
+    n_carry = sum(1 for f in payload.keys() if str(f).startswith("carry"))
+    carry = tuple(jnp.asarray(payload[f"carry{i}"])
+                  for i in range(n_carry))
+    note_partial_resume()
+    return state, carry, int(payload["step"])
+
+
+# -- host driver -------------------------------------------------------------
+
+def _source_sq_norm(source, dtype, plan: TilePlan) -> float:
+    """‖A‖² of the dtype-cast source, float64-accumulated host-side
+    (tile-blocked so it never materializes a dense atlas) — the
+    constant term of the Gram-form residual."""
+    if isinstance(source, SparseMatrix):
+        data = np.asarray(source.data, dtype).astype(np.float64)
+        return float(np.sum(data * data))
+    total = 0.0
+    for r0, r1 in plan.boundaries:
+        blk = np.asarray(source[r0:r1], dtype).astype(np.float64)
+        total += float(np.sum(blk * blk))
+    return total
+
+
+def run_tiled_pool(source, keys, k: int, solver_cfg: SolverConfig,
+                   init_cfg: InitConfig, *, plan: "TilePlan | None" = None,
+                   profiler=None, poison: tuple = (), resume=None,
+                   on_check=None) -> TiledPoolResult:
+    """Solve a restart pool out-of-core: one host-driven loop whose
+    per-iteration schedule is head (A-free H half-step) then one
+    streaming W-pass over A, with per-lane freeze masks replicating the
+    batched while_loop semantics of the in-core driver (checks fire at
+    ``check_every`` multiples past iteration 1; frozen lanes never
+    advance). ``keys`` are the EXPLICIT per-restart keys — a slice of
+    the canonical ``split(fold_in(root, k), restarts)`` chain, same as
+    every other engine.
+
+    ``resume`` is a :func:`partial_payload` mapping to continue from;
+    ``on_check(step, state, carry)`` fires after every convergence
+    check (device-synced) — the checkpoint layer saves partials and
+    rehearses preemptions there."""
+    from nmfx.sweep import _poison_restart_lanes
+
+    if solver_cfg.algorithm not in TILED_ALGORITHMS:
+        raise ValueError(
+            "the out-of-core tile pipeline implements the Gram-"
+            f"accumulation algorithms {TILED_ALGORITHMS}, got "
+            f"algorithm={solver_cfg.algorithm!r}")
+    if init_cfg.method != "random":
+        raise ValueError(
+            "tiled solves need init method 'random' (shape-only, key-"
+            "deterministic); nndsvd reads the full matrix, which an "
+            "out-of-core solve cannot hold")
+    if profiler is None:
+        profiler = NullProfiler()
+    dtype = jnp.dtype(solver_cfg.dtype)
+    m, n = int(source.shape[0]), int(source.shape[1])
+    if plan is None:
+        plan = plan_for(source, solver_cfg)
+    stream = TileStream(source, plan, dtype, profiler=profiler)
+    nrm_a_sq = jnp.asarray(_source_sq_norm(source, dtype, plan),
+                           jnp.float32)
+
+    keys = jnp.asarray(keys)
+    r = keys.shape[0]
+    if resume is None:
+        w0, h0 = jax.vmap(
+            lambda kk: random_init(kk, m, n, k, init_cfg, dtype))(keys)
+        w0 = _poison_restart_lanes(w0, poison)
+        state = TiledState(
+            w=w0, h=h0, w_prev=w0, h_prev=h0,
+            iteration=jnp.zeros((r,), jnp.int32),
+            dnorm=jnp.full((r,), jnp.inf, dtype),
+            classes=jnp.full((r, n), -1, jnp.int32),
+            stable=jnp.zeros((r,), jnp.int32),
+            done=jnp.zeros((r,), bool),
+            stop_reason=jnp.full((r,), StopReason.MAX_ITER, jnp.int32))
+        carry = _zero_carry(solver_cfg.algorithm, r, k, n)
+        inner = jnp.zeros((r,), jnp.float32)
+        # prologue: iteration 1's Gram carry from W0 (in-core step 1
+        # computes WᵀA/WᵀW from W0 directly; here it streams)
+        for _, r0, _r1, a_t in stream.tiles():
+            carry, inner = _tile_accumulate(state, carry, inner, a_t,
+                                            r0, solver_cfg)
+        start = 0
+    else:
+        state, carry, start = resume_from_payload(resume)
+
+    done_host = np.asarray(state.done)
+    for step in range(start + 1, solver_cfg.max_iter + 1):
+        if done_host.all():
+            break
+        state, hht = _head_update(state, carry, solver_cfg)
+        carry = _zero_carry(solver_cfg.algorithm, r, k, n)
+        inner = jnp.zeros((r,), jnp.float32)
+        for _, r0, _r1, a_t in stream.tiles():
+            state, carry, inner = _tile_update(state, hht, carry, inner,
+                                               a_t, r0, solver_cfg)
+        if step > 1 and step % solver_cfg.check_every == 0:
+            state = _tiled_check(state, inner, nrm_a_sq, solver_cfg)
+            done_host = np.asarray(state.done)
+            if on_check is not None:
+                on_check(step, state, carry)
+
+    # final residual for every lane, from one dedicated accumulation
+    # pass (also covers the resumed-when-already-done edge, where the
+    # iteration loop above never ran)
+    carry_f = _zero_carry(solver_cfg.algorithm, r, k, n)
+    inner_f = jnp.zeros((r,), jnp.float32)
+    for _, r0, _r1, a_t in stream.tiles():
+        carry_f, inner_f = _tile_accumulate(state, carry_f, inner_f,
+                                            a_t, r0, solver_cfg)
+    state = _final_dnorm(state, inner_f, nrm_a_sq, solver_cfg)
+    return TiledPoolResult(w=state.w, h=state.h,
+                           iterations=state.iteration,
+                           dnorm=state.dnorm,
+                           stop_reason=state.stop_reason)
+
+
+# -- sweep epilogues ---------------------------------------------------------
+
+def sweep_one_k_tiled(source, key, k: int, restarts: int,
+                      solver_cfg: SolverConfig, init_cfg: InitConfig,
+                      label_rule: str = "argmax",
+                      keep_factors: bool = False, profiler=None,
+                      poison: tuple = ()):
+    """One rank's consensus sweep through the tiled engine — the
+    out-of-core analogue of the vmapped ``_solve_batch`` path, sharing
+    the canonical key chain and the exact quarantine/consensus/argmin
+    epilogue helpers so downstream semantics cannot drift."""
+    from nmfx.consensus import labels_from_h
+    from nmfx.sweep import (KSweepOutput, _quarantine_lanes,
+                            _quarantined_consensus)
+
+    keys = jax.random.split(key, restarts)
+    res = run_tiled_pool(source, keys, k, solver_cfg, init_cfg,
+                         profiler=profiler, poison=poison)
+    labels = jax.vmap(partial(labels_from_h, rule=label_rule))(res.h)
+    labels, dnorm_best, faulted = _quarantine_lanes(
+        labels, res.dnorm, res.stop_reason)
+    cons = _quarantined_consensus(labels, k, restarts, faulted)
+    best = jnp.argmin(dnorm_best)
+    return KSweepOutput(
+        consensus=cons, iterations=res.iterations, dnorms=res.dnorm,
+        stop_reasons=res.stop_reason, labels=labels,
+        best_w=res.w[best], best_h=res.h[best],
+        all_w=res.w if keep_factors else None,
+        all_h=res.h if keep_factors else None)
+
+
+def solve_chunk_tiled(source, keys, k: int, solver_cfg: SolverConfig,
+                      init_cfg: InitConfig, label_rule: str,
+                      poison: tuple = (), profiler=None, resume=None,
+                      on_check=None):
+    """One restart-chunk through the tiled engine, returning the same
+    ``ChunkSweepOutput`` record payload as ``_build_chunk_sweep_fn``'s
+    executor (labels quarantine-masked to -1, raw dnorms, chunk-local
+    first-min best among survivors) so the durable ledger's finalize
+    step is engine-agnostic."""
+    from nmfx.consensus import labels_from_h
+    from nmfx.sweep import ChunkSweepOutput, _quarantine_lanes
+
+    res = run_tiled_pool(source, keys, k, solver_cfg, init_cfg,
+                         profiler=profiler, poison=poison,
+                         resume=resume, on_check=on_check)
+    labels = jax.vmap(partial(labels_from_h, rule=label_rule))(res.h)
+    labels, dnorm_best, _ = _quarantine_lanes(labels, res.dnorm,
+                                              res.stop_reason)
+    best = jnp.argmin(dnorm_best).astype(jnp.int32)
+    return ChunkSweepOutput(labels, res.iterations, res.dnorm,
+                            res.stop_reason, best, res.w[best],
+                            res.h[best])

@@ -428,3 +428,77 @@ def test_close_never_spills_cancelled_requests(tmp_path):
     import os
 
     assert not os.path.isdir(spill) or os.listdir(spill) == []
+
+
+# ---------------------------------------------------------------------
+# out-of-core (tiled) chunks: mid-matrix partials (ISSUE 17)
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("algorithm", ["mu", "hals"])
+def test_tiled_kill_mid_matrix_then_resume_bit_identical(
+        small_data, tmp_path, algorithm):
+    """The atlas-scale acceptance property: preempting a TILED
+    checkpointed sweep mid-chunk leaves a fingerprint-stamped partial
+    record (``k*_r*-*.part.npz``) on disk, the resumed run CONSUMES it
+    (rather than recomputing the chunk from scratch) and still lands
+    bit-identical to an uninterrupted run — and commits clear the
+    partial. The tiled chunk executor polls ``proc.preempt`` at every
+    convergence-check boundary AFTER saving the partial, so the
+    injected kill is a genuine mid-matrix preemption."""
+    from nmfx import tiles
+
+    scfg = SolverConfig(algorithm=algorithm, max_iter=60, tile_rows=16)
+    ref = _run(small_data, tmp_path / "ref", scfg)
+    faults.arm("proc.preempt", every=3, max_fires=1)
+    try:
+        with pytest.raises(ckpt.Preempted):
+            _run(small_data, tmp_path / "kill", scfg)
+    finally:
+        faults.disarm("proc.preempt")
+    parts = [n for n in os.listdir(tmp_path / "kill")
+             if n.endswith(".part.npz")]
+    assert parts, "the in-flight chunk's partial must survive the kill"
+    before = tiles._tile_partial_resumes_total.value()
+    res = _run(small_data, tmp_path / "kill", scfg)
+    assert tiles._tile_partial_resumes_total.value() - before >= 1, \
+        "the surviving partial was recomputed, not resumed"
+    assert not [n for n in os.listdir(tmp_path / "kill")
+                if n.endswith(".part.npz")], \
+        "partials must be cleared once their chunk commits"
+    assert_bit_identical(res, ref)
+
+
+def test_tiled_uninterrupted_run_leaves_no_partials(small_data,
+                                                    tmp_path):
+    scfg = SolverConfig(algorithm="mu", max_iter=40, tile_rows=16)
+    _run(small_data, tmp_path / "c", scfg)
+    names = os.listdir(tmp_path / "c")
+    assert not [n for n in names if n.endswith(".part.npz")]
+    assert any(n.endswith(".npz") for n in names)  # committed records
+
+
+def test_tiled_plan_change_is_cold_start(small_data, tmp_path):
+    """A different tile plan is a different reduction order: the
+    manifest must not resume across tile_rows changes."""
+    scfg16 = SolverConfig(algorithm="mu", max_iter=30, tile_rows=16)
+    _run(small_data, tmp_path / "c", scfg16)
+    before = ckpt.chunks_solved_count()
+    scfg8 = SolverConfig(algorithm="mu", max_iter=30, tile_rows=8)
+    with pytest.warns(RuntimeWarning, match="cold"):
+        _run(small_data, tmp_path / "c", scfg8)
+    assert ckpt.chunks_solved_count() - before == 4  # all recomputed
+
+
+def test_sparse_checkpointed_sweep_resumes(tmp_path):
+    """Sparse inputs route through the tiled chunk executor and the
+    durable ledger: a second run of the same (sparse data, config)
+    serves every chunk from disk."""
+    from nmfx.datasets import make_sparse_design
+
+    sp = make_sparse_design(80, 24, k=2, density=0.3, seed=6)
+    scfg = SolverConfig(algorithm="mu", max_iter=30)
+    ref = _run(sp, tmp_path / "c", scfg)
+    before = ckpt.chunks_solved_count()
+    again = _run(sp, tmp_path / "c", scfg)
+    assert ckpt.chunks_solved_count() == before  # zero new solves
+    assert_bit_identical(again, ref)

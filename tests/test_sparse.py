@@ -1,0 +1,266 @@
+"""Sparse ingestion (ISSUE 17): the CSR container's canonical form,
+content addressing, tile slicing, the sparse==densified agreement
+contract, and the nnz-aware cost-model rows.
+
+Exactness scope: a sparse solve contracts stored nonzeros through BCOO
+GEMMs, a different reduction order than the dense GEMM — so the
+contract is consensus/label agreement at planted shapes (the
+``nmfx/agreement.py`` yardstick), never bit-identity. Everything
+host-side (canonicalization, fingerprints, slicing) IS exact and is
+pinned exactly.
+"""
+
+import numpy as np
+import pytest
+
+from nmfx.config import SolverConfig
+from nmfx.datasets import make_sparse_design
+from nmfx.sparse import SparseMatrix
+
+
+@pytest.fixture()
+def planted():
+    return make_sparse_design(120, 40, k=3, density=0.3, seed=3)
+
+
+# ---------------------------------------------------------------------
+# canonical form
+# ---------------------------------------------------------------------
+
+def test_from_dense_roundtrip_exact():
+    rng = np.random.default_rng(0)
+    a = rng.uniform(size=(30, 11))
+    a[rng.random(a.shape) < 0.7] = 0.0
+    sp = SparseMatrix.from_dense(a)
+    np.testing.assert_array_equal(sp.toarray(), a)
+    assert sp.nnz == np.count_nonzero(a)
+    assert sp.density == pytest.approx(np.count_nonzero(a) / a.size)
+
+
+def test_from_coo_sums_duplicates_and_drops_zeros():
+    sp = SparseMatrix.from_coo(rows=[2, 0, 2, 1, 1],
+                               cols=[1, 0, 1, 2, 2],
+                               vals=[1.5, 3.0, 0.5, 2.0, -2.0],
+                               shape=(3, 4))
+    dense = np.zeros((3, 4))
+    dense[0, 0] = 3.0
+    dense[2, 1] = 2.0  # 1.5 + 0.5 summed; (1, 2) cancelled to zero
+    np.testing.assert_array_equal(sp.toarray(), dense)
+    assert sp.nnz == 2
+
+
+def test_two_representations_fingerprint_identically():
+    rng = np.random.default_rng(1)
+    a = rng.uniform(size=(20, 9))
+    a[rng.random(a.shape) < 0.6] = 0.0
+    via_dense = SparseMatrix.from_dense(a)
+    r, c = np.nonzero(a)
+    perm = np.random.default_rng(2).permutation(len(r))
+    via_coo = SparseMatrix.from_coo(r[perm], c[perm], a[r, c][perm],
+                                    a.shape)
+    assert via_dense.fingerprint() == via_coo.fingerprint()
+
+
+def test_fingerprint_tracks_content_not_identity(planted):
+    fp = planted.fingerprint()
+    assert fp == planted.fingerprint()  # stable
+    mutated = SparseMatrix(indptr=planted.indptr,
+                           indices=planted.indices,
+                           data=planted.data * 1.0000001,
+                           shape=planted.shape)
+    assert mutated.fingerprint() != fp
+
+
+def test_validation_rejects_malformed():
+    with pytest.raises(ValueError, match="indptr"):
+        SparseMatrix(indptr=np.array([0, 1]), indices=np.array([0]),
+                     data=np.array([1.0]), shape=(2, 2))
+    with pytest.raises(ValueError, match="out of range"):
+        SparseMatrix(indptr=np.array([0, 1, 1]), indices=np.array([5]),
+                     data=np.array([1.0]), shape=(2, 2))
+    with pytest.raises(ValueError, match="out of range"):
+        SparseMatrix.from_coo([0], [9], [1.0], shape=(2, 2))
+
+
+# ---------------------------------------------------------------------
+# tiling queries
+# ---------------------------------------------------------------------
+
+def test_row_block_matches_dense_slice(planted):
+    dense = planted.toarray()
+    block = planted.row_block(40, 100)
+    assert block.shape == (60, planted.shape[1])
+    np.testing.assert_array_equal(block.toarray(), dense[40:100])
+
+
+def test_tile_coo_is_row_local_and_cast(planted):
+    dense = planted.toarray()
+    idx, data = planted.tile_coo(30, 90, np.float32)
+    assert idx.dtype == np.int32 and data.dtype == np.float32
+    rebuilt = np.zeros((60, planted.shape[1]), np.float32)
+    rebuilt[idx[:, 0], idx[:, 1]] = data
+    np.testing.assert_array_equal(rebuilt,
+                                  dense[30:90].astype(np.float32))
+
+
+def test_block_sq_norms_match_dense(planted):
+    dense = planted.toarray()
+    bounds = ((0, 50), (50, 120))
+    got = planted.block_sq_norms(bounds)
+    want = [float((dense[r0:r1].astype(np.float64) ** 2).sum())
+            for r0, r1 in bounds]
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+# ---------------------------------------------------------------------
+# generator
+# ---------------------------------------------------------------------
+
+def test_make_sparse_design_properties():
+    sp = make_sparse_design(200, 50, k=4, density=0.08, seed=0)
+    assert isinstance(sp, SparseMatrix)
+    assert sp.shape == (200, 50)
+    assert sp.density == pytest.approx(0.08, rel=0.2)
+    assert np.all(sp.data > 0)  # non-negative with zeros dropped
+    # deterministic in the seed
+    again = make_sparse_design(200, 50, k=4, density=0.08, seed=0)
+    assert again.fingerprint() == sp.fingerprint()
+    with pytest.raises(ValueError, match="density"):
+        make_sparse_design(10, 10, k=2, density=1.5)
+
+
+# ---------------------------------------------------------------------
+# content addressing through the cache layers
+# ---------------------------------------------------------------------
+
+def test_data_key_hashes_triplets_never_densifies(planted):
+    from nmfx.data_cache import DataCache
+
+    cache = DataCache()
+    key = cache.key_for(planted, np.float32)
+    assert key.fingerprint == planted.fingerprint()
+    mutated = SparseMatrix(indptr=planted.indptr,
+                           indices=planted.indices,
+                           data=planted.data + 1.0,
+                           shape=planted.shape)
+    assert cache.key_for(mutated, np.float32).fingerprint \
+        != key.fingerprint
+
+
+def test_result_cache_key_covers_sparse_content(planted):
+    from nmfx.config import ConsensusConfig, InitConfig
+    from nmfx.result_cache import key_for_array
+
+    ccfg = ConsensusConfig(ks=(2,), restarts=2, seed=1)
+    scfg = SolverConfig(algorithm="mu", max_iter=10)
+    icfg = InitConfig()
+    k1 = key_for_array(planted, scfg, ccfg, icfg)
+    assert k1 == key_for_array(planted, scfg, ccfg, icfg)
+    mutated = SparseMatrix(indptr=planted.indptr,
+                           indices=planted.indices,
+                           data=planted.data + 1.0,
+                           shape=planted.shape)
+    assert key_for_array(mutated, scfg, ccfg, icfg) != k1
+
+
+# ---------------------------------------------------------------------
+# the agreement contract: sparse == densified
+# ---------------------------------------------------------------------
+
+def test_sparse_agrees_with_densified_consensus():
+    """The exactness contract at a planted shape: the BCOO path and the
+    densified twin recover the same cluster structure (ARI at the
+    planted rank) and rank alike (bounded |d rho|)."""
+    from nmfx.agreement import consensus_agreement
+    from nmfx.api import nmfconsensus
+
+    sp = make_sparse_design(150, 36, k=3, density=0.25, seed=9)
+    scfg = SolverConfig(algorithm="mu", max_iter=200)
+    kw = dict(ks=(2, 3), restarts=4, seed=5, use_mesh=False)
+    res_sp = nmfconsensus(sp, solver_cfg=scfg, **kw)
+    res_dn = nmfconsensus(sp.toarray(), solver_cfg=scfg, **kw)
+    rep = consensus_agreement(res_sp, res_dn)
+    assert rep["min_ari"] >= 0.9
+    assert rep["max_rho_gap"] <= 0.1
+
+
+def test_sparse_books_nnz_counters():
+    from nmfx import sparse as sparse_mod
+    from nmfx.api import nmfconsensus
+
+    sp = make_sparse_design(80, 24, k=2, density=0.2, seed=4)
+    nnz0 = sparse_mod._sparse_nnz_total.total()
+    bytes0 = sparse_mod._sparse_nnz_bytes_total.total()
+    nmfconsensus(sp, ks=(2,), restarts=2, seed=1, use_mesh=False,
+                 solver_cfg=SolverConfig(algorithm="mu", max_iter=10))
+    assert sparse_mod._sparse_nnz_total.total() > nnz0
+    assert sparse_mod._sparse_nnz_bytes_total.total() > bytes0
+
+
+def test_legacy_registry_refuses_sparse(tmp_path):
+    from nmfx.api import nmfconsensus
+
+    sp = make_sparse_design(40, 12, k=2, density=0.3, seed=2)
+    with pytest.raises(ValueError, match="durable chunked"):
+        nmfconsensus(sp, ks=(2,), restarts=2, seed=1, use_mesh=False,
+                     checkpoint_dir=str(tmp_path))
+
+
+# ---------------------------------------------------------------------
+# nnz-aware cost model (NMFX009 universe extension)
+# ---------------------------------------------------------------------
+
+def test_tiled_engines_modeled_and_universe_clean():
+    from nmfx.analysis.rules_perf import _live_universe
+    from nmfx.config import TILED_ALGORITHMS
+    from nmfx.obs import costmodel as cm
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    for algo in TILED_ALGORITHMS:
+        assert (algo, "tiled") in cm.engine_universe()
+        assert (algo, "tiled") in cm.covered_engines()
+    assert check_costmodel_coverage(**_live_universe()) == []
+
+
+def test_nmfx009_fires_if_tiled_model_dropped():
+    """Bad universe: a reachable tiled engine without a cost model is
+    exactly the mfu-blind-spot NMFX009 exists to catch."""
+    from nmfx.analysis.rules_perf import _live_universe
+    from nmfx.obs.costmodel import check_costmodel_coverage
+
+    universe = _live_universe()
+    universe["covered"] = frozenset(universe["covered"]) \
+        - {("mu", "tiled")}
+    problems = check_costmodel_coverage(**universe)
+    assert any("tiled" in p and "no cost model" in p for p in problems)
+
+
+def test_sparse_density_scales_data_terms():
+    from nmfx.obs import costmodel as cm
+
+    m, n, k = 400, 100, 5
+    cfg = SolverConfig(algorithm="mu", tile_rows=64)
+    try:
+        cm.set_sparse_density(1.0)
+        dense_f = cm.iteration_flops("mu", "tiled", m, n, k, cfg)
+        dense_b = cm.iteration_bytes("mu", "tiled", m, n, k, cfg)
+        cm.set_sparse_density(0.01)
+        sp_f = cm.iteration_flops("mu", "tiled", m, n, k, cfg)
+        sp_b = cm.iteration_bytes("mu", "tiled", m, n, k, cfg)
+    finally:
+        cm.set_sparse_density(1.0)
+    # data-sized terms scale with density; k-sized terms stay dense
+    assert sp_f < dense_f
+    assert sp_f > 4.0 * k * k * (m + n) - 1  # floor: the Gram terms
+    assert sp_b < dense_b
+    with pytest.raises(ValueError, match="density"):
+        cm.set_sparse_density(1.5)
+
+
+def test_sparse_density_hint_validated():
+    from nmfx.obs import costmodel as cm
+
+    assert cm.sparse_density() == 1.0
+    cm.set_sparse_density(0.25)
+    assert cm.sparse_density() == 0.25
+    cm.set_sparse_density(1.0)

@@ -1,0 +1,291 @@
+"""Out-of-core tile pipeline (ISSUE 17 tentpole): plan determinism,
+the in-core bit-identity contract, prefetch inertness, and the cache/
+fingerprint key interaction.
+
+The acceptance property: where A fits in-core (one tile), the tiled
+sweep is BIT-IDENTICAL to the dense sweep — sweep() delegates a
+single-tile dense input back to the in-core path with ``tile_rows``
+stripped, so identity is by construction, and these tests pin that the
+construction holds per engine family. Multi-tile runs change the Gram
+reduction order (f32 accumulation in fixed tile order), so their
+contract is prefetch-toggle bit-identity (overlap must never change
+math) plus statistical agreement with the dense result. Heavy engine
+variants carry the ``slow`` marker; tier-1 keeps the smallest shapes.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from nmfx import tiles
+from nmfx.api import nmfconsensus
+from nmfx.config import TILED_ALGORITHMS, SolverConfig
+
+KW = dict(ks=(2, 3), restarts=4, seed=5, use_mesh=False)
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    from nmfx.datasets import two_group_matrix
+
+    return two_group_matrix(n_genes=60, n_per_group=10, seed=7)
+
+
+@pytest.fixture(autouse=True)
+def _tile_globals_restored():
+    yield
+    tiles.set_tile_budget_bytes(None)
+    tiles.set_tile_prefetch(True)
+
+
+def assert_bit_identical(got, ref):
+    assert set(got.per_k) == set(ref.per_k)
+    for k in ref.per_k:
+        s, q = got.per_k[k], ref.per_k[k]
+        for field in ("consensus", "membership", "order", "iterations",
+                      "dnorms", "stop_reasons", "best_w", "best_h"):
+            sv = np.ascontiguousarray(np.asarray(getattr(s, field)))
+            qv = np.ascontiguousarray(np.asarray(getattr(q, field)))
+            assert sv.shape == qv.shape and sv.dtype == qv.dtype \
+                and sv.tobytes() == qv.tobytes(), f"{field} k={k}"
+        assert s.rho == q.rho, f"rho k={k}"
+
+
+# ---------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------
+
+def test_plan_boundaries_cover_matrix_exactly():
+    plan = tiles.TilePlan(m=100, n=40, tile_rows=30)
+    assert plan.n_tiles == 4
+    assert plan.boundaries == ((0, 30), (30, 60), (60, 90), (90, 100))
+    assert plan.boundaries[-1][1] == plan.m
+
+
+def test_plan_clamps_tile_rows_to_m():
+    plan = tiles.TilePlan(m=10, n=4, tile_rows=64)
+    assert plan.tile_rows == 10 and plan.n_tiles == 1
+
+
+def test_plan_rejects_degenerate():
+    with pytest.raises(ValueError, match="degenerate"):
+        tiles.TilePlan(m=0, n=4, tile_rows=1)
+    with pytest.raises(ValueError, match="tile_rows"):
+        tiles.TilePlan(m=4, n=4, tile_rows=0)
+
+
+def test_resolve_auto_sizes_two_buffers_to_budget():
+    # budget fits 2 buffers of 25 rows x 10 cols x 4 bytes
+    rows = tiles.resolve_tile_rows("auto", m=200, n=10, itemsize=4,
+                                   budget=2 * 25 * 10 * 4)
+    assert rows == 25
+    assert tiles.resolve_tile_rows(999, m=40, n=10, itemsize=4) == 40
+    with pytest.raises(ValueError, match="resolve"):
+        tiles.resolve_tile_rows("huge", m=40, n=10, itemsize=4)
+
+
+def test_budget_override_feeds_plan_for(small_data):
+    itemsize = 4  # float32 solve dtype
+    n = small_data.shape[1]
+    tiles.set_tile_budget_bytes(2 * 16 * n * itemsize)
+    scfg = SolverConfig(algorithm="mu", tile_rows="auto")
+    plan = tiles.plan_for(small_data, scfg)
+    assert plan.tile_rows == 16
+    assert plan.n_tiles == -(-small_data.shape[0] // 16)
+    # identical inputs -> identical plan (determinism: the plan is part
+    # of the checkpoint fingerprint)
+    assert tiles.plan_for(small_data, scfg) == plan
+    assert plan.as_meta()["n_tiles"] == plan.n_tiles
+
+
+def test_config_rejects_untileable_combinations():
+    with pytest.raises(ValueError, match="tile_rows"):
+        SolverConfig(algorithm="als", tile_rows=8)
+    with pytest.raises(ValueError, match="tile_rows"):
+        SolverConfig(algorithm="mu", backend="pallas", tile_rows=8)
+    with pytest.raises(ValueError, match="tile_rows"):
+        SolverConfig(algorithm="mu", tile_rows=True)
+    assert "als" not in TILED_ALGORITHMS and "kl" not in TILED_ALGORITHMS
+
+
+# ---------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------
+
+def test_tile_rows_resolves_tiled_family_and_disables_grid():
+    from nmfx.sweep import grid_exec_ok, resolve_engine_family
+
+    scfg = SolverConfig(algorithm="mu", tile_rows=8)
+    assert resolve_engine_family(scfg, None) == "tiled"
+    assert not grid_exec_ok(scfg, None)
+
+
+def test_base_solve_refuses_tile_rows(small_data):
+    from nmfx.solvers import base
+
+    a32 = np.asarray(small_data, np.float32)
+    m, n = a32.shape
+    rng = np.random.default_rng(0)
+    w0 = rng.uniform(0.1, 1.0, (m, 2)).astype(np.float32)
+    h0 = rng.uniform(0.1, 1.0, (2, n)).astype(np.float32)
+    scfg = SolverConfig(algorithm="mu", max_iter=5, tile_rows=8)
+    with pytest.raises(ValueError, match="tile_rows"):
+        base.solve(a32, w0, h0, scfg)
+
+
+# ---------------------------------------------------------------------
+# the in-core contract: one tile == dense, bitwise
+# ---------------------------------------------------------------------
+
+ENGINES = [
+    pytest.param(SolverConfig(algorithm="mu", max_iter=30,
+                              backend="packed"), id="mu-packed"),
+    pytest.param(SolverConfig(algorithm="hals", max_iter=30),
+                 id="hals"),
+]
+
+ENGINES_SLOW = [
+    pytest.param(SolverConfig(algorithm="mu", max_iter=30,
+                              backend="vmap"), id="mu-vmap"),
+]
+
+
+def _delegation_roundtrip(small_data, scfg):
+    ref = nmfconsensus(small_data, solver_cfg=scfg, **KW)
+    one_tile = dataclasses.replace(scfg,
+                                   tile_rows=small_data.shape[0])
+    got = nmfconsensus(small_data, solver_cfg=one_tile, **KW)
+    assert_bit_identical(got, ref)
+
+
+@pytest.mark.parametrize("scfg", ENGINES)
+def test_single_tile_delegates_bit_identical(small_data, scfg):
+    _delegation_roundtrip(small_data, scfg)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scfg", ENGINES_SLOW)
+def test_single_tile_delegates_bit_identical_slow(small_data, scfg):
+    _delegation_roundtrip(small_data, scfg)
+
+
+# ---------------------------------------------------------------------
+# multi-tile: prefetch inertness + dense agreement
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("algorithm", sorted(TILED_ALGORITHMS))
+def test_prefetch_toggle_is_bit_inert(small_data, algorithm):
+    """Double-buffered streaming reorders TRANSFERS, never math: the
+    multi-tile sweep with prefetch off must match prefetch on bitwise."""
+    scfg = SolverConfig(algorithm=algorithm, max_iter=30, tile_rows=16)
+    on = nmfconsensus(small_data, solver_cfg=scfg, **KW)
+    tiles.set_tile_prefetch(False)
+    off = nmfconsensus(small_data, solver_cfg=scfg, **KW)
+    tiles.set_tile_prefetch(True)
+    assert_bit_identical(on, off)
+
+
+def test_multi_tile_agrees_with_dense(small_data):
+    """Multi-tile Gram accumulation is a different f32 summation order,
+    so the dense contract is agreement, not bit-identity."""
+    from nmfx.agreement import consensus_agreement
+
+    scfg = SolverConfig(algorithm="mu", max_iter=200)
+    dense = nmfconsensus(small_data, solver_cfg=scfg, **KW)
+    tiled = nmfconsensus(
+        small_data,
+        solver_cfg=dataclasses.replace(scfg, tile_rows=16), **KW)
+    rep = consensus_agreement(tiled, dense)
+    assert rep["min_ari"] >= 0.9
+    assert rep["max_rho_gap"] <= 0.1
+
+
+def test_multi_tile_books_stream_counters(small_data):
+    passes0 = tiles._tile_passes_total.value()
+    h2d0 = tiles._tile_h2d_bytes_total.value()
+    scfg = SolverConfig(algorithm="mu", max_iter=20, tile_rows=16)
+    nmfconsensus(small_data, solver_cfg=scfg, **KW)
+    assert tiles._tile_passes_total.value() > passes0
+    assert tiles._tile_h2d_bytes_total.value() > h2d0
+
+
+# ---------------------------------------------------------------------
+# cache/fingerprint key interaction (ISSUE 17 satellite): tile_rows is
+# a numerics-affecting field and must reach every identity layer
+# ---------------------------------------------------------------------
+
+def test_tile_rows_in_exec_and_persist_keys():
+    from nmfx.exec_cache import persist_key_fields, solver_key_fields
+
+    assert "tile_rows" in solver_key_fields()
+    assert "tile_rows" in persist_key_fields()
+    # two configs differing only in tile_rows must never alias one
+    # cached executable (in-memory key = dataclass hash/eq) nor one
+    # disk entry (persistent key = dataclass repr)
+    a = SolverConfig(algorithm="mu", tile_rows=8)
+    b = SolverConfig(algorithm="mu", tile_rows=16)
+    assert a != b and hash(a) != hash(b)
+    assert repr(a) != repr(b)
+
+
+def test_tile_rows_in_registry_fingerprint_fields():
+    from nmfx.registry import fingerprint_solver_fields
+
+    assert "tile_rows" in fingerprint_solver_fields()
+
+
+def test_nmfx001_live_universe_covers_tile_rows():
+    """Clean twin: the real config/exec-cache/registry triple passes
+    NMFX001 with tile_rows present everywhere."""
+    from nmfx.analysis.rules_config import (_live_universe,
+                                            check_config_coverage)
+
+    universe = _live_universe()
+    assert "tile_rows" in universe["solver_fields"]
+    assert check_config_coverage(**universe) == []
+
+
+def test_nmfx001_fires_if_tile_rows_leaves_bucket_key():
+    """Bad universe: dropping tile_rows from the exec-cache bucket key
+    (what a compare=False regression would do) must fire NMFX001 —
+    a tiled and an in-core config would otherwise share an executable."""
+    from nmfx.analysis.rules_config import (_live_universe,
+                                            check_config_coverage)
+
+    universe = _live_universe()
+    universe["exec_key_covered"] = frozenset(
+        universe["exec_key_covered"]) - {"tile_rows"}
+    problems = check_config_coverage(**universe)
+    assert any("tile_rows" in p and "bucket key" in p for p in problems)
+
+
+def test_nmfx001_fires_if_tile_rows_leaves_persist_key():
+    from nmfx.analysis.rules_config import (_live_universe,
+                                            check_config_coverage)
+
+    universe = _live_universe()
+    universe["persist_key_covered"] = frozenset(
+        universe["persist_key_covered"]) - {"tile_rows"}
+    problems = check_config_coverage(**universe)
+    assert any("tile_rows" in p and "persistent" in p for p in problems)
+
+
+def test_checkpoint_fingerprint_embeds_tile_plan(small_data):
+    """Two tiled runs with different plans must cold-start each other's
+    ledgers: the fingerprint hashes the resolved TilePlan meta."""
+    from nmfx.checkpoint import _fingerprint
+    from nmfx.config import ConsensusConfig, InitConfig
+
+    ccfg = ConsensusConfig(ks=(2, 3), restarts=4, seed=5)
+    icfg = InitConfig()
+    a32 = np.asarray(small_data, np.float32)
+    fp8 = _fingerprint(a32, ccfg,
+                       SolverConfig(algorithm="mu", tile_rows=8), icfg)
+    fp16 = _fingerprint(a32, ccfg,
+                        SolverConfig(algorithm="mu", tile_rows=16),
+                        icfg)
+    fp_dense = _fingerprint(a32, ccfg, SolverConfig(algorithm="mu"),
+                            icfg)
+    assert fp8 != fp16
+    assert fp8 != fp_dense and fp16 != fp_dense
