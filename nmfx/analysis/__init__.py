@@ -21,6 +21,16 @@ NMFX007    checkpoint-manifest coverage (the durable sweep ledger's
            resume-safety fingerprint, nmfx/checkpoint.py)
 NMFX008    fault-site flight-recorder coverage (every registered fault
            site reaches the crash postmortem, nmfx/obs/flight.py)
+NMFX009    engine-family cost-model coverage (nmfx/obs/costmodel.py)
+NMFX012    guarded state: attributes declared via nmfx.guards are only
+           accessed under their owning lock (concurrency layer)
+NMFX013    lock order: the static lock-acquisition graph stays
+           cycle-free (deadlock freedom; cross-validated at runtime by
+           nmfx/analysis/witness.py in the threaded test suites)
+NMFX014    future-resolution completeness: every owned Future resolves,
+           transfers, or is unpublished on every exception path
+NMFX015    thread lifecycle: every Thread/Timer is daemonized or
+           provably joined on its owner's close path
 NMFX101    engine jaxpr stays f32 under x64 parity (jaxpr layer)
 NMFX102    no device_put inside engine loop bodies (jaxpr layer)
 =========  ==============================================================
@@ -51,6 +61,7 @@ from nmfx.analysis import rules_alias   # noqa: F401  (NMFX003)
 from nmfx.analysis import rules_handlers  # noqa: F401  (NMFX006)
 from nmfx.analysis import rules_obs     # noqa: F401  (NMFX008)
 from nmfx.analysis import rules_perf    # noqa: F401  (NMFX009)
+from nmfx.analysis import concurrency   # noqa: F401  (NMFX012-015)
 from nmfx.analysis import jaxpr_rules   # noqa: F401  (NMFX101/102)
 
 __all__ = ["run", "RULES", "Finding", "Rule", "register", "active",

@@ -32,15 +32,29 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--write-baseline", metavar="FILE", default=None,
                     help="write the current unsuppressed findings as a "
                          "baseline file and exit 0")
+    ap.add_argument("--update-baseline", metavar="FILE", nargs="?",
+                    const="lint_baseline.json", default=None,
+                    help="regenerate a baseline file IN PLACE from the "
+                         "current findings, preserving each surviving "
+                         "record's required 'reason' field (default "
+                         "target: lint_baseline.json); exits 0")
     args = ap.parse_args(argv)
+
+    import os
 
     from nmfx.analysis import active, run
 
     rule_ids = (None if args.rules is None
                 else tuple(s.strip() for s in args.rules.split(",")
                            if s.strip()))
+    baseline_path = args.baseline
+    if (baseline_path is None and args.update_baseline is not None
+            and os.path.exists(args.update_baseline)):
+        # refreshing in place: the current file's records must be
+        # treated as tolerated (and re-recorded), not re-reported
+        baseline_path = args.update_baseline
     try:
-        findings = run(args.paths, baseline=args.baseline,
+        findings = run(args.paths, baseline=baseline_path,
                        jaxpr=not args.no_jaxpr, rule_ids=rule_ids)
     except FileNotFoundError as e:
         print(f"nmfx-lint: {e}", file=sys.stderr)
@@ -48,6 +62,46 @@ def main(argv: "list[str] | None" = None) -> int:
 
     errors = active(findings, "error")
     warnings = active(findings, "warning")
+
+    if args.update_baseline:
+        target = args.update_baseline
+        old: "list[dict]" = []
+        if os.path.exists(target):
+            with open(target) as fh:
+                old = json.load(fh)
+        # reasons survive regeneration: exact (file, rule, line) match
+        # first, then (file, rule) so a finding that merely moved keeps
+        # its recorded justification instead of silently losing it
+        exact: "dict[tuple, str]" = {}
+        loose: "dict[tuple, str]" = {}
+        for r in old:
+            reason = str(r.get("reason") or "")
+            if not reason:
+                continue
+            fkey = (os.path.abspath(str(r.get("file"))), r.get("rule"))
+            exact[fkey + (r.get("line"),)] = reason
+            loose.setdefault(fkey, reason)
+        records = []
+        for f in findings:
+            if f.suppressed:
+                continue
+            fkey = (os.path.abspath(f.file), f.rule_id)
+            records.append({"file": f.file, "rule": f.rule_id,
+                            "line": f.line,
+                            "reason": exact.get(fkey + (f.line,),
+                                                loose.get(fkey, ""))})
+        records.sort(key=lambda r: (r["file"], r["line"], r["rule"]))
+        with open(target, "w") as fh:
+            json.dump(records, fh, indent=2)
+            fh.write("\n")
+        missing = sum(1 for r in records if not r["reason"])
+        msg = (f"nmfx-lint: rewrote {target} with {len(records)} "
+               "baseline record(s)")
+        if missing:
+            msg += (f"; {missing} lack a 'reason' — every tolerated "
+                    "finding needs one before review")
+        print(msg)
+        return 0
 
     if args.write_baseline:
         # include findings the CURRENT --baseline already tolerates —

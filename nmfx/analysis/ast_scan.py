@@ -41,6 +41,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
+from collections import deque
 from typing import Iterable
 
 #: callables whose function-typed arguments are traced
@@ -72,23 +73,40 @@ def _dotted(node: ast.AST) -> "str | None":
     return None
 
 
-def own_nodes(stmt: ast.stmt) -> "Iterable[ast.AST]":
+def own_nodes(stmt: ast.stmt) -> "list[ast.AST]":
     """The statement's OWN subtree — header expressions included, nested
     statement lists excluded. Statement-ordered rules (NMFX003's
-    donation tracking, NMFX004's key threading) flatten compound
-    statements into source order; walking the full subtree at the
-    compound's position would process nested events OUT of order (a
-    donation deep in the body would precede a read that textually
-    comes before it)."""
-    nested: "set[int]" = set()
+    donation tracking, NMFX004's key threading, the NMFX012-015
+    concurrency scans) flatten compound statements into source order;
+    walking the full subtree at the compound's position would process
+    nested events OUT of order (a donation deep in the body would
+    precede a read that textually comes before it).
+
+    Memoized on the node (one project = one parse, trees are
+    immutable for the run's lifetime) and pruned at the excluded
+    statement lists instead of filtering a full ``ast.walk`` — every
+    rule shares the same per-statement index, which is where the bulk
+    of a multi-rule run's time went before the cache. Returns in
+    ``ast.walk`` (breadth-first) order."""
+    cached = getattr(stmt, "_nmfx_own_nodes", None)
+    if cached is not None:
+        return cached
+    skip: "set[int]" = set()
     for field in ("body", "orelse", "finalbody"):
-        for child in getattr(stmt, field, []) or []:
-            nested.update(id(n) for n in ast.walk(child))
-    for handler in getattr(stmt, "handlers", []) or []:
-        nested.update(id(n) for n in ast.walk(handler))
-    for node in ast.walk(stmt):
-        if id(node) not in nested:
-            yield node
+        children = getattr(stmt, field, None)
+        if isinstance(children, list):
+            skip.update(id(c) for c in children)
+    skip.update(id(h) for h in getattr(stmt, "handlers", []) or [])
+    out: "list[ast.AST]" = []
+    queue: "deque[ast.AST]" = deque([stmt])
+    while queue:
+        node = queue.popleft()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if id(child) not in skip:
+                queue.append(child)
+    stmt._nmfx_own_nodes = out
+    return out
 
 
 def stores(stmt: ast.stmt) -> "set[str]":

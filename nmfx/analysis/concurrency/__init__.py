@@ -1,0 +1,16 @@
+"""Concurrency-discipline rules (NMFX012-015) for the threaded service
+tier, built on one shared statically-derived model (``model.py``) and
+cross-validated at runtime by the instrumented-lock witness
+(``nmfx/analysis/witness.py``). See docs/analysis.md for the incident
+behind each rule."""
+
+from nmfx.analysis.concurrency.model import (ConcurrencyModel,
+                                             concurrency_model)
+
+# registering imports — each populates nmfx.analysis.core.RULES
+from nmfx.analysis.concurrency import rules_guarded    # noqa: F401
+from nmfx.analysis.concurrency import rules_lockorder  # noqa: F401
+from nmfx.analysis.concurrency import rules_futures    # noqa: F401
+from nmfx.analysis.concurrency import rules_threads    # noqa: F401
+
+__all__ = ["ConcurrencyModel", "concurrency_model"]

@@ -1,0 +1,309 @@
+"""Runtime lock-order witness: instrumented locks for the threaded
+test suites.
+
+The static rules (NMFX012/013, ``nmfx/analysis/concurrency/``) derive
+a lock-acquisition order graph from the source; this module is the
+other half of the contract — it observes the orders threads ACTUALLY
+acquire locks in while the serve/router/replica/harvest suites run,
+and
+
+* fails a test when two lock creation sites are acquired in both
+  orders (a dynamic inversion — the precondition of every real
+  deadlock the static graph exists to prevent), or when an observed
+  order inverts an edge the static graph already pinned;
+* exposes :func:`observed_edges` so a test can assert the static
+  graph's completeness against real executions (every observed edge
+  between statically-known locks must be a static edge — see
+  tests/test_witness.py).
+
+Arming (``arm()``/``disarm()``, or the :func:`armed` context manager;
+tests/conftest.py arms it per-test for the threaded suites) patches
+``threading.Lock``/``threading.RLock`` with factories that wrap locks
+CREATED BY NMFX OR TEST CODE in recording proxies — creation sites
+are classified by caller filename, so third-party locks (jax,
+concurrent.futures internals) pass through untouched and pay one
+frame inspection at creation, nothing per acquisition.
+
+Known blind spots, by design:
+
+* locks created BEFORE arming are never wrapped — module-level
+  singletons (``nmfx.faults._lock``, the flight-recorder and metrics
+  registry locks) are born at import time and stay invisible; the
+  static rules cover them.
+* ``threading.Condition()`` with no argument allocates its RLock from
+  inside ``threading.py`` — a non-nmfx creation site, unwrapped.
+  ``Condition(self._lock)`` on a wrapped lock IS tracked: the
+  condition's release/reacquire protocol routes through the proxy's
+  plain ``acquire``/``release`` (the CPython fallback paths, since
+  neither the proxy nor the raw C lock exposes ``_release_save``/
+  ``_acquire_restore``/``_is_owned``).
+
+Edges are keyed by lock CREATION site ``(abspath, lineno)`` — the
+same identity the static model's ``LockInfo.site`` records — so many
+instances of one class collapse onto one node, exactly like the
+static graph's ``mod.Class._attr`` keys.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+__all__ = ["arm", "disarm", "armed", "reset", "is_armed",
+           "observed_edges", "violations", "check_static_inversions",
+           "static_order_edges"]
+
+#: originals, captured at import of THIS module (before any patching)
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_armed_depth = 0
+
+#: (site_a, site_b) -> (thread name, example acquire site pair count)
+_edges: "dict[tuple, int]" = {}
+#: recorded inversions: dicts with kind/site_a/site_b/thread
+_violations: "list[dict]" = []
+_state_lock = _REAL_LOCK()
+_tls = threading.local()
+
+
+def _held() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+class _LockWitness:
+    """Proxy around one lock object, recording acquisition order by
+    creation site. Context-manager and acquire/release compatible;
+    everything else delegates to the wrapped lock."""
+
+    __slots__ = ("_inner", "site", "reentrant")
+
+    def __init__(self, inner, site: "tuple[str, int]", reentrant: bool):
+        self._inner = inner
+        self.site = site
+        self.reentrant = reentrant
+
+    # -- the recorded protocol ------------------------------------------
+    def acquire(self, *args, **kwargs):
+        blocking = bool(args[0]) if args else kwargs.get("blocking", True)
+        if blocking:
+            self._pre_acquire()
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            _held().append(self)
+            self._record_edges()
+        return got
+
+    def release(self):
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # -- recording ------------------------------------------------------
+    def _pre_acquire(self) -> None:
+        if self.reentrant:
+            return
+        for h in _held():
+            if h is self:
+                # a plain Lock re-acquired by its owner: guaranteed
+                # self-deadlock. Record BEFORE blocking so the hang's
+                # postmortem names the site, then block as the real
+                # lock would — the witness never changes semantics.
+                with _state_lock:
+                    _violations.append({
+                        "kind": "self-deadlock",
+                        "site_a": self.site, "site_b": self.site,
+                        "thread": threading.current_thread().name})
+                return
+
+    def _record_edges(self) -> None:
+        me = self.site
+        seen = set()
+        for h in _held():
+            if h is self or h.site == me or h.site in seen:
+                continue
+            seen.add(h.site)
+            edge = (h.site, me)
+            with _state_lock:
+                _edges[edge] = _edges.get(edge, 0) + 1
+                if (me, h.site) in _edges:
+                    _violations.append({
+                        "kind": "inversion",
+                        "site_a": h.site, "site_b": me,
+                        "thread": threading.current_thread().name})
+
+
+def _wrap_site(depth: int) -> "tuple[str, int] | None":
+    """The creation call site when it belongs to nmfx or its test
+    suite, else None (leave the lock unwrapped)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:  # pragma: no cover - no caller frame
+        return None
+    fn = frame.f_globals.get("__file__") or frame.f_code.co_filename
+    fn = os.path.abspath(fn)
+    parts = fn.replace("\\", "/")
+    if "/nmfx/analysis/" in parts:
+        return None  # never instrument the instrumentation
+    if "/nmfx/" in parts or "/tests/" in parts:
+        return (fn, frame.f_lineno)
+    return None
+
+
+def _patched_lock():
+    inner = _REAL_LOCK()
+    site = _wrap_site(2)
+    if site is None:
+        return inner
+    return _LockWitness(inner, site, reentrant=False)
+
+
+def _patched_rlock():
+    inner = _REAL_RLOCK()
+    site = _wrap_site(2)
+    if site is None:
+        return inner
+    return _LockWitness(inner, site, reentrant=True)
+
+
+# -- arming ------------------------------------------------------------
+def arm() -> None:
+    """Start wrapping newly created nmfx locks (idempotent/nested)."""
+    global _armed_depth
+    with _state_lock:
+        _armed_depth += 1
+        if _armed_depth == 1:
+            threading.Lock = _patched_lock
+            threading.RLock = _patched_rlock
+
+
+def disarm() -> None:
+    """Undo one :func:`arm`. Locks wrapped while armed keep recording
+    until garbage-collected — disarming only stops wrapping NEW ones,
+    so a server outliving its test keeps a consistent proxy."""
+    global _armed_depth
+    with _state_lock:
+        if _armed_depth == 0:
+            return
+        _armed_depth -= 1
+        if _armed_depth == 0:
+            threading.Lock = _REAL_LOCK
+            threading.RLock = _REAL_RLOCK
+
+
+def is_armed() -> bool:
+    return _armed_depth > 0
+
+
+class armed:
+    """``with witness.armed():`` — arm for the block, disarm after."""
+
+    def __enter__(self):
+        arm()
+        return sys.modules[__name__]
+
+    def __exit__(self, *exc):
+        disarm()
+        return False
+
+
+def reset() -> None:
+    """Clear observed edges and violations (per-test isolation)."""
+    with _state_lock:
+        _edges.clear()
+        _violations.clear()
+
+
+def observed_edges() -> "dict[tuple, int]":
+    """``{(site_a, site_b): count}`` — site is the lock's creation
+    ``(abspath, lineno)``; the edge means a thread acquired b while
+    holding a."""
+    with _state_lock:
+        return dict(_edges)
+
+
+def violations() -> "list[dict]":
+    with _state_lock:
+        return list(_violations)
+
+
+# -- static cross-check ------------------------------------------------
+_static_cache: "dict | None" = None
+
+
+def static_order_edges() -> "dict[tuple, tuple]":
+    """The static model's order graph translated to creation-site
+    keys: ``{(site_a, site_b): (key_a, key_b)}``. Built once per
+    process (one AST pass over the package)."""
+    global _static_cache
+    if _static_cache is not None:
+        return _static_cache
+    from nmfx.analysis.ast_scan import load_project
+    from nmfx.analysis.concurrency.model import concurrency_model
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    model = concurrency_model(load_project([pkg_dir]))
+    site_of = {key: (os.path.abspath(li.site[0]), li.site[1])
+               for key, li in model.lock_index.items()}
+    out = {}
+    for (a, b) in model.order_edges:
+        sa, sb = site_of.get(a), site_of.get(b)
+        if sa is not None and sb is not None:
+            out[(sa, sb)] = (a, b)
+    _static_cache = out
+    return out
+
+
+def check_static_inversions() -> "list[dict]":
+    """Observed edges whose REVERSE is a static-graph edge — a runtime
+    order contradicting the order the source pins. Returned, not
+    raised; the conftest fixture asserts on it at teardown."""
+    observed = observed_edges()
+    if not observed:
+        return []  # nothing to cross-check; skip the model build
+    static = static_order_edges()
+    out = []
+    for (sa, sb) in observed:
+        if (sb, sa) in static:
+            ka, kb = static[(sb, sa)]
+            out.append({"kind": "static-inversion",
+                        "site_a": sa, "site_b": sb,
+                        "static_edge": f"{kb} -> {ka}"})
+    return out
+
+
+def render(problems: "list[dict]") -> str:
+    def site(s):
+        return f"{os.path.relpath(s[0])}:{s[1]}"
+
+    lines = []
+    for v in problems:
+        head = (f"lock-order {v['kind']}: "
+                f"{site(v['site_a'])} -> {site(v['site_b'])}")
+        if v.get("thread"):
+            head += f"  [thread {v['thread']}]"
+        if v.get("static_edge"):
+            head += f"  (static graph pins {v['static_edge']})"
+        lines.append(head)
+    return "\n".join(lines)

@@ -70,6 +70,7 @@ import numpy as np
 
 from nmfx.config import (CheckpointConfig, ConsensusConfig, InitConfig,
                          SolverConfig)
+from nmfx.guards import guarded_by
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
 from nmfx.obs import trace as _trace
@@ -315,6 +316,7 @@ def atomic_save_npz(path: str, arrays: dict) -> None:
         raise
 
 
+@guarded_by("_pending_lock", "_pending")
 class SweepCheckpoint:
     """Directory of per-(rank, restart-chunk) completion records behind
     one content-addressed manifest — the durable sweep ledger."""

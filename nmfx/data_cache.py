@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nmfx.guards import guarded_by
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
 
@@ -159,6 +160,7 @@ class _Entry:
         self.nbytes = nbytes
 
 
+@guarded_by("_lock", "_entries", "hits", "misses", "evictions")
 class DataCache:
     """LRU of device-resident input matrices keyed by content
     fingerprint + placement (:class:`DataKey`).

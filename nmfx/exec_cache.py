@@ -99,6 +99,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from nmfx.config import (ConsensusConfig, ExecCacheConfig, InitConfig,
                          SolverConfig)
+from nmfx.guards import guarded_by
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
 from nmfx.sweep import (KSweepOutput, _attribute_dispatch, _noop_rank,
@@ -292,6 +293,9 @@ class WarmTask:
         return self._box["report"]
 
 
+@guarded_by("_lock", "_entries", "_entries_cap", "_inflight", "_warned",
+            "_warm_failures", "hits", "misses", "evictions",
+            "persist_hits", "persist_misses", "disk_evictions")
 class ExecCache:
     """LRU of AOT-compiled, shape-bucketed sweep executables, optionally
     backed by a persistent on-disk store (``ExecCacheConfig.cache_dir``).

@@ -1,0 +1,68 @@
+"""Concurrency-discipline declarations: which lock owns which state.
+
+The threaded service tier (serve scheduler, router maintenance,
+replica heartbeats, harvest workers, cache compile pools) documents
+its locking discipline in comments; this module turns those comments
+into machine-checkable declarations. ``nmfx-lint``'s NMFX012 rule
+(``nmfx/analysis/concurrency/``) reads them SYNTACTICALLY — a class
+decorated ``@guarded_by("_lock", "_queue", ...)`` promises that every
+access to ``self._queue`` outside a ``with self._lock`` scope is a
+bug — and the runtime lock-order witness
+(``nmfx/analysis/witness.py``) cross-validates the derived lock graph
+against actual acquisition orders in the threaded test suites.
+
+Usage::
+
+    from nmfx.guards import guarded_by
+
+    @guarded_by("_lock", "_queue", "_inflight", "counters")
+    @guarded_by("_tracked_lock", "_tracked", "_followers")
+    class NMFXServer: ...
+
+Stacked decorators declare one guarded set per lock. A
+``threading.Condition`` built on a declared lock counts as that lock
+(the linter resolves the alias from the ``Condition(self._lock)``
+construction site). Module-level state is declared with a top-level
+call::
+
+    module_guarded("_warned_lock", "_warned")
+
+Both forms are runtime no-ops beyond recording metadata — they import
+nothing from the analysis package and add zero per-access overhead.
+"""
+
+from __future__ import annotations
+
+#: module dotted path -> {lock name -> guarded global names}; filled by
+#: :func:`module_guarded` at import time of the declaring module
+GUARDED_BY: "dict[str, dict[str, tuple[str, ...]]]" = {}
+
+
+def guarded_by(lock_attr: str, *attrs: str):
+    """Class decorator: ``attrs`` are instance attributes that must only
+    be accessed while ``self.<lock_attr>`` is held. Metadata lands in
+    ``cls.__nmfx_guarded__`` (lock attr -> guarded attr tuple); the
+    decorated class is returned unchanged."""
+
+    def deco(cls):
+        # copy — a subclass decorating again must not mutate the base's
+        # registry through the inherited reference
+        reg = dict(getattr(cls, "__nmfx_guarded__", {}))
+        reg[lock_attr] = tuple(attrs)
+        cls.__nmfx_guarded__ = reg
+        return cls
+
+    return deco
+
+
+def module_guarded(lock_name: str, *names: str, module: "str | None" = None):
+    """Declare module-level globals guarded by a module-level lock.
+    Call at module top level; the linter reads the call site
+    syntactically, so ``lock_name``/``names`` must be string literals."""
+    import inspect
+
+    if module is None:
+        frame = inspect.currentframe()
+        caller = frame.f_back if frame is not None else None
+        module = caller.f_globals.get("__name__", "?") if caller else "?"
+    GUARDED_BY.setdefault(module, {})[lock_name] = tuple(names)

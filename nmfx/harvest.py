@@ -126,15 +126,20 @@ class HarvestPipeline:
         if k in self._futures:
             raise ValueError(f"rank {k} submitted twice")
         fut: Future = Future()
-        self._futures[k] = fut
-        self._outs[k] = out
-        self._queue.put((k, out, fut))
+        # grow the worker pool BEFORE publishing the future: a failed
+        # thread spawn must surface here, while nothing references the
+        # future yet — spawning after self._futures[k] = fut stranded
+        # the waiter forever when start() raised (the worker just
+        # blocks on queue.get(), so starting it early is free)
         if len(self._threads) < min(self._max_workers,
-                                    len(self._futures)):
+                                    len(self._futures) + 1):
             t = threading.Thread(target=self._work, daemon=True,
                                  name="nmfx-harvest")
             t.start()
             self._threads.append(t)
+        self._futures[k] = fut
+        self._outs[k] = out
+        self._queue.put((k, out, fut))
 
     # -- consumer side ----------------------------------------------------
     def _work(self) -> None:

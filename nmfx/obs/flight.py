@@ -46,6 +46,8 @@ import threading
 import time
 from collections import deque
 
+from nmfx.guards import guarded_by
+
 __all__ = ["FAULT_EVENTS", "FlightRecorder", "configure",
            "default_recorder", "dump", "fault_event_categories",
            "install_signal_dump", "last_dump", "record"]
@@ -100,6 +102,7 @@ def _redact_value(v):
     return s
 
 
+@guarded_by("_lock", "_events", "_recorded", "_dir", "_last_dump")
 class FlightRecorder:
     """Thread-safe bounded event ring + postmortem dump."""
 

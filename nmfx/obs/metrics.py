@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import threading
 
+from nmfx.guards import guarded_by
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "bucket_quantile", "counter", "gauge", "histogram",
            "merge_bucket_state", "registry", "render_prometheus",
@@ -254,6 +256,7 @@ class Histogram(_Metric):
                 for key, st in self._series.items()}
 
 
+@guarded_by("_lock", "_metrics")
 class MetricsRegistry:
     """One namespace of typed instruments. ``counter``/``gauge``/
     ``histogram`` are idempotent get-or-create (re-importing a module

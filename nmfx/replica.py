@@ -61,6 +61,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from nmfx.guards import guarded_by
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
 
@@ -246,6 +247,7 @@ class ThreadReplica:
         directly (uniform surface with :class:`ProcessReplica`)."""
 
 
+@guarded_by("_lock", "_pending", "_read_failures")
 class ProcessReplica:
     """One subprocess replica: the worker (``python -m nmfx.replica``)
     serves spill-format requests from its ``inbox/`` and writes
@@ -437,6 +439,7 @@ class ProcessReplica:
         self.state = "dead"
 
 
+@guarded_by("_lock", "replicas")
 class ReplicaPool:
     """N replicas sharing one pool root + heartbeat ledger.
 
@@ -556,7 +559,9 @@ class ReplicaPool:
         return self.ledger.status(stale_after_s)
 
     def poll(self) -> None:
-        for rep in list(self.replicas.values()):
+        # snapshot under the pool lock: a bare replicas.values() walk
+        # races spawn()/remove() resizing the dict mid-iteration
+        for rep in self.all():
             rep.poll()
 
     def close(self) -> None:

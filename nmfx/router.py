@@ -68,6 +68,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from nmfx.guards import guarded_by
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
 from nmfx.serve import (QueueFull, RequestFailed, ServeError,
@@ -303,6 +304,8 @@ class _Pending:
     ckey_parts: "tuple | None" = None
 
 
+@guarded_by("_lock", "_pending", "_retryq", "_outstanding", "_closed",
+            "_burning", "_coalesce", "_cofollowers", "counters")
 class NMFXRouter:
     """The front door: ``submit()`` with the ``NMFXServer`` surface,
     placed across a :class:`nmfx.replica.ReplicaPool` (see the module

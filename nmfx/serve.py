@@ -76,6 +76,7 @@ from typing import TYPE_CHECKING, Mapping, Protocol, Sequence
 import numpy as np
 
 from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
+from nmfx.guards import guarded_by
 from nmfx.obs import costmodel as _costmodel
 from nmfx.obs import flight as _flight
 from nmfx.obs import metrics as _metrics
@@ -944,6 +945,11 @@ class ExecCacheEngine:
         return [per_req[r.seq] for r in reqs]
 
 
+@guarded_by("_lock", "_queue", "_queued", "_pending_bytes", "_closed",
+            "_paused", "_inflight", "_crash", "_sched_clean", "_down",
+            "_heartbeat")
+@guarded_by("_tracked_lock", "_tracked", "_coalesce", "_followers")
+@guarded_by("_harvest_cond", "_harvest_q", "_harvest_owned")
 class NMFXServer:
     """Async multi-tenant consensus-NMF server over one device.
 

@@ -176,6 +176,48 @@ def _threaded_hang_guard(request):
             pass
 
 
+#: suites where the runtime lock-order witness is armed: every module
+#: that drives the service tier's threads (scheduler/harvesters,
+#:  router maintenance, replica heartbeats, chaos) plus the coalescing
+#: and serve-quality suites that exercise the done-callback paths.
+#: Disable with NMFX_LOCK_WITNESS=0 (e.g. when bisecting a timing
+#: issue the instrumentation could perturb).
+_WITNESS_MODULES = frozenset({
+    "test_serve", "test_serve_quality", "test_harvest", "test_faults",
+    "test_pipeline", "test_router", "test_fleet", "test_coalesce"})
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(request):
+    """Arm the instrumented-lock witness (nmfx.analysis.witness) for
+    the threaded suites: locks the test creates record their real
+    acquisition orders, and the teardown fails the test on any dynamic
+    inversion (two creation sites acquired in both orders — the
+    precondition of every real deadlock) or any order contradicting
+    the static NMFX013 graph. docs/analysis.md "Runtime witness"."""
+    import os
+
+    mod = request.node.fspath.purebasename \
+        if request.node.fspath else ""
+    if (mod not in _WITNESS_MODULES
+            or os.environ.get("NMFX_LOCK_WITNESS", "1") == "0"):
+        yield
+        return
+    from nmfx.analysis import witness
+
+    witness.reset()
+    witness.arm()
+    try:
+        yield
+    finally:
+        witness.disarm()
+        problems = witness.violations() + witness.check_static_inversions()
+        witness.reset()
+    assert not problems, (
+        "lock-order witness caught an inversion:\n"
+        + witness.render(problems))
+
+
 @pytest.fixture(autouse=True)
 def _tracer_state_isolated():
     """A test that enables the process-wide structured tracer

@@ -182,3 +182,28 @@ def test_pipeline_close_idempotent_and_rejects_late_submit():
 def test_pipeline_workers_validation():
     with pytest.raises(ValueError, match="workers"):
         HarvestPipeline(workers=0)
+
+
+def test_pipeline_failed_worker_spawn_strands_nothing(monkeypatch):
+    """NMFX014 regression (the stranded-future gap the concurrency
+    lint surfaced): a Thread spawn that fails on the first submit must
+    raise out of submit() with NOTHING published — before the fix the
+    future was registered first, so a caller that caught the error and
+    went on to results() hung forever on a waiter no worker would ever
+    resolve."""
+    import threading
+
+    pipe = HarvestPipeline()
+
+    def boom(*a, **kw):
+        raise RuntimeError("no threads today")
+
+    monkeypatch.setattr(threading, "Thread", boom)
+    with pytest.raises(RuntimeError, match="no threads today"):
+        pipe.submit(2, object())
+    # the failed submit left no stranded waiter and no orphaned output
+    assert pipe._futures == {}
+    assert pipe._outs == {}
+    monkeypatch.undo()
+    # results() terminates immediately instead of hanging
+    assert pipe.results() == {}

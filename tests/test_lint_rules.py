@@ -917,6 +917,74 @@ def test_cli_write_baseline_refresh_keeps_records(tmp_path):
     assert json.loads(baseline.read_text()) == first
 
 
+def _update_baseline(path, baseline, env):
+    return subprocess.run(
+        [sys.executable, "-m", "nmfx.analysis", str(path), "--no-jaxpr",
+         "--rules", "NMFX002", "--update-baseline", str(baseline)],
+        capture_output=True, text=True, timeout=240, env=env)
+
+
+def test_cli_update_baseline_round_trip_byte_stable(tmp_path):
+    """--update-baseline regenerates in place; a second run with no
+    source change reproduces the file BYTE for byte (the property that
+    keeps baseline refreshes out of code review noise), and recorded
+    'reason' fields survive the regeneration — including when the
+    finding moved lines."""
+    import os
+
+    path = tmp_path / "bad.py"
+    path.write_text(textwrap.dedent(_ENV_BAD))
+    baseline = tmp_path / "lint_baseline.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    proc = _update_baseline(path, baseline, env)
+    assert proc.returncode == 0, proc.stderr
+    first = baseline.read_bytes()
+    records = json.loads(first)
+    assert len(records) == 1 and records[0]["reason"] == ""
+    assert "lack a 'reason'" in proc.stdout
+
+    # a human records the required reason; regeneration keeps it
+    records[0]["reason"] = "trace-time read audited 2026-08"
+    baseline.write_text(json.dumps(records, indent=2) + "\n")
+    proc = _update_baseline(path, baseline, env)
+    assert proc.returncode == 0
+    again = json.loads(baseline.read_text())
+    assert again[0]["reason"] == "trace-time read audited 2026-08"
+    assert "lack a 'reason'" not in proc.stdout
+
+    # byte-stable round trip from here on
+    stable = baseline.read_bytes()
+    proc = _update_baseline(path, baseline, env)
+    assert proc.returncode == 0
+    assert baseline.read_bytes() == stable
+
+    # the finding moves a line: reason follows via the (file, rule)
+    # fallback instead of resetting to ""
+    path.write_text("\n" + path.read_text())
+    proc = _update_baseline(path, baseline, env)
+    assert proc.returncode == 0
+    moved = json.loads(baseline.read_text())
+    assert moved[0]["line"] == records[0]["line"] + 1
+    assert moved[0]["reason"] == "trace-time read audited 2026-08"
+
+
+def test_cli_update_baseline_drops_fixed_findings(tmp_path):
+    """A fixed finding leaves the baseline on refresh — tolerated debt
+    does not outlive the code it tolerated."""
+    import os
+
+    path = tmp_path / "bad.py"
+    path.write_text(textwrap.dedent(_ENV_BAD))
+    baseline = tmp_path / "lint_baseline.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    assert _update_baseline(path, baseline, env).returncode == 0
+    assert len(json.loads(baseline.read_text())) == 1
+    path.write_text("x = 1\n")  # the defect is gone
+    assert _update_baseline(path, baseline, env).returncode == 0
+    assert json.loads(baseline.read_text()) == []
+
+
 # ---------------------------------------------------------------- NMFX007
 
 def _manifest_universe(**overrides):
@@ -1452,3 +1520,411 @@ def test_nmfx011_rule_registered():
     from nmfx.analysis import RULES
 
     assert "NMFX011" in RULES
+
+
+# ---------------------------------------------------------------- NMFX012
+
+_GUARDED_HEADER = """
+    import threading
+    from nmfx.guards import guarded_by
+
+"""
+
+_GUARDED_CLEAN = _GUARDED_HEADER + """
+    @guarded_by("_lock", "_items", "count")
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self.count = 0
+
+        def push(self, x):
+            with self._lock:
+                self._items.append(x)
+                self.count += 1
+
+        def flush(self):
+            with self._lock:
+                self._drain()
+
+        def _drain(self):
+            # no with: provably called under the lock (fixpoint)
+            self._items.clear()
+            self.count = 0
+"""
+
+_GUARDED_BAD = _GUARDED_HEADER + """
+    @guarded_by("_lock", "_items", "count")
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self.count = 0
+
+        def push(self, x):
+            self._items.append(x)
+            self.count += 1
+"""
+
+
+def test_nmfx012_clean_twin_quiet(tmp_path):
+    """Guarded accesses under the lock — including through a private
+    helper only ever called with the lock held — are clean."""
+    assert _ids(_lint(tmp_path, _GUARDED_CLEAN, ("NMFX012",))) == []
+
+
+def test_nmfx012_unguarded_access_fires(tmp_path):
+    findings = active(_lint(tmp_path, _GUARDED_BAD, ("NMFX012",)))
+    assert [f.rule_id for f in findings] == ["NMFX012", "NMFX012"]
+    assert "self._items" in findings[0].message
+    assert "without it in Box.push" in findings[0].message
+
+
+def test_nmfx012_init_exempt(tmp_path):
+    """__init__ publishes the object (happens-before); bare stores
+    there are not findings — the clean twin's __init__ already passes,
+    and an __init__-only class stays quiet."""
+    src = _GUARDED_HEADER + """
+    @guarded_by("_lock", "_items")
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX012",))) == []
+
+
+def test_nmfx012_stale_declaration_fires(tmp_path):
+    """Declaring a guard lock the class never creates is itself a
+    finding — a silently dead declaration checks nothing."""
+    src = _GUARDED_HEADER + """
+    @guarded_by("_missing_lock", "_items")
+    class Box:
+        def __init__(self):
+            self._items = []
+    """
+    findings = active(_lint(tmp_path, src, ("NMFX012",)))
+    assert len(findings) == 1
+    assert "_missing_lock" in findings[0].message
+
+
+def test_nmfx012_suppression_with_reason(tmp_path):
+    """The standard machinery applies: an inline reasoned suppression
+    silences one access (single-thread confinement the analysis cannot
+    see), and active() goes green."""
+    src = _GUARDED_HEADER + """
+    @guarded_by("_lock", "count")
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def peek(self):
+            return self.count  # nmfx: ignore[NMFX012] -- racy read OK
+    """
+    findings = _lint(tmp_path, src, ("NMFX012",))
+    assert _ids(findings) == []
+    assert any(f.suppressed for f in findings)
+
+
+def test_nmfx012_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX012" in RULES
+
+
+# ---------------------------------------------------------------- NMFX013
+
+def test_nmfx013_clean_consistent_order_quiet(tmp_path):
+    src = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._a:
+                with self._b:
+                    pass
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX013",))) == []
+
+
+def test_nmfx013_inverted_order_cycle_fires(tmp_path):
+    """The PR-7 deadlock shape: the resolver path nests lock -> tracked
+    while the expiry path nests tracked -> lock (via a helper the call
+    graph resolves) — a cycle, i.e. two threads can deadlock."""
+    src = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._tracked_lock = threading.Lock()
+
+        def resolve(self):
+            with self._lock:
+                self._untrack()
+
+        def _untrack(self):
+            with self._tracked_lock:
+                pass
+
+        def expire(self):
+            with self._tracked_lock:
+                with self._lock:
+                    pass
+    """
+    findings = active(_lint(tmp_path, src, ("NMFX013",)))
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+    assert "_lock" in findings[0].message
+
+
+def test_nmfx013_plain_lock_reentry_fires(tmp_path):
+    """A plain Lock re-acquired through a self-call is a guaranteed
+    self-deadlock (the PR-10 SIGTERM incident shape)."""
+    src = """
+    import threading
+
+    class Rec:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def dump(self):
+            with self._lock:
+                self.snapshot()
+
+        def snapshot(self):
+            with self._lock:
+                return 1
+    """
+    findings = active(_lint(tmp_path, src, ("NMFX013",)))
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_nmfx013_rlock_reentry_exempt(tmp_path):
+    """The same shape on an RLock is the documented fix — quiet."""
+    src = """
+    import threading
+
+    class Rec:
+        def __init__(self):
+            self._lock = threading.RLock()
+
+        def dump(self):
+            with self._lock:
+                self.snapshot()
+
+        def snapshot(self):
+            with self._lock:
+                return 1
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX013",))) == []
+
+
+def test_nmfx013_live_tree_acyclic():
+    """The real service tier's static lock graph has no cycles — the
+    deadlock-freedom contract docs/serving.md documents."""
+    findings = [f for f in run(["nmfx"], jaxpr=False,
+                               rule_ids=["NMFX013"])
+                if f.rule_id == "NMFX013"]
+    assert findings == []
+
+
+def test_nmfx013_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX013" in RULES
+
+
+# ---------------------------------------------------------------- NMFX014
+
+def test_nmfx014_dead_future_fires(tmp_path):
+    src = """
+    from concurrent.futures import Future
+
+    class Svc:
+        def submit(self, k):
+            fut = Future()
+            return k
+    """
+    findings = active(_lint(tmp_path, src, ("NMFX014",)))
+    assert len(findings) == 1
+    assert "never resolves" in findings[0].message
+
+
+def test_nmfx014_unprotected_publication_gap_fires(tmp_path):
+    """The harvest-submit shape this PR fixed: publish into a pending
+    map, then a failable call with no handler that resolves or
+    unpublishes — the waiter strands."""
+    src = """
+    from concurrent.futures import Future
+
+    class Pipe:
+        def submit(self, k):
+            fut = Future()
+            self._futures[k] = fut
+            self._spawn_worker()
+
+        def _spawn_worker(self):
+            raise RuntimeError
+    """
+    findings = active(_lint(tmp_path, src, ("NMFX014",)))
+    assert len(findings) == 1
+    assert "publishes Future" in findings[0].message
+    assert "_spawn_worker" in findings[0].message
+
+
+def test_nmfx014_protecting_handler_quiet(tmp_path):
+    """The replica-forward shape: the risky hand-off sits under a
+    handler that unpublishes and re-raises — clean."""
+    src = """
+    from concurrent.futures import Future
+
+    class Rep:
+        def forward(self, rid):
+            fut = Future()
+            self._pending[rid] = fut
+            try:
+                self._write_record(rid)
+            except Exception:
+                self._pending.pop(rid, None)
+                raise
+            return fut
+
+        def _write_record(self, rid):
+            raise OSError
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX014",))) == []
+
+
+def test_nmfx014_lexical_resolution_quiet(tmp_path):
+    """The exec-cache shape: the function itself resolves the future
+    after the work — the publication gap is the producer's own body,
+    already covered by its try/except discipline (NMFX006)."""
+    src = """
+    from concurrent.futures import Future
+
+    class Cache:
+        def executable(self, key):
+            fut = Future()
+            self._inflight[key] = fut
+            entry = self._build(key)
+            fut.set_result(entry)
+            return entry
+
+        def _build(self, key):
+            return key
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX014",))) == []
+
+
+def test_nmfx014_ownership_transfer_quiet(tmp_path):
+    """Passing the future to another owner (wrapper dataclass, another
+    component's register call) transfers the resolution obligation."""
+    src = """
+    from concurrent.futures import Future
+
+    def dispatch(router, req):
+        fut = Future()
+        router.register(req, fut)
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX014",))) == []
+
+
+def test_nmfx014_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX014" in RULES
+
+
+# ---------------------------------------------------------------- NMFX015
+
+def test_nmfx015_unowned_thread_fires(tmp_path):
+    src = """
+    import threading
+
+    class Svc:
+        def start(self):
+            t = threading.Thread(target=self._run)
+            t.start()
+    """
+    findings = active(_lint(tmp_path, src, ("NMFX015",)))
+    assert len(findings) == 1
+    assert "non-daemon" in findings[0].message
+
+
+def test_nmfx015_daemon_quiet(tmp_path):
+    src = """
+    import threading
+
+    class Svc:
+        def start(self):
+            t = threading.Thread(target=self._run, daemon=True)
+            t.start()
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX015",))) == []
+
+
+def test_nmfx015_joined_container_quiet(tmp_path):
+    """Threads stored in a container the owner drains with join() on
+    its close path are owned lifetimes — quiet."""
+    src = """
+    import threading
+
+    class Svc:
+        def start(self):
+            t = threading.Thread(target=self._run)
+            t.start()
+            self._threads.append(t)
+
+        def close(self):
+            for t in self._threads:
+                t.join()
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX015",))) == []
+
+
+def test_nmfx015_local_join_quiet(tmp_path):
+    """A run-and-wait helper joins its thread locally — quiet."""
+    src = """
+    import threading
+
+    def run_both(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        fn()
+        t.join()
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX015",))) == []
+
+
+def test_nmfx015_timer_cancel_quiet(tmp_path):
+    """Timers cancelled on the owner's close path count as joined."""
+    src = """
+    import threading
+
+    class Svc:
+        def start(self):
+            self._timer = threading.Timer(5.0, self._fire)
+            self._timer.start()
+
+        def close(self):
+            self._timer.cancel()
+    """
+    assert _ids(_lint(tmp_path, src, ("NMFX015",))) == []
+
+
+def test_nmfx015_rule_registered():
+    from nmfx.analysis import RULES
+
+    assert "NMFX015" in RULES
