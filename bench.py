@@ -612,6 +612,209 @@ def run_durability_child(args) -> int:
     return 3
 
 
+def run_mesh_child(args) -> int:
+    """The mesh rung's subprocess body (ISSUE 19, detail.mesh): runs
+    under forced CPU devices (the parent sets
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` before the
+    child's jax initializes — device count is fixed at import, which
+    is why this is a subprocess). Four sub-rungs, all hard-gated:
+
+    strong / weak
+        restart-axis scaling over 1/2/4/8 restart shards: strong holds
+        the total restart count fixed, weak holds the per-shard count.
+        restarts/s counts REAL restarts only — surplus pad lanes
+        (``nmfx_mesh_pad_lanes_total``, booked per rung from the
+        counter delta) are computed-and-discarded work and are
+        subtracted, so the curves measure honest throughput.
+    exactness
+        the meshed-vs-unmeshed contract: restart-only mesh
+        BIT-IDENTICAL per engine (consensus + labels + dnorms), grid
+        (feature×sample) mesh agreement-gated.
+    comm
+        ``costmodel.comm_model`` vs the compiled HLO's collective ops
+        (``xla_comm_cost``): per-iteration allreduce counts must match
+        EXACTLY and payload bytes to 1%% — the FLOPs-vs-cost_analysis
+        discipline applied to communication.
+    fleet
+        heterogeneous 1-chip + 4-chip thread-replica pool behind the
+        priced router: atlas-shaped submissions MUST place on the mesh
+        class and small ones on the 1-chip class (the placement-
+        correctness gate), results bit-identical to the direct sweep.
+    """
+    import numpy as np
+
+    import jax
+
+    from nmfx.config import ConsensusConfig, SolverConfig
+    from nmfx.datasets import grouped_matrix
+    from nmfx.obs import metrics as obs_metrics
+    from nmfx.sweep import GRID_SOLVERS, grid_mesh, sweep
+
+    n_dev = len(jax.devices())
+    problems = []
+    m_b, n_b = min(args.genes, 96), min(args.samples, 48)
+    a = grouped_matrix(m_b, (n_b // 2, n_b - n_b // 2), effect=2.0,
+                       seed=0)
+    maxiter = min(args.maxiter, 120)
+
+    def pad_lanes_total() -> float:
+        snap = obs_metrics.registry().snapshot()
+        rec = snap.get("nmfx_mesh_pad_lanes_total")
+        if not rec:
+            return 0.0
+        return float(sum(rec["series"].values()))
+
+    def timed_sweep(scfg, restarts, mesh):
+        ccfg = ConsensusConfig(ks=(3,), restarts=restarts, seed=123)
+        pads0 = pad_lanes_total()
+        t0 = time.perf_counter()
+        out = sweep(a, ccfg, scfg, mesh=mesh)
+        np.asarray(out[3].consensus)  # sync
+        wall = time.perf_counter() - t0
+        return out, wall, pad_lanes_total() - pads0
+
+    scfg = SolverConfig(algorithm="kl", max_iter=maxiter)
+    shard_counts = [s for s in (1, 2, 4, 8) if s <= n_dev]
+    strong_r, weak_per_shard = 12, 2
+    strong, weak = [], []
+    for s in shard_counts:
+        mesh = grid_mesh(s, 1, 1) if s > 1 else None
+        _, wall, pads = timed_sweep(scfg, strong_r, mesh)
+        strong.append({"shards": s, "restarts": strong_r,
+                       "pad_lanes": pads, "wall_s": round(wall, 3),
+                       "restarts_per_s": round(strong_r / wall, 2)})
+        total = weak_per_shard * s
+        _, wall, pads = timed_sweep(scfg, total, mesh)
+        weak.append({"shards": s, "restarts": total,
+                     "pad_lanes": pads, "wall_s": round(wall, 3),
+                     "restarts_per_s": round(total / wall, 2)})
+
+    # exactness: restart-only mesh bit-identical per engine; 12 lanes
+    # on 8 shards also pins the pad path (lanes 12..15 discarded)
+    exact = {}
+    r_mesh = grid_mesh(min(4, n_dev), 1, 1)
+    for alg in sorted(set(GRID_SOLVERS) | {"mu"}):
+        e_scfg = SolverConfig(algorithm=alg, max_iter=maxiter)
+        ccfg = ConsensusConfig(ks=(3,), restarts=6, seed=123)
+        ref = sweep(a, ccfg, e_scfg)[3]
+        got = sweep(a, ccfg, e_scfg, mesh=r_mesh)[3]
+        bit = all(
+            np.array_equal(np.asarray(getattr(ref, f)),
+                           np.asarray(getattr(got, f)))
+            for f in ("consensus", "labels", "dnorms"))
+        exact[alg] = "bit-identical" if bit else "MISMATCH"
+        if not bit:
+            problems.append(f"restart-mesh exactness: {alg} diverged "
+                            "from the unmeshed sweep")
+        if n_dev >= 4:
+            g_mesh = grid_mesh(1, 2, 2)
+            grid_got = sweep(a, ccfg, e_scfg, mesh=g_mesh)[3]
+            agree = np.allclose(np.asarray(ref.consensus),
+                                np.asarray(grid_got.consensus),
+                                atol=0.35)
+            if not agree:
+                problems.append(f"grid-mesh agreement: {alg} consensus "
+                                "diverged beyond tolerance")
+
+    # comm model vs compiled HLO (exact count match, ~payload match)
+    from nmfx.obs import costmodel
+
+    comm = {}
+    if n_dev >= 4:
+        g_mesh = grid_mesh(1, 2, 2)
+        for alg in sorted(costmodel.comm_covered_algorithms()):
+            model = costmodel.comm_model(alg, m_b, n_b, 3,
+                                         feature_shards=2,
+                                         sample_shards=2, restarts=2)
+            meas = costmodel.xla_comm_cost(alg, m_b, n_b, 3, g_mesh,
+                                           r_loc=2)
+            if meas is None:
+                comm[alg] = "unmeasurable"
+                continue
+            ok_ops = (model["collectives_per_iter"]
+                      == meas["collectives_per_iter"])
+            pb_m = model["payload_bytes_per_iter"]
+            pb_x = meas["payload_bytes_per_iter"]
+            ok_bytes = abs(pb_m - pb_x) <= 0.01 * max(pb_m, 1.0)
+            comm[alg] = {
+                "collectives_per_iter": model["collectives_per_iter"],
+                "hlo_collectives_per_iter":
+                    meas["collectives_per_iter"],
+                "payload_bytes_per_iter": pb_m,
+                "hlo_payload_bytes_per_iter": pb_x,
+                "match": bool(ok_ops and ok_bytes)}
+            if not (ok_ops and ok_bytes):
+                problems.append(
+                    f"comm model: {alg} predicts "
+                    f"{model['collectives_per_iter']} collectives/"
+                    f"{pb_m:.0f}B per iter, compiled HLO has "
+                    f"{meas['collectives_per_iter']}/{pb_x:.0f}B")
+
+    # heterogeneous fleet: priced placement correctness + parity
+    fleet = {}
+    if n_dev >= 4:
+        import shutil
+        import tempfile
+
+        from nmfx.replica import ReplicaPool
+        from nmfx.router import NMFXRouter, RouterConfig
+
+        root = tempfile.mkdtemp(prefix="nmfx-bench-mesh-fleet-")
+        router = None
+        try:
+            pool = ReplicaPool(2, root=root, mode="thread",
+                               mesh_specs=(None, "4"))
+            router = NMFXRouter(
+                pool, RouterConfig(atlas_floor_bytes=a.nbytes))
+            ccfg = ConsensusConfig(ks=(3,), restarts=6, seed=123)
+            ref = sweep(a, ccfg, scfg)[3]
+            small = np.ascontiguousarray(a[:12, :8])
+            t0 = time.perf_counter()
+            futs = [("atlas", router.submit(
+                        a, ks=(3,), restarts=6, seed=123,
+                        solver_cfg=scfg)) for _ in range(2)]
+            futs += [("small", router.submit(
+                         small, ks=(2,), restarts=2, seed=123,
+                         solver_cfg=scfg)) for _ in range(2)]
+            placements = {"atlas": [], "small": []}
+            for shape, fut in futs:
+                res = fut.result(timeout=300)
+                placements[shape].append(fut.stats.placement_class)
+                if shape == "atlas" and not np.array_equal(
+                        np.asarray(res.per_k[3].consensus),
+                        np.asarray(ref.consensus)):
+                    problems.append("fleet: routed atlas result "
+                                    "diverged from the direct sweep")
+            wall = time.perf_counter() - t0
+            if any(c != 4 for c in placements["atlas"]):
+                problems.append(
+                    "fleet placement: atlas-shaped request landed on "
+                    f"class {placements['atlas']} with a 4-chip "
+                    "replica routable")
+            if any(c != 1 for c in placements["small"]):
+                problems.append(
+                    "fleet placement: small request landed on class "
+                    f"{placements['small']} instead of the 1-chip "
+                    "replica")
+            fleet = {"classes": [1, 4],
+                     "atlas_placements": placements["atlas"],
+                     "small_placements": placements["small"],
+                     "wall_s": round(wall, 3),
+                     "placement": ("ok" if not any(
+                         "placement" in p for p in problems)
+                         else "WRONG")}
+        finally:
+            if router is not None:
+                router.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    out = {"n_devices": n_dev, "strong": strong, "weak": weak,
+           "exactness": exact, "comm": comm, "fleet": fleet,
+           "problems": problems, "ok": not problems}
+    print(json.dumps({"mesh_child": out}), flush=True)
+    return 0 if not problems else 2
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--genes", type=int, default=5000)
@@ -667,6 +870,18 @@ def main():
     # between chunks
     p.add_argument("--atlas-tile-rows", type=int, default=None,
                    help=argparse.SUPPRESS)
+    # internal: the mesh rung's forced-CPU-devices subprocess re-enters
+    # THIS entrypoint (the parent sets XLA_FLAGS before the child's jax
+    # initializes — device count is import-time state)
+    p.add_argument("--mesh-child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--dryrun-multichip", type=int, default=None,
+                   metavar="N",
+                   help="jit one restart-sharded consensus step across "
+                        "N devices (__graft_entry__.dryrun_multichip) "
+                        "and exit — the CI multichip smoke entrypoint; "
+                        "run under XLA_FLAGS=--xla_force_host_platform"
+                        "_device_count=N for forced CPU devices")
     p.add_argument("--regress", action="store_true",
                    help="after recording, judge this run's metrics "
                         "against the best prior BENCH_r*.json round "
@@ -703,6 +918,15 @@ def main():
                 "jax_persistent_cache_min_compile_time_secs", 0.1)
     if args.durability_child:
         raise SystemExit(run_durability_child(args))
+    if args.mesh_child:
+        raise SystemExit(run_mesh_child(args))
+    if args.dryrun_multichip is not None:
+        import __graft_entry__ as graft
+
+        graft.dryrun_multichip(args.dryrun_multichip)
+        print(json.dumps({"dryrun_multichip": {
+            "n_devices": args.dryrun_multichip, "ok": True}}))
+        raise SystemExit(0)
     import numpy as np
 
     from nmfx.config import ConsensusConfig, InitConfig, SolverConfig
@@ -1281,6 +1505,56 @@ def main():
         finally:
             shutil.rmtree(ref_dir, ignore_errors=True)
             shutil.rmtree(kill_dir, ignore_errors=True)
+
+    def run_mesh_stage():
+        """Mesh rung (ISSUE 19, detail.mesh): run :func:`run_mesh_child`
+        under 8 forced CPU devices (a subprocess — XLA fixes the device
+        count at import) and hard-gate its verdict: scaling curves are
+        data, but a meshed-vs-unmeshed mismatch, a comm-model-vs-HLO
+        divergence, or a wrong placement is exit 2. The stage result
+        carries a MULTICHIP-record-shaped ``record`` block so mesh
+        rounds read like the driver's multichip probes."""
+        import subprocess
+
+        n_forced = 8
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={n_forced}"
+        ).strip()
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--mesh-child",
+               "--genes", str(args.genes),
+               "--samples", str(args.samples),
+               "--restarts", str(args.restarts),
+               "--maxiter", str(args.maxiter),
+               "--kmax", str(args.kmax),
+               "--algorithm", args.algorithm]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              env=env)
+        detail = None
+        for line in proc.stdout.splitlines():
+            try:
+                detail = json.loads(line)["mesh_child"]
+            except (ValueError, KeyError, TypeError):
+                continue
+        ok = proc.returncode == 0 and detail is not None \
+            and detail.get("ok", False)
+        record = {"n_devices": n_forced, "rc": proc.returncode,
+                  "ok": ok, "skipped": False,
+                  "tail": "" if ok else proc.stderr[-800:]}
+        if not ok:
+            probs = (detail or {}).get("problems") or \
+                [f"mesh child exited {proc.returncode} without a "
+                 "verdict"]
+            for prob in probs:
+                print(f"bench MESH STAGE FAILURE: {prob}",
+                      file=sys.stderr)
+            print(proc.stderr[-2000:], file=sys.stderr)
+            raise SystemExit(2)
+        detail["record"] = record
+        return detail
 
     def run_atlas_stage():
         """Atlas rung (ISSUE 17, detail.atlas): the out-of-core tile
@@ -2854,6 +3128,10 @@ def main():
     print(f"bench: durability stage: {json.dumps(durability)}",
           file=sys.stderr)
 
+    mesh_detail = run_mesh_stage()
+    print(f"bench: mesh stage: {json.dumps(mesh_detail)}",
+          file=sys.stderr)
+
     atlas_detail = run_atlas_stage()
     print(f"bench: atlas stage: {json.dumps(atlas_detail)}",
           file=sys.stderr)
@@ -2917,6 +3195,7 @@ def main():
             "exec_cache": serving,
             "serve": traffic,
             "durability": durability,
+            "mesh": mesh_detail,
             "atlas": atlas_detail,
             "sketched": sketched_detail,
             "obs": obs_detail,

@@ -210,7 +210,7 @@ def _live_metrics() -> "dict[str, str]":
     for mod in ("nmfx.exec_cache", "nmfx.data_cache", "nmfx.serve",
                 "nmfx.checkpoint", "nmfx.distributed", "nmfx.router",
                 "nmfx.replica", "nmfx.result_cache", "nmfx.tiles",
-                "nmfx.sparse", "nmfx.obs.costmodel",
+                "nmfx.sparse", "nmfx.sweep", "nmfx.obs.costmodel",
                 "nmfx.obs.export", "nmfx.obs.slo"):
         importlib.import_module(mod)
     from nmfx.obs import metrics as obs_metrics

@@ -665,7 +665,7 @@ class SweepCheckpoint:
 # -- chunk execution -------------------------------------------------------
 def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
                      ccfg: ConsensusConfig, scfg: SolverConfig,
-                     icfg: InitConfig, keys=None, ck=None):
+                     icfg: InitConfig, keys=None, ck=None, mesh=None):
     """Solve restarts ``[r0, r1)`` of rank ``k`` and materialize the
     chunk's record on host. ``keys`` is the rank's full canonical key
     array (``split(fold_in(key(seed), k), restarts)``) — recomputed here
@@ -684,7 +684,13 @@ def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
     Passes the ``proc.preempt`` chaos site AFTER the solve completes
     but BEFORE the caller can commit the record: a fired preemption
     raises :class:`Preempted`, losing exactly the in-flight chunk —
-    the rehearsal of SIGKILL mid-chunk."""
+    the rehearsal of SIGKILL mid-chunk.
+
+    ``mesh``: a restart-only sub-mesh to shard the chunk's lanes over
+    (``ElasticShardRunner`` meshed mode — a shard owning a device SET;
+    ISSUE 19). Per-lane math is unchanged, so the record stays
+    bit-identical to the unmeshed executor's; refused for the tiled/
+    sparse streaming paths, whose engines are single-device."""
     import jax
 
     from nmfx import faults
@@ -708,6 +714,12 @@ def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
             ccfg.restarts)
     poison = tuple(r - r0 for r in faults.poison_restarts(k, ccfg.restarts)
                    if r0 <= r < r1)
+    if mesh is not None and (scfg.tile_rows is not None
+                             or isinstance(a_dev, SparseMatrix)):
+        raise ValueError(
+            "meshed chunk execution does not compose with the tiled/"
+            "sparse streaming engines (single-device tile pipelines); "
+            "drop the mesh or the tile/sparse input")
     if scfg.tile_rows is not None or isinstance(a_dev, SparseMatrix):
         from nmfx import tiles
 
@@ -736,7 +748,7 @@ def solve_chunk_host(a_dev, k: int, r0: int, r1: int,
                 "lost; every committed record survives for resume")
         return host
     fn = _build_chunk_sweep_fn(k, r1 - r0, scfg, icfg, ccfg.label_rule,
-                               poison, faults.trace_token())
+                               poison, faults.trace_token(), mesh=mesh)
     host = jax.device_get(fn(a_dev, keys[r0:r1]))
     _note(solved=1)
     if faults.fire("proc.preempt"):

@@ -229,6 +229,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tile each factorization's columns (A, H) across "
                         "this many devices — sequence parallelism for huge "
                         "n (default 1 = off)")
+    p.add_argument("--restart-shards", type=int, default=None,
+                   metavar="N",
+                   help="pin the restart axis to exactly N devices "
+                        "(communication-avoiding data parallelism: zero "
+                        "per-iteration collectives). Default: auto — all "
+                        "local devices. Composes with --feature-shards/"
+                        "--sample-shards into an N x F x S grid mesh")
     p.add_argument("--checkpoint-dir", default=None,
                    help="durable sweep ledger (docs/serving.md "
                         "'Durability model'): persist per-(rank, "
@@ -371,6 +378,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "migration records and replica_<id>.json "
                         "heartbeats live here; default: a temporary "
                         "directory)")
+    p.add_argument("--replica-mesh", default=None, metavar="SPECS",
+                   help="with --replicas: comma-separated per-replica "
+                        "mesh specs making the fleet HETEROGENEOUS — "
+                        "each entry is R, RxF, or RxFxS (that replica "
+                        "owns a carved block of r*f*s local devices) "
+                        "or '-' for a plain 1-device replica. Must "
+                        "name one spec per replica, e.g. "
+                        "--replicas 2 --replica-mesh -,4. The router "
+                        "prices placement across the classes "
+                        "(docs/serving.md 'Mesh tier')")
     p.add_argument("--compile-cache", default=_DEFAULT_COMPILE_CACHE,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory: "
@@ -547,11 +564,12 @@ def _run_cli(argv: list[str] | None = None) -> int:
                          "out-of-core engine; --backend pallas/sketched "
                          "and --screen need the whole matrix device-"
                          "resident — use --backend auto")
-        if args.feature_shards > 1 or args.sample_shards > 1:
-            parser.error(f"{what} do(es) not compose with --feature-"
-                         "shards/--sample-shards (the tile stream owns "
-                         "one device; shard across processes with "
-                         "nmfx.distributed instead)")
+        if args.feature_shards > 1 or args.sample_shards > 1 \
+                or args.restart_shards is not None:
+            parser.error(f"{what} do(es) not compose with --restart-"
+                         "shards/--feature-shards/--sample-shards (the "
+                         "tile stream owns one device; shard across "
+                         "processes with nmfx.distributed instead)")
         if args.exec_cache or args.warm_shapes or args.cache_dir \
                 or args.pipeline_ranks:
             parser.error(f"{what} do(es) not compose with --exec-cache/"
@@ -663,6 +681,8 @@ def _run_cli(argv: list[str] | None = None) -> int:
         obs_trace.enable()
     if args.feature_shards < 1 or args.sample_shards < 1:
         parser.error("--feature-shards/--sample-shards must be >= 1")
+    if args.restart_shards is not None and args.restart_shards < 1:
+        parser.error("--restart-shards must be >= 1")
     mesh = None
     if args.feature_shards > 1 or args.sample_shards > 1:
         if args.no_mesh:
@@ -684,7 +704,20 @@ def _run_cli(argv: list[str] | None = None) -> int:
                          "defeat the memory bound; use nmfx.restart_factors "
                          "to recompute single restarts)")
         try:
-            mesh = grid_mesh(None, args.feature_shards, args.sample_shards)
+            mesh = grid_mesh(args.restart_shards, args.feature_shards,
+                             args.sample_shards)
+        except ValueError as e:
+            parser.error(str(e))
+    elif args.restart_shards is not None:
+        # restart-only mesh: communication-avoiding data parallelism
+        # over exactly N devices (auto mesh uses ALL devices; pinning N
+        # is the reproducible-placement / benchmark-protocol knob)
+        if args.no_mesh:
+            parser.error("--restart-shards conflicts with --no-mesh")
+        from nmfx.sweep import grid_mesh
+
+        try:
+            mesh = grid_mesh(args.restart_shards, 1, 1)
         except ValueError as e:
             parser.error(str(e))
     # ONE SolverConfig for warmup and the run: the exec-cache key hashes
@@ -786,14 +819,39 @@ def _run_cli(argv: list[str] | None = None) -> int:
                          "cannot share one HTTP port; scrape the "
                          "merged fleet via --telemetry-dir + "
                          "nmfx.obs.aggregate instead)")
+        if args.replica_mesh is not None:
+            specs = [s.strip() for s in args.replica_mesh.split(",")]
+            if len(specs) != args.replicas:
+                parser.error(f"--replica-mesh names {len(specs)} "
+                             f"spec(s) for --replicas {args.replicas} "
+                             "— one entry per replica ('-' = plain "
+                             "1-device)")
+            from nmfx.distributed import MeshSpecError, parse_mesh_spec
+
+            for spec in specs:
+                if spec in ("-", ""):
+                    continue
+                try:
+                    parse_mesh_spec(spec)
+                except MeshSpecError as e:
+                    parser.error(f"--replica-mesh: {e}")
+            args.replica_mesh_specs = tuple(
+                None if s in ("-", "") else s for s in specs)
+        else:
+            args.replica_mesh_specs = None
     elif args.router_spill_dir is not None:
         parser.error("--router-spill-dir configures the replica "
                      "pool's ledger; pass --replicas")
+    elif args.replica_mesh is not None:
+        parser.error("--replica-mesh shapes the replica pool's device "
+                     "ownership; pass --serve-smoke --replicas N")
     if args.serve_smoke:
         if mesh is not None:
             parser.error("--serve-smoke owns ONE device (the serving "
                          "scheduler's contract); drop "
-                         "--feature-shards/--sample-shards")
+                         "--restart-shards/--feature-shards/"
+                         "--sample-shards (mesh-tier serving is "
+                         "per-REPLICA: --replicas N --replica-mesh ...)")
         if args.checkpoint_dir is not None:
             parser.error("--serve-smoke does not compose with "
                          "--checkpoint-dir (served requests dispatch "
@@ -821,8 +879,10 @@ def _run_cli(argv: list[str] | None = None) -> int:
 
         if mesh is not None:
             parser.error("--exec-cache does not compose with "
-                         "--feature-shards/--sample-shards (the grid "
-                         "builders do their own shape padding)")
+                         "--restart-shards/--feature-shards/"
+                         "--sample-shards (the grid builders do their "
+                         "own shape padding, and the cache tier "
+                         "already restart-shards over all devices)")
         if args.checkpoint_dir is not None:
             # sweep() routes checkpointed runs past the cache — erroring
             # here beats silently paying the warmup compile twice
@@ -1029,7 +1089,8 @@ def _serve_smoke_router(args, run_scfg, exec_cache, output, profiler):
     pool = ReplicaPool(
         args.replicas, root=root, mode="thread",
         serve_cfg=ServeConfig(),
-        exec_cache=exec_cache, telemetry_dir=args.telemetry_dir)
+        exec_cache=exec_cache, telemetry_dir=args.telemetry_dir,
+        mesh_specs=getattr(args, "replica_mesh_specs", None))
     try:
         with NMFXRouter(pool, RouterConfig(
                 result_cache_dir=args.result_cache_dir)) as router:
@@ -1063,7 +1124,7 @@ def _serve_smoke_router(args, run_scfg, exec_cache, output, profiler):
           f"completed={s['completed']} retried={s['retried']} "
           f"readmitted={s['readmitted']} "
           f"replica={st.replica} sticky={st.sticky} "
-          f"attempts={st.attempts} "
+          f"class={st.placement_class} attempts={st.attempts} "
           f"latency={'n/a' if st.latency_s is None else f'{st.latency_s:.3f}s'}",
           file=sys.stderr)
     if args.telemetry_dir is not None:

@@ -131,6 +131,71 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+class MeshSpecError(ValueError):
+    """A mesh specification does not fit the available devices.
+
+    Typed so callers (CLI validation, `ReplicaPool` spawn, the serve
+    config) can catch the *spec* problem distinctly from arbitrary
+    ``ValueError``s — before it would otherwise surface as an opaque
+    XLA reshape failure deep inside device assignment."""
+
+
+def parse_mesh_spec(spec: str) -> "tuple[int, int, int]":
+    """Parse a replica mesh spec into ``(restart_shards,
+    feature_shards, sample_shards)``.
+
+    Grammar: ``"R"`` (restart-only, e.g. ``"4"``), ``"RxF"`` or
+    ``"RxFxS"`` (e.g. ``"2x2"``, ``"2x2x2"``) — the axis order of
+    :func:`nmfx.grid_mesh`. Every count must be a positive integer;
+    anything else raises :class:`MeshSpecError`."""
+    parts = str(spec).lower().split("x")
+    if not 1 <= len(parts) <= 3:
+        raise MeshSpecError(
+            f"mesh spec {spec!r} must be R, RxF, or RxFxS "
+            "(restarts × features × samples)")
+    try:
+        counts = tuple(int(p) for p in parts)
+    except ValueError:
+        raise MeshSpecError(
+            f"mesh spec {spec!r} has a non-integer axis count") from None
+    if any(c < 1 for c in counts):
+        raise MeshSpecError(
+            f"mesh spec {spec!r} has a non-positive axis count")
+    return counts + (1,) * (3 - len(counts))
+
+
+def build_replica_mesh(spec: str, devices=None) -> Mesh:
+    """Build the mesh a replica's device set executes on, from its
+    ``ServeConfig.mesh_spec`` string.
+
+    An explicit ``devices`` set (a pool-carved block) must be consumed
+    exactly — a replica owning 8 chips but meshing 4 would silently
+    idle half its capacity while the router prices it as an 8-chip
+    replica, so the mismatch is a :class:`MeshSpecError`, not a
+    truncation. With ``devices=None`` (a standalone server) the mesh
+    takes the first ``r*f*s`` of ``jax.devices()``."""
+    r, f, s = parse_mesh_spec(spec)
+    need = r * f * s
+    if devices is None:
+        devices = list(jax.devices())
+        if len(devices) < need:
+            raise MeshSpecError(
+                f"mesh spec {spec!r} needs {need} device(s) "
+                f"({r}x{f}x{s}); this process has {len(devices)}")
+        devices = devices[:need]
+    else:
+        devices = list(devices)
+        if len(devices) != need:
+            raise MeshSpecError(
+                f"mesh spec {spec!r} needs exactly {need} device(s) "
+                f"({r}x{f}x{s}); this replica owns {len(devices)}")
+    if f == 1 and s == 1:
+        return Mesh(np.array(devices), (RESTART_AXIS,))
+    from nmfx.sweep import grid_mesh
+
+    return grid_mesh(r, f, s, devices=devices)
+
+
 def global_mesh(feature_shards: int = 1, sample_shards: int = 1) -> Mesh:
     """Mesh over every device in the job (all hosts): restart axis by
     default, optionally a 3-D restarts×features×samples grid.
@@ -143,14 +208,23 @@ def global_mesh(feature_shards: int = 1, sample_shards: int = 1) -> Mesh:
     collective-light restart axis spans DCN — the layout
     jax-ml.github.io/scaling-book prescribes for bandwidth-hungry axes.
     """
+    if feature_shards < 1 or sample_shards < 1:
+        raise MeshSpecError(
+            "feature_shards/sample_shards must be >= 1, got "
+            f"{feature_shards}×{sample_shards}")
     devices = jax.devices()
     if feature_shards == 1 and sample_shards == 1:
         return Mesh(np.array(devices), (RESTART_AXIS,))
     grid = feature_shards * sample_shards
+    if grid > len(devices):
+        raise MeshSpecError(
+            f"features×samples={feature_shards}×{sample_shards} needs "
+            f"{grid} devices; this job has {len(devices)}")
     if len(devices) % grid:
-        raise ValueError(
+        raise MeshSpecError(
             f"{len(devices)} devices don't divide into "
-            f"features×samples={feature_shards}×{sample_shards}")
+            f"features×samples={feature_shards}×{sample_shards} "
+            f"(= {grid}); the restart axis would be ragged")
     from nmfx.sweep import grid_mesh
 
     return grid_mesh(len(devices) // grid, feature_shards, sample_shards,
@@ -216,7 +290,8 @@ class ElasticShardRunner:
     """
 
     def __init__(self, ck, ccfg, scfg, icfg, arr, devices=None,
-                 telemetry_dir=None, trace_id=None):
+                 telemetry_dir=None, trace_id=None,
+                 shard_devices: int = 1):
         self.ck = ck
         self.ccfg = ccfg
         self.scfg = scfg
@@ -226,6 +301,25 @@ class ElasticShardRunner:
                             if devices is None else devices)
         if not self.devices:
             raise ValueError("need at least one device")
+        # meshed mode (ISSUE 19): a shard owns a device SET — its units
+        # solve over a restart-only sub-mesh (communication-avoiding;
+        # records stay bit-identical to the unmeshed executor's)
+        if shard_devices < 1:
+            raise MeshSpecError("shard_devices must be >= 1, got "
+                                f"{shard_devices}")
+        if shard_devices > len(self.devices):
+            raise MeshSpecError(
+                f"shard_devices={shard_devices} exceeds the "
+                f"{len(self.devices)} available device(s)")
+        if len(self.devices) % shard_devices:
+            raise MeshSpecError(
+                f"{len(self.devices)} device(s) don't divide into "
+                f"sub-meshes of {shard_devices}; a ragged remainder "
+                "would idle silently")
+        self.shard_devices = shard_devices
+        self._groups = [self.devices[i:i + shard_devices]
+                        for i in range(0, len(self.devices),
+                                       shard_devices)]
         #: cross-process sweep identity (ISSUE 14): every shard
         #: heartbeat in the ledger and every elastic.unit trace span
         #: carries it, so N processes sharding one ledger join into one
@@ -250,8 +344,18 @@ class ElasticShardRunner:
         from nmfx.sweep import place_input
 
         done = 0
-        a_dev = jax.device_put(
-            place_input(self.arr, self.scfg, None), dev)
+        group = list(dev) if isinstance(dev, (list, tuple)) else [dev]
+        submesh = None
+        if len(group) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            submesh = Mesh(np.array(group), (RESTART_AXIS,))
+            a_dev = jax.device_put(
+                place_input(self.arr, self.scfg, None),
+                NamedSharding(submesh, PartitionSpec()))
+        else:
+            a_dev = jax.device_put(
+                place_input(self.arr, self.scfg, None), group[0])
         key_cache: dict = {}
         tracer = _trace.default_tracer()
         while True:
@@ -273,10 +377,14 @@ class ElasticShardRunner:
             k, r0, r1 = unit
             try:
                 if k not in key_cache:
-                    key_cache[k] = jax.device_put(jax.random.split(
+                    keys_k = jax.random.split(
                         jax.random.fold_in(jax.random.key(self.ccfg.seed),
                                            k),
-                        self.ccfg.restarts), dev)
+                        self.ccfg.restarts)
+                    # meshed shards leave keys host-side: the meshed
+                    # chunk executor shards them over the sub-mesh
+                    key_cache[k] = (keys_k if submesh is not None
+                                    else jax.device_put(keys_k, group[0]))
                 with tracer.span("elastic.unit", cat="elastic",
                                  args={"shard": idx, "k": k, "r0": r0,
                                        "r1": r1,
@@ -284,7 +392,8 @@ class ElasticShardRunner:
                     rec = ckpt.solve_chunk_host(a_dev, k, r0, r1,
                                                 self.ccfg, self.scfg,
                                                 self.icfg,
-                                                keys=key_cache[k])
+                                                keys=key_cache[k],
+                                                mesh=submesh)
             except ckpt.Preempted:
                 # shard death: hand the in-flight unit back so a
                 # survivor re-runs it (same keys => same results), and
@@ -357,11 +466,11 @@ class ElasticShardRunner:
                 self.telemetry_dir, role="elastic",
                 instance=f"elastic-{os.getpid()}",
                 interval_s=1.0).start()
-        _shards_alive_gauge.set(len(self.devices))
-        threads = [threading.Thread(target=self._worker, args=(i, d),
+        _shards_alive_gauge.set(len(self._groups))
+        threads = [threading.Thread(target=self._worker, args=(i, g),
                                     daemon=True,
                                     name=f"nmfx-elastic-{i}")
-                   for i, d in enumerate(self.devices)]
+                   for i, g in enumerate(self._groups)]
         for t in threads:
             t.start()
         for t in threads:
@@ -391,7 +500,8 @@ def elastic_consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, *,
                       checkpoint, seed: int = 123, solver_cfg=None,
                       init_cfg=None, label_rule: str = "argmax",
                       linkage: str = "average", min_restarts: int = 1,
-                      devices=None, telemetry_dir=None):
+                      devices=None, telemetry_dir=None,
+                      shard_devices: int = 1):
     """Durable, elastic restart-grid consensus sweep: the (k x chunk)
     units of ``checkpoint``'s plan are dispatched across ``devices``
     (default: all local devices) by :class:`ElasticShardRunner`; a
@@ -402,8 +512,10 @@ def elastic_consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, *,
     dispatch). ``telemetry_dir`` publishes this process's registry
     snapshots (per-shard progress included) into a shared fleet-
     telemetry ledger while the sweep runs (``nmfx.obs.export``;
-    docs/observability.md "Fleet telemetry"). Returns the same
-    ``ConsensusResult`` as ``nmfconsensus``."""
+    docs/observability.md "Fleet telemetry"). ``shard_devices`` makes
+    each shard a SUB-MESH of that many devices (meshed mode: units
+    solve restart-sharded over the sub-mesh, same records). Returns
+    the same ``ConsensusResult`` as ``nmfconsensus``."""
     from nmfx import checkpoint as ckpt
     from nmfx.api import ConsensusResult, _as_matrix, _build_k_result
     from nmfx.config import (CheckpointConfig, ConsensusConfig,
@@ -426,7 +538,8 @@ def elastic_consensus(data, ks=(2, 3, 4, 5), restarts: int = 10, *,
     ck = ckpt.SweepCheckpoint.open(arr, ccfg, scfg, icfg, checkpoint)
     runner = ElasticShardRunner(ck, ccfg, scfg, icfg, arr,
                                 devices=devices,
-                                telemetry_dir=telemetry_dir)
+                                telemetry_dir=telemetry_dir,
+                                shard_devices=shard_devices)
     solved = runner.run()
     per_k = {}
     for k in ccfg.ks:

@@ -49,12 +49,14 @@ from nmfx.obs import metrics as _metrics
 
 __all__ = [
     "COSTMODEL_EXEMPT", "DEVICE_PEAKS", "attribute_dispatch",
-    "attribution_enabled", "check_costmodel_coverage", "covered_engines",
+    "attribution_enabled", "check_costmodel_coverage",
+    "comm_covered_algorithms", "comm_model", "covered_engines",
     "device_peak", "disable_attribution", "dispatch_cost",
     "enable_attribution", "engine_universe", "iteration_bytes",
     "iteration_flops", "perf_report", "perf_summary",
     "recent_attributions", "reset_perf", "set_device_peak",
-    "set_sparse_density", "sparse_density", "xla_iteration_cost",
+    "set_sparse_density", "sparse_density", "xla_comm_cost",
+    "xla_iteration_cost",
 ]
 
 #: algorithms deliberately WITHOUT a cost model, with the rationale the
@@ -886,3 +888,298 @@ def _compile_unrolled(algorithm, family, m, n, k, cfg, t):
         return jax.jit(run).lower(a, wb, hb).compile()
 
     raise ValueError(f"unknown engine family {family!r}")
+
+
+# ---------------------------------------------------------------------------
+# Communication model (ISSUE 19): bytes-over-interconnect + collective
+# counts per iteration per (algorithm × mesh shape), cross-validated
+# against the compiled HLO's collective ops the same way the FLOPs
+# models are validated against cost_analysis().
+#
+# The schedule being modeled is the MPI-FAUN/HPC-NMF communication-
+# optimal one the grid-sharded driver executes (arxiv 1609.09154,
+# 1509.09313): A is 2-D block-distributed and never moves; per factor
+# update each shard contracts Gram-first and allreduces only the k×k
+# Gram (or the kl k-vector) plus the k×(dim/shard) factor slab — one
+# allreduce pair per present grid axis per iteration, O(k² + k·dim/p)
+# words, and the restart axis is COMMUNICATION-FREE per iteration (its
+# only collectives are the consensus psum and best-restart selection in
+# the epilogue). The table below is exact against compiled HLO on the
+# forced-CPU meshes (tests/test_costmodel.py; bench `detail.mesh` gates
+# it per round), with payload element counts read off the solver psums:
+#
+#   kl           per axis: k×dim_loc quotient slab + k vector      (2 ops)
+#   neals/snmf   per axis: k×k Gram + k×dim_loc normal-eq slab     (2 ops)
+#   hals         per axis: k×k Gram + k×dim_loc shared-GEMM slab   (2 ops)
+#   mu (packed)  per axis: (r_loc·k)² pool Gram + r_loc·k×dim_loc
+#                numerator slab + the r_loc-lane i32 nonfinite-guard
+#                reduction                                         (3 ops)
+#
+# dim_loc is n_loc for the feature axis (H-side terms, m-contracted)
+# and m_loc for the sample axis (W-side terms, n-contracted); all f32
+# payloads scale ×r_loc because vmapped lanes batch into one collective.
+# ---------------------------------------------------------------------------
+
+#: per-(grid-driver algorithm) collective schedule: ops per present
+#: grid axis per iteration, f32 payload elements as a function of
+#: (k, dim_loc, r_loc), and the optional i32 guard-lane payload. A
+#: LITERAL table like _FLOPS/_BYTES: adding a grid algorithm without a
+#: comm entry fails comm_model loudly, and the HLO cross-check pins
+#: each entry exactly.
+_COMM = {
+    "kl": dict(ops_per_axis=2,
+               payload=lambda k, d, r: r * (k * d + k),
+               guard=None),
+    "neals": dict(ops_per_axis=2,
+                  payload=lambda k, d, r: r * (k * d + k * k),
+                  guard=None),
+    "snmf": dict(ops_per_axis=2,
+                 payload=lambda k, d, r: r * (k * d + k * k),
+                 guard=None),
+    "hals": dict(ops_per_axis=2,
+                 payload=lambda k, d, r: r * (k * d + k * k),
+                 guard=None),
+    "mu": dict(ops_per_axis=3,
+               payload=lambda k, d, r: r * k * d + (r * k) ** 2,
+               guard=lambda r: r),
+}
+
+
+def comm_covered_algorithms() -> frozenset:
+    """Algorithms with a communication model — exactly the set the
+    grid-sharded driver accepts (mu via the packed pool path, plus
+    ``sweep.GRID_SOLVERS``); everything else is restart-parallel only
+    and moves zero per-iteration bytes."""
+    return frozenset(_COMM)
+
+
+def _ring_wire_bytes(payload_bytes: float, p: int) -> float:
+    """Bytes a p-participant ring allreduce moves per participant over
+    the interconnect: 2(p-1)/p × payload (reduce-scatter +
+    all-gather) — the standard bandwidth-optimal convention, and the
+    convention MPI-FAUN's word counts use."""
+    if p <= 1:
+        return 0.0
+    return 2.0 * (p - 1) / p * payload_bytes
+
+
+def comm_model(algorithm: str, m: int, n: int, k: int, *,
+               restart_shards: int = 1, feature_shards: int = 1,
+               sample_shards: int = 1, restarts: "int | None" = None,
+               itemsize: int = 4) -> dict:
+    """Per-iteration collective schedule of one meshed factorization.
+
+    Returns a dict with ``collectives_per_iter`` (allreduce op count in
+    the compiled update program — 0 on a restart-only mesh: the
+    communication-avoiding property), ``payload_bytes_per_iter`` (sum
+    of allreduce payload sizes), ``wire_bytes_per_iter`` (ring-
+    allreduce bytes over the interconnect per participant), a
+    ``per_axis`` breakdown, and the ``epilogue`` (the per-k consensus
+    reduction over the restart axis: one n_pad×n_pad psum plus the
+    fault-count scalar — amortized over the whole solve, not per
+    iteration). Counts and payload bytes are exact against compiled
+    HLO (:func:`xla_comm_cost`); wire bytes are the ring convention.
+
+    ``restarts``/``restart_shards`` set the local lane count r_loc
+    (payloads scale with it); shapes use the padded local dims the
+    sharded program actually allocates."""
+    if algorithm not in _COMM:
+        raise ValueError(
+            f"no communication model for algorithm {algorithm!r} — the "
+            "grid-sharded driver accepts "
+            f"{sorted(_COMM)} (everything else is restart-parallel "
+            "only); add a _COMM entry with the new schedule")
+    for name, v in (("restart_shards", restart_shards),
+                    ("feature_shards", feature_shards),
+                    ("sample_shards", sample_shards)):
+        if v < 1:
+            raise ValueError(f"{name} must be >= 1, got {v}")
+    ent = _COMM[algorithm]
+    r_total = restart_shards if restarts is None else restarts
+    r_loc = -(-r_total // restart_shards)
+    m_loc = -(-m // feature_shards)
+    n_loc = -(-n // sample_shards)
+    per_axis = {}
+    total_ops = 0
+    total_payload = 0.0
+    total_wire = 0.0
+    for axis, p, dim_loc in (("features", feature_shards, n_loc),
+                             ("samples", sample_shards, m_loc)):
+        if p <= 1:
+            continue
+        payload = ent["payload"](k, dim_loc, r_loc) * itemsize
+        ops = ent["ops_per_axis"]
+        if ent["guard"] is not None:
+            payload += ent["guard"](r_loc) * 4  # i32 lane flags
+        wire = _ring_wire_bytes(payload, p)
+        per_axis[axis] = dict(collectives=ops, payload_bytes=payload,
+                              wire_bytes=wire, participants=p)
+        total_ops += ops
+        total_payload += payload
+        total_wire += wire
+    n_pad = n_loc * sample_shards
+    epi_payload = (float(n_pad) * n_pad + 1) * itemsize \
+        if restart_shards > 1 else 0.0
+    epilogue = dict(
+        collectives=2 if restart_shards > 1 else 0,
+        payload_bytes=epi_payload,
+        wire_bytes=_ring_wire_bytes(epi_payload, restart_shards))
+    return dict(algorithm=algorithm,
+                mesh_shape=(restart_shards, feature_shards,
+                            sample_shards),
+                r_loc=r_loc,
+                collectives_per_iter=total_ops,
+                payload_bytes_per_iter=total_payload,
+                wire_bytes_per_iter=total_wire,
+                per_axis=per_axis,
+                epilogue=epilogue)
+
+
+#: HLO scalar dtype sizes for collective payload parsing
+_HLO_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2,
+                    "s16": 2, "u16": 2, "f32": 4, "s32": 4, "u32": 4,
+                    "f64": 8, "s64": 8, "u64": 8}
+
+
+def _hlo_collectives(hlo_text: str) -> "tuple[int, float]":
+    """(op count, total payload bytes) of the all-reduce instructions
+    in an HLO module dump. Tuple-shaped results (XLA's allreduce
+    combiner) count as one op with the summed payload."""
+    import re
+
+    ops = 0
+    payload = 0.0
+    for mres in re.finditer(r"=\s+(\(?[a-z0-9\[\],{}/ ]+?\)?)\s+"
+                            r"all-reduce(?:-start)?\(", hlo_text):
+        ops += 1
+        for dt, dims in re.findall(r"([a-z][a-z0-9]*)\[([0-9,]*)\]",
+                                   mres.group(1)):
+            size = _HLO_DTYPE_BYTES.get(dt)
+            if size is None:
+                continue
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            payload += elems * size
+    return ops, payload
+
+
+def xla_comm_cost(algorithm: str, m: int, n: int, k: int, mesh,
+                  cfg=None, r_loc: int = 2,
+                  unrolls: "tuple[int, int]" = (1, 3)) -> "dict | None":
+    """Measure the per-iteration collective count and payload bytes of
+    the grid-sharded update program by compiling it at two unroll
+    depths over ``mesh`` and differencing the HLO's all-reduce ops —
+    the collective-op analogue of :func:`xla_iteration_cost`'s FLOP
+    differencing (fixed setup/epilogue collectives cancel).
+
+    Compiles the same per-step programs the sharded sweep executes:
+    ``SOLVERS[alg].step`` with a bound ``ShardInfo`` under vmap for the
+    grid solvers, the packed-pool ``_step`` for mu — update math only
+    (check=False), matching what :func:`comm_model` models. Returns
+    ``{"collectives_per_iter", "payload_bytes_per_iter"}``, or None
+    when the program can't compile here (missing backend support)."""
+    try:
+        counts = [
+            _hlo_collectives(
+                _compile_grid_unrolled(algorithm, m, n, k, cfg, mesh,
+                                       t, r_loc).as_text())
+            for t in unrolls]
+    except Exception:  # nmfx: ignore[NMFX006] -- the documented "no
+        return None    # measurement on this backend" contract: callers
+    #                    (tests, the bench mesh stage) skip the gate
+    #                    when compilation is unavailable here
+    dt = unrolls[1] - unrolls[0]
+    return dict(
+        collectives_per_iter=(counts[1][0] - counts[0][0]) / dt,
+        payload_bytes_per_iter=(counts[1][1] - counts[0][1]) / dt)
+
+
+def _compile_grid_unrolled(algorithm: str, m: int, n: int, k: int,
+                           cfg, mesh, t: int, r_loc: int):
+    """Compile ``t`` unrolled grid-sharded update steps over ``mesh``
+    (no while loop — a while body's collectives appear once in HLO
+    regardless of trip count, which would defeat the differencing)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from nmfx._compat import shard_map
+    from nmfx.config import SolverConfig
+    from nmfx.sweep import FEATURE_AXIS, RESTART_AXIS, SAMPLE_AXIS
+
+    if cfg is None:
+        cfg = SolverConfig(algorithm=algorithm)
+    f_sh = mesh.shape.get(FEATURE_AXIS, 1)
+    s_sh = mesh.shape.get(SAMPLE_AXIS, 1)
+    r_sh = mesh.shape.get(RESTART_AXIS, 1)
+    f_ax = FEATURE_AXIS if f_sh > 1 else None
+    s_ax = SAMPLE_AXIS if s_sh > 1 else None
+    rs = RESTART_AXIS if r_sh > 1 else None
+    R = r_sh * r_loc
+    a = jnp.ones((m, n), jnp.float32)
+
+    if algorithm == "mu":
+        from nmfx.ops import packed_mu as pm
+
+        def body(a_loc, wp, hp):
+            bd = pm.block_diag_mask(r_loc, k, jnp.float32)
+            st = pm.PackedState(
+                wp=wp, hp=hp, wp_prev=wp, hp_prev=hp,
+                iteration=jnp.zeros((), jnp.int32),
+                classes=jnp.full((r_loc, hp.shape[1]), -1, jnp.int32),
+                stable=jnp.zeros((r_loc,), jnp.int32),
+                done=jnp.zeros((r_loc,), bool),
+                done_iter=jnp.zeros((r_loc,), jnp.int32),
+                stop_reason=jnp.zeros((r_loc,), jnp.int32),
+                nonfinite=None)
+            for _ in range(t):
+                st = pm._step(a_loc, bd, st, cfg, r_loc, False,
+                              feature_axis=f_ax, sample_axis=s_ax,
+                              n_total=n)
+            return st.wp, st.hp
+
+        wp = jnp.ones((m, R * k), jnp.float32)
+        hp = jnp.ones((R * k, n), jnp.float32)
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P(f_ax, s_ax), P(f_ax, rs),
+                                 P(rs, s_ax)),
+                       out_specs=(P(f_ax, rs), P(rs, s_ax)),
+                       check_vma=False)
+        return jax.jit(fn).lower(a, wp, hp).compile()
+
+    from nmfx.solvers import SOLVERS, base
+    from nmfx.sweep import GRID_SOLVERS
+
+    if algorithm not in GRID_SOLVERS:
+        raise ValueError(
+            f"algorithm {algorithm!r} has no grid-sharded form")
+    grid_mod = SOLVERS[algorithm]
+    shard_info = base.ShardInfo(f_ax, s_ax, m, n)
+    step_fn = functools.partial(grid_mod.step, shard=shard_info)
+
+    def body(a_loc, w0s, h0s):
+        def lane(w0, h0):
+            st = base.init_state(
+                a_loc, w0, h0,
+                grid_mod.init_aux(a_loc, w0, h0, cfg,
+                                  shard=shard_info))
+            for _ in range(t):
+                st = st._replace(w_prev=st.w, h_prev=st.h,
+                                 iteration=st.iteration + 1)
+                st = step_fn(a_loc, st, cfg, False)
+            return st.w, st.h
+
+        return jax.vmap(lane)(w0s, h0s)
+
+    w0s = jnp.ones((R, m, k), jnp.float32)
+    h0s = jnp.ones((R, k, n), jnp.float32)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(f_ax, s_ax), P(rs, f_ax, None),
+                             P(rs, None, s_ax)),
+                   out_specs=(P(rs, f_ax, None), P(rs, None, s_ax)),
+                   check_vma=False)
+    return jax.jit(fn).lower(a, w0s, h0s).compile()

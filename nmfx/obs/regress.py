@@ -151,6 +151,23 @@ METRICS = (
                0.35,
                "from-scratch wall over incremental-extend wall at a "
                "2x-widened restart budget, bit-identity gated"),
+    # --- mesh tier (ISSUE 19: multi-chip solves) --------------------
+    # forced-CPU-device curves: host-dependent walls, loose thresholds;
+    # the bench's own exit-2 gates (bit-identity, comm-vs-HLO,
+    # placement correctness) are the hard contracts
+    MetricSpec("mesh_strong_restarts_per_s_x4",
+               ("detail.mesh.strong[shards=4].restarts_per_s",),
+               "higher", 0.50,
+               "fixed-total-restart throughput on a 4-shard restart "
+               "mesh (pad lanes subtracted)"),
+    MetricSpec("mesh_weak_restarts_per_s_x4",
+               ("detail.mesh.weak[shards=4].restarts_per_s",),
+               "higher", 0.50,
+               "fixed-per-shard throughput on a 4-shard restart mesh"),
+    MetricSpec("mesh_fleet_wall_s",
+               ("detail.mesh.fleet.wall_s",), "lower", 0.50,
+               "heterogeneous-fleet rung wall (2 atlas on the mesh "
+               "class + 2 small on the 1-chip class)"),
     # --- atlas-scale solves (ISSUE 17: tiles + sparse ingestion) ----
     MetricSpec("atlas_tiled_restarts_per_s",
                ("detail.atlas.out_of_core.restarts_per_s",), "higher",

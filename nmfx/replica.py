@@ -173,6 +173,7 @@ class ThreadReplica:
 
     def __init__(self, replica_id: str, root: str, ledger, *,
                  serve_cfg=None, engine=None, exec_cache=None,
+                 mesh_spec: "str | None" = None, devices=None,
                  profiler=None, telemetry_dir: "str | None" = None,
                  heartbeat_interval_s: float = 0.5):
         import dataclasses
@@ -186,11 +187,31 @@ class ThreadReplica:
         os.makedirs(self.spill_dir, exist_ok=True)
         self.state = "routable"
         cfg = serve_cfg if serve_cfg is not None else ServeConfig()
+        if mesh_spec is None:
+            mesh_spec = cfg.mesh_spec
+        self.mesh_spec = mesh_spec
+        if mesh_spec is not None:
+            from nmfx.distributed import parse_mesh_spec
+
+            r, f, s = parse_mesh_spec(mesh_spec)
+            self.n_devices = r * f * s
+        else:
+            self.n_devices = 1
         cfg = dataclasses.replace(
             cfg, role="replica", instance=replica_id,
-            spill_dir=self.spill_dir,
+            spill_dir=self.spill_dir, mesh_spec=mesh_spec,
             telemetry_dir=(telemetry_dir if cfg.telemetry_dir is None
                            else cfg.telemetry_dir))
+        if engine is None and mesh_spec is not None \
+                and devices is not None:
+            # the pool carved this replica an explicit device block —
+            # build the mesh engine over exactly those devices (the
+            # server's own mesh_spec path would grab the head of
+            # jax.devices() and alias siblings onto the same chips)
+            from nmfx.serve import MeshEngine
+
+            engine = MeshEngine(mesh_spec, devices=devices,
+                                profiler=profiler)
         self.server = NMFXServer(
             cfg, engine=engine,
             exec_cache=None if engine is not None else exec_cache,
@@ -202,7 +223,8 @@ class ThreadReplica:
         s = self.server.stats()
         return {"role": "replica", "kind": self.kind,
                 "state": self.state, "queue_depth": s["queued"],
-                "inflight": s["inflight"]}
+                "inflight": s["inflight"],
+                "mesh": self.mesh_spec, "devices": self.n_devices}
 
     def forward(self, rid: str, a: np.ndarray, meta: dict) -> Future:
         """Submit one spill-format payload to this replica's server;
@@ -259,6 +281,7 @@ class ProcessReplica:
     def __init__(self, replica_id: str, root: str, ledger, *,
                  cache_dir: "str | None" = None,
                  telemetry_dir: "str | None" = None,
+                 mesh_spec: "str | None" = None,
                  heartbeat_interval_s: float = 0.5,
                  poll_interval_s: float = 0.05,
                  worker_args: "tuple[str, ...]" = (),
@@ -266,6 +289,14 @@ class ProcessReplica:
         self.replica_id = replica_id
         self.root = root
         self.spawned_at = time.monotonic()
+        self.mesh_spec = mesh_spec
+        if mesh_spec is not None:
+            from nmfx.distributed import parse_mesh_spec
+
+            r, f, s = parse_mesh_spec(mesh_spec)
+            self.n_devices = r * f * s
+        else:
+            self.n_devices = 1
         self.inbox = os.path.join(root, "inbox")
         self.outbox = os.path.join(root, "outbox")
         #: for a process replica the INBOX is the spill dir the router
@@ -289,6 +320,8 @@ class ProcessReplica:
             cmd += ["--cache-dir", cache_dir]
         if telemetry_dir is not None:
             cmd += ["--telemetry-dir", telemetry_dir]
+        if mesh_spec is not None:
+            cmd += ["--mesh-spec", mesh_spec]
         cmd += list(worker_args)
         self.process = subprocess.Popen(
             cmd, env=env, stdout=subprocess.DEVNULL,
@@ -439,7 +472,7 @@ class ProcessReplica:
         self.state = "dead"
 
 
-@guarded_by("_lock", "replicas")
+@guarded_by("_lock", "replicas", "_device_cursor")
 class ReplicaPool:
     """N replicas sharing one pool root + heartbeat ledger.
 
@@ -449,13 +482,22 @@ class ReplicaPool:
     ``cache_dir`` so spawns land on the warm executable cache).
     ``engine_factory`` (thread mode) builds each replica's
     ``nmfx.serve.Engine`` — the hook the router test-suite uses to run
-    the whole tier against scriptable fakes."""
+    the whole tier against scriptable fakes.
+
+    ``mesh_specs`` (ISSUE 19) makes the fleet HETEROGENEOUS: one spec
+    per replica (None = a plain 1-device replica), so one pool holds
+    1-chip and 8-chip members behind one router. In thread mode each
+    meshed member is carved a CONTIGUOUS block of ``jax.devices()``
+    (no two meshed replicas alias a chip); in process mode the spec
+    travels to the worker as ``--mesh-spec`` (each subprocess owns its
+    own runtime, so carving is the deployment's concern)."""
 
     def __init__(self, replicas: int = 2, *, root: str,
                  mode: str = "thread", serve_cfg=None,
                  exec_cache=None, engine_factory=None,
                  cache_dir: "str | None" = None,
                  telemetry_dir: "str | None" = None,
+                 mesh_specs=None,
                  heartbeat_interval_s: float = 0.5,
                  worker_args: "tuple[str, ...]" = (),
                  env: "dict | None" = None):
@@ -467,6 +509,18 @@ class ReplicaPool:
             raise ValueError("replicas must be >= 1")
         if mode == "process" and engine_factory is not None:
             raise ValueError("engine_factory is a thread-mode hook")
+        if mesh_specs is not None:
+            mesh_specs = tuple(mesh_specs)
+            if len(mesh_specs) != replicas:
+                raise ValueError(
+                    f"mesh_specs has {len(mesh_specs)} entries for "
+                    f"{replicas} replicas — pass one spec (or None) "
+                    "per replica")
+            from nmfx.distributed import parse_mesh_spec
+
+            for spec in mesh_specs:
+                if spec is not None:
+                    parse_mesh_spec(spec)  # raises MeshSpecError
         os.makedirs(root, exist_ok=True)
         self.root = root
         self.mode = mode
@@ -481,9 +535,14 @@ class ReplicaPool:
         self.ledger = HeartbeatLedger(root, prefix=HEARTBEAT_PREFIX)
         self._seq = itertools.count()
         self._lock = threading.Lock()
+        #: next unclaimed jax.devices() index for thread-mode mesh
+        #: carving (plain replicas never advance it — they share the
+        #: default device, today's behavior)
+        self._device_cursor = 0
         self.replicas: "dict[str, object]" = {}
-        for _ in range(replicas):
-            self.spawn()
+        for i in range(replicas):
+            self.spawn(mesh_spec=None if mesh_specs is None
+                       else mesh_specs[i])
 
     def _sync_gauge(self) -> None:
         states: "dict[str, int]" = {}
@@ -492,11 +551,36 @@ class ReplicaPool:
         for state in ("routable", "draining", "dead"):
             _replicas_gauge.set(states.get(state, 0), state=state)
 
-    def spawn(self):
+    def _carve_devices(self, mesh_spec: str) -> list:
+        """Claim the next contiguous ``jax.devices()`` block for one
+        meshed thread replica (the HPC-NMF processor-grid discipline:
+        a replica's sub-mesh is a fixed partition of the fleet, never
+        an overlapping view)."""
+        import jax
+
+        from nmfx.distributed import parse_mesh_spec
+
+        r, f, s = parse_mesh_spec(mesh_spec)
+        need = r * f * s
+        devs = jax.devices()
+        with self._lock:
+            lo = self._device_cursor
+            if lo + need > len(devs):
+                raise SpawnFailed(
+                    f"mesh_spec {mesh_spec!r} needs {need} devices but "
+                    f"only {len(devs) - lo} of {len(devs)} remain "
+                    "unclaimed by earlier meshed replicas")
+            self._device_cursor = lo + need
+        return devs[lo:lo + need]
+
+    def spawn(self, mesh_spec: "str | None" = None):
         """Scale-up: one new replica against the (warm) cache. Passes
         the ``replica.spawn`` chaos site; a failure raises
         :class:`SpawnFailed` — the caller (the router's autoscaler)
-        degrades warn-once and keeps the current fleet."""
+        degrades warn-once and keeps the current fleet. A
+        ``mesh_spec`` spawns a MESH member (see the class docstring);
+        the autoscaler's bare ``spawn()`` keeps adding 1-device
+        replicas."""
         from nmfx import faults
 
         rid = f"replica-{os.getpid()}-{next(self._seq)}"
@@ -506,15 +590,20 @@ class ReplicaPool:
             if self.mode == "thread":
                 engine = (self.engine_factory()
                           if self.engine_factory is not None else None)
+                devices = None
+                if mesh_spec is not None and engine is None:
+                    devices = self._carve_devices(mesh_spec)
                 rep = ThreadReplica(
                     rid, root, self.ledger, serve_cfg=self.serve_cfg,
                     engine=engine, exec_cache=self.exec_cache,
+                    mesh_spec=mesh_spec, devices=devices,
                     telemetry_dir=self.telemetry_dir,
                     heartbeat_interval_s=self.heartbeat_interval_s)
             else:
                 rep = ProcessReplica(
                     rid, root, self.ledger, cache_dir=self.cache_dir,
                     telemetry_dir=self.telemetry_dir,
+                    mesh_spec=mesh_spec,
                     heartbeat_interval_s=self.heartbeat_interval_s,
                     worker_args=self.worker_args, env=self.env)
         except faults.FaultInjected as e:
@@ -638,6 +727,7 @@ def worker_main(argv: "list[str] | None" = None) -> int:
     p.add_argument("--cache-dir", default=None)
     p.add_argument("--telemetry-dir", default=None)
     p.add_argument("--max-queue-depth", type=int, default=64)
+    p.add_argument("--mesh-spec", default=None)
     args = p.parse_args(argv)
 
     from nmfx.faults import warn_once
@@ -653,15 +743,22 @@ def worker_main(argv: "list[str] | None" = None) -> int:
     os.makedirs(inbox, exist_ok=True)
     os.makedirs(outbox, exist_ok=True)
     exec_cache = None
-    if args.cache_dir is not None:
+    if args.cache_dir is not None and args.mesh_spec is None:
         from nmfx.config import ExecCacheConfig
         from nmfx.exec_cache import ExecCache
 
         exec_cache = ExecCache(ExecCacheConfig(cache_dir=args.cache_dir))
+    n_devices = 1
+    if args.mesh_spec is not None:
+        from nmfx.distributed import parse_mesh_spec
+
+        r_sh, f_sh, s_sh = parse_mesh_spec(args.mesh_spec)
+        n_devices = r_sh * f_sh * s_sh
     server = NMFXServer(
         ServeConfig(role="replica", instance=args.id,
                     max_queue_depth=args.max_queue_depth,
-                    telemetry_dir=args.telemetry_dir),
+                    telemetry_dir=args.telemetry_dir,
+                    mesh_spec=args.mesh_spec),
         exec_cache=exec_cache)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -672,7 +769,8 @@ def worker_main(argv: "list[str] | None" = None) -> int:
         s = server.stats()
         return {"role": "replica", "kind": "process",
                 "state": "draining" if stop.is_set() else "routable",
-                "queue_depth": s["queued"], "inflight": s["inflight"]}
+                "queue_depth": s["queued"], "inflight": s["inflight"],
+                "mesh": args.mesh_spec, "devices": n_devices}
 
     ledger = HeartbeatLedger(args.pool_dir, prefix=HEARTBEAT_PREFIX)
     beater = _Beater(ledger, args.id, status,

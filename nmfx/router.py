@@ -100,6 +100,11 @@ _readmits_total = _metrics.counter(
 _outstanding_gauge = _metrics.gauge(
     "nmfx_router_outstanding",
     "requests accepted by the router and not yet resolved")
+_placement_total = _metrics.counter(
+    "nmfx_router_placement_total",
+    "placements by capability class — the device count of the chosen "
+    "replica's mesh (1 = a plain single-device replica)",
+    labelnames=("class",))
 _router_e2e_hist = _metrics.histogram(
     "nmfx_router_e2e_seconds",
     "router submit-to-resolution latency", labelnames=("outcome",))
@@ -216,6 +221,23 @@ class RouterConfig:
     #: (``nmfx.result_cache``) — a warm hit resolves at the router with
     #: zero forwards; None disables the disk tier and the cache
     result_cache_dir: "str | None" = None
+    #: cost-priced placement over a heterogeneous fleet (ISSUE 19,
+    #: docs/serving.md "Mesh tier"): partition the routable set into
+    #: CAPABILITY CLASSES by replica device count, price each request
+    #: from the analytic cost model (solve FLOPs + per-iteration comm
+    #: bytes + queue depth — the inputs land in
+    #: ``RouterStats.placement_inputs``), and restrict placement to one
+    #: class: atlas-shaped requests (input ≥ ``atlas_floor_bytes``) go
+    #: to the LARGEST routable class — never to a 1-chip replica while
+    #: a mesh replica is routable — and small requests stay on the
+    #: SMALLEST (mesh time is too expensive to burn on work a single
+    #: chip serves at equal latency). Content-hash stickiness then
+    #: operates WITHIN the chosen class. Default-on is safe: a
+    #: homogeneous fleet has one class, where this is exactly the old
+    #: placement.
+    price_placement: bool = True
+    #: input-matrix bytes at and above which a request is atlas-class
+    atlas_floor_bytes: int = 64 << 20
 
     def __post_init__(self):
         if self.max_outstanding < 1:
@@ -248,6 +270,8 @@ class RouterConfig:
             raise ValueError("spawn_grace_s must be >= 0")
         if self.drain_kill_after_s <= 0:
             raise ValueError("drain_kill_after_s must be positive")
+        if self.atlas_floor_bytes < 1:
+            raise ValueError("atlas_floor_bytes must be >= 1")
 
 
 @dataclasses.dataclass
@@ -271,6 +295,17 @@ class RouterStats:
     degraded_cause: "str | None" = None
     #: causes of the re-forwards this request survived
     retried: "list[str]" = dataclasses.field(default_factory=list)
+    #: capability class the request placed into: the device count of
+    #: the chosen replica's mesh (1 = plain replica); recorded on every
+    #: placement, priced or not (it is telemetry); None before the
+    #: first placement
+    placement_class: "int | None" = None
+    #: the priced-placement decision inputs (ISSUE 19): input bytes,
+    #: the atlas verdict, the per-iteration solve FLOPs and meshed comm
+    #: bytes the cost model priced the chosen class at, and the queue
+    #: depth the load comparison saw — the audit trail for "why did
+    #: this land on an 8-chip mesh"
+    placement_inputs: "dict | None" = None
 
 
 class _RouterFuture(Future):
@@ -605,9 +640,66 @@ class NMFXRouter:
             hashlib.sha256(f"{chash}:{replica_id}".encode())
             .digest()[:8], "big")
 
+    @staticmethod
+    def _capability_class(rep) -> int:
+        """Devices behind one replica (1 = plain single-device)."""
+        return int(getattr(rep, "n_devices", 1) or 1)
+
+    def _price_placement(self, pending: _Pending, candidates: list,
+                         routable: list) -> "tuple[list, list, dict]":
+        """Cost-priced class selection (ISSUE 19): restrict placement
+        to ONE capability class — the largest for atlas-shaped inputs
+        (the hard rule the mesh-tier acceptance test pins: an atlas
+        request never lands on a 1-chip replica while a mesh replica
+        is routable), the smallest otherwise — and price the request
+        against it from the analytic cost model."""
+        classes = sorted({self._capability_class(rep)
+                          for rep in candidates})
+        atlas = int(pending.a.nbytes) >= self.cfg.atlas_floor_bytes
+        chosen = classes[-1] if atlas else classes[0]
+        candidates = [rep for rep in candidates
+                      if self._capability_class(rep) == chosen]
+        routable = [rep for rep in routable
+                    if self._capability_class(rep) == chosen]
+        inputs = {"bytes": int(pending.a.nbytes), "atlas": atlas,
+                  "class": chosen, "classes": classes,
+                  "flops_per_iter": None, "comm_bytes_per_iter": None}
+        try:
+            from nmfx.obs import costmodel
+
+            meta = pending.meta
+            alg = meta["solver_cfg"]["algorithm"]
+            m, n = (int(d) for d in pending.a.shape)
+            kmax = max(int(k) for k in meta["ks"])
+            lanes = len(meta["ks"]) * int(meta["restarts"])
+            fl = costmodel.iteration_flops(alg, "vmap", m, n, kmax)
+            if fl is not None:
+                inputs["flops_per_iter"] = fl * lanes
+            if chosen > 1 and alg in \
+                    costmodel.comm_covered_algorithms():
+                spec = next((rep.mesh_spec for rep in candidates
+                             if getattr(rep, "mesh_spec", None)
+                             is not None), None)
+                if spec is not None:
+                    from nmfx.distributed import parse_mesh_spec
+
+                    r_sh, f_sh, s_sh = parse_mesh_spec(spec)
+                    cm = costmodel.comm_model(
+                        alg, m, n, kmax, restart_shards=r_sh,
+                        feature_shards=f_sh, sample_shards=s_sh,
+                        restarts=int(meta["restarts"]))
+                    inputs["comm_bytes_per_iter"] = \
+                        cm["wire_bytes_per_iter"]
+        except Exception:  # nmfx: ignore[NMFX006] -- pricing is an
+            pass           # annotation; a model gap must never make a
+        #                    request unroutable
+        return candidates, routable, inputs
+
     def _place(self, pending: _Pending):
-        """Pick the target replica: content-sticky by rendezvous hash,
-        yielding to least-loaded when the sticky choice is more than
+        """Pick the target replica: cost-priced capability-class
+        selection first (``RouterConfig.price_placement``), then
+        content-sticky by rendezvous hash WITHIN the class, yielding
+        to least-loaded when the sticky choice is more than
         ``stickiness_slack`` outstanding requests busier."""
         routable = self.pool.routable()
         candidates = [rep for rep in routable
@@ -617,6 +709,10 @@ class NMFXRouter:
                 "no routable replica"
                 + (f" outside {sorted(pending.exclude)}"
                    if pending.exclude else ""))
+        inputs = None
+        if self.cfg.price_placement:
+            candidates, routable, inputs = self._price_placement(
+                pending, candidates, routable)
         with self._lock:
             loads = {rep.replica_id:
                      self._outstanding.get(rep.replica_id, 0)
@@ -637,8 +733,14 @@ class NMFXRouter:
         for rep in ranked:
             if loads[rep.replica_id] \
                     <= min_load + self.cfg.stickiness_slack:
-                pending.future.stats.sticky = \
-                    rep.replica_id == sticky_id
+                st = pending.future.stats
+                st.sticky = rep.replica_id == sticky_id
+                klass = self._capability_class(rep)
+                st.placement_class = klass
+                if inputs is not None:
+                    inputs["queue_depth"] = loads[rep.replica_id]
+                    st.placement_inputs = inputs
+                _placement_total.inc(**{"class": str(klass)})
                 return rep
         raise AssertionError("unreachable: the min-load candidate "
                              "always satisfies the slack bound")

@@ -651,6 +651,17 @@ class ServeConfig:
     #: scraper-based deployments; 0 = ephemeral port (read it from
     #: ``NMFXServer.metrics_port``). None = no endpoint.
     metrics_port: "int | None" = None
+    #: mesh tier (ISSUE 19, docs/serving.md "Mesh tier"): the device
+    #: mesh this server solves over, as a ``distributed.parse_mesh_spec``
+    #: string — "R" (restart-only), "RxF", or "RxFxS". None = the
+    #: single-device engine stack (exec-cache, packing — today's
+    #: behavior). A spec makes the server a MESH replica: dispatches run
+    #: the grid-sharded sweep over ``build_replica_mesh(mesh_spec)``,
+    #: the heartbeat advertises the device count, and the router prices
+    #: atlas-shaped requests onto it. Participates in comparison like
+    #: every field (two servers on different meshes are different
+    #: serving policies).
+    mesh_spec: "str | None" = None
 
     def __post_init__(self):
         if self.max_queue_depth < 1:
@@ -685,6 +696,10 @@ class ServeConfig:
                              "None")
         if not self.role:
             raise ValueError("role must be non-empty")
+        if self.mesh_spec is not None:
+            from nmfx.distributed import parse_mesh_spec
+
+            parse_mesh_spec(self.mesh_spec)  # raises MeshSpecError
 
 
 def serve_key_fields() -> frozenset:
@@ -945,6 +960,49 @@ class ExecCacheEngine:
         return [per_req[r.seq] for r in reqs]
 
 
+class MeshEngine:
+    """The mesh-tier :class:`Engine` (ISSUE 19): every dispatch runs
+    the grid-sharded sweep over one fixed device mesh
+    (``ServeConfig.mesh_spec`` → ``distributed.build_replica_mesh``).
+    Solo-only by design — cross-request lane packing composes restarts
+    into one executable whose pool geometry depends on the batch, which
+    would break the meshed-vs-unmeshed exactness contract the mesh
+    parity suite pins; the mesh's parallelism comes from sharding the
+    solve itself (communication-avoiding restart axis + Gram-first grid
+    axes), not from batching tenants."""
+
+    def __init__(self, mesh_spec: str, *, devices=None, profiler=None):
+        from nmfx.distributed import build_replica_mesh, parse_mesh_spec
+        from nmfx.profiling import NullProfiler
+
+        self.mesh_spec = mesh_spec
+        self.shape = parse_mesh_spec(mesh_spec)
+        self.mesh = build_replica_mesh(mesh_spec, devices=devices)
+        self._prof = profiler if profiler is not None else NullProfiler()
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def compatibility_key(self, req: _Request) -> "tuple | None":
+        return None  # solo only (see class docstring)
+
+    def place(self, req: _Request):
+        return None  # sweep() owns meshed placement
+
+    def dispatch_solo(self, req: _Request, placed, scfg: SolverConfig):
+        from nmfx.sweep import sweep
+
+        ccfg = ExecCacheEngine._ccfg(req)
+        return sweep(req.a, ccfg, scfg, req.icfg, self.mesh,
+                     profiler=self._prof)
+
+    def dispatch_packed(self, reqs, placed):
+        raise RuntimeError(
+            "MeshEngine is solo-only (compatibility_key is always "
+            "None); a packed dispatch reaching it is a scheduler bug")
+
+
 @guarded_by("_lock", "_queue", "_queued", "_pending_bytes", "_closed",
             "_paused", "_inflight", "_crash", "_sched_clean", "_down",
             "_heartbeat")
@@ -975,8 +1033,19 @@ class NMFXServer:
             raise ValueError("pass either engine or exec_cache, not both")
         self.cfg = serve_cfg
         self._prof = profiler if profiler is not None else NullProfiler()
-        self.engine: Engine = engine if engine is not None else \
-            ExecCacheEngine(exec_cache, profiler=self._prof)
+        if engine is not None:
+            self.engine: Engine = engine
+        elif serve_cfg.mesh_spec is not None:
+            if exec_cache is not None:
+                raise ValueError(
+                    "mesh_spec selects the MeshEngine, which does not "
+                    "serve through an executable cache — pass either "
+                    "mesh_spec or exec_cache, not both")
+            self.engine = MeshEngine(serve_cfg.mesh_spec,
+                                     profiler=self._prof)
+        else:
+            self.engine = ExecCacheEngine(exec_cache,
+                                          profiler=self._prof)
         # finished-result cache (ISSUE 16): an explicit instance wins;
         # else a configured directory builds one; else caching is off
         if result_cache is None and serve_cfg.result_cache_dir is not None:

@@ -31,9 +31,19 @@ from nmfx.config import (PACKED_ALGORITHMS, ConsensusConfig,
                          InitConfig, SolverConfig)
 from nmfx.consensus import labels_from_h
 from nmfx.init import initialize, random_init
+from nmfx.obs import metrics as _metrics
 from nmfx.solvers.base import StopReason, solve
 
 _log = logging.getLogger("nmfx")
+
+#: pad-lane honesty (ISSUE 19): surplus restart lanes added so the pool
+#: shards evenly over the mesh's restart axis are computed and
+#: discarded — booked here so scaling numbers (bench `detail.mesh`)
+#: can subtract them instead of crediting them as throughput
+_pad_lanes_total = _metrics.counter(
+    "nmfx_mesh_pad_lanes_total",
+    "surplus restart lanes padded onto meshed sweeps (computed and "
+    "discarded; subtract from restarts/s)")
 
 #: mesh axis name for the restart batch dimension
 RESTART_AXIS = "restarts"
@@ -190,7 +200,10 @@ def _pad_count(restarts: int, mesh: Mesh | None) -> int:
     if mesh is None or RESTART_AXIS not in mesh.axis_names:
         return restarts
     size = mesh.shape[RESTART_AXIS]
-    return -(-restarts // size) * size
+    padded = -(-restarts // size) * size
+    if padded > restarts:
+        _pad_lanes_total.inc(padded - restarts)
+    return padded
 
 
 def _use_packed(solver_cfg: SolverConfig) -> bool:
@@ -734,7 +747,8 @@ def _build_screened_sweep_fn(k: int, restarts: int,
 @lru_cache(maxsize=64)
 def _build_chunk_sweep_fn(k: int, n_chunk: int, solver_cfg: SolverConfig,
                           init_cfg: InitConfig, label_rule: str,
-                          poison: tuple = (), fault_token=None):
+                          poison: tuple = (), fault_token=None,
+                          mesh: "Mesh | None" = None):
     """Sweep builder for the durable-checkpoint chunk executor
     (``nmfx/checkpoint.py``): solve ``n_chunk`` restarts of rank ``k``
     from EXPLICIT per-restart keys (a slice of the canonical
@@ -762,6 +776,10 @@ def _build_chunk_sweep_fn(k: int, n_chunk: int, solver_cfg: SolverConfig,
     packed = _use_packed(solver_cfg)
     if packed:
         from nmfx.ops.packed_mu import mu_packed, unpack_w
+    if mesh is not None:
+        return _build_meshed_chunk_sweep_fn(k, n_chunk, solver_cfg,
+                                            init_cfg, label_rule, poison,
+                                            mesh, packed)
 
     def impl(a: jax.Array, keys: jax.Array) -> ChunkSweepOutput:
         a = jnp.asarray(a, dtype)
@@ -783,6 +801,95 @@ def _build_chunk_sweep_fn(k: int, n_chunk: int, solver_cfg: SolverConfig,
         return ChunkSweepOutput(labels, res.iterations, res.dnorm,
                                 res.stop_reason,
                                 best.astype(jnp.int32), ws[best], hs[best])
+
+    return jax.jit(impl)
+
+
+def _build_meshed_chunk_sweep_fn(k: int, n_chunk: int,
+                                 solver_cfg: SolverConfig,
+                                 init_cfg: InitConfig, label_rule: str,
+                                 poison: tuple, mesh: Mesh,
+                                 packed: bool):
+    """The durable chunk executor over a restart-only sub-mesh
+    (``ElasticShardRunner`` meshed mode, ISSUE 19: a shard is a device
+    *set*, not a device).
+
+    The chunk's lanes shard over the sub-mesh's restart axis — the
+    communication-avoiding layout: zero per-iteration collectives, one
+    tiled all_gather of the per-lane stats plus the masked-psum
+    best-restart selection in the epilogue. Each lane's math is the
+    same vmapped generic driver the unmeshed executor runs, so the
+    persisted record stays bit-identical to a single-device run of the
+    same chunk plan (the elastic exactness contract; pinned in
+    tests/test_distributed.py).
+
+    The packed family is refused: its pool geometry (and therefore its
+    GEMM reduction shapes) is composition-dependent, so sharding a
+    chunk's pool would break record parity with the unmeshed executor.
+    """
+    if any(ax != RESTART_AXIS and mesh.shape[ax] > 1
+           for ax in mesh.axis_names):
+        raise ValueError(
+            "meshed chunk execution shards the restart axis only; got "
+            f"mesh axes {dict(mesh.shape)}")
+    if packed or RESTART_AXIS not in mesh.axis_names:
+        raise ValueError(
+            "meshed chunk execution supports the vmapped generic "
+            "driver only (the packed family's pool geometry is "
+            "composition-dependent; ledger records must stay "
+            "bit-identical to the unmeshed chunk executor)")
+    dtype = jnp.dtype(solver_cfg.dtype)
+    rsize = mesh.shape[RESTART_AXIS]
+    r_loc = -(-n_chunk // rsize)
+    n_pad = r_loc * rsize
+
+    def shard_body(a: jax.Array, keys_loc: jax.Array):
+        ridx = lax.axis_index(RESTART_AXIS)
+        gidx = ridx * r_loc + jnp.arange(r_loc)
+        w0s, h0s = jax.vmap(
+            lambda kk: initialize(kk, a, k, init_cfg, dtype))(keys_loc)
+        if poison:
+            pmask = jnp.isin(gidx, jnp.asarray(poison))
+            w0s = w0s.at[:, 0, 0].set(jnp.where(
+                pmask, jnp.asarray(jnp.nan, w0s.dtype), w0s[:, 0, 0]))
+        res = jax.vmap(
+            lambda w0, h0: solve(a, w0, h0, solver_cfg))(w0s, h0s)
+        labels = jax.vmap(
+            partial(labels_from_h, rule=label_rule))(res.h)
+        labels, dnorm_best, _ = _quarantine_lanes(labels, res.dnorm,
+                                                  res.stop_reason)
+        # global first-min argmin over the canonical lane order: gather
+        # the (tiny) per-lane dnorms, mask the pad lanes to +inf, and
+        # psum-select the owning shard's factors — the same masked-psum
+        # idiom as the grid driver's best-restart epilogue
+        dn_all = lax.all_gather(dnorm_best, RESTART_AXIS, tiled=True)
+        dn_all = jnp.where(jnp.arange(n_pad) < n_chunk, dn_all, jnp.inf)
+        best = jnp.argmin(dn_all).astype(jnp.int32)
+        loc = best - ridx * r_loc
+        mine = (loc >= 0) & (loc < r_loc)
+        sel = jnp.where(mine, jnp.asarray(1, res.w.dtype), 0)
+        locc = jnp.clip(loc, 0, r_loc - 1)
+        wb = lax.psum(sel * res.w[locc], RESTART_AXIS)
+        hb = lax.psum(sel * res.h[locc], RESTART_AXIS)
+        return (labels, res.iterations, res.dnorm, res.stop_reason,
+                best, wb, hb)
+
+    sharded = shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(), P(RESTART_AXIS)),
+        out_specs=(P(RESTART_AXIS), P(RESTART_AXIS), P(RESTART_AXIS),
+                   P(RESTART_AXIS), P(), P(), P()),
+        check_vma=False)
+
+    def impl(a: jax.Array, keys: jax.Array) -> ChunkSweepOutput:
+        a = jnp.asarray(a, dtype)
+        if n_pad != n_chunk:
+            reps = -(-n_pad // n_chunk)
+            keys = jnp.concatenate([keys] * reps)[:n_pad]
+        labels, iters, dnorm, stop, best, wb, hb = sharded(a, keys)
+        return ChunkSweepOutput(labels[:n_chunk], iters[:n_chunk],
+                                dnorm[:n_chunk], stop[:n_chunk],
+                                best, wb, hb)
 
     return jax.jit(impl)
 

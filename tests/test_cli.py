@@ -639,3 +639,69 @@ def test_cli_result_cache_rejects_keep_factors(gct_path, tmp_path,
         main([gct_path, "--keep-factors", "--no-files",
               "--result-cache-dir", str(tmp_path / "rescache")])
     assert "keep-factors" in capsys.readouterr().err
+
+
+def test_cli_restart_shards(gct_path, capsys):
+    """ISSUE 19: --restart-shards N pins the communication-avoiding
+    restart axis to exactly N devices (auto uses all 8); results reach
+    the same summary as the auto-mesh path."""
+    rc = main([gct_path, "--ks", "2", "--restarts", "4",
+               "--maxiter", "100", "--no-files",
+               "--restart-shards", "4"])
+    assert rc == 0
+    assert "best k = 2" in capsys.readouterr().out
+    # composes with the grid axes into an R x F x S mesh
+    rc = main([gct_path, "--ks", "2", "--restarts", "4",
+               "--maxiter", "100", "--no-files", "--restart-shards",
+               "2", "--feature-shards", "2", "--sample-shards", "2"])
+    assert rc == 0
+
+
+def test_cli_restart_shards_rejects_bad_combos(gct_path, tmp_path):
+    for argv in (
+        [gct_path, "--restart-shards", "0", "--no-files"],
+        [gct_path, "--restart-shards", "16", "--no-files"],  # > devices
+        [gct_path, "--restart-shards", "2", "--no-mesh", "--no-files"],
+        # the serving scheduler owns one device; mesh-tier serving is
+        # per-replica (--replica-mesh)
+        [gct_path, "--serve-smoke", "--restart-shards", "2",
+         "--no-files"],
+        # the tile stream owns one device
+        [gct_path, "--restart-shards", "2", "--tile-rows", "16",
+         "--no-files"],
+        # the cache tier already restart-shards over all devices
+        [gct_path, "--exec-cache", "--restart-shards", "2",
+         "--no-files"],
+    ):
+        with pytest.raises(SystemExit):
+            main(argv)
+
+
+def test_cli_replica_mesh_heterogeneous_fleet(gct_path, capsys):
+    """ISSUE 19: --replica-mesh makes the serve-smoke pool
+    heterogeneous (one plain + one 4-device mesh replica); the priced
+    router routes this small request to the 1-device class."""
+    rc = main([gct_path, "--ks", "2", "--restarts", "3",
+               "--maxiter", "100", "--no-files", "--serve-smoke",
+               "--replicas", "2", "--replica-mesh=-,4"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "best k = 2" in cap.out
+    assert "class=1" in cap.err  # small request -> plain replica
+
+
+def test_cli_replica_mesh_rejects_bad_combos(gct_path, capsys):
+    # requires the service tier
+    with pytest.raises(SystemExit):
+        main([gct_path, "--replica-mesh=-,4", "--no-files"])
+    assert "pass --serve-smoke --replicas" in capsys.readouterr().err
+    # one spec per replica
+    with pytest.raises(SystemExit):
+        main([gct_path, "--serve-smoke", "--replicas", "3",
+              "--replica-mesh=-,4", "--no-files"])
+    assert "one entry per replica" in capsys.readouterr().err
+    # specs are validated before any replica spawns
+    with pytest.raises(SystemExit):
+        main([gct_path, "--serve-smoke", "--replicas", "2",
+              "--replica-mesh=-,bogus", "--no-files"])
+    assert "non-integer axis count" in capsys.readouterr().err

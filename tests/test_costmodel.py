@@ -438,3 +438,64 @@ def test_regress_corrupt_round_skipped(tmp_path):
                 tmp_path / "BENCH_r05.json")
     rounds = regress.load_rounds(str(tmp_path))
     assert [r["round"] for r in rounds] == [5]
+
+
+# ---------------------------------------------------------------------
+# communication model (ISSUE 19): analytic collective schedule vs the
+# compiled HLO's allreduce ops — the FLOPs-vs-cost_analysis discipline
+# applied to bytes-over-interconnect
+# ---------------------------------------------------------------------
+
+def test_comm_covered_matches_grid_driver():
+    """Coverage invariant: exactly the engines the grid-sharded driver
+    accepts (GRID_SOLVERS plus packed mu) have a comm model."""
+    from nmfx.sweep import GRID_SOLVERS
+
+    assert cm.comm_covered_algorithms() == frozenset(GRID_SOLVERS) | {"mu"}
+    with pytest.raises(ValueError, match="no communication model"):
+        cm.comm_model("pg", M, N, K)
+
+
+def test_comm_model_restart_only_is_communication_avoiding():
+    """The mesh tier's central claim: a restart-only mesh moves ZERO
+    bytes per iteration — every lane is independent; only the per-k
+    consensus epilogue reduces over the restart axis."""
+    for alg in sorted(cm.comm_covered_algorithms()):
+        model = cm.comm_model(alg, M, N, K, restart_shards=4, restarts=8)
+        assert model["collectives_per_iter"] == 0, alg
+        assert model["payload_bytes_per_iter"] == 0.0, alg
+        assert model["wire_bytes_per_iter"] == 0.0, alg
+        assert model["epilogue"]["payload_bytes"] > 0, alg
+
+
+def test_comm_model_validation_and_scaling():
+    with pytest.raises(ValueError, match=">= 1"):
+        cm.comm_model("kl", M, N, K, feature_shards=0)
+    one = cm.comm_model("kl", M, N, K, feature_shards=2, restarts=1)
+    two = cm.comm_model("kl", M, N, K, feature_shards=2, restarts=2)
+    # payloads scale with the local lane count (factors carry r_loc)
+    assert two["payload_bytes_per_iter"] == 2 * one["payload_bytes_per_iter"]
+    # wire bytes follow the ring convention: 2(p-1)/p of payload
+    per = one["per_axis"]["features"]
+    assert per["participants"] == 2
+    assert per["wire_bytes"] == pytest.approx(per["payload_bytes"])
+
+
+@pytest.mark.parametrize("alg,ops", [("kl", 4), ("mu", 6)])
+def test_comm_model_matches_compiled_hlo(alg, ops):
+    """Exact-count, exact-payload cross-validation on a 1×2×2 grid mesh
+    (2 allreduces per grid axis per iteration for the generic drivers,
+    3 for packed mu). The heavier engines ride the bench's detail.mesh
+    comm gate; here the two serving defaults pin the contract in
+    tier-1."""
+    from nmfx.sweep import grid_mesh
+
+    mesh = grid_mesh(1, 2, 2)
+    model = cm.comm_model(alg, M, N, K, feature_shards=2,
+                          sample_shards=2, restarts=2)
+    meas = cm.xla_comm_cost(alg, M, N, K, mesh, r_loc=2)
+    assert meas is not None, "HLO collective measurement unavailable"
+    assert model["collectives_per_iter"] == ops
+    assert meas["collectives_per_iter"] == model["collectives_per_iter"]
+    assert meas["payload_bytes_per_iter"] == pytest.approx(
+        model["payload_bytes_per_iter"], rel=0.01)

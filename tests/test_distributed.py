@@ -281,3 +281,102 @@ def test_elastic_absorbed_crash_does_not_raise(two_group_data, tmp_path,
                                         every_n_restarts=2),
             devices=jax.devices()[:2], **kw)
     _bit_identical(res, ref)
+
+
+# ---------------------------------------------------------------------
+# replica mesh specs (ISSUE 19): grammar, device fit, meshed shards
+# ---------------------------------------------------------------------
+
+def test_parse_mesh_spec_grammar():
+    assert dist.parse_mesh_spec("4") == (4, 1, 1)
+    assert dist.parse_mesh_spec("2x2") == (2, 2, 1)
+    assert dist.parse_mesh_spec("2x2x2") == (2, 2, 2)
+    assert dist.parse_mesh_spec("1") == (1, 1, 1)
+    for bad in ("", "ax2", "2x", "0", "2x0", "-1", "2x2x2x2"):
+        with pytest.raises(dist.MeshSpecError):
+            dist.parse_mesh_spec(bad)
+    # the typed error is still a ValueError for legacy handlers
+    assert issubclass(dist.MeshSpecError, ValueError)
+
+
+def test_build_replica_mesh_default_devices_prefix():
+    from nmfx.sweep import RESTART_AXIS
+
+    mesh = dist.build_replica_mesh("4")
+    assert mesh.shape[RESTART_AXIS] == 4
+    assert list(mesh.devices.flat) == jax.devices()[:4]
+    with pytest.raises(dist.MeshSpecError, match="needs 16 device"):
+        dist.build_replica_mesh("16")  # this process has only 8
+
+
+def test_build_replica_mesh_explicit_devices_exact_count():
+    """A pool-carved device block must be consumed EXACTLY: a replica
+    owning more chips than its mesh uses would idle capacity the
+    router still prices — typed error, not truncation."""
+    devs = jax.devices()
+    mesh = dist.build_replica_mesh("2", devices=devs[:2])
+    assert list(mesh.devices.flat) == devs[:2]
+    with pytest.raises(dist.MeshSpecError, match="exactly 2"):
+        dist.build_replica_mesh("2", devices=devs[:4])
+    with pytest.raises(dist.MeshSpecError, match="exactly 4"):
+        dist.build_replica_mesh("2x2", devices=devs[:2])
+
+
+def test_build_replica_mesh_grid_axes():
+    from nmfx.sweep import FEATURE_AXIS, RESTART_AXIS, SAMPLE_AXIS
+
+    mesh = dist.build_replica_mesh("2x2x2")
+    assert mesh.shape[RESTART_AXIS] == 2
+    assert mesh.shape[FEATURE_AXIS] == 2
+    assert mesh.shape[SAMPLE_AXIS] == 2
+
+
+def test_elastic_shard_devices_uneven_counts_typed(two_group_data,
+                                                   tmp_path):
+    """Meshed elastic mode rejects device counts that don't tile: a
+    ragged remainder would idle devices silently."""
+    from nmfx import checkpoint as ckpt
+    from nmfx.config import (CheckpointConfig, ConsensusConfig,
+                             InitConfig, SolverConfig)
+
+    ccfg = ConsensusConfig(ks=(2,), restarts=4, seed=5)
+    scfg, icfg = SolverConfig(algorithm="mu", max_iter=10), InitConfig()
+    ck = ckpt.SweepCheckpoint.open(
+        np.asarray(two_group_data), ccfg, scfg, icfg,
+        CheckpointConfig(str(tmp_path / "ck"), every_n_restarts=2))
+    mk = lambda **kw: dist.ElasticShardRunner(
+        ck, ccfg, scfg, icfg, np.asarray(two_group_data), **kw)
+    with pytest.raises(dist.MeshSpecError, match=">= 1"):
+        mk(shard_devices=0)
+    with pytest.raises(dist.MeshSpecError, match="exceeds"):
+        mk(devices=jax.devices()[:2], shard_devices=4)
+    with pytest.raises(dist.MeshSpecError, match="divide"):
+        mk(devices=jax.devices()[:6], shard_devices=4)
+    # an even tiling builds sub-mesh groups, one worker per group
+    r = mk(devices=jax.devices()[:6], shard_devices=2)
+    assert [len(g) for g in r._groups] == [2, 2, 2]
+
+
+@pytest.mark.slow
+def test_elastic_meshed_shards_bit_identical(two_group_data, tmp_path):
+    """shard_devices=2 over 4 devices (2 meshed shards) must match the
+    single-device checkpointed run bit-for-bit — the meshed executor
+    draws the same canonical keys and commits the same records. kl
+    rides the vmapped generic driver, the only family the meshed chunk
+    executor accepts (packed-mu pool geometry is composition-dependent,
+    so it is typed-rejected rather than silently divergent)."""
+    from nmfx.api import nmfconsensus
+    from nmfx.config import CheckpointConfig, SolverConfig
+
+    scfg = SolverConfig(algorithm="kl", max_iter=40)
+    kw = dict(ks=(2, 3), restarts=6, seed=5)
+    ref = nmfconsensus(two_group_data, solver_cfg=scfg,
+                       checkpoint=CheckpointConfig(
+                           str(tmp_path / "ref"), every_n_restarts=2),
+                       **kw)
+    res = dist.elastic_consensus(
+        two_group_data, solver_cfg=scfg,
+        checkpoint=CheckpointConfig(str(tmp_path / "mesh"),
+                                    every_n_restarts=2),
+        devices=jax.devices()[:4], shard_devices=2, **kw)
+    _bit_identical(res, ref)

@@ -1,0 +1,82 @@
+"""Meshed-vs-unmeshed parity matrix (ISSUE 19, satellite c).
+
+The mesh tier's core promise is that placement is a *pricing* decision,
+never a *numerics* decision: a sweep on a restart-sharded mesh must be
+BIT-identical to the single-device sweep (same keys, same math, only
+device placement differs), and a grid (feature×sample) mesh — whose
+per-iteration psums reorder float reductions — must still agree on the
+consensus matrix to clustering tolerance. Every grid-driver engine plus
+the packed-mu engine goes through the matrix on 4 of the 8 forced CPU
+devices (conftest.py pins the platform). The heavy engines ride the
+``slow`` marker; ``kl`` and ``mu`` (the two serving defaults) stay in
+tier-1 so the contract is checked on every push.
+"""
+
+import numpy as np
+import pytest
+
+from nmfx.config import ConsensusConfig, SolverConfig
+from nmfx.sweep import GRID_SOLVERS, grid_mesh, sweep
+
+# engines cheap enough for tier-1; the rest of the matrix is `slow`
+_FAST = ("kl", "mu")
+_ENGINES = tuple(sorted(set(GRID_SOLVERS) | {"mu"}))
+_BIT_FIELDS = ("consensus", "labels", "dnorms")
+
+
+def _params():
+    return [
+        pytest.param(alg, marks=() if alg in _FAST else (pytest.mark.slow,))
+        for alg in _ENGINES
+    ]
+
+
+def _run(a, alg, mesh, restarts=6):
+    scfg = SolverConfig(algorithm=alg, max_iter=60)
+    ccfg = ConsensusConfig(ks=(3,), restarts=restarts, seed=123)
+    return sweep(a, ccfg, scfg, mesh=mesh)[3]
+
+
+@pytest.mark.parametrize("alg", _params())
+def test_restart_mesh_bit_identical(two_group_data, alg):
+    ref = _run(two_group_data, alg, mesh=None)
+    got = _run(two_group_data, alg, mesh=grid_mesh(4, 1, 1))
+    for field in _BIT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, field)),
+            np.asarray(getattr(got, field)),
+            err_msg=f"{alg}: {field} diverged on the restart mesh")
+
+
+@pytest.mark.parametrize("alg", _params())
+def test_grid_mesh_agreement(two_group_data, alg):
+    """Feature×sample sharding reorders the psum reductions, so the gate
+    is agreement (consensus entries within clustering tolerance), not
+    bit-identity."""
+    ref = _run(two_group_data, alg, mesh=None)
+    got = _run(two_group_data, alg, mesh=grid_mesh(1, 2, 2))
+    assert np.allclose(np.asarray(ref.consensus),
+                       np.asarray(got.consensus), atol=0.35), (
+        f"{alg}: grid-mesh consensus diverged beyond tolerance")
+
+
+def test_restart_mesh_pads_surplus_lanes(two_group_data):
+    """5 restarts on 4 shards pads to 8 lanes; the 3 surplus lanes are
+    computed-and-discarded, booked on the honesty counter, and the
+    result is still bit-identical to the unmeshed sweep."""
+    from nmfx.obs import metrics as obs_metrics
+
+    def pads():
+        rec = obs_metrics.registry().snapshot().get(
+            "nmfx_mesh_pad_lanes_total")
+        return float(sum(rec["series"].values())) if rec else 0.0
+
+    before = pads()
+    ref = _run(two_group_data, "kl", mesh=None, restarts=5)
+    got = _run(two_group_data, "kl", mesh=grid_mesh(4, 1, 1), restarts=5)
+    assert pads() - before >= 3.0
+    for field in _BIT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, field)),
+            np.asarray(getattr(got, field)),
+            err_msg=f"padded restart mesh: {field} diverged")

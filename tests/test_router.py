@@ -818,3 +818,114 @@ def test_replica_heartbeats_carry_levels(tmp_path):
         fut.result(timeout=60)
     finally:
         pool.close()
+
+
+# ---------------------------------------------------------------------
+# priced placement over a heterogeneous fleet (ISSUE 19)
+# ---------------------------------------------------------------------
+
+def _hetero_pool(tmp_path, **kw):
+    """One plain 1-chip replica + one 4-chip mesh replica (FakeEngine —
+    placement mechanics only, no device dispatch)."""
+    return _pool(tmp_path, n=2, mesh_specs=(None, "4"), **kw)
+
+
+def _placement_count(klass) -> float:
+    from nmfx.obs import metrics as obs_metrics
+
+    rec = obs_metrics.registry().snapshot().get(
+        "nmfx_router_placement_total")
+    if not rec:
+        return 0.0
+    return float(rec["series"].get((str(klass),), 0.0))
+
+
+def test_atlas_floor_validation():
+    with pytest.raises(ValueError):
+        RouterConfig(atlas_floor_bytes=0)
+
+
+def test_heartbeats_advertise_mesh_class(tmp_path):
+    pool = _hetero_pool(tmp_path)
+    try:
+        classes = sorted((str(r.mesh_spec), r.n_devices)
+                         for r in pool.routable())
+        assert classes == [("4", 4), ("None", 1)]
+        # the heartbeat ledger carries the same capability facts — the
+        # router prices off these fields cross-process
+        for rep in pool.routable():
+            rep._beater.beat_once()
+        beats = sorted((str(hb.get("mesh")), hb.get("devices"))
+                       for hb in pool.heartbeats().values())
+        assert beats == classes
+    finally:
+        pool.close()
+
+
+def test_priced_placement_small_vs_atlas(tmp_path):
+    """The acceptance gate: an atlas-shaped request must NEVER land on
+    a 1-chip replica while a mesh replica is routable — and small
+    requests must not squat the mesh."""
+    small = _mat()                        # 8x6 f32 = 192 B
+    atlas = np.asarray(_mat(n=32, m=64))  # 8 KiB
+    floor = small.nbytes + 1
+    pool = _hetero_pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg(atlas_floor_bytes=floor)) as router:
+        c1, c4 = _placement_count(1), _placement_count(4)
+        for _ in range(3):
+            fut = router.submit(atlas, ks=(2,), restarts=2)
+            fut.result(timeout=60)
+            assert fut.stats.placement_class == 4
+            inputs = fut.stats.placement_inputs
+            assert inputs["atlas"] is True
+            assert inputs["bytes"] == atlas.nbytes
+            assert inputs["classes"] == [1, 4]
+            assert "queue_depth" in inputs
+        for _ in range(3):
+            fut = router.submit(small, ks=(2,), restarts=2)
+            fut.result(timeout=60)
+            assert fut.stats.placement_class == 1
+            assert fut.stats.placement_inputs["atlas"] is False
+        assert _placement_count(4) - c4 == 3
+        assert _placement_count(1) - c1 == 3
+
+
+def test_pricing_off_leaves_stats_unpriced(tmp_path):
+    """price_placement=False drops the class FILTER (any replica may
+    win) and the decision-inputs audit; the landed class is still
+    recorded — it is telemetry, not policy."""
+    pool = _hetero_pool(tmp_path)
+    with NMFXRouter(pool, _fast_cfg(price_placement=False)) as router:
+        fut = router.submit(_mat(), ks=(2,), restarts=2)
+        fut.result(timeout=60)
+        assert fut.stats.placement_class in (1, 4)
+        assert fut.stats.placement_inputs is None
+
+
+def test_atlas_falls_back_when_mesh_unroutable(tmp_path):
+    """Pricing is a preference, not an admission gate: with the mesh
+    replica down, atlas requests still flow to the 1-chip replica."""
+    pool = _hetero_pool(tmp_path)
+    atlas = np.asarray(_mat(n=32, m=64))
+    with NMFXRouter(pool, _fast_cfg(atlas_floor_bytes=1)) as router:
+        meshed = [r for r in pool.routable() if r.n_devices == 4][0]
+        meshed.drain()
+        deadline = time.time() + 10
+        while any(r.n_devices == 4 for r in pool.routable()):
+            if time.time() > deadline:
+                pytest.fail("mesh replica never left the routable set")
+            time.sleep(0.05)
+        fut = router.submit(atlas, ks=(2,), restarts=2)
+        fut.result(timeout=60)
+        assert fut.stats.placement_class == 1
+
+
+def test_pool_mesh_specs_validation(tmp_path):
+    from nmfx.distributed import MeshSpecError
+
+    with pytest.raises(ValueError, match="mesh_specs has 1"):
+        ReplicaPool(2, root=str(tmp_path / "p1"), mode="thread",
+                    engine_factory=FakeEngine, mesh_specs=("4",))
+    with pytest.raises(MeshSpecError):
+        ReplicaPool(1, root=str(tmp_path / "p2"), mode="thread",
+                    engine_factory=FakeEngine, mesh_specs=("zero",))

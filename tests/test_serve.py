@@ -844,3 +844,51 @@ def test_served_request_traces_nested_spans_across_threads(
     assert "nmfx_serve_e2e_seconds_bucket" in text
     assert "nmfx_serve_queue_wait_seconds" in text
     tracer.clear()
+
+
+# ---------------------------------------------------------------------
+# mesh-tier engine (ISSUE 19): ServeConfig.mesh_spec + MeshEngine
+# ---------------------------------------------------------------------
+
+def test_serve_config_mesh_spec_validated_at_construction():
+    from nmfx.distributed import MeshSpecError
+
+    with pytest.raises(MeshSpecError):
+        ServeConfig(mesh_spec="two-by-two")
+    with pytest.raises(MeshSpecError):
+        ServeConfig(mesh_spec="0x2")
+    assert ServeConfig(mesh_spec="2x2").mesh_spec == "2x2"
+
+
+def test_mesh_engine_is_solo_only():
+    from nmfx.serve import MeshEngine
+
+    eng = MeshEngine("4")
+    assert eng.n_devices == 4
+    assert eng.compatibility_key(None) is None  # never packs
+    with pytest.raises(RuntimeError, match="solo-only"):
+        eng.dispatch_packed([], None)
+
+
+def test_mesh_server_rejects_exec_cache_and_matches_direct(tmp_path):
+    """A meshed server can't also be a cache-tier server (one engine
+    per server), and its results are bit-identical to the direct
+    meshed sweep — serving is placement, never numerics."""
+    from nmfx.config import ConsensusConfig, SolverConfig
+    from nmfx.exec_cache import ExecCache
+    from nmfx.serve import MeshEngine
+    from nmfx.sweep import sweep
+
+    with pytest.raises(ValueError, match="mesh_spec"):
+        NMFXServer(ServeConfig(mesh_spec="4"), exec_cache=ExecCache(),
+                   start=False)
+    a = _mat()
+    scfg = SolverConfig(algorithm="mu", max_iter=20)
+    with NMFXServer(ServeConfig(mesh_spec="4")) as srv:
+        assert isinstance(srv.engine, MeshEngine)
+        res = srv.submit(a, ks=(2,), restarts=4, seed=7,
+                         solver_cfg=scfg).result(timeout=120)
+    ref = sweep(a, ConsensusConfig(ks=(2,), restarts=4, seed=7),
+                scfg, mesh=srv.engine.mesh)
+    np.testing.assert_array_equal(np.asarray(res.per_k[2].consensus),
+                                  np.asarray(ref[2].consensus))
