@@ -938,9 +938,9 @@ def main():
         p.error("--kmax must be >= 2")
     if args.reps < 1:
         p.error("--reps must be >= 1")
-    if args.backend == "pallas" and args.algorithm != "mu":
-        p.error("--backend pallas is only implemented for --algorithm mu "
-                "(use auto to fall back per algorithm)")
+    if args.backend == "pallas" and args.algorithm not in ("mu", "hals"):
+        p.error("--backend pallas is only implemented for --algorithm "
+                "mu/hals (use auto to fall back per algorithm)")
     from nmfx.config import PACKED_ALGORITHMS
     if (args.backend == "packed"
             and args.algorithm not in PACKED_ALGORITHMS):
@@ -3033,6 +3033,191 @@ def main():
                 "packing_efficiency": serve_mod.packing_efficiency()},
         }
 
+    # --- kernel-schedule stage (ISSUE 20, detail.kernel) ----------------
+    # fused-vs-phased A/B on the pallas block-kernel route + the
+    # autotune cold/warm counter-gated rung.
+    def run_kernel_stage():
+        """Measurement protocol (recorded because the numbers need
+        interpreting): the fused_vs_phased rung runs the SAME sweep
+        (same matrix, same seeds, the mu pallas block-kernel route)
+        under ``experimental.fused_updates="phased"`` vs ``"fused"``,
+        reps interleaved. The fused kernel's contract is BIT exactness
+        against the phased one — identical dot_generals in identical
+        tile order with identical f32 accumulators, only A's read
+        schedule changes — so consensus/iterations/stop_reasons are
+        asserted exactly equal between the arms on the session's real
+        device (exit 2 on drift; interpret-mode pinning lives in
+        tests/test_fused_kernel.py). On a TPU session both arms run at
+        the bench shape and ``fused.mfu_solve`` feeds the >=0.18
+        steering metric; on a CPU host the route runs in interpret
+        mode at a smoke shape — walls are recorded but not comparable,
+        MFU reports None (no device peak).
+
+        The autotune rung measures the cold candidate-search wall into
+        a FRESH store, then simulates a fresh process (in-process memo
+        cleared) and re-resolves: the warm path must perform ZERO
+        searches, serve >=1 store hit (both by nmfx_autotune_* counter
+        deltas — the honesty-counter discipline) and resolve to the
+        IDENTICAL config; ``warm_hit`` records that binary verdict for
+        the regress judge."""
+        import dataclasses as _dc
+        import shutil as _sh
+        import tempfile as _tf
+
+        from nmfx import autotune as _at
+        from nmfx.config import ExperimentalConfig as _Exp
+        from nmfx.profiling import Profiler as _Prof
+
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu:
+            genes_k, samples_k = args.genes, args.samples
+            ks_k = (max(2, args.kmax // 2), args.kmax)
+            restarts_k = min(args.restarts, 16)
+            mi_k = min(args.maxiter, 400)
+            a_k = a
+        else:
+            genes_k, samples_k = 96, 48
+            ks_k = (2, 3)
+            restarts_k = 4
+            mi_k = 40
+            a_k = grouped_matrix(genes_k, (samples_k // 2,
+                                           samples_k // 2),
+                                 effect=2.0, seed=0)
+        base_k = SolverConfig(algorithm="mu", backend="pallas",
+                              max_iter=mi_k,
+                              matmul_precision=args.precision)
+        ccfg_k = ConsensusConfig(ks=ks_k, restarts=restarts_k, seed=seed)
+        cfg_arm = {mode: _dc.replace(
+                       base_k, experimental=_Exp(fused_updates=mode))
+                   for mode in ("phased", "fused")}
+
+        def run_arm(scfg_a):
+            prof_a = _Prof()
+            t0 = time.perf_counter()
+            with prof_a:
+                raw = sweep(a_k, ccfg_k, scfg_a, icfg, None,
+                            profiler=prof_a)
+                got = jax.device_get({k: (raw[k].consensus,
+                                          raw[k].iterations,
+                                          raw[k].stop_reasons)
+                                      for k in ks_k})
+            wall_a = time.perf_counter() - t0
+            solve_a = sum(rec.seconds
+                          for name, rec in prof_a.phases.items()
+                          if name.startswith("solve"))
+            return wall_a, solve_a, got
+
+        walls_k = {"phased": [], "fused": []}
+        solves_k = {"phased": [], "fused": []}
+        outs_k = {}
+        for _ in range(2):
+            for mode in ("phased", "fused"):
+                wall_a, solve_a, got = run_arm(cfg_arm[mode])
+                walls_k[mode].append(wall_a)
+                solves_k[mode].append(solve_a)
+                outs_k[mode] = got
+        for k in ks_k:
+            for pi, name in ((0, "consensus"), (1, "iterations"),
+                             (2, "stop_reasons")):
+                if not np.array_equal(np.asarray(outs_k["phased"][k][pi]),
+                                      np.asarray(outs_k["fused"][k][pi])):
+                    print("bench KERNEL PARITY FAILURE: fused vs "
+                          f"phased {name} differ at k={k} — the "
+                          "join-the-updates kernel's bit-exactness "
+                          "contract is broken on this device",
+                          file=sys.stderr)
+                    raise SystemExit(2)
+
+        def arm_record(mode):
+            min_s = min(walls_k[mode])
+            solve_s = min(solves_k[mode])
+            mfu_solve = None
+            if peak is not None and solve_s > 0:
+                fpi = {k: costmodel.iteration_flops(
+                           "mu", "pallas", genes_k, samples_k, k,
+                           cfg_arm[mode]) for k in ks_k}
+                if all(v is not None for v in fpi.values()):
+                    its_a = {k: np.asarray(outs_k[mode][k][1])
+                             for k in ks_k}
+                    model_f = sum(fpi[k] * float(its_a[k].sum())
+                                  for k in ks_k)
+                    mfu_solve = round(model_f / solve_s
+                                      / (peak * len(jax.devices())), 4)
+            return {"min_s": round(min_s, 3),
+                    "solve_s": round(solve_s, 3),
+                    "mfu_solve": mfu_solve}
+
+        fused_rec = arm_record("fused")
+        phased_rec = arm_record("phased")
+        fused_rec["speedup_vs_phased"] = round(
+            phased_rec["min_s"] / fused_rec["min_s"], 4)
+
+        # autotune rung: cold search into a fresh store, then a
+        # fresh-process-simulated warm resolution, counter-gated
+        from nmfx.ops.sched_mu import _pallas_slot_clamp
+
+        k_hi = ks_k[-1]
+        slots_at = _pallas_slot_clamp(ccfg_k.grid_slots, k_hi, genes_k,
+                                      samples_k, base_k, None)
+        cfg_at = _dc.replace(base_k,
+                             experimental=_Exp(autotune="on"))
+        at_dir = _tf.mkdtemp(prefix="nmfx-bench-autotune-")
+        try:
+            s0, h0 = (_at.searches_total.total(), _at.hits_total.total())
+            t0 = time.perf_counter()
+            cold_cfg = _at.resolve(cfg_at, genes_k, samples_k, k_hi,
+                                   slots_at, cache_dir=at_dir)
+            cold_at = time.perf_counter() - t0
+            s1, h1 = (_at.searches_total.total(), _at.hits_total.total())
+            with _at._lock:
+                _at._memo.clear()  # fresh-process simulation
+            t0 = time.perf_counter()
+            warm_cfg = _at.resolve(cfg_at, genes_k, samples_k, k_hi,
+                                   slots_at, cache_dir=at_dir)
+            warm_at = time.perf_counter() - t0
+            s2, h2 = (_at.searches_total.total(), _at.hits_total.total())
+        finally:
+            _sh.rmtree(at_dir, ignore_errors=True)
+        warm_ok = (s1 - s0 == 1 and s2 == s1 and h2 > h1
+                   and warm_cfg == cold_cfg)
+        if not warm_ok:
+            print("bench AUTOTUNE FAILURE: cold searches="
+                  f"{s1 - s0} (want 1), warm searches={s2 - s1} "
+                  f"(want 0), warm hits={h2 - h1} (want >=1), "
+                  f"configs equal={warm_cfg == cold_cfg} — the "
+                  "persisted-store warm path is broken",
+                  file=sys.stderr)
+            raise SystemExit(2)
+
+        return {
+            "unit": f"ks={list(ks_k)} x {restarts_k} restarts, "
+                    f"{genes_k}x{samples_k}, mu pallas route"
+                    + ("" if on_tpu
+                       else " (interpret-mode smoke shape — walls not "
+                            "cross-round comparable)"),
+            "fused_vs_phased": {
+                "contract": "same seeds, same matrix; fused gated "
+                            "BIT-EXACT vs phased on consensus/"
+                            "iterations/stop_reasons (exit 2 on drift)",
+                "parity": "ok",
+                "phased": phased_rec,
+                "fused": fused_rec,
+            },
+            "autotune": {
+                "cold_search_wall_s": round(cold_at, 3),
+                "warm_resolve_wall_s": round(warm_at, 4),
+                "searches_cold": int(s1 - s0),
+                "searches_warm": int(s2 - s1),
+                "hits_warm": int(h2 - h1),
+                "warm_hit": 1.0 if warm_ok else 0.0,
+                "resolved": {
+                    "check_block": cold_cfg.check_block,
+                    "block_m": cold_cfg.experimental.block_m,
+                    "fused_updates":
+                        cold_cfg.experimental.fused_updates},
+            },
+        }
+
     # headline = the requested backend's same-session minimum; per-backend
     # min/median/all-reps in detail
     primary = args.backend
@@ -3144,6 +3329,10 @@ def main():
     print(f"bench: observability stage: {json.dumps(obs_detail)}",
           file=sys.stderr)
 
+    kernel_detail = run_kernel_stage()
+    print(f"bench: kernel stage: {json.dumps(kernel_detail)}",
+          file=sys.stderr)
+
     # regression tracking: compare against the best prior round's record
     # (the warm metric drifted 1.384 s → 2.041/1.848 s across r03-r05
     # with nothing in the record to flag it) and stamp this run's
@@ -3199,6 +3388,7 @@ def main():
             "atlas": atlas_detail,
             "sketched": sketched_detail,
             "obs": obs_detail,
+            "kernel": kernel_detail,
             # cold_wall_s/compile_wall_s are first-session numbers; with
             # a persistent cache dir a second session's cold run re-loads
             # these programs from disk instead of recompiling

@@ -36,6 +36,14 @@ introspection hooks added for it — no hash-body parsing):
   eq/hash, so a field added with ``compare=False`` would alias two
   different admission/packing policies onto one — the control-plane
   twin of the key hazards above.
+* ``autotune.autotune_key_fields()`` — what the block-shape autotune
+  store's key covers, against the declared tunable exemptions
+  (``autotune.AUTOTUNE_EXEMPT_SOLVER`` /
+  ``AUTOTUNE_EXEMPT_EXPERIMENTAL``): a config field outside both would
+  let a shape tuned under one configuration be SERVED to another that
+  compiles (and times) differently — a silent performance downgrade,
+  and for fields like ``use_tol_checks`` a tuned ``check_block`` the
+  scheduler then rejects outright.
 
 Every field must be fingerprint-covered or declared non-numerics; every
 exclusion must be declared; the declaration must not go stale; both
@@ -81,6 +89,10 @@ def check_config_coverage(
     data_key_covered: "frozenset[str] | None" = None,
     serve_fields: "frozenset[str] | None" = None,
     serve_key_covered: "frozenset[str] | None" = None,
+    autotune_solver_covered: "frozenset[str] | None" = None,
+    autotune_experimental_covered: "frozenset[str] | None" = None,
+    autotune_exempt_solver: "tuple[str, ...]" = (),
+    autotune_exempt_experimental: "tuple[str, ...]" = (),
 ) -> "list[str]":
     """The pure contract check; returns human-readable problems.
 
@@ -207,11 +219,65 @@ def check_config_coverage(
                 f"ServeConfig.{name} is not covered by the serving-"
                 "policy fingerprint (serve.serve_key_fields) — two "
                 "serving policies differing in it would compare equal")
+    # 11. the block-shape autotune store's key must cover every config
+    #     field that is not a DECLARED tunable: a tunable is what the
+    #     stored entry decides (so it must be normalized out of the
+    #     key), while any other field outside the key would serve one
+    #     tuned shape to two configs whose kernels compile — and time —
+    #     differently (a silent performance downgrade, or a tuned
+    #     check_block the scheduler rejects under the other config)
+    if autotune_solver_covered is not None:
+        for name in autotune_exempt_solver:
+            if name not in solver_fields:
+                problems.append(
+                    "autotune.AUTOTUNE_EXEMPT_SOLVER names "
+                    f"{name!r}, which is not a SolverConfig field — "
+                    "stale declaration")
+        for name in sorted(solver_fields - autotune_solver_covered):
+            if name not in autotune_exempt_solver:
+                problems.append(
+                    f"SolverConfig.{name} neither reaches the autotune "
+                    "store key (autotune.autotune_key_fields) nor is "
+                    "declared tunable in AUTOTUNE_EXEMPT_SOLVER — a "
+                    "shape tuned under one value would be served to "
+                    "the other")
+        for name in autotune_exempt_solver:
+            if name in autotune_solver_covered:
+                problems.append(
+                    f"SolverConfig.{name} is declared tunable in "
+                    "AUTOTUNE_EXEMPT_SOLVER but still reaches the "
+                    "autotune key — the entry could never be applied "
+                    "to the field it claims to decide; drop one "
+                    "declaration")
+    if autotune_experimental_covered is not None:
+        for name in autotune_exempt_experimental:
+            if name not in experimental_fields:
+                problems.append(
+                    "autotune.AUTOTUNE_EXEMPT_EXPERIMENTAL names "
+                    f"{name!r}, which is not an ExperimentalConfig "
+                    "field — stale declaration")
+        for name in sorted(
+                experimental_fields - autotune_experimental_covered):
+            if name not in autotune_exempt_experimental:
+                problems.append(
+                    f"ExperimentalConfig.{name} neither reaches the "
+                    "autotune store key (autotune.autotune_key_fields) "
+                    "nor is declared tunable in "
+                    "AUTOTUNE_EXEMPT_EXPERIMENTAL — a shape tuned "
+                    "under one value would be served to the other")
+        for name in autotune_exempt_experimental:
+            if name in autotune_experimental_covered:
+                problems.append(
+                    f"ExperimentalConfig.{name} is declared tunable in "
+                    "AUTOTUNE_EXEMPT_EXPERIMENTAL but still reaches "
+                    "the autotune key — the entry could never be "
+                    "applied to the field it claims to decide; drop "
+                    "one declaration")
     return problems
 
 
 def _live_universe():
-    from nmfx import data_cache, exec_cache, registry, serve
+    from nmfx import autotune, data_cache, exec_cache, registry, serve
     from nmfx.config import ExperimentalConfig, SolverConfig
 
     def _hashable(cls) -> bool:
@@ -219,6 +285,7 @@ def _live_universe():
                 and cls.__hash__ is not None
                 and cls.__dataclass_params__.frozen)
 
+    at_solver, at_experimental = autotune.autotune_key_fields()
     return dict(
         solver_fields=frozenset(
             f.name for f in dataclasses.fields(SolverConfig)),
@@ -251,6 +318,12 @@ def _live_universe():
                                 for f in dataclasses.fields(cls)
                                 if not f.repr)
             for cls in (SolverConfig, ExperimentalConfig)},
+        autotune_solver_covered=at_solver,
+        autotune_experimental_covered=at_experimental,
+        autotune_exempt_solver=tuple(
+            sorted(autotune.AUTOTUNE_EXEMPT_SOLVER)),
+        autotune_exempt_experimental=tuple(
+            sorted(autotune.AUTOTUNE_EXEMPT_EXPERIMENTAL)),
     )
 
 

@@ -210,8 +210,9 @@ def _live_metrics() -> "dict[str, str]":
     for mod in ("nmfx.exec_cache", "nmfx.data_cache", "nmfx.serve",
                 "nmfx.checkpoint", "nmfx.distributed", "nmfx.router",
                 "nmfx.replica", "nmfx.result_cache", "nmfx.tiles",
-                "nmfx.sparse", "nmfx.sweep", "nmfx.obs.costmodel",
-                "nmfx.obs.export", "nmfx.obs.slo"):
+                "nmfx.sparse", "nmfx.sweep", "nmfx.autotune",
+                "nmfx.obs.costmodel", "nmfx.obs.export",
+                "nmfx.obs.slo"):
         importlib.import_module(mod)
     from nmfx.obs import metrics as obs_metrics
 

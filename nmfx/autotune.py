@@ -1,0 +1,368 @@
+"""On-first-run block-shape autotuner for the pallas slot scheduler.
+
+The fused/phased choice, the tile rows (``experimental.block_m``) and the
+launch-resident check cadence (``check_block``) trade VMEM residency
+against HBM round-trips differently at different (m, n, k, slots)
+shapes — the round-4 envelope probes showed the best tile geometry
+moving with both m and the packed width, and no closed-form model
+survived contact with Mosaic's layout choices. So, PL-NMF style, we
+*measure*: the first solve at a shape bucket times a small candidate
+grid of (block_m, check_block, fused-vs-phased) with RAW kernel
+launches on the real device, picks the fastest per-iteration candidate,
+and persists the verdict content-addressed next to the exec cache — the
+second process at the same bucket pays ZERO search (the warm path is
+gated in the bench by the ``nmfx_autotune_{searches,hits}_total``
+counter pair, and in tests/test_autotune.py).
+
+Opt-in and strictly resolution-time: ``experimental.autotune="on"``
+makes :func:`resolve` rewrite the config ONCE, host-side, before any
+tracing — the solver itself never consults the store, so jit keys,
+registry fingerprints and exec-cache keys all see the RESOLVED numerics
+(``autotune="off"`` plus explicit ``check_block``/``block_m``/
+``fused_updates``). A warm run resolves to the identical config, so a
+checkpoint written by a cold run resumes cleanly under a warm one.
+Explicit user values always win: the search still times the FULL
+candidate grid (so the persisted entry's content is independent of
+which fields happened to be explicit in the requesting config), but
+tuned values only fill ``"auto"``/``None`` gaps at apply time.
+
+Key discipline (the NMFX001 family): a tuned shape must never be
+served across anything that changes what "fastest" means — data shape
+(bucketed on the exec cache's lattice), every config field that reaches
+the kernels, device kind, jax/jaxlib/PJRT versions. The key is the
+repr of ``(normalized cfg, shape bucket, env fingerprint)`` where the
+normalized config pins exactly the TUNABLE fields to sentinels (they
+are what the entry decides, so they must not split the key) — the
+exempt sets below are the authoritative declaration the static
+analyzer cross-references against :func:`autotune_key_fields`, so a
+new config field joins the key automatically and can only leave it via
+a reviewed exemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import os
+import tempfile
+import threading
+import time
+import warnings
+
+from nmfx.obs import metrics
+
+#: Disk-entry format; bump on any record-layout change (a mismatched
+#: format re-searches, never mis-reads).
+_FORMAT = 1
+
+#: Iterations per timed launch (one check sub-block); per-iteration
+#: normalization divides by ``_TIME_ITERS * check_block``.
+_TIME_ITERS = 4
+_TIME_REPS = 3
+
+#: Cold-path searches performed (one per unseen key) / warm-path store
+#: hits (memo or disk). A warm process at a tuned bucket must show
+#: hits > 0 and searches == 0 — the bench autotune rung and
+#: tests/test_autotune.py gate on exactly these.
+searches_total = metrics.counter(
+    "nmfx_autotune_searches_total",
+    help="block-shape autotune candidate searches performed (cold path)")
+hits_total = metrics.counter(
+    "nmfx_autotune_hits_total",
+    help="block-shape autotune store hits served without search")
+
+#: AUTHORITATIVE tunable declarations — the ONLY fields the key may
+#: normalize away, because they are what the stored entry decides.
+#: Everything else in the config tree reaches the key via its repr;
+#: the static analyzer (NMFX001's autotune clause) cross-references
+#: these against the live dataclasses so the lists cannot go stale and
+#: a new field cannot silently skip the key.
+AUTOTUNE_EXEMPT_SOLVER = frozenset({"check_block"})
+AUTOTUNE_EXEMPT_EXPERIMENTAL = frozenset({
+    "autotune", "block_m", "fused_updates"})
+
+_lock = threading.Lock()
+_memo: "dict[str, dict]" = {}
+_warned: "set[str]" = set()
+
+
+def autotune_key_fields() -> "tuple[frozenset, frozenset]":
+    """The (SolverConfig, ExperimentalConfig) fields the autotune key
+    covers — the introspection hook the NMFX001-family lint clause
+    reads. Total by construction: the key is the repr of the config
+    with ONLY the declared tunables pinned to sentinels, so every
+    repr-visible field outside the exempt sets participates (and
+    NMFX001's repr=False clause independently forbids repr-invisible
+    fields anywhere in the config tree)."""
+    from nmfx.config import ExperimentalConfig, SolverConfig
+
+    solver = frozenset(f.name for f in dataclasses.fields(SolverConfig)
+                       if f.repr) - AUTOTUNE_EXEMPT_SOLVER
+    exp = frozenset(f.name for f in dataclasses.fields(ExperimentalConfig)
+                    if f.repr) - AUTOTUNE_EXEMPT_EXPERIMENTAL
+    return solver, exp
+
+
+def shape_bucket(m: int, n: int, k_max: int, slots: int) -> tuple:
+    """The (m, n, k_max, slots) lattice point a tuned entry is keyed
+    (and timed) at — the exec cache's bucket quanta, so the two caches
+    agree on which shapes share a compiled/tuned artifact."""
+    from nmfx import exec_cache
+
+    return (exec_cache.bucket_dim(int(m), 256),
+            exec_cache.bucket_dim(int(n), 64),
+            int(k_max), int(slots))
+
+
+def _normalized(cfg):
+    """``cfg`` with exactly the tunable fields pinned to sentinels —
+    the config part of the key. ``dataclasses.replace`` round-trips
+    through ``__post_init__``, so the sentinels stay valid values."""
+    exp = dataclasses.replace(cfg.experimental, autotune="off",
+                              block_m=None, fused_updates="auto")
+    return dataclasses.replace(cfg, check_block="auto", experimental=exp)
+
+
+def _key_repr(cfg, m: int, n: int, k_max: int, slots: int) -> str:
+    from nmfx import exec_cache
+
+    return repr((_normalized(cfg), shape_bucket(m, n, k_max, slots),
+                 exec_cache._env_fingerprint()))
+
+
+def _warn_once(category: str, msg: str) -> None:
+    with _lock:
+        if category in _warned:
+            return
+        _warned.add(category)
+    warnings.warn(f"nmfx autotune: {msg}", RuntimeWarning, stacklevel=3)
+
+
+def _disk_path(cache_dir: str, key_repr: str) -> str:
+    h = hashlib.sha256(key_repr.encode()).hexdigest()[:40]
+    return os.path.join(cache_dir, h + ".json")
+
+
+def _disk_load(cache_dir: str, key_repr: str) -> "dict | None":
+    """A verified entry's ``best`` dict, or None. Anything short of a
+    full match — unreadable JSON, wrong format, a key that differs
+    despite the matching hash (collision or hand-moved file) — warns
+    once, removes the entry and falls back to a fresh search: the
+    degradation is always a re-measure, never a mis-applied shape."""
+    path = _disk_path(cache_dir, key_repr)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        rec = None
+    best = rec.get("best") if isinstance(rec, dict) else None
+    if (not isinstance(rec, dict) or rec.get("format") != _FORMAT
+            or rec.get("key") != key_repr
+            or not isinstance(best, dict)
+            or not {"block_m", "check_block",
+                    "fused_updates"} <= set(best)):
+        _warn_once(path, f"entry at {path!r} is corrupt or was written "
+                         "under a different key/format; re-searching")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    return best
+
+
+def _disk_store(cache_dir: str, key_repr: str, best: dict,
+                timings: dict) -> None:
+    """Atomic tmp+rename publish (the exec cache's discipline): a
+    concurrent reader sees either nothing or a complete entry."""
+    rec = {"format": _FORMAT, "key": key_repr, "best": best,
+           "timings": timings}
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=cache_dir, prefix="write-",
+                                   suffix=".part")
+    except OSError as e:
+        _warn_once(cache_dir, f"cannot write under {cache_dir!r} ({e}); "
+                              "tuning stays in-process only")
+        return
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, _disk_path(cache_dir, key_repr))
+    except OSError as e:
+        _warn_once(cache_dir, f"cannot publish under {cache_dir!r} "
+                              f"({e}); tuning stays in-process only")
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def _candidates(cfg, m: int, n: int, k_max: int,
+                slots: int) -> "list[dict]":
+    """The full candidate grid at this (bucketed) shape, validity-pruned
+    by the scheduler's VMEM envelope. Always the FULL grid — entry
+    content must not depend on which fields the requesting config had
+    explicit (explicit values win at apply time instead)."""
+    from nmfx.ops import sched_mu
+    from nmfx.ops.grid_mu import USES_TOLFUN
+
+    default_bm = sched_mu._pallas_block_geometry(m)[1]
+    bms = sorted({int(default_bm), 256, 512})
+    cbs = [1, 4]
+    if (cfg.algorithm == "hals" and USES_TOLFUN["hals"]
+            and cfg.use_tol_checks):
+        # interior boundaries cannot replay TolFun from the kernel's
+        # boundary exports — mirror the scheduler's hals restriction
+        cbs = [1]
+    fuseds = (["phased", "fused"] if cfg.algorithm == "mu"
+              else ["phased"])
+    rk = slots * k_max
+    out = []
+    for bm in bms:
+        for cb in cbs:
+            for fu in fuseds:
+                if rk > sched_mu._pallas_max_rk(
+                        m, n, cfg, cfg.experimental.factor_dtype,
+                        check_block=cb, fused=(fu == "fused"),
+                        algorithm=cfg.algorithm, block_m=bm):
+                    continue
+                out.append({"block_m": int(bm), "check_block": int(cb),
+                            "fused_updates": fu})
+    return out
+
+
+def _cand_label(cand: dict) -> str:
+    return (f"bm{cand['block_m']}_cb{cand['check_block']}"
+            f"_{cand['fused_updates']}")
+
+
+def _time_candidate(cfg, cand: dict, m: int, n: int, k_max: int,
+                    slots: int) -> float:
+    """Per-iteration wall seconds of one raw block-kernel launch at the
+    bucket shape on synthetic data (fixed PRNG key — determinism keeps
+    reruns comparable). Raw launches, not a full ``mu_sched`` solve:
+    the candidates differ only inside the kernel, and a full solve per
+    candidate would pay scheduler compile time ~10x the signal."""
+    import jax
+    import jax.numpy as jnp
+
+    from nmfx.ops import sched_mu
+    from nmfx.ops.pallas_mu import (fused_block_iterations,
+                                    hals_block_iterations)
+
+    bm, cb = cand["block_m"], cand["check_block"]
+    m_pad = -(-m // bm) * bm
+    rk = slots * k_max
+    exp = cfg.experimental
+    a_dt = (jnp.bfloat16 if sched_mu._streams_bf16_a(cfg)
+            else jnp.float32)
+    w_dt = (jnp.bfloat16 if exp.factor_dtype in ("bfloat16", "bfloat16_w")
+            else jnp.float32)
+    h_dt = jnp.bfloat16 if exp.factor_dtype == "bfloat16" else jnp.float32
+    ka, kw, kh = jax.random.split(jax.random.PRNGKey(0), 3)
+    a = jax.random.uniform(ka, (m_pad, n), a_dt)
+    wp = jax.random.uniform(kw, (m_pad, rk), w_dt)
+    hp = jax.random.uniform(kh, (rk, n), h_dt)
+    frozen = jnp.zeros((1, rk), jnp.float32)
+    kw_common = dict(k=k_max, iters=_TIME_ITERS, block_m=bm,
+                     eps=cfg.div_eps,
+                     zero_threshold=cfg.zero_threshold,
+                     matmul_precision=cfg.matmul_precision,
+                     interpret=jax.default_backend() != "tpu",
+                     check_block=cb)
+    if cb > 1:
+        # no lane hits its budget during a timing launch
+        kw_common["budget_cols"] = jnp.full((1, rk), 1e9, jnp.float32)
+    if cfg.algorithm == "hals":
+        def launch():
+            return hals_block_iterations(a, wp, hp, frozen, slots=slots,
+                                         **kw_common)
+    else:
+        def launch():
+            return fused_block_iterations(
+                a, wp, hp, frozen,
+                fused=cand["fused_updates"] == "fused", **kw_common)
+    jax.block_until_ready(launch())  # compile + warm
+    best = math.inf
+    for _ in range(_TIME_REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(launch())
+        best = min(best, time.perf_counter() - t0)
+    return best / (_TIME_ITERS * cb)
+
+
+def _lookup_or_search(cfg, m: int, n: int, k_max: int, slots: int,
+                      cache_dir: "str | None") -> "dict | None":
+    key = _key_repr(cfg, m, n, k_max, slots)
+    with _lock:
+        if key in _memo:
+            hits_total.inc()
+            return dict(_memo[key])
+    if cache_dir is not None:
+        best = _disk_load(cache_dir, key)
+        if best is not None:
+            hits_total.inc()
+            with _lock:
+                _memo[key] = dict(best)
+            return dict(best)
+    m_b, n_b, _, _ = shape_bucket(m, n, k_max, slots)
+    cands = _candidates(cfg, m_b, n_b, k_max, slots)
+    if not cands:
+        # the shape overflows the VMEM envelope at every candidate —
+        # the scheduler's own clamp will route it; nothing to tune
+        return None
+    searches_total.inc()
+    timings, best, best_t = {}, None, math.inf
+    for cand in cands:
+        t = _time_candidate(cfg, cand, m_b, n_b, k_max, slots)
+        timings[_cand_label(cand)] = t
+        if t < best_t:
+            best, best_t = cand, t
+    with _lock:
+        _memo[key] = dict(best)
+    if cache_dir is not None:
+        _disk_store(cache_dir, key, best, timings)
+    return dict(best)
+
+
+def resolve(cfg, m: int, n: int, k_max: int, slots: int,
+            cache_dir: "str | None" = None):
+    """The one entry point: rewrite ``cfg`` with tuned kernel-schedule
+    values for this problem shape, or return it unchanged (minus the
+    ``autotune`` flag itself) when there is nothing to tune.
+
+    Host-side and idempotent: the returned config always has
+    ``autotune="off"`` and fully explicit tuned fields, so every
+    downstream key (jit static args, registry fingerprint, exec-cache
+    bucket) sees the resolved numerics, and a warm process resolves to
+    the IDENTICAL config. Tuned values fill only ``"auto"``/``None``
+    gaps — explicit user choices always win. ``cache_dir`` (normally
+    ``<exec cache dir>/autotune``) enables the cross-process warm path;
+    ``None`` keeps tuning in-process (the memo)."""
+    exp = cfg.experimental
+    if exp.autotune != "on":
+        return cfg
+    off = dataclasses.replace(exp, autotune="off")
+    if cfg.backend != "pallas" or exp.ragged:
+        # nothing to tune: the block-kernel route is pallas-only, and
+        # the ragged pool runs the per-iteration kernels (no block_m /
+        # check_block / fused choice to make)
+        return dataclasses.replace(cfg, experimental=off)
+    best = _lookup_or_search(cfg, m, n, k_max, slots, cache_dir)
+    if best is None:
+        return dataclasses.replace(cfg, experimental=off)
+    tuned_exp = dataclasses.replace(
+        off,
+        block_m=(exp.block_m if exp.block_m is not None
+                 else int(best["block_m"])),
+        fused_updates=(exp.fused_updates if exp.fused_updates != "auto"
+                       else str(best["fused_updates"])))
+    tuned_cb = (cfg.check_block if cfg.check_block != "auto"
+                else int(best["check_block"]))
+    return dataclasses.replace(cfg, check_block=tuned_cb,
+                               experimental=tuned_exp)

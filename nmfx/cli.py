@@ -194,6 +194,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "'auto' (default) = 4 on the pallas block-kernel "
                         "scheduler, 1 elsewhere; see docs/design.md "
                         "'Check cadence'")
+    p.add_argument("--autotune", action="store_true",
+                   help="measure-don't-model kernel scheduling on the "
+                        "pallas backend (ExperimentalConfig.autotune): "
+                        "the first solve at a shape bucket times a small "
+                        "(block_m, check_block, fused-vs-phased) "
+                        "candidate grid on the real device and persists "
+                        "the winner next to the exec cache (under "
+                        "--cache-dir when given), so later processes "
+                        "resolve with zero search; explicit "
+                        "--check-block still wins. No-op off the pallas "
+                        "backend")
     p.add_argument("--rank-selection", default="host",
                    choices=("host", "device"),
                    help="where hclust/cophenetic/cutree run: host numpy/C++ "
@@ -723,7 +734,7 @@ def _run_cli(argv: list[str] | None = None) -> int:
     # ONE SolverConfig for warmup and the run: the exec-cache key hashes
     # it, so warming with a copy that could drift from the run's config
     # would silently compile a never-hit executable
-    from nmfx.config import SketchConfig
+    from nmfx.config import ExperimentalConfig, SketchConfig
 
     run_scfg = SolverConfig(algorithm=args.algorithm,
                             max_iter=args.maxiter,
@@ -736,7 +747,10 @@ def _run_cli(argv: list[str] | None = None) -> int:
                                     else SketchConfig()),
                             screen=args.screen,
                             screen_keep=args.screen_keep,
-                            tile_rows=args.tile_rows)
+                            tile_rows=args.tile_rows,
+                            experimental=ExperimentalConfig(
+                                autotune=("on" if args.autotune
+                                          else "off")))
     ckpt_cfg = None
     if args.checkpoint_every is not None and args.checkpoint_every < 1:
         parser.error("--checkpoint-every must be >= 1")

@@ -101,6 +101,28 @@ class ExperimentalConfig:
     #: quotient upcasts to f32 before dividing anyway (kl is
     #: quotient-FLOP-bound, not A-bandwidth-bound)
     kl_bf16_quotient: bool = False
+    #: on-first-run pallas block-shape autotuner (round 7,
+    #: ``nmfx.autotune``): "on" times a small (block_m, check_block,
+    #: fused-vs-phased) candidate grid on the real device at this
+    #: (m, n, k, slots) bucket on first contact and persists the winner
+    #: next to the exec cache (keyed by bucket + device kind + jax/PJRT
+    #: versions — a second process pays zero search); "off" (default)
+    #: never searches and never reads the store. Explicit ``block_m``/
+    #: ``fused_updates``/``check_block`` settings always win over a
+    #: tuned entry — the tuner only fills what was left on "auto".
+    autotune: str = "off"
+    #: pallas block-kernel tile rows override (None = the built-in
+    #: ~512-row 16-aligned geometry, ``sched_mu._pallas_block_geometry``).
+    #: Must be a positive multiple of 16; set by hand or by the
+    #: autotuner. Changes kernel numerics only through Mosaic tile-order
+    #: accumulation (the gate-checkable float-tolerance class)
+    block_m: "int | None" = None
+    #: mu block-kernel schedule: "auto" (default — resolves to the
+    #: phased two-pass kernel, byte-identical numerics to round 6),
+    #: "phased", or "fused" (the round-7 PL-NMF join-the-updates kernel:
+    #: A read once per iteration instead of twice, bit-exact vs phased —
+    #: tests/test_fused_kernel.py pins the equivalence)
+    fused_updates: str = "auto"
 
     def __post_init__(self):
         if self.factor_dtype not in (None, "bfloat16", "bfloat16_w"):
@@ -109,6 +131,19 @@ class ExperimentalConfig:
                 f"'bfloat16_w', got {self.factor_dtype!r}")
         if self.evict_batch < 1:
             raise ValueError("experimental.evict_batch must be >= 1")
+        if self.autotune not in ("off", "on"):
+            raise ValueError(
+                "experimental.autotune must be 'off' or 'on', got "
+                f"{self.autotune!r}")
+        if self.block_m is not None and (
+                self.block_m <= 0 or self.block_m % 16):
+            raise ValueError(
+                "experimental.block_m must be a positive multiple of 16 "
+                f"(the TPU sublane tiling), got {self.block_m!r}")
+        if self.fused_updates not in ("auto", "phased", "fused"):
+            raise ValueError(
+                "experimental.fused_updates must be 'auto', 'phased' or "
+                f"'fused', got {self.fused_updates!r}")
         if self.ragged_iters_est is not None:
             est = tuple((int(k), float(v))
                         for k, v in self.ragged_iters_est)
@@ -404,10 +439,11 @@ class SolverConfig:
             raise ValueError(
                 f"backend must be 'auto', 'vmap', 'packed', 'pallas' or "
                 f"'sketched', got {self.backend!r}")
-        if self.backend == "pallas" and self.algorithm != "mu":
+        if self.backend == "pallas" and self.algorithm not in ("mu",
+                                                               "hals"):
             raise ValueError(
-                "backend='pallas' is only implemented for algorithm='mu'; "
-                "use 'auto' to fall back per algorithm")
+                "backend='pallas' is only implemented for algorithm='mu' "
+                "and 'hals'; use 'auto' to fall back per algorithm")
         if (self.backend == "sketched"
                 and self.algorithm not in SKETCHED_ALGORITHMS):
             raise ValueError(

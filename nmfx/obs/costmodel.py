@@ -267,14 +267,20 @@ def _als_bytes(m, n, k, cfg=None, family="vmap"):
     return _dense_bytes(m, n, k, cfg, family, "als", factor_passes=10.0)
 
 
-def _pallas_mu_bytes(m, n, k, cfg=None, family="pallas"):
-    """The fused block kernels stream A per iteration but keep the
-    factors VMEM-resident for a whole launch: the W/H HBM round-trip
-    amortizes over the ``check_every × check_block`` in-launch
-    iterations (the PR-2 check-cadence contract) — the locality story
-    PL-NMF's blocking is about, and the reason the pallas engine's
-    arithmetic intensity reads higher than the XLA engines' at the same
-    shape."""
+def _pallas_block_bytes(m, n, k, cfg, algo):
+    """Shared per-iteration HBM model for the slot scheduler's block
+    kernels: A streams per iteration while the factors stay
+    VMEM-resident for the whole launch, so the W/H round-trip amortizes
+    over the ``check_every × check_block`` in-launch iterations.
+
+    The PHASED kernels read A twice per iteration (once per
+    half-update). The round-7 fused mu kernel
+    (``experimental.fused_updates="fused"``) joins the halves on each
+    streamed tile, so a T-iteration launch reads A T+1 times instead of
+    2T — an A-read factor of (T+1)/T that approaches 1.0 as the
+    resident cadence grows (the PL-NMF join-the-updates amortization;
+    cross-validated against ``compiled_cost_analysis`` in
+    tests/test_costmodel.py)."""
     cfg_ce = (getattr(cfg, "check_every", _DEFAULT_CHECK_EVERY)
               if cfg is not None else _DEFAULT_CHECK_EVERY)
     cb = (getattr(cfg, "check_block", "auto")
@@ -283,9 +289,29 @@ def _pallas_mu_bytes(m, n, k, cfg=None, family="pallas"):
         cb = _DEFAULT_PALLAS_CHECK_BLOCK
     launch_iters = max(cfg_ce * int(cb), 1)
     s = _itemsize(cfg)
-    sa = _a_itemsize(cfg, "pallas", "mu")
-    return (2.0 * m * n * sa
+    sa = _a_itemsize(cfg, "pallas", algo)
+    fused = (algo == "mu" and cfg is not None
+             and getattr(getattr(cfg, "experimental", None),
+                         "fused_updates", "auto") == "fused")
+    a_passes = (launch_iters + 1.0) / launch_iters if fused else 2.0
+    return (a_passes * m * n * sa
             + 2.0 * (m * k + k * n) * s / launch_iters)
+
+
+def _pallas_mu_bytes(m, n, k, cfg=None, family="pallas"):
+    """The mu block kernels (phased or fused per
+    ``experimental.fused_updates``) — see ``_pallas_block_bytes`` for
+    the locality story and the fused single-A-read amortization."""
+    return _pallas_block_bytes(m, n, k, cfg, "mu")
+
+
+def _pallas_hals_bytes(m, n, k, cfg=None, family="pallas"):
+    """The hals coordinate-sweep block kernel: A streams twice per
+    iteration (Gram accumulation + the W half's A·Hᵀ), the per-component
+    sweeps touch only the VMEM-resident work tiles (no HBM factor
+    traffic beyond the amortized launch round-trip), so the byte shape
+    matches the phased mu kernel's."""
+    return _pallas_block_bytes(m, n, k, cfg, "hals")
 
 
 def _tiled_bytes_common(m, n, k, cfg, factor_passes):
@@ -350,6 +376,11 @@ _FLOPS = {
     ("mu", "tiled"): _tiled_flops,
     ("hals", "vmap"): _hals_flops,
     ("hals", "packed"): _hals_flops,
+    # the packed kernel's permutation conjugations (Q·G·Qᵀ on (R·k)²
+    # Grams) are O(R²k²·Rk) per LAUNCH, not per iteration — subleading
+    # vs the per-iteration m×n Grams at modeled shapes, so the dense
+    # hals FLOPs stand
+    ("hals", "pallas"): _hals_flops,
     ("hals", "sketched"): _sketched_flops,
     ("hals", "tiled"): _tiled_flops,
     ("kl", "vmap"): _kl_flops,
@@ -370,6 +401,7 @@ _BYTES = {
     ("mu", "tiled"): _tiled_mu_bytes,
     ("hals", "vmap"): _hals_bytes,
     ("hals", "packed"): _hals_bytes,
+    ("hals", "pallas"): _pallas_hals_bytes,
     ("hals", "sketched"): _sketched_bytes,
     ("hals", "tiled"): _tiled_hals_bytes,
     ("kl", "vmap"): _kl_bytes,

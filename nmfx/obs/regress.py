@@ -184,6 +184,20 @@ METRICS = (
                0.50,
                "mid-matrix kill/resume overhead of the tiled durable "
                "ledger; bit-identity gated by the bench itself"),
+    # --- kernel schedule (ISSUE 20: fused kernels + autotune) -------
+    MetricSpec("mfu_solve_pallas_fused",
+               ("detail.kernel.fused_vs_phased.fused.mfu_solve",),
+               "higher", 0.15,
+               "solve-phase MFU of the fused join-the-updates mu "
+               "kernel at the north-star shape — the ≥0.18 steering "
+               "metric; its phased twin in the same record is "
+               "bit-compat gated by the bench itself"),
+    MetricSpec("autotune_warm_hit",
+               ("detail.kernel.autotune.warm_hit",), "higher", 0.01,
+               "1.0 iff the warm-process resolution came entirely "
+               "from the persisted store (hits>0, searches==0 by the "
+               "nmfx_autotune_* counter deltas) — a binary contract, "
+               "any drop regresses"),
 )
 
 

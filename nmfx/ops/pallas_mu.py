@@ -379,9 +379,163 @@ def _block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref, seg_col_ref,
                     wm_ref[:] = jnp.maximum(wm_ref[:], wm_t)
 
 
+def _fused_block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref,
+                        seg_col_ref, *rest, block_m: int, k: int,
+                        eps: float, zero_threshold: float, matmul_dtype,
+                        check_every: int = 0, check_block: int = 1):
+    """Join-the-updates variant of ``_block_kernel`` (PL-NMF blocking,
+    arxiv 1904.07935): ONE grid axis of T+1 passes (T = check_every ·
+    check_block iterations) replaces the (iteration, 2-phase) pair, and
+    each pass touches each A tile ONCE for both half-updates — the
+    W-half of iteration p−1 consumes the tile, then the H-half
+    accumulation for iteration p re-reads it while it is still
+    VMEM-resident. A's HBM traffic per launch drops from 2T reads to
+    T+1 (pass 0 is H-accumulate-only, pass T W-only).
+
+    Exactness: every dot_general fires in the same tile order with the
+    same f32 accumulators as the phased kernel — pass p's W-half is
+    phased iteration p−1's phase 1 (budget fence ``<= p−1``), its
+    H-half is iteration p's phase 0 (fence ``<= p``), and the masked
+    HHᵀ for the next W-half is refreshed into a third scratch
+    (``hgram``) at each pass's last tile, after the W-half of this pass
+    has consumed the previous one. Boundary stats/snapshots land on the
+    same iterations: W stats when ``p % check_every == 0`` (p > 0, row
+    p/check_every − 1), H stats + snapshot DMA when ``(p+1) %
+    check_every == 0`` (p < T). The cost of the fusion is that third
+    (rk, rk) scratch — ~0.9 MB at rk = 480 — accounted by the ``fused``
+    term in ``sched_mu._pallas_max_rk``.
+    """
+    p = pl.program_id(0)
+    t = pl.program_id(1)
+    last_pass = pl.num_programs(0) - 1  # == T
+    if check_block > 1:
+        (budget_ref, budgetr_ref, w_in_ref, h_in_ref,
+         w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref, hck_ref,
+         numer_acc, gram_acc, hgram) = rest
+    else:
+        (w_in_ref, h_in_ref,
+         w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref,
+         numer_acc, gram_acc, hgram) = rest
+
+    # same one-shot DMA data path as _block_kernel (NOT aliasing — see
+    # the round-3 note there)
+    @pl.when((p == 0) & (t == 0))
+    def _():
+        def init(sems):
+            dma_w = pltpu.make_async_copy(w_in_ref, w_ref, sems.at[0])
+            dma_h = pltpu.make_async_copy(h_in_ref, h_ref, sems.at[1])
+            dma_w.start()
+            dma_h.start()
+            dma_w.wait()
+            dma_h.wait()
+
+        pl.run_scoped(init, pltpu.SemaphoreType.DMA((2,)))
+
+    bd = seg_row_ref[:] == seg_col_ref[:]
+    frozen_c = frozen_ref[:] > 0.0  # (1, rk) — W-half column mask
+    frozen_r = frozenr_ref[:] > 0.0  # (rk, 1) — H-half row mask
+    if check_block > 1:
+        # pass p advances iteration p−1's W-half and iteration p's
+        # H-half, so the two fences sit one pass apart
+        p_f = p.astype(jnp.float32)
+        frozen_c = frozen_c | (budget_ref[:] <= p_f - 1.0)
+        frozen_r = frozen_r | (budgetr_ref[:] <= p_f)
+
+    at = _maybe_cast(a_ref[:], matmul_dtype)
+    rk = h_ref.shape[0]
+
+    # --- W-half of iteration p−1: consumes hgram (masked H_p·H_pᵀ from
+    # the previous pass) and the A tile the accumulation below re-reads
+    @pl.when(p > 0)
+    def _():
+        h = h_ref[:].astype(jnp.float32)
+        numer = jax.lax.dot_general(
+            at, _maybe_cast(h, matmul_dtype), _CONTRACT_COLS,
+            preferred_element_type=jnp.float32)
+        wt0 = w_ref[pl.dslice(t * block_m, block_m), :].astype(jnp.float32)
+        denom = jax.lax.dot_general(
+            _maybe_cast(wt0, matmul_dtype),
+            _maybe_cast(hgram[:], matmul_dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        wn = _epilogue(wt0, numer, denom, eps, zero_threshold, jnp.float32)
+        wn = jnp.where(frozen_c, wt0, wn)
+        w_ref[pl.dslice(t * block_m, block_m), :] = wn.astype(w_ref.dtype)
+
+        @pl.when(p % check_every == 0)
+        def _():
+            # iteration p−1 closes sub-block p/check_every − 1
+            bidx = p // check_every - 1
+            wd_t = jnp.max(jnp.abs(wn - wt0), axis=0, keepdims=True)
+            wm_t = jnp.max(jnp.abs(wt0), axis=0, keepdims=True)
+            row = pl.dslice(bidx, 1)
+
+            @pl.when(t == 0)
+            def _():
+                wd_ref[row, :] = wd_t
+                wm_ref[row, :] = wm_t
+
+            @pl.when(t > 0)
+            def _():
+                wd_ref[row, :] = jnp.maximum(wd_ref[row, :], wd_t)
+                wm_ref[row, :] = jnp.maximum(wm_ref[row, :], wm_t)
+
+    # --- H-half accumulation for iteration p (skipped on the final,
+    # W-only pass): the A tile is already VMEM-resident
+    @pl.when(p < last_pass)
+    def _():
+        @pl.when(t == 0)
+        def _():
+            numer_acc[:] = jnp.zeros_like(numer_acc)
+            gram_acc[:] = jnp.zeros_like(gram_acc)
+
+        wt = _maybe_cast(w_ref[pl.dslice(t * block_m, block_m), :],
+                         matmul_dtype)
+        numer_acc[:] += jax.lax.dot_general(
+            wt, at, _CONTRACT_ROWS, preferred_element_type=jnp.float32)
+        gram_acc[:] += jax.lax.dot_general(
+            wt, wt, _CONTRACT_ROWS, preferred_element_type=jnp.float32)
+
+        @pl.when(t == pl.num_programs(1) - 1)
+        def _():
+            gram = jnp.where(bd, gram_acc[:], 0.0)
+            h0 = h_ref[:].astype(jnp.float32)
+            denom = jax.lax.dot_general(
+                _maybe_cast(gram, matmul_dtype),
+                _maybe_cast(h0, matmul_dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            hn = _epilogue(h0, numer_acc[:], denom, eps, zero_threshold,
+                           jnp.float32)
+            hn = jnp.where(frozen_r, h0, hn)
+            h_ref[:] = hn.astype(h_ref.dtype)
+
+            @pl.when((p + 1) % check_every == 0)
+            def _():
+                bidx = (p + 1) // check_every - 1
+                sl = pl.dslice(bidx * rk, rk)
+                hd_ref[sl, :] = jnp.max(jnp.abs(hn - h0), axis=1,
+                                        keepdims=True)
+                hm_ref[sl, :] = jnp.max(jnp.abs(h0), axis=1, keepdims=True)
+                if check_block > 1:
+                    def snap(sem):
+                        dma = pltpu.make_async_copy(
+                            h_ref, hck_ref.at[bidx], sem.at[0])
+                        dma.start()
+                        dma.wait()
+
+                    pl.run_scoped(snap, pltpu.SemaphoreType.DMA((1,)))
+
+            # masked HHᵀ for the NEXT pass's W-half — safe to overwrite
+            # here: this pass's W-half already consumed the previous one
+            hc = _maybe_cast(hn, matmul_dtype)
+            hgram[:] = jnp.where(bd, jax.lax.dot_general(
+                hc, hc, _CONTRACT_COLS,
+                preferred_element_type=jnp.float32), 0.0)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "k", "iters", "block_m", "eps", "zero_threshold", "matmul_precision",
-    "interpret", "alias_io", "check_block"))
+    "interpret", "alias_io", "check_block", "fused"))
 def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
                            frozen_cols: jax.Array, *, k: int,
                            iters: int = 2, block_m: int = 512,
@@ -391,7 +545,8 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
                            seg_ids: "jax.Array | None" = None,
                            alias_io: bool = False,
                            check_block: int = 1,
-                           budget_cols: "jax.Array | None" = None):
+                           budget_cols: "jax.Array | None" = None,
+                           fused: bool = False):
     """``iters`` full MU iterations (both half-updates) in ONE pallas_call
     with the packed factors VMEM-resident throughout — the whole-solve
     launch count drops from ~4 kernels per iteration-pair to 1.
@@ -447,14 +602,26 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
     measured ~8% slower than the carry copies on v5e, so it stays
     opt-in).
 
+    ``fused=True`` (round 7 — PL-NMF join-the-updates blocking) swaps in
+    ``_fused_block_kernel``: grid (T+1, nt) with T = iters·check_block,
+    both half-updates sharing each streamed A tile, cutting A's HBM
+    reads per launch from 2T to T+1 at the price of one extra (rk, rk)
+    f32 scratch. Operand list, output signature, boundary cadence,
+    budget fences and frozen-lane semantics are IDENTICAL to the phased
+    kernel — and so are the dot_generals, in the same tile order with
+    the same f32 accumulators, so the two modes are bit-exact against
+    each other (pinned by tests/test_fused_kernel.py in interpret mode;
+    the hardware gate is the bench fused-vs-phased rung).
+
     VMEM budget (measured on v5e, round 4 —
     ``benchmarks/probe_vmem_envelope*.py``): W full-resident dominates;
     the empirical fit accepted by the scheduler
     (``sched_mu._pallas_slot_clamp``, the single source of truth for the
     formula) is ``4·rk·(m_pad + 3·n_pad + rk) + 2·block_m·n_pad·a_bytes
     ≤ 14.3 MiB`` with n_pad = n rounded up to 128 lanes (e.g. rk ≤ 480
-    at m=5120, n=512, bf16 A; rk ≤ ~368 at n=1024). Beyond it Mosaic
-    rejects at compile time — use the per-iteration kernels there.
+    at m=5120, n=512, bf16 A; rk ≤ ~368 at n=1024); ``fused`` adds a
+    ``4·rk²`` term for the hgram scratch. Beyond it Mosaic rejects at
+    compile time — use the per-iteration kernels there.
     """
     m, n = a.shape
     rk = wp.shape[1]
@@ -464,8 +631,9 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
         raise ValueError("check_block > 1 needs budget_cols (each lane's "
                          "remaining iteration allowance at launch entry)")
     nt = m // block_m
+    kern_fn = _fused_block_kernel if fused else _block_kernel
     kernel = functools.partial(
-        _block_kernel, block_m=block_m, k=k, eps=eps,
+        kern_fn, block_m=block_m, k=k, eps=eps,
         zero_threshold=zero_threshold,
         matmul_dtype=_matmul_dtype(matmul_precision),
         check_every=iters, check_block=check_block)
@@ -475,9 +643,17 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
         seg_ids = jnp.arange(rk, dtype=jnp.int32) // k
     seg_ids = seg_ids.astype(jnp.int32)
 
+    if fused:
+        grid = (iters * check_block + 1, nt)
+        a_map = lambda p, t: (t, 0)  # noqa: E731
+        zero_map = lambda p, t: (0, 0)  # noqa: E731
+    else:
+        grid = (iters * check_block, 2, nt)
+        a_map = lambda i, p, t: (t, 0)  # noqa: E731
+        zero_map = lambda i, p, t: (0, 0)  # noqa: E731
+
     def const(shape):
-        return pl.BlockSpec(shape, lambda i, p, t: (0, 0),
-                            memory_space=pltpu.VMEM)
+        return pl.BlockSpec(shape, zero_map, memory_space=pltpu.VMEM)
 
     # w0/h0 stay in HBM (ANY); the kernel DMAs them into the resident
     # output windows exactly once — same total traffic as the round-3
@@ -494,8 +670,7 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
     # reload-exercising boundary stage) must pass with this on — see
     # benchmarks/probe_alias_io.py for the bit-exactness bisect.
     in_specs = [
-        pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
-                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_m, n), a_map, memory_space=pltpu.VMEM),
         const((1, rk)), const((rk, 1)),
         const((rk, 1)), const((1, rk)),
     ]
@@ -530,17 +705,23 @@ def fused_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
         out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         out_shape.append(
             jax.ShapeDtypeStruct((nck, rk, n), hp.dtype))
+    scratch_shapes = [
+        pltpu.VMEM((rk, n), jnp.float32),
+        pltpu.VMEM((rk, rk), jnp.float32),
+    ]
+    if fused:
+        # hgram: the masked HHᵀ carried from each pass's H-half to the
+        # next pass's W-half (the phased kernel reuses gram_acc, but the
+        # fused pass needs both alive at once)
+        scratch_shapes.append(pltpu.VMEM((rk, rk), jnp.float32))
     return pl.pallas_call(
         kernel,
-        grid=(iters * check_block, 2, nt),
+        grid=grid,
         input_output_aliases=alias,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((rk, n), jnp.float32),
-            pltpu.VMEM((rk, rk), jnp.float32),
-        ],
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(*operands)
 
@@ -578,3 +759,321 @@ def fused_w_update(a: jax.Array, wp: jax.Array, hp: jax.Array,
         out_shape=jax.ShapeDtypeStruct((m, rk), wp.dtype),
         interpret=interpret,
     )(a, wp, hp, gh_masked)
+
+
+def _perm_matrix(rk: int, k: int, slots: int):
+    """(rk, rk) f32 permutation grouping component jj of every slot into
+    contiguous rows: row r = jj·slots + s selects packed column
+    s·k + jj. Built from 2-D iotas in-kernel (Mosaic needs ≥2-D iota),
+    applied as GEMMs so the HALS coordinate sweep below runs on
+    contiguous (slots, ·) slices — MXU-dense instead of a strided
+    gather, which Mosaic does not support on TPU."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 1)
+    return ((r % slots) * k + r // slots == c).astype(jnp.float32)
+
+
+def _clamp(x, zero_threshold):
+    """base.clamp inlined for the kernel (nmf_als.c:247-250)."""
+    return jnp.where(x <= zero_threshold, jnp.zeros_like(x), x)
+
+
+def _hals_block_kernel(a_ref, frozen_ref, frozenr_ref, seg_row_ref,
+                       seg_col_ref, *rest, block_m: int, k: int,
+                       slots: int, eps: float, zero_threshold: float,
+                       matmul_dtype, check_every: int = 0,
+                       check_block: int = 1):
+    """HALS sibling of ``_block_kernel`` — same grid (iters, 2 phases,
+    nt m-tiles), same VMEM-resident factor windows / step-0 DMA /
+    budget fences / boundary stat+snapshot cadence, but the epilogues
+    are the Cichocki–Phan coordinate sweeps of ``grid_mu.hals_block``
+    instead of the mu ratio. The packed layout interleaves the pool's
+    lanes (column s·k + jj is slot s, component jj), so the per-jj
+    sweep is re-expressed through a permutation GEMM (``_perm_matrix``):
+    conjugating the masked Gram with Q makes each component's rows/cols
+    of ALL slots contiguous, each of the k sweep steps updates one
+    (slots, ·) slice in scratch, and a final GEMM un-permutes. The
+    block-diagonal mask zeroes every cross-slot Gram entry, so the
+    sweep is exactly ``slots`` independent dense HALS sweeps run in
+    lockstep — frozen-lane passthrough after the sweep is exact, and
+    zero-padded components (k_j < k jobs) stay invariant (zero
+    numerator, eps-guarded diagonal). Overhead vs mu: ~4 extra
+    rk²-sized GEMM-equivalents per tile (the permutation conjugations
+    and the k accumulated (·, slots) slice products) — subleading to
+    the 2·block_m·n·rk streaming terms at north-star shapes, and
+    priced honestly by the (hals, pallas) costmodel row."""
+    it = pl.program_id(0)
+    ph = pl.program_id(1)
+    t = pl.program_id(2)
+    if check_block > 1:
+        (budget_ref, budgetr_ref, w_in_ref, h_in_ref,
+         w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref, hck_ref,
+         numer_acc, gram_acc, diag_ref, hwork, wwork) = rest
+        is_boundary = (it + 1) % check_every == 0
+        bidx = (it + 1) // check_every - 1
+    else:
+        (w_in_ref, h_in_ref,
+         w_ref, h_ref, wd_ref, wm_ref, hd_ref, hm_ref,
+         numer_acc, gram_acc, diag_ref, hwork, wwork) = rest
+
+    @pl.when((it == 0) & (ph == 0) & (t == 0))
+    def _():
+        def init(sems):
+            dma_w = pltpu.make_async_copy(w_in_ref, w_ref, sems.at[0])
+            dma_h = pltpu.make_async_copy(h_in_ref, h_ref, sems.at[1])
+            dma_w.start()
+            dma_h.start()
+            dma_w.wait()
+            dma_h.wait()
+
+        pl.run_scoped(init, pltpu.SemaphoreType.DMA((2,)))
+    last_it = it == pl.num_programs(0) - 1
+    bd = seg_row_ref[:] == seg_col_ref[:]
+    frozen_c = frozen_ref[:] > 0.0
+    frozen_r = frozenr_ref[:] > 0.0
+    if check_block > 1:
+        it_f = it.astype(jnp.float32)
+        frozen_c = frozen_c | (budget_ref[:] <= it_f)
+        frozen_r = frozen_r | (budgetr_ref[:] <= it_f)
+    rk = h_ref.shape[0]
+    eye = (jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 0)
+           == jax.lax.broadcasted_iota(jnp.int32, (rk, rk), 1))
+
+    @pl.when((ph == 0) & (t == 0))
+    def _():
+        numer_acc[:] = jnp.zeros_like(numer_acc)
+        gram_acc[:] = jnp.zeros_like(gram_acc)
+
+    @pl.when(ph == 0)
+    def _():
+        wt = _maybe_cast(w_ref[pl.dslice(t * block_m, block_m), :],
+                         matmul_dtype)
+        at = _maybe_cast(a_ref[:], matmul_dtype)
+        numer_acc[:] += jax.lax.dot_general(
+            wt, at, _CONTRACT_ROWS, preferred_element_type=jnp.float32)
+        gram_acc[:] += jax.lax.dot_general(
+            wt, wt, _CONTRACT_ROWS, preferred_element_type=jnp.float32)
+
+        @pl.when(t == pl.num_programs(2) - 1)
+        def _():
+            q = _perm_matrix(rk, k, slots)
+            g = jnp.where(bd, gram_acc[:], 0.0)
+            # conjugate: g_p[jj·S+s, ll·S+s'] = wtw[s][jj, ll]·[s==s']
+            g_p = jax.lax.dot_general(
+                jnp.dot(q, g, preferred_element_type=jnp.float32), q,
+                _CONTRACT_COLS, preferred_element_type=jnp.float32)
+            diag_g = jnp.sum(jnp.where(eye, g_p, 0.0), axis=1,
+                             keepdims=True)  # (rk, 1)
+            h0 = h_ref[:].astype(jnp.float32)
+            hwork[:] = jnp.dot(q, h0, preferred_element_type=jnp.float32)
+            wta_p = jnp.dot(q, numer_acc[:],
+                            preferred_element_type=jnp.float32)
+            for jj in range(k):
+                lo = jj * slots
+                sl = pl.dslice(lo, slots)
+                # current hwork (prior components already updated) —
+                # the dense sweep's Gauss–Seidel order, hals_block:157-160
+                num = wta_p[lo:lo + slots, :] - jnp.dot(
+                    g_p[lo:lo + slots, :], hwork[:],
+                    preferred_element_type=jnp.float32)
+                hj = hwork[sl, :] + num / (diag_g[lo:lo + slots, :] + eps)
+                hwork[sl, :] = _clamp(hj, zero_threshold)
+            hn = jax.lax.dot_general(
+                q, hwork[:], _CONTRACT_ROWS,
+                preferred_element_type=jnp.float32)  # un-permute: Qᵀ·
+            hn = jnp.where(frozen_r, h0, hn)
+            h_ref[:] = hn.astype(h_ref.dtype)
+
+            if check_block > 1:
+                @pl.when(is_boundary)
+                def _():
+                    sl = pl.dslice(bidx * rk, rk)
+                    hd_ref[sl, :] = jnp.max(jnp.abs(hn - h0), axis=1,
+                                            keepdims=True)
+                    hm_ref[sl, :] = jnp.max(jnp.abs(h0), axis=1,
+                                            keepdims=True)
+
+                    def snap(sem):
+                        dma = pltpu.make_async_copy(
+                            h_ref, hck_ref.at[bidx], sem.at[0])
+                        dma.start()
+                        dma.wait()
+
+                    pl.run_scoped(snap, pltpu.SemaphoreType.DMA((1,)))
+            else:
+                @pl.when(last_it)
+                def _():
+                    hd_ref[:] = jnp.max(jnp.abs(hn - h0), axis=1,
+                                        keepdims=True)
+                    hm_ref[:] = jnp.max(jnp.abs(h0), axis=1, keepdims=True)
+            # pre-permute the masked HHᵀ + its diagonal for phase 1
+            hc = _maybe_cast(hn, matmul_dtype)
+            hht = jnp.where(bd, jax.lax.dot_general(
+                hc, hc, _CONTRACT_COLS,
+                preferred_element_type=jnp.float32), 0.0)
+            gh_p = jax.lax.dot_general(
+                jnp.dot(q, hht, preferred_element_type=jnp.float32), q,
+                _CONTRACT_COLS, preferred_element_type=jnp.float32)
+            gram_acc[:] = gh_p
+            diag_ref[:] = jnp.sum(jnp.where(eye, gh_p, 0.0), axis=0,
+                                  keepdims=True)  # (1, rk)
+
+    @pl.when(ph == 1)
+    def _():
+        q = _perm_matrix(rk, k, slots)
+        at = _maybe_cast(a_ref[:], matmul_dtype)
+        h = _maybe_cast(h_ref[:], matmul_dtype)
+        aht = jax.lax.dot_general(
+            at, h, _CONTRACT_COLS, preferred_element_type=jnp.float32)
+        wt0 = w_ref[pl.dslice(t * block_m, block_m), :].astype(jnp.float32)
+        # permute columns: x_p = x·Qᵀ
+        wwork[:] = jax.lax.dot_general(
+            wt0, q, _CONTRACT_COLS, preferred_element_type=jnp.float32)
+        aht_p = jax.lax.dot_general(
+            aht, q, _CONTRACT_COLS, preferred_element_type=jnp.float32)
+        g = gram_acc[:]  # permuted masked HHᵀ from phase 0
+        diag = diag_ref[:]  # (1, rk), permuted
+        for jj in range(k):
+            lo = jj * slots
+            csl = pl.dslice(lo, slots)
+            num = aht_p[:, lo:lo + slots] - jnp.dot(
+                wwork[:], g[:, lo:lo + slots],
+                preferred_element_type=jnp.float32)
+            wj = wwork[:, csl] + num / (diag[:, lo:lo + slots] + eps)
+            wwork[:, csl] = _clamp(wj, zero_threshold)
+        wn = jnp.dot(wwork[:], q, preferred_element_type=jnp.float32)
+        wn = jnp.where(frozen_c, wt0, wn)
+        w_ref[pl.dslice(t * block_m, block_m), :] = wn.astype(w_ref.dtype)
+
+        if check_block > 1:
+            @pl.when(is_boundary)
+            def _():
+                wd_t = jnp.max(jnp.abs(wn - wt0), axis=0, keepdims=True)
+                wm_t = jnp.max(jnp.abs(wt0), axis=0, keepdims=True)
+                row = pl.dslice(bidx, 1)
+
+                @pl.when(t == 0)
+                def _():
+                    wd_ref[row, :] = wd_t
+                    wm_ref[row, :] = wm_t
+
+                @pl.when(t > 0)
+                def _():
+                    wd_ref[row, :] = jnp.maximum(wd_ref[row, :], wd_t)
+                    wm_ref[row, :] = jnp.maximum(wm_ref[row, :], wm_t)
+        else:
+            @pl.when(last_it)
+            def _():
+                wd_t = jnp.max(jnp.abs(wn - wt0), axis=0, keepdims=True)
+                wm_t = jnp.max(jnp.abs(wt0), axis=0, keepdims=True)
+
+                @pl.when(t == 0)
+                def _():
+                    wd_ref[:] = wd_t
+                    wm_ref[:] = wm_t
+
+                @pl.when(t > 0)
+                def _():
+                    wd_ref[:] = jnp.maximum(wd_ref[:], wd_t)
+                    wm_ref[:] = jnp.maximum(wm_ref[:], wm_t)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "slots", "iters", "block_m", "eps", "zero_threshold",
+    "matmul_precision", "interpret", "alias_io", "check_block"))
+def hals_block_iterations(a: jax.Array, wp: jax.Array, hp: jax.Array,
+                          frozen_cols: jax.Array, *, k: int, slots: int,
+                          iters: int = 2, block_m: int = 512,
+                          eps: float = 1e-9, zero_threshold: float = 0.0,
+                          matmul_precision: str = "default",
+                          interpret: bool = False,
+                          alias_io: bool = False,
+                          check_block: int = 1,
+                          budget_cols: "jax.Array | None" = None):
+    """``iters`` full HALS iterations for the UNIFORM packed pool in one
+    ``pallas_call`` — the hals sibling of ``fused_block_iterations``,
+    with the identical operand list (minus seg overrides: hals is
+    uniform-pool only, seg = iota // k), identical outputs, identical
+    check_block/budget semantics, so the slot scheduler routes both
+    through the same ``make_do_block``/``make_do_multi`` plumbing. The
+    update math is ``grid_mu.hals_block`` re-expressed for the packed
+    layout via a permutation conjugation (see ``_hals_block_kernel``);
+    agreement with the vmapped dense engine is consensus-level (Mosaic
+    accumulation order differs), gated by
+    tests/test_fused_kernel.py::test_hals_pallas_agreement.
+
+    VMEM: on top of the mu block kernel's envelope this holds one extra
+    (rk, n) f32 sweep scratch, a (block_m, rk) f32 W work tile and the
+    (rk, rk) permutation temporaries — ``sched_mu._pallas_max_rk``
+    prices it via its ``algorithm="hals"`` term.
+    """
+    m, n = a.shape
+    rk = wp.shape[1]
+    if m % block_m:
+        raise ValueError(f"m={m} must be a multiple of block_m={block_m}")
+    if rk != k * slots:
+        raise ValueError(f"packed width {rk} != k*slots = {k}*{slots}")
+    if check_block > 1 and budget_cols is None:
+        raise ValueError("check_block > 1 needs budget_cols (each lane's "
+                         "remaining iteration allowance at launch entry)")
+    nt = m // block_m
+    kernel = functools.partial(
+        _hals_block_kernel, block_m=block_m, k=k, slots=slots, eps=eps,
+        zero_threshold=zero_threshold,
+        matmul_dtype=_matmul_dtype(matmul_precision),
+        check_every=iters, check_block=check_block)
+    frozen_rows = frozen_cols.reshape(rk, 1)
+    seg_ids = jnp.arange(rk, dtype=jnp.int32) // k
+
+    def const(shape):
+        return pl.BlockSpec(shape, lambda i, p, t: (0, 0),
+                            memory_space=pltpu.VMEM)
+
+    in_specs = [
+        pl.BlockSpec((block_m, n), lambda i, p, t: (t, 0),
+                     memory_space=pltpu.VMEM),
+        const((1, rk)), const((rk, 1)),
+        const((rk, 1)), const((1, rk)),
+    ]
+    operands = [a, frozen_cols, frozen_rows, seg_ids.reshape(rk, 1),
+                seg_ids.reshape(1, rk)]
+    if check_block > 1:
+        in_specs += [const((1, rk)), const((rk, 1))]
+        budget_cols = budget_cols.astype(jnp.float32).reshape(1, rk)
+        operands += [budget_cols, budget_cols.reshape(rk, 1)]
+    w_in_idx = len(operands)
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    operands += [wp, hp]
+    alias = {w_in_idx: 0, w_in_idx + 1: 1} if alias_io else {}
+    nck = check_block
+    out_specs = [const((m, rk)), const((rk, n)), const((nck, rk)),
+                 const((nck, rk)), const((nck * rk, 1)),
+                 const((nck * rk, 1))]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, rk), wp.dtype),
+        jax.ShapeDtypeStruct((rk, n), hp.dtype),
+        jax.ShapeDtypeStruct((nck, rk), jnp.float32),
+        jax.ShapeDtypeStruct((nck, rk), jnp.float32),
+        jax.ShapeDtypeStruct((nck * rk, 1), jnp.float32),
+        jax.ShapeDtypeStruct((nck * rk, 1), jnp.float32),
+    ]
+    if check_block > 1:
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_shape.append(jax.ShapeDtypeStruct((nck, rk, n), hp.dtype))
+    return pl.pallas_call(
+        kernel,
+        grid=(iters * check_block, 2, nt),
+        input_output_aliases=alias,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((rk, n), jnp.float32),
+            pltpu.VMEM((rk, rk), jnp.float32),
+            pltpu.VMEM((1, rk), jnp.float32),
+            pltpu.VMEM((rk, n), jnp.float32),
+            pltpu.VMEM((block_m, rk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)

@@ -180,10 +180,16 @@ def _streams_bf16_a(cfg: SolverConfig) -> bool:
             and jax.default_backend() == "tpu")
 
 
-def _pallas_block_geometry(m: int):
+def _pallas_block_geometry(m: int, block_m: "int | None" = None):
     """Tile geometry shared by the clamp and the solver: ~512-row tiles,
-    16-row-aligned so bf16 A streams on its native sublane tiling."""
+    16-row-aligned so bf16 A streams on its native sublane tiling.
+    ``block_m`` overrides the tile rows (``experimental.block_m`` — set
+    by hand or by the autotuner); the override must be 16-aligned
+    (validated by ExperimentalConfig) and m pads up to a multiple."""
     ceil_div = lambda x, d: -(-x // d)
+    if block_m is not None:
+        tiles = ceil_div(m, block_m)
+        return tiles, block_m, tiles * block_m
     tiles = ceil_div(m, 512)
     block_m = ceil_div(ceil_div(m, tiles), 16) * 16
     return tiles, block_m, tiles * block_m
@@ -191,7 +197,9 @@ def _pallas_block_geometry(m: int):
 
 def _pallas_max_rk(m: int, n: int, cfg: SolverConfig,
                    factor_dtype: "str | None" = None,
-                   check_block: int = 1) -> int:
+                   check_block: int = 1, fused: bool = False,
+                   algorithm: str = "mu",
+                   block_m: "int | None" = None) -> int:
     """Largest packed column count the resident-W block kernel's VMEM
     envelope admits at this shape (the inequality documented in
     ``_pallas_slot_clamp``; shared by the uniform clamp and the ragged
@@ -207,16 +215,32 @@ def _pallas_max_rk(m: int, n: int, cfg: SolverConfig,
     ``check_block > 1`` adds the per-boundary stat windows (the H
     snapshots live in HBM and cost no VMEM): ``16·check_block·rk + 8·rk``
     bytes — ~64 KB at the north star, inside the fitted model's measured
-    slack, but counted so the boundary stays honest."""
-    _, block_m, m_pad = _pallas_block_geometry(m)
+    slack, but counted so the boundary stays honest.
+
+    ``fused=True`` (round 7 join-the-updates kernel) adds the hgram
+    scratch: ``4·rk²`` — one extra (rk, rk) f32 window. ``algorithm=
+    "hals"`` adds the coordinate-sweep scratches of
+    ``_hals_block_kernel``: the (rk, n) f32 sweep buffer, the
+    (block_m, rk) f32 W work tile and ~3 transient (rk, rk) f32
+    permutation temporaries — ``4·rk·n_pad + 4·block_m·rk + 12·rk²``,
+    deliberately conservative (Mosaic still rejects loudly if the model
+    ever over-admits). ``block_m`` forwards the experimental tile-shape
+    override into the geometry so the envelope prices the tiles that
+    will actually run."""
+    _, block_m, m_pad = _pallas_block_geometry(m, block_m)
     n_pad = -(-n // 128) * 128
     a_bytes = 2 if _streams_bf16_a(cfg) else jnp.dtype(cfg.dtype).itemsize
     # per-boundary TolX stat outputs (wd/wm (N, rk) + hd/hm (N·rk, 1),
     # f32) plus the two (·, rk) budget-fence inputs
     def check_extra(rk):
-        if check_block <= 1:
-            return 0
-        return 16 * check_block * rk + 8 * rk
+        extra = 0
+        if check_block > 1:
+            extra += 16 * check_block * rk + 8 * rk
+        if fused:
+            extra += 4 * rk * rk
+        if algorithm == "hals":
+            extra += 4 * rk * n_pad + 4 * block_m * rk + 12 * rk * rk
+        return extra
 
     if factor_dtype in ("bfloat16", "bfloat16_w"):
         # bf16 W window; the n-proportional term keeps f32 numer/extra
@@ -241,7 +265,9 @@ def _pallas_max_rk(m: int, n: int, cfg: SolverConfig,
 def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
                        cfg: SolverConfig,
                        factor_dtype: "str | None" = None,
-                       check_block: int = 1) -> int:
+                       check_block: int = 1, fused: bool = False,
+                       algorithm: str = "mu",
+                       block_m: "int | None" = None) -> int:
     """Clamp the slot pool to the resident-W block kernel's VMEM envelope.
 
     Empirical v5e model (round 4, benchmarks/probe_vmem_envelope*.py —
@@ -270,8 +296,9 @@ def _pallas_slot_clamp(s: int, k_max: int, m: int, n: int,
     WARNING.
     """
     def fits(slots: int) -> bool:
-        return slots * k_max <= _pallas_max_rk(m, n, cfg, factor_dtype,
-                                               check_block)
+        return slots * k_max <= _pallas_max_rk(
+            m, n, cfg, factor_dtype, check_block, fused=fused,
+            algorithm=algorithm, block_m=block_m)
 
     if not fits(1):
         raise ValueError(
@@ -828,8 +855,10 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     factor_dtype = exp.factor_dtype
     alias_io = exp.alias_io
     use_pallas = cfg.backend == "pallas"
-    if use_pallas and cfg.algorithm != "mu":
-        raise ValueError("the pallas slot scheduler is mu-only")
+    if use_pallas and cfg.algorithm not in ("mu", "hals"):
+        raise ValueError(
+            "the pallas slot scheduler implements algorithm='mu' and "
+            "'hals'; use backend='packed'/'auto' for the others")
     dtype = jnp.dtype(cfg.dtype)
     a = jnp.asarray(a, dtype)
     w0 = jnp.asarray(w0, dtype)
@@ -860,9 +889,28 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
     # uniform pool's 1.32 s at the north star. Kept as an opt-in for
     # mixes where padding waste is extreme (k_max >> typical k).
     use_ragged = bool(exp.ragged)
+    if use_pallas and cfg.algorithm == "hals":
+        # hals has no per-iteration pallas fallback (the coordinate
+        # sweep only exists as the block kernel) and the ragged stage's
+        # kernel is mu-hardwired
+        if not ce_ok:
+            raise ValueError(
+                "backend='pallas' with algorithm='hals' requires "
+                "max_iter to be a multiple of check_every (the block-"
+                "kernel route; there is no per-iteration hals fallback)")
+        if use_ragged:
+            raise ValueError(
+                "experimental.ragged=True is mu-only (the ragged "
+                "class-blocked kernel); use the uniform pool for hals")
     # the block-kernel route: one fused launch per check block (and the
     # only route where check_block batches INSIDE the kernel)
     blk_route = use_pallas and ce_ok and not use_ragged
+    # hals uses the TolFun residual test: its interior multi-check
+    # boundaries would need a per-boundary residual the kernel cannot
+    # export (the snapshots carry H, not ‖A−WH‖), so the multi-check
+    # launch is only sound for hals when TolFun is off
+    hals_multi_ok = (cfg.algorithm != "hals"
+                     or not (USES_TOLFUN["hals"] and cfg.use_tol_checks))
     ncheck = cfg.check_block
     if ncheck == "auto":
         # resolved per engine: the round-5 trace decomposition puts the
@@ -870,8 +918,13 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
         # bookkeeping against a 136 µs kernel) on the pallas scheduler;
         # the dense engine's bookkeeping measured within noise there, so
         # its default cadence stays 1 (the knob remains available)
-        ncheck = 4 if blk_route else 1
+        ncheck = 4 if (blk_route and hals_multi_ok) else 1
     ncheck = int(ncheck)
+    if ncheck > 1 and blk_route and not hals_multi_ok:
+        raise ValueError(
+            "check_block > 1 on the pallas hals route needs "
+            "use_tol_checks=False: TolFun's residual cannot be replayed "
+            "from the kernel's boundary exports")
     if ncheck > 1 and use_ragged:
         raise ValueError(
             "check_block > 1 requires the uniform pool "
@@ -892,10 +945,32 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
             "experimental.alias_io=True is the uniform pallas "
             "block-kernel route only: backend='pallas', max_iter a "
             "multiple of check_every, non-ragged")
+    # join-the-updates kernel selection (round 7): "auto" resolves to
+    # the phased kernel — the default numerics stay byte-for-byte the
+    # round-6 build's; "fused" opts into the single-A-read variant
+    # (bit-exact vs phased, pinned by tests/test_fused_kernel.py) and
+    # is what the autotuner sets when it wins the timed search
+    use_fused = exp.fused_updates == "fused"
+    if use_fused and cfg.algorithm != "mu":
+        raise ValueError(
+            "experimental.fused_updates='fused' is the mu join-the-"
+            "updates kernel; the hals block kernel has its own schedule")
+    if use_fused and not blk_route:
+        raise ValueError(
+            "experimental.fused_updates='fused' is the uniform pallas "
+            "block-kernel route only: backend='pallas', max_iter a "
+            "multiple of check_every, non-ragged")
+    if exp.block_m is not None and not use_pallas:
+        raise ValueError(
+            "experimental.block_m is a pallas tile-shape override; it "
+            "has no meaning for backend="
+            f"{cfg.backend!r}")
     if use_pallas and not use_ragged:
         s = _pallas_slot_clamp(s, k_max, m, n, cfg,
                                factor_dtype=factor_dtype,
-                               check_block=ncheck)
+                               check_block=ncheck, fused=use_fused,
+                               algorithm=cfg.algorithm,
+                               block_m=exp.block_m)
     if cfg.algorithm == "kl":
         s = _kl_slot_clamp(s, m, n, dtype)
     ce = cfg.check_every
@@ -940,13 +1015,14 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
         if use_pallas:
             from nmfx.ops.packed_mu import block_diag_mask
             from nmfx.ops.pallas_mu import (fused_block_iterations,
-                                            fused_h_update, fused_w_update)
+                                            fused_h_update, fused_w_update,
+                                            hals_block_iterations)
 
             # m padded to the kernels' tile grid (zero rows are invariant
             # under the MU epilogue — same scheme as mu_packed, but
             # 16-row-aligned: A streams in bf16 under that precision, and
             # bf16's native sublane tiling is 16
-            _, block_m, m_pad = _pallas_block_geometry(m)
+            _, block_m, m_pad = _pallas_block_geometry(m, exp.block_m)
             if m_pad != m:
                 a_loop = jnp.pad(a_loop, ((0, m_pad - m), (0, 0)))
                 w0 = jnp.pad(w0, ((0, 0), (0, m_pad - m), (0, 0)))
@@ -955,6 +1031,20 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                            zero_threshold=cfg.zero_threshold,
                            matmul_precision=cfg.matmul_precision,
                            interpret=interp)
+
+            def block_launch(width, wp, hp, fcol, **kw):
+                """The one block-kernel dispatch point: the mu kernel
+                (phased or round-7 fused per ``use_fused``) or the hals
+                coordinate-sweep kernel — identical operand/output
+                signatures, so both check-block drivers below stay
+                algorithm-agnostic."""
+                if cfg.algorithm == "hals":
+                    return hals_block_iterations(
+                        a_loop, wp, hp, fcol, k=k_max, slots=width,
+                        iters=ce, alias_io=alias_io, **kern_kw, **kw)
+                return fused_block_iterations(
+                    a_loop, wp, hp, fcol, k=k_max, iters=ce,
+                    alias_io=alias_io, fused=use_fused, **kern_kw, **kw)
 
             # bf16-factor-storage experiments (experimental.factor_dtype):
             # "bfloat16" (round 5) stores BOTH pool factors bf16 — halves
@@ -1000,13 +1090,12 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                     # multiple of check_every, so a slot crosses the cap
                     # only at a block boundary.
                     def do_block(wp, hp, active, slot_iter, slot_job):
-                        del slot_job  # mu-only path: no per-job auxiliaries
+                        del slot_job  # no per-job auxiliaries on this path
                         frozen = ~active | (slot_iter >= cfg.max_iter)
                         fcol = jnp.repeat(frozen, k_max).astype(
                             jnp.float32)[None, :]
-                        wp, hp, wd, wm, hd, hm = fused_block_iterations(
-                            a_loop, wp, hp, fcol, k=k_max, iters=ce,
-                            alias_io=alias_io, **kern_kw)
+                        wp, hp, wd, wm, hd, hm = block_launch(
+                            width, wp, hp, fcol)
 
                         def lane_max(x):  # (1, rk)/(rk, 1) → per-slot max
                             return jnp.max(x.reshape(-1, k_max), axis=1)
@@ -1056,17 +1145,16 @@ def mu_sched(a: jax.Array, w0: jax.Array, h0: jax.Array,
                 rk = width * k_max
 
                 def do_multi(wp, hp, active, slot_iter, slot_job):
-                    del slot_job  # mu-only path: no per-job auxiliaries
+                    del slot_job  # no per-job auxiliaries on this path
                     frozen = ~active | (slot_iter >= cfg.max_iter)
                     fcol = jnp.repeat(frozen, k_max).astype(
                         jnp.float32)[None, :]
                     budget = jnp.repeat(
                         jnp.maximum(cfg.max_iter - slot_iter, 0),
                         k_max).astype(jnp.float32)[None, :]
-                    wp, hp, wd, wm, hd, hm, hck = fused_block_iterations(
-                        a_loop, wp, hp, fcol, k=k_max, iters=ce,
-                        alias_io=alias_io, check_block=ncheck,
-                        budget_cols=budget, **kern_kw)
+                    wp, hp, wd, wm, hd, hm, hck = block_launch(
+                        width, wp, hp, fcol, check_block=ncheck,
+                        budget_cols=budget)
 
                     def lane_max(x):  # (rk,) → per-slot max
                         return jnp.max(x.reshape(-1, k_max), axis=1)

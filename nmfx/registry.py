@@ -47,7 +47,14 @@ _META_NAME = "registry.json"
 #: "sketched" family; every one of them changes the numbers a sweep
 #: records (a screened registry masks lanes an unscreened one solves),
 #: so the v3 rule applies
-_FORMAT_VERSION = 8
+#: v9: ISSUE 20 — ExperimentalConfig gained the kernel-schedule knobs
+#: (autotune, block_m, fused_updates) and backend='pallas' now routes
+#: algorithm='hals' through the slot scheduler. fused/phased mu is
+#: bit-exact either way, but block_m changes Mosaic tile-order
+#: accumulation and hals-pallas is a different engine family than the
+#: XLA hals it replaces under that backend — the v3 rule (any hashed
+#: field-map change invalidates) applies regardless
+_FORMAT_VERSION = 9
 
 #: AUTHORITATIVE list of SolverConfig fields excluded from the
 #: fingerprint payload. Every entry must be declared execution-strategy

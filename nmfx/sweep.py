@@ -231,7 +231,10 @@ def grid_axes_active(mesh: Mesh | None) -> bool:
 #: opt-in whose win is compile time (one jit vs one per rank), not
 #: iteration throughput (they converge in ~14–21 iterations).
 _GRID_EXEC_BACKENDS = {"mu": ("auto", "packed", "pallas"),
-                       "hals": ("auto", "packed"),
+                       # hals pallas (ISSUE 20): the coordinate-sweep
+                       # block kernel rides the same slot scheduler as
+                       # mu — packed hals serving included
+                       "hals": ("auto", "packed", "pallas"),
                        "neals": ("packed",),
                        # als (round 5): one whole-grid compile for the
                        # multi-rank sweep — its lstsq half-steps batch
@@ -1966,6 +1969,31 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
                                 mesh=mesh, registry=registry,
                                 profiler=profiler, on_rank=on_rank,
                                 checkpoint=checkpoint)
+    # Block-shape autotune resolves HERE — before the checkpoint /
+    # exec-cache / registry branches — so every downstream key
+    # (fingerprint, bucket key, ledger manifest, jit static args) sees
+    # the RESOLVED kernel schedule; a warm process resolves to the
+    # identical config (nmfx/autotune.py's key discipline), so
+    # artifacts written by a cold run are served to warm ones.
+    if solver_cfg.experimental.autotune == "on":
+        import os as _os
+
+        from nmfx import autotune as _autotune
+
+        m_a, n_a = a.shape
+        k_hi = int(max(cfg.ks))
+        at_slots = 1
+        if solver_cfg.backend == "pallas":
+            from nmfx.ops.sched_mu import _pallas_slot_clamp
+
+            at_slots = _pallas_slot_clamp(
+                cfg.grid_slots, k_hi, m_a, n_a, solver_cfg,
+                solver_cfg.experimental.factor_dtype)
+        at_dir = None
+        if exec_cache is not None and exec_cache.cfg.cache_dir:
+            at_dir = _os.path.join(exec_cache.cfg.cache_dir, "autotune")
+        solver_cfg = _autotune.resolve(solver_cfg, m_a, n_a, k_hi,
+                                       at_slots, cache_dir=at_dir)
     if checkpoint is not None:
         if registry is not None:
             raise ValueError(
@@ -2037,7 +2065,8 @@ def sweep(a, cfg: ConsensusConfig = ConsensusConfig(),
         raise ValueError(
             "grid_exec='grid' needs an algorithm/backend pair that routes "
             "into the slot scheduler — mu (backend "
-            "'auto'/'packed'/'pallas'), hals ('auto'/'packed'), or "
+            "'auto'/'packed'/'pallas'), hals ('auto'/'packed'/'pallas'), "
+            "or "
             "neals/snmf/kl (explicit 'packed') — and no feature/sample "
             "mesh "
             f"axes; got algorithm={solver_cfg.algorithm!r}, "

@@ -61,6 +61,51 @@ def test_pallas_bytes_below_packed():
             == cm.iteration_flops("mu", "packed", 5000, 500, 10, cfg))
 
 
+def test_hals_pallas_bytes_below_packed():
+    """The hals block kernel rides the same slot scheduler and VMEM
+    residency as the mu kernel, so its modeled per-iteration traffic
+    must sit below the XLA packed family at the same shape while the
+    FLOPs stay identical (the permutation conjugation is O(per-launch),
+    subleading — not modeled per iteration)."""
+    cfg = SolverConfig(algorithm="hals", backend="pallas")
+    assert (cm.iteration_bytes("hals", "pallas", 5000, 500, 10, cfg)
+            < cm.iteration_bytes("hals", "packed", 5000, 500, 10, cfg))
+    assert (cm.iteration_flops("hals", "pallas", 5000, 500, 10, cfg)
+            == cm.iteration_flops("hals", "packed", 5000, 500, 10, cfg))
+
+
+def test_fused_mu_bytes_encode_single_a_read():
+    """The round-7 claim the costmodel must price honestly: the fused
+    join-the-updates kernel reads each A tile ONCE per iteration
+    ((T+1)/T passes per launch) where the phased kernel reads it twice
+    — so fused bytes are strictly below phased at the same config, by
+    less than the full A term (the +1 prologue pass), with FLOPs
+    unchanged (the arithmetic is identical, only the locality moves)."""
+    from nmfx.config import ExperimentalConfig
+
+    def cfg(mode):
+        return SolverConfig(
+            algorithm="mu", backend="pallas",
+            experimental=ExperimentalConfig(fused_updates=mode))
+
+    m, n, k = 5000, 500, 10
+    phased = cm.iteration_bytes("mu", "pallas", m, n, k, cfg("phased"))
+    fused = cm.iteration_bytes("mu", "pallas", m, n, k, cfg("fused"))
+    assert fused < phased
+    # the delta is A-traffic only: strictly less than one full A pass
+    # per iteration, and more than nothing
+    a_pass = m * n * 4
+    assert phased - fused < a_pass
+    assert phased - fused > a_pass / 2  # (2 - (T+1)/T) ≈ 1 for real T
+    assert (cm.iteration_flops("mu", "pallas", m, n, k, cfg("fused"))
+            == cm.iteration_flops("mu", "pallas", m, n, k,
+                                  cfg("phased")))
+    # 'auto' prices as phased — the default numerics ARE phased
+    auto = cm.iteration_bytes("mu", "pallas", m, n, k, SolverConfig(
+        algorithm="mu", backend="pallas"))
+    assert auto == phased
+
+
 def test_dispatch_cost_resolves_family_and_sums():
     scfg = SolverConfig(algorithm="mu", max_iter=50)
     cost = cm.dispatch_cost(scfg, M, N, {2: [10, 20], 3: [5]})

@@ -1,0 +1,297 @@
+"""Round-7 fused (join-the-updates) pallas kernel + hals block kernel.
+
+Two contracts pinned here, both in interpret mode on CPU (the hardware
+twin is bench.py's fused-vs-phased rung, which hard-fails on any
+parity break):
+
+1. FUSED ≡ PHASED, bit-exact. ``experimental.fused_updates='fused'``
+   swaps the phased W/H half-update grid for the PL-NMF blocking that
+   runs the W-half of iteration p−1 and the H-half of iteration p on
+   the same VMEM-resident A tile (A read once per iteration instead of
+   twice). The dot_generals are the same ops in the same tile order
+   with the same f32 accumulators, so the results must be
+   BYTE-identical — iterations, stop reasons, AND factors, at every
+   check_block. Anything weaker would let a "perf mode" fork numerics.
+
+2. The hals block kernel rides the same slot scheduler with the same
+   operand/export signature, so cadence semantics (stop decisions,
+   budget fence, auto-resolution) transfer; its numerics agree with
+   the vmapped dense hals engine at the consensus/label level (the
+   coordinate sweep re-associates accumulations across the packed
+   layout, so bit-equality is not the contract — the hardware gate's
+   restart-equivalent band is).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nmfx.config import (ConsensusConfig, ExperimentalConfig, InitConfig,
+                         SolverConfig)
+from nmfx.datasets import grouped_matrix
+from nmfx.init import initialize
+from nmfx.ops.sched_mu import mu_sched
+from nmfx.sweep import sweep
+
+KS = (4, 3, 2)
+R = 5
+
+
+@pytest.fixture(scope="module")
+def jobs():
+    a = jnp.asarray(grouped_matrix(200, (10, 10, 10), effect=2.0, seed=0),
+                    jnp.float32)
+    k_max = max(KS)
+    root = jax.random.key(123)
+    w0l, h0l = [], []
+    for k in KS:
+        keys = jax.random.split(jax.random.fold_in(root, k), R)
+        w0s, h0s = jax.vmap(
+            lambda kk, k=k: initialize(kk, a, k, InitConfig(),
+                                       jnp.float32))(keys)
+        w0l.append(jnp.pad(w0s, ((0, 0), (0, 0), (0, k_max - k))))
+        h0l.append(jnp.pad(h0s, ((0, 0), (0, k_max - k), (0, 0))))
+    return a, jnp.concatenate(w0l), jnp.concatenate(h0l)
+
+
+def _cfg(mode, check_block=1, max_iter=600, **kw):
+    return SolverConfig(
+        max_iter=max_iter, backend="pallas", check_block=check_block,
+        experimental=ExperimentalConfig(fused_updates=mode), **kw)
+
+
+def _assert_bit_equal(ref, got):
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    np.testing.assert_array_equal(np.asarray(ref.w), np.asarray(got.w))
+    np.testing.assert_array_equal(np.asarray(ref.h), np.asarray(got.h))
+
+
+# --------------------------------------------------------------------------
+# contract 1: fused ≡ phased, bit-exact
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ncheck", [1, 4])
+def test_fused_phased_bit_exact(jobs, ncheck):
+    """The whole exactness contract in one assert set: at the same
+    check_block, fused and phased agree on EVERY recorded field —
+    iterations, stop reasons, factors — byte for byte."""
+    a, w0, h0 = jobs
+    phased = mu_sched(a, w0, h0, _cfg("phased", ncheck), slots=6)
+    fused = mu_sched(a, w0, h0, _cfg("fused", ncheck), slots=6)
+    _assert_bit_equal(phased, fused)
+
+
+def test_auto_resolves_to_phased(jobs):
+    """fused_updates='auto' (the default) stays on the phased kernel —
+    the round-6 numerics remain the default byte-for-byte; 'fused' is
+    an opt-in (the autotuner's, or an explicit override)."""
+    a, w0, h0 = jobs
+    auto = mu_sched(a, w0, h0, SolverConfig(max_iter=100,
+                                            backend="pallas"), slots=6)
+    phased = mu_sched(a, w0, h0, _cfg("phased", "auto", max_iter=100),
+                      slots=6)
+    _assert_bit_equal(auto, phased)
+
+
+def test_fused_multi_check_drift_bound_unchanged(jobs):
+    """check_block=4 fused vs check_block=1 phased: stop DECISIONS exact
+    (the boundary exports replay the same checks), factors within the
+    SAME post-stop drift class the phased multi-check launch is held to
+    (test_check_block.py) — fusing the halves must not widen it."""
+    a, w0, h0 = jobs
+    ref = mu_sched(a, w0, h0, _cfg("phased", 1), slots=6)
+    got = mu_sched(a, w0, h0, _cfg("fused", 4), slots=6)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    w_ref, w_got = np.asarray(ref.w), np.asarray(got.w)
+    denom = np.maximum(np.abs(w_ref), 1e-3)
+    assert np.max(np.abs(w_ref - w_got) / denom) < 0.25
+    l_ref = np.asarray(jnp.argmax(ref.h, axis=1))
+    l_got = np.asarray(jnp.argmax(got.h, axis=1))
+    assert (l_ref != l_got).mean(axis=1).max() <= 0.05
+
+
+def test_fused_max_iter_fence(jobs):
+    """The in-kernel budget fence is mode-independent: a cap crossing
+    mid-launch freezes every lane at exactly max_iter with factors
+    bit-identical to the phased N=1 schedule."""
+    from nmfx.solvers.base import StopReason
+
+    a, w0, h0 = jobs
+    ref = mu_sched(a, w0, h0, _cfg("phased", 1, max_iter=20), slots=4)
+    got = mu_sched(a, w0, h0, _cfg("fused", 4, max_iter=20), slots=4)
+    assert np.all(np.asarray(got.iterations) == 20)
+    assert np.all(np.asarray(got.stop_reason) == StopReason.MAX_ITER)
+    np.testing.assert_array_equal(np.asarray(ref.w), np.asarray(got.w))
+    np.testing.assert_array_equal(np.asarray(ref.h), np.asarray(got.h))
+
+
+def test_fused_kernel_direct_bit_exact():
+    """The kernel pair below the scheduler: fused_block_iterations with
+    fused=True vs False on identical packed operands — every output
+    (factors, TolX stats, boundary snapshots) byte-identical."""
+    from nmfx.ops.pallas_mu import fused_block_iterations
+
+    m, n, k, slots, bm = 192, 32, 3, 2, 64
+    rk = slots * k
+    key = jax.random.key(7)
+    ka, kw, kh = jax.random.split(key, 3)
+    a = jax.random.uniform(ka, (m, n), jnp.float32, 0.1, 1.0)
+    wp = jax.random.uniform(kw, (m, rk), jnp.float32, 0.1, 1.0)
+    hp = jax.random.uniform(kh, (rk, n), jnp.float32, 0.1, 1.0)
+    fcol = jnp.zeros((1, rk), jnp.float32)
+    common = dict(k=k, iters=2, block_m=bm, interpret=True)
+    for extra in (dict(),
+                  dict(check_block=4,
+                       budget_cols=jnp.full((1, rk), 1e9, jnp.float32))):
+        ref = fused_block_iterations(a, wp, hp, fcol, fused=False,
+                                     **common, **extra)
+        got = fused_block_iterations(a, wp, hp, fcol, fused=True,
+                                     **common, **extra)
+        assert len(ref) == len(got)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_block_m_override_same_decisions(jobs):
+    """experimental.block_m reshapes the row tiling only: stop
+    iterations/reasons are invariant (per-lane reductions don't cross
+    row blocks in a decision-changing way at these shapes) and labels
+    stay inside the class-stability band. Not bit-exactness — the W
+    gram accumulates across row blocks, so tile count reorders f32
+    adds; the contract is that TUNING the tile never changes what the
+    user is told converged."""
+    a, w0, h0 = jobs
+    ref = mu_sched(a, w0, h0, _cfg("fused", 4), slots=6)
+    cfg = SolverConfig(
+        max_iter=600, backend="pallas", check_block=4,
+        experimental=ExperimentalConfig(fused_updates="fused",
+                                        block_m=128))
+    got = mu_sched(a, w0, h0, cfg, slots=6)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+    l_ref = np.asarray(jnp.argmax(ref.h, axis=1))
+    l_got = np.asarray(jnp.argmax(got.h, axis=1))
+    assert (l_ref != l_got).mean(axis=1).max() <= 0.05
+
+
+def test_fused_guards(jobs):
+    """The mode is fenced, not silently ignored, off its route."""
+    a, w0, h0 = jobs
+    with pytest.raises(ValueError, match="fused_updates"):
+        mu_sched(a, w0, h0, SolverConfig(
+            algorithm="hals", max_iter=600, backend="pallas",
+            experimental=ExperimentalConfig(fused_updates="fused")),
+            slots=6)
+    with pytest.raises(ValueError, match="fused_updates"):
+        # max_iter not a multiple of check_every: off the block route
+        mu_sched(a, w0, h0, SolverConfig(
+            max_iter=601, backend="pallas",
+            experimental=ExperimentalConfig(fused_updates="fused")),
+            slots=6)
+    with pytest.raises(ValueError, match="block_m"):
+        mu_sched(a, w0, h0, SolverConfig(
+            max_iter=600, backend="auto",
+            experimental=ExperimentalConfig(block_m=256)), slots=6)
+    with pytest.raises(ValueError, match="fused_updates"):
+        ExperimentalConfig(fused_updates="always")
+    with pytest.raises(ValueError, match="block_m"):
+        ExperimentalConfig(block_m=100)
+
+
+# --------------------------------------------------------------------------
+# contract 2: the hals block kernel on the slot scheduler
+# --------------------------------------------------------------------------
+
+def test_hals_pallas_agreement(jobs):
+    """hals on the pallas slot scheduler vs the vmapped dense hals
+    engine, full sweep: consensus within the hardware gate's
+    restart-equivalent band (mean|dC|·R ≤ 0.6) and labels within the
+    class-stability band — the packed coordinate sweep re-associates
+    f32 accumulation, so agreement, not bit-equality, is the
+    contract."""
+    a, _, _ = jobs
+    ks, r = (2, 3), 4
+    out = {}
+    for backend in ("packed", "pallas"):
+        scfg = SolverConfig(algorithm="hals", max_iter=400,
+                            backend=backend)
+        out[backend] = sweep(a, ConsensusConfig(ks=ks, restarts=r,
+                                                grid_exec="grid"),
+                             scfg, InitConfig(), None)
+    for k in ks:
+        dc = np.abs(np.asarray(out["packed"][k].consensus)
+                    - np.asarray(out["pallas"][k].consensus))
+        assert dc.mean() * r <= 0.6, (k, dc.mean() * r)
+        l_ref = np.asarray(out["packed"][k].labels)
+        l_got = np.asarray(out["pallas"][k].labels)
+        assert (l_ref != l_got).mean(axis=1).max() <= 0.1, k
+
+
+def test_hals_check_block_needs_tolfun_off(jobs):
+    """hals's TolFun residual cannot be replayed from the kernel's
+    boundary exports: explicit check_block>1 on the pallas hals route
+    with TolFun armed is a hard error; with use_tol_checks=False the
+    multi-check launch is sound and its stop DECISIONS match the
+    check-per-trip schedule exactly."""
+    a, w0, h0 = jobs
+    with pytest.raises(ValueError, match="use_tol_checks"):
+        mu_sched(a, w0, h0, SolverConfig(
+            algorithm="hals", max_iter=200, backend="pallas",
+            check_block=4), slots=6)
+    base = SolverConfig(algorithm="hals", max_iter=200,
+                        backend="pallas", use_tol_checks=False)
+    ref = mu_sched(a, w0, h0, dataclasses.replace(base, check_block=1),
+                   slots=6)
+    got = mu_sched(a, w0, h0, dataclasses.replace(base, check_block=4),
+                   slots=6)
+    np.testing.assert_array_equal(np.asarray(ref.iterations),
+                                  np.asarray(got.iterations))
+    np.testing.assert_array_equal(np.asarray(ref.stop_reason),
+                                  np.asarray(got.stop_reason))
+
+
+def test_hals_auto_check_block_resolves_to_one(jobs):
+    """With TolFun armed (the default), 'auto' on the pallas hals route
+    resolves to check-per-trip — bit-identical to explicit 1 — instead
+    of erroring or silently disarming the residual test."""
+    a, w0, h0 = jobs
+    auto = mu_sched(a, w0, h0, SolverConfig(
+        algorithm="hals", max_iter=200, backend="pallas"), slots=6)
+    one = mu_sched(a, w0, h0, SolverConfig(
+        algorithm="hals", max_iter=200, backend="pallas",
+        check_block=1), slots=6)
+    _assert_bit_equal(auto, one)
+
+
+@pytest.mark.slow
+def test_fused_phased_bit_exact_heavy():
+    """The exactness contract at a shape big enough to cross several
+    row blocks and slot reloads (marked slow; CI runs the 200-row
+    slice above)."""
+    a = jnp.asarray(grouped_matrix(1024, (512, 512), effect=2.0, seed=1),
+                    jnp.float32)
+    ks, r = (6, 4), 8
+    out = {}
+    for mode in ("phased", "fused"):
+        scfg = _cfg(mode, 4, max_iter=400)
+        out[mode] = sweep(a, ConsensusConfig(ks=ks, restarts=r,
+                                             grid_exec="grid"),
+                          scfg, InitConfig(), None)
+    for k in ks:
+        np.testing.assert_array_equal(
+            np.asarray(out["phased"][k].iterations),
+            np.asarray(out["fused"][k].iterations))
+        np.testing.assert_array_equal(
+            np.asarray(out["phased"][k].consensus),
+            np.asarray(out["fused"][k].consensus))

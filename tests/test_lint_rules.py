@@ -203,6 +203,63 @@ def test_nmfx001_serve_key_check_skipped_when_not_provided():
         serve_fields=frozenset({"max_queue_depth"}))) == []
 
 
+def test_nmfx001_autotune_key_gap_fires():
+    """The round-7 acceptance mutation: a config field outside both the
+    autotune store key AND the declared tunable exemptions would let a
+    shape tuned under one value be served to the other."""
+    problems = check_config_coverage(**_universe(
+        autotune_solver_covered=frozenset({"algorithm", "experimental"}),
+        autotune_experimental_covered=frozenset({"ragged"}),
+        autotune_exempt_solver=("restart_chunk",)))
+    assert any("tol_x" in p and "autotune store key" in p
+               for p in problems)
+
+
+def test_nmfx001_autotune_experimental_gap_fires():
+    problems = check_config_coverage(**_universe(
+        autotune_solver_covered=frozenset({"algorithm", "tol_x",
+                                           "restart_chunk",
+                                           "experimental"}),
+        autotune_experimental_covered=frozenset()))
+    assert any("ExperimentalConfig.ragged" in p
+               and "autotune store key" in p for p in problems)
+
+
+def test_nmfx001_autotune_stale_exemption_fires():
+    """AUTOTUNE_EXEMPT_* naming a non-field is a stale declaration (a
+    renamed tunable would silently join the key and split it)."""
+    problems = check_config_coverage(**_universe(
+        autotune_solver_covered=frozenset({"algorithm", "tol_x",
+                                           "restart_chunk",
+                                           "experimental"}),
+        autotune_experimental_covered=frozenset({"ragged"}),
+        autotune_exempt_solver=("gone_knob",)))
+    assert any("gone_knob" in p and "stale" in p for p in problems)
+
+
+def test_nmfx001_autotune_contradictory_declaration_fires():
+    """A field both exempt (tunable) and in the key could never be
+    applied — the entry's verdict for it would always be masked by the
+    key split."""
+    problems = check_config_coverage(**_universe(
+        autotune_solver_covered=frozenset({"algorithm", "tol_x",
+                                           "restart_chunk",
+                                           "experimental"}),
+        autotune_experimental_covered=frozenset({"ragged"}),
+        autotune_exempt_solver=("tol_x",)))
+    assert any("tol_x" in p and "drop one declaration" in p
+               for p in problems)
+
+
+def test_nmfx001_autotune_clean_twin_quiet():
+    problems = check_config_coverage(**_universe(
+        autotune_solver_covered=frozenset({"algorithm", "tol_x",
+                                           "experimental"}),
+        autotune_experimental_covered=frozenset({"ragged"}),
+        autotune_exempt_solver=("restart_chunk",)))
+    assert problems == []
+
+
 def test_nmfx001_live_serve_config_covered():
     """The REAL ServeConfig: every field participates in comparison
     (serve_key_fields == the full field set), so the live tree stays
